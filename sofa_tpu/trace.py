@@ -1,0 +1,293 @@
+"""The unified trace schema and timeline-series model.
+
+Everything every collector produces — perf samples, HLO ops, ICI collectives,
+packets, disk I/O, syscalls, Python stacks, utilization samples — is coerced
+into ONE flat schema before analysis.  This mirrors the single most
+load-bearing design decision of the reference (13-column schema,
+/root/reference/bin/sofa_config.py:49-62), with TPU-era extension columns
+(device_kind, hlo_category, module, flops, bytes_accessed) that default to
+empty and never break base-schema consumers.
+
+Column semantics (base 13, reference-compatible):
+
+  timestamp  float  seconds since the run's time base (sofa_time.txt)
+  event      float  numeric y-value for the scatter timeline (source-specific:
+                    log10(IP) for CPU samples, op index for HLO ops, metric id
+                    for samplers)
+  duration   float  seconds
+  deviceId   int    host = -1; TPU core/chip ordinal otherwise; cpu core for
+                    per-core samplers
+  copyKind   int    data-movement taxonomy, see CopyKind
+  payload    int    bytes moved (copies/packets) or event-specific magnitude
+  bandwidth  float  bytes/second for transfers
+  pkt_src    int    packed IPv4 of the sender (packets only)
+  pkt_dst    int    packed IPv4 of the receiver (packets only)
+  pid        int
+  tid        int
+  name       str    human-readable event name (demangled symbol, HLO op, ...)
+  category   int    reserved series tag (reference kept it, we keep it)
+
+Extension columns (TPU build):
+
+  device_kind   str   "cpu" | "tpu" | "net" | "disk" | ...
+  hlo_category  str   XLA-reported op category ("convolution", "all-reduce"...)
+  module        str   enclosing XLA module (jit function) name
+  flops         float XLA-reported flop count for the op
+  bytes_accessed float XLA-reported memory traffic for the op
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+BASE_COLUMNS = [
+    "timestamp",
+    "event",
+    "duration",
+    "deviceId",
+    "copyKind",
+    "payload",
+    "bandwidth",
+    "pkt_src",
+    "pkt_dst",
+    "pid",
+    "tid",
+    "name",
+    "category",
+]
+
+EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops", "bytes_accessed"]
+
+COLUMNS = BASE_COLUMNS + EXTRA_COLUMNS
+
+_DEFAULTS = {
+    "timestamp": 0.0,
+    "event": 0.0,
+    "duration": 0.0,
+    "deviceId": -1,
+    "copyKind": -1,
+    "payload": 0,
+    "bandwidth": 0.0,
+    "pkt_src": -1,
+    "pkt_dst": -1,
+    "pid": -1,
+    "tid": -1,
+    "name": "",
+    "category": 0,
+    "device_kind": "",
+    "hlo_category": "",
+    "module": "",
+    "flops": 0.0,
+    "bytes_accessed": 0.0,
+}
+
+
+class CopyKind(IntEnum):
+    """Data-movement taxonomy.
+
+    Values 0/1/2/8/10 keep the reference's CUPTI-derived numbering
+    (/root/reference/bin/sofa_common.py:20) so cross-tool comparisons hold;
+    the >=20 range adds first-class XLA/ICI collective kinds, which the
+    reference could only approximate by NCCL kernel-name matching
+    (sofa_analyze.py:363-368).
+    """
+
+    NA = -1
+    KERNEL = 0          # pure compute (HLO op with no transfer semantics)
+    H2D = 1             # host->device (infeed / transfer-to-device)
+    D2H = 2             # device->host (outfeed / transfer-from-device)
+    D2D = 8             # on-chip copy
+    P2P = 10            # inter-chip point-to-point (ICI send/recv)
+    ALL_REDUCE = 20
+    ALL_GATHER = 21
+    REDUCE_SCATTER = 22
+    ALL_TO_ALL = 23
+    COLLECTIVE_PERMUTE = 24
+    COLLECTIVE_BROADCAST = 25
+
+
+CK_NAMES = {
+    int(CopyKind.NA): "NA",
+    int(CopyKind.KERNEL): "KERNEL",
+    int(CopyKind.H2D): "H2D",
+    int(CopyKind.D2H): "D2H",
+    int(CopyKind.D2D): "D2D",
+    int(CopyKind.P2P): "P2P",
+    int(CopyKind.ALL_REDUCE): "ALL_REDUCE",
+    int(CopyKind.ALL_GATHER): "ALL_GATHER",
+    int(CopyKind.REDUCE_SCATTER): "REDUCE_SCATTER",
+    int(CopyKind.ALL_TO_ALL): "ALL_TO_ALL",
+    int(CopyKind.COLLECTIVE_PERMUTE): "COLLECTIVE_PERMUTE",
+    int(CopyKind.COLLECTIVE_BROADCAST): "COLLECTIVE_BROADCAST",
+}
+
+# Map an HLO op/category name onto the taxonomy.
+_COLLECTIVE_KINDS = [
+    ("all-reduce", CopyKind.ALL_REDUCE),
+    ("all-gather", CopyKind.ALL_GATHER),
+    ("reduce-scatter", CopyKind.REDUCE_SCATTER),
+    ("all-to-all", CopyKind.ALL_TO_ALL),
+    ("collective-permute", CopyKind.COLLECTIVE_PERMUTE),
+    ("collective-broadcast", CopyKind.COLLECTIVE_BROADCAST),
+]
+
+
+def classify_hlo_kind(name: str, category: str = "") -> CopyKind:
+    """Classify an HLO op into the CopyKind taxonomy by name/category."""
+    text = f"{name} {category}".lower()
+    for key, kind in _COLLECTIVE_KINDS:
+        if key in text or key.replace("-", "_") in text:
+            return kind
+    if "infeed" in text or "transfer-to-device" in text or "host-to-device" in text:
+        return CopyKind.H2D
+    if "outfeed" in text or "transfer-from-device" in text or "device-to-host" in text:
+        return CopyKind.D2H
+    if "send" in text.split() or text.startswith("send") or "recv" in text.split() or text.startswith("recv"):
+        return CopyKind.P2P
+    if text.startswith("copy") or " copy " in text:
+        return CopyKind.D2D
+    return CopyKind.KERNEL
+
+
+def empty_frame() -> pd.DataFrame:
+    return pd.DataFrame({c: pd.Series(dtype=type(_DEFAULTS[c]) if not isinstance(_DEFAULTS[c], str) else "object") for c in COLUMNS})
+
+
+def make_frame(rows_or_cols) -> pd.DataFrame:
+    """Build a schema DataFrame from a list of dicts or a dict of columns.
+
+    Missing columns are filled with schema defaults; unknown keys rejected.
+    """
+    if isinstance(rows_or_cols, dict):
+        df = pd.DataFrame(rows_or_cols)
+    else:
+        df = pd.DataFrame(list(rows_or_cols))
+    if df.empty:
+        return empty_frame()
+    unknown = set(df.columns) - set(COLUMNS)
+    if unknown:
+        raise ValueError(f"columns outside the unified schema: {sorted(unknown)}")
+    for col in COLUMNS:
+        if col not in df.columns:
+            df[col] = _DEFAULTS[col]
+    return df[COLUMNS]
+
+
+def write_csv(df: pd.DataFrame, path: str) -> None:
+    df.to_csv(path, index=False)
+
+
+def read_csv(path: str) -> pd.DataFrame:
+    df = pd.read_csv(path)
+    for col in COLUMNS:
+        if col not in df.columns:
+            df[col] = _DEFAULTS[col]
+    for col, default in _DEFAULTS.items():
+        if isinstance(default, str) and col in df.columns:
+            df[col] = df[col].fillna("").astype(str)
+    return df[COLUMNS]
+
+
+def downsample(df: pd.DataFrame, max_points: int) -> pd.DataFrame:
+    """Stride-downsample a frame to at most ``max_points`` rows.
+
+    The reference downsampled with a fixed iteration stride
+    (sofa_preprocess.py:51-57); a target row count adapts to trace volume,
+    which matters far more for HLO-op traces (SURVEY §7 "Trace volume").
+    """
+    if max_points <= 0 or len(df) <= max_points:
+        return df
+    stride = int(np.ceil(len(df) / max_points))
+    return df.iloc[::stride]
+
+
+@dataclass
+class SofaSeries:
+    """One named, colored series on the master timeline.
+
+    The reference models this as SOFATrace (bin/sofa_models.py:1-7) and
+    serializes every series into ``report.js`` (sofa_preprocess.py:343-374);
+    our board consumes the same contract as pure JSON.
+    """
+
+    name: str           # JS-identifier-ish unique key
+    title: str          # legend text
+    color: str
+    data: pd.DataFrame = field(default_factory=empty_frame)
+    y_axis: str = "event"    # which column supplies y values
+    kind: str = "scatter"    # scatter | line | band
+
+    def to_points(self, max_points: int = 10000) -> List[dict]:
+        df = downsample(self.data, max_points)
+        if df.empty:
+            return []
+        ys = df[self.y_axis] if self.y_axis in df.columns else df["event"]
+        pts = [
+            {
+                "x": round(float(x), 6),
+                "y": float(y),
+                "name": str(n),
+                "d": round(float(d), 9),
+            }
+            for x, y, n, d in zip(df["timestamp"], ys, df["name"], df["duration"])
+        ]
+        return pts
+
+
+def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 10000,
+                        extra: Optional[dict] = None) -> None:
+    """Serialize all series to ``report.js`` — the board's data contract.
+
+    Written as ``sofa_traces = [...]`` (one JSON blob), the modern analogue of
+    the reference's per-series JS vars + sofa_traces array
+    (sofa_preprocess.py:343-374,2104).
+    """
+    payload = [
+        {
+            "name": s.name,
+            "title": s.title,
+            "color": s.color,
+            "kind": s.kind,
+            "data": s.to_points(max_points),
+        }
+        for s in series
+    ]
+    doc = {"series": payload, "meta": extra or {}}
+    with open(path, "w") as f:
+        f.write("sofa_traces = ")
+        json.dump(doc, f)
+        f.write(";\n")
+
+
+def packed_ip(ip: str) -> int:
+    """Pack dotted IPv4 into the reference's integer encoding.
+
+    pkt_src/dst = sum(octet * 1000^(3-i)) — kept bit-compatible with
+    sofa_preprocess.py:182-186 so diffing against reference traces works.
+    """
+    try:
+        octets = [int(o) for o in ip.split(".")]
+    except ValueError:
+        return -1
+    if len(octets) != 4:
+        return -1
+    value = 0
+    for i, o in enumerate(octets):
+        value += o * 1000 ** (3 - i)
+    return value
+
+
+def unpack_ip(value: int) -> str:
+    octets = []
+    v = int(value)
+    for i in range(4):
+        octets.append(v // 1000 ** (3 - i))
+        v %= 1000 ** (3 - i)
+    return ".".join(str(o) for o in octets)

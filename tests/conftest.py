@@ -1,0 +1,43 @@
+"""Test configuration.
+
+Tests never require real TPU hardware: JAX is pinned to the CPU backend with
+8 virtual devices so sharding/collective paths (device meshes, pjit,
+shard_map) compile and execute anywhere.  Set SOFA_TPU_TEST_REAL=1 to run the
+few opt-in tests that want the real chip.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def logdir(tmp_path):
+    d = tmp_path / "sofalog"
+    d.mkdir()
+    return str(d) + "/"
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "real_tpu: needs the real TPU chip")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("SOFA_TPU_TEST_REAL"):
+        return
+    skip = pytest.mark.skip(reason="set SOFA_TPU_TEST_REAL=1 to run on real TPU")
+    for item in items:
+        if "real_tpu" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,47 @@
+import pytest
+
+from sofa_tpu.cli import build_parser, config_from_args
+
+
+def parse(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_record_flags():
+    cfg = parse(["record", "sleep 1", "--logdir", "x", "--sys_mon_rate", "33",
+                 "--enable_strace", "--disable_xprof"])
+    assert cfg.command == "sleep 1"
+    assert cfg.logdir == "x/"
+    assert cfg.sys_mon_rate == 33
+    assert cfg.enable_strace
+    assert not cfg.enable_xprof
+
+
+def test_filter_flags():
+    cfg = parse(["preprocess", "--cpu_filters", "idle:black,mem:red",
+                 "--tpu_filters", "all-reduce:indigo"])
+    assert [f.keyword for f in cfg.cpu_filters] == ["idle", "mem"]
+    assert cfg.tpu_filters[0].color == "indigo"
+
+
+def test_cluster_hosts():
+    cfg = parse(["report", "--cluster_hosts", "a,b,c"])
+    assert cfg.cluster_hosts == ["a", "b", "c"]
+
+
+def test_toml_with_cli_override(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text('sys_mon_rate = 5\nviz_port = 9999\n')
+    cfg = parse(["analyze", "--config", str(p), "--viz_port", "7777"])
+    assert cfg.sys_mon_rate == 5       # from file
+    assert cfg.viz_port == 7777        # CLI wins
+
+
+def test_record_without_command_errors(capsys):
+    from sofa_tpu.cli import main
+    assert main(["record"]) == 2
+
+
+def test_unknown_subcommand_rejected():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["explode"])

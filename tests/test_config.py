@@ -1,0 +1,50 @@
+import pytest
+
+from sofa_tpu.config import DEFAULT_TPU_FILTERS, Filter, SofaConfig
+
+
+def test_defaults_mirror_reference():
+    cfg = SofaConfig()
+    # Reference defaults preserved: sofa_config.py:47 (10 Hz), :44 (20 iters),
+    # :45 (10 swarms), bin/sofa viz_port 8000, strace_min_time 1e-6.
+    assert cfg.sys_mon_rate == 10
+    assert cfg.num_iterations == 20
+    assert cfg.num_swarms == 10
+    assert cfg.viz_port == 8000
+    assert cfg.strace_min_time == pytest.approx(1e-6)
+    assert cfg.logdir.endswith("/")
+
+
+def test_logdir_trailing_slash_and_paths():
+    cfg = SofaConfig(logdir="/tmp/x")
+    assert cfg.logdir == "/tmp/x/"
+    assert cfg.path("a.csv") == "/tmp/x/a.csv"
+    assert cfg.xprof_dir == "/tmp/x/xprof"
+
+
+def test_filter_parse():
+    f = Filter.parse("all-reduce:indigo")
+    assert f.keyword == "all-reduce" and f.color == "indigo"
+    assert Filter.parse("idle").color == "orange"
+
+
+def test_default_tpu_filters_cover_collectives():
+    kws = {f.keyword for f in DEFAULT_TPU_FILTERS}
+    for kw in ("all-reduce", "all-gather", "reduce-scatter", "infeed", "outfeed"):
+        assert kw in kws
+
+
+def test_from_dict_rejects_unknown():
+    with pytest.raises(ValueError):
+        SofaConfig.from_dict({"nope": 1})
+
+
+def test_from_toml(tmp_path):
+    p = tmp_path / "sofa.toml"
+    p.write_text(
+        'logdir = "run1/"\nsys_mon_rate = 25\ncpu_filters = ["idle:black", "memcpy:red"]\n'
+    )
+    cfg = SofaConfig.from_toml(str(p))
+    assert cfg.logdir == "run1/"
+    assert cfg.sys_mon_rate == 25
+    assert cfg.cpu_filters[1] == Filter("memcpy", "red")

@@ -1,0 +1,117 @@
+import json
+
+import pandas as pd
+import pytest
+
+from sofa_tpu import trace
+from sofa_tpu.trace import (
+    BASE_COLUMNS,
+    COLUMNS,
+    CopyKind,
+    SofaSeries,
+    classify_hlo_kind,
+    downsample,
+    empty_frame,
+    make_frame,
+    packed_ip,
+    read_csv,
+    series_to_report_js,
+    unpack_ip,
+    write_csv,
+)
+
+
+def test_base_schema_is_reference_compatible():
+    # The 13 columns, in order (reference sofa_config.py:49-62).
+    assert BASE_COLUMNS == [
+        "timestamp", "event", "duration", "deviceId", "copyKind", "payload",
+        "bandwidth", "pkt_src", "pkt_dst", "pid", "tid", "name", "category",
+    ]
+
+
+def test_make_frame_defaults_and_order():
+    df = make_frame([{"timestamp": 1.5, "name": "matmul"}])
+    assert list(df.columns) == COLUMNS
+    assert df.loc[0, "deviceId"] == -1
+    assert df.loc[0, "copyKind"] == -1
+    assert df.loc[0, "name"] == "matmul"
+
+
+def test_make_frame_rejects_unknown_columns():
+    with pytest.raises(ValueError):
+        make_frame([{"timestamp": 1.0, "bogus": 2}])
+
+
+def test_csv_round_trip(tmp_path):
+    df = make_frame(
+        [
+            {"timestamp": 0.1, "name": "a", "copyKind": int(CopyKind.ALL_REDUCE)},
+            {"timestamp": 0.2, "name": "b", "payload": 4096, "bandwidth": 1e9},
+        ]
+    )
+    p = tmp_path / "t.csv"
+    write_csv(df, str(p))
+    df2 = read_csv(str(p))
+    assert list(df2.columns) == COLUMNS
+    pd.testing.assert_frame_equal(
+        df.reset_index(drop=True), df2.reset_index(drop=True), check_dtype=False
+    )
+
+
+def test_read_csv_fills_missing_extension_columns(tmp_path):
+    # A base-13-only CSV (e.g. produced by the reference) must load cleanly.
+    p = tmp_path / "old.csv"
+    pd.DataFrame({c: [0] if c != "name" else ["x"] for c in BASE_COLUMNS}).to_csv(
+        p, index=False
+    )
+    df = read_csv(str(p))
+    assert df.loc[0, "device_kind"] == ""
+    assert df.loc[0, "flops"] == 0.0
+
+
+def test_downsample():
+    df = make_frame([{"timestamp": i * 0.01, "name": str(i)} for i in range(1000)])
+    out = downsample(df, 100)
+    assert len(out) <= 100
+    assert out.iloc[0]["name"] == "0"
+    assert downsample(df, 0) is df
+    assert downsample(df, 2000) is df
+
+
+def test_classify_hlo_kind():
+    assert classify_hlo_kind("all-reduce.1") == CopyKind.ALL_REDUCE
+    assert classify_hlo_kind("all-reduce-start") == CopyKind.ALL_REDUCE
+    assert classify_hlo_kind("fusion.3", "convolution") == CopyKind.KERNEL
+    assert classify_hlo_kind("infeed.0") == CopyKind.H2D
+    assert classify_hlo_kind("outfeed.0") == CopyKind.D2H
+    assert classify_hlo_kind("collective-permute.2") == CopyKind.COLLECTIVE_PERMUTE
+    assert classify_hlo_kind("copy.5") == CopyKind.D2D
+    assert classify_hlo_kind("all_gather", "") == CopyKind.ALL_GATHER
+
+
+def test_report_js_contract(tmp_path):
+    s = SofaSeries(
+        name="tpu_ops",
+        title="TPU ops",
+        color="purple",
+        data=make_frame([{"timestamp": 1.0, "event": 2.0, "name": "fusion.1"}]),
+    )
+    p = tmp_path / "report.js"
+    series_to_report_js([s], str(p), extra={"elapsed": 3.0})
+    text = p.read_text()
+    assert text.startswith("sofa_traces = ")
+    doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+    assert doc["series"][0]["name"] == "tpu_ops"
+    assert doc["series"][0]["data"][0]["x"] == 1.0
+    assert doc["meta"]["elapsed"] == 3.0
+
+
+def test_packed_ip_round_trip():
+    # Bit-compatible with the reference packing (sofa_preprocess.py:182-186).
+    assert packed_ip("10.1.2.3") == 10 * 1000**3 + 1 * 1000**2 + 2 * 1000 + 3
+    assert unpack_ip(packed_ip("192.168.0.254")) == "192.168.0.254"
+    assert packed_ip("not.an.ip") == -1
+
+
+def test_empty_frame_columns():
+    assert list(empty_frame().columns) == trace.COLUMNS
