@@ -1,0 +1,90 @@
+"""Programmatic, in-process profiling API.
+
+For users who own the Python process (the common JAX case) and do not want
+the wrap-a-command CLI:
+
+    import sofa_tpu.api as sofa
+
+    with sofa.profile("sofalog/"):
+        train_step(...)  # any JAX work
+
+    # then: sofa report --logdir sofalog/
+
+This records the same artifact set as `sofa record` minus the process-level
+wrappers (perf/strace prefixes do not apply in-process).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+from sofa_tpu.config import SofaConfig
+
+
+@contextlib.contextmanager
+def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
+    import jax
+
+    if cfg is None:
+        cfg = SofaConfig(logdir=logdir)
+    else:
+        cfg.logdir = logdir
+        cfg.__post_init__()
+    os.makedirs(cfg.logdir, exist_ok=True)
+
+    from sofa_tpu.collectors.procmon import ProcMonCollector
+    from sofa_tpu.collectors.timebase import TimebaseCollector
+
+    timebase = TimebaseCollector(cfg)
+    procmon = ProcMonCollector(cfg)
+    timebase.start()
+    if procmon.probe() is None:
+        procmon.start()
+
+    jax.profiler.start_trace(cfg.xprof_dir)
+    t0 = time.time_ns()
+    with jax.profiler.TraceAnnotation(f"sofa_timebase_marker:{t0}"):
+        t1 = time.time_ns()
+    with open(cfg.path("xprof_marker.txt"), "w") as f:
+        f.write(f"{t0} {t1}\n")
+    _snapshot_topology(jax, cfg.logdir)
+
+    start = time.time()
+    try:
+        yield cfg
+    finally:
+        jax.profiler.stop_trace()
+        procmon.stop()
+        elapsed = time.time() - start
+        with open(cfg.path("misc.txt"), "w") as f:
+            f.write(f"elapsed_time {elapsed:.6f}\n")
+            f.write(f"cores {os.cpu_count() or 1}\n")
+            f.write(f"pid {os.getpid()}\n")
+            f.write("rc 0\n")
+
+
+def _snapshot_topology(jax, logdir: str) -> None:
+    devs = [
+        {
+            "id": d.id,
+            "process_index": d.process_index,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", ""),
+            "coords": list(getattr(d, "coords", []) or []),
+            "core_on_chip": getattr(d, "core_on_chip", -1),
+        }
+        for d in jax.devices()
+    ]
+    info = {
+        "platform": jax.default_backend(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "devices": devs,
+    }
+    with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+        json.dump(info, f, indent=1)

@@ -1,0 +1,209 @@
+"""TPU trace collection by zero-code-change injection.
+
+The reference attaches to GPU work from outside the process with
+`nvprof --profile-all-processes` (/root/reference/bin/sofa_record.py:217-221).
+There is no external attach for libtpu, so we get inside instead: record
+writes a self-contained ``sitecustomize.py`` into logdir/_inject/ and prepends
+that directory to the child's PYTHONPATH.  Python imports sitecustomize
+automatically at startup; ours arms a watcher that waits for the profiled
+program to import JAX, then:
+
+  1. calls jax.profiler.start_trace(logdir/xprof) — XPlane capture;
+  2. stamps the clock marker: records CLOCK_REALTIME and immediately opens a
+     TraceAnnotation named ``sofa_timebase_marker:<unix_ns>`` so the XPlane
+     session clock can be pinned to unix time at preprocess (this replaces
+     the reference's cuhello known-kernel trick, sofa_preprocess.py:1557-1616);
+  3. snapshots TPU topology (device coords, kinds, process indices) to
+     tpu_topo.json — the nvlink_topo.txt analogue (sofa_record.py:311-312);
+  4. optionally runs the in-process Python stack sampler (the pyflame
+     analogue, sofa_record.py:326-333) — see collectors/pystacks.py docs;
+  5. stops the trace at process exit (atexit) or after a fixed duration.
+
+Non-Python or non-JAX commands simply never trigger the watcher; the
+injection is inert.  Programmatic users can instead use sofa_tpu.api.profile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+from sofa_tpu.collectors.base import Collector
+
+# The injected file is deliberately dependency-free: it must work in any
+# Python the user's command runs, including ones that cannot import sofa_tpu.
+_SITECUSTOMIZE = '''
+"""sofa_tpu record-time injection (auto-generated; removed by `sofa clean`)."""
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+
+_OPTS = json.loads(os.environ.get("SOFA_TPU_XPROF_OPTS", "{}"))
+_DONE = {"started": False, "stopped": False}
+
+
+def _chain_next_sitecustomize():
+    # Python imports exactly one sitecustomize — the first on sys.path, which
+    # is ours because record prepends the injection dir. Environments often
+    # have their own (e.g. to register accelerator plugins); shadowing it
+    # would change the profiled program's behavior, so find the next one and
+    # execute it too.
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in sys.path:
+        try:
+            ap = os.path.abspath(p or os.getcwd())
+        except OSError:
+            continue
+        if ap == here:
+            continue
+        cand = os.path.join(ap, "sitecustomize.py")
+        if os.path.isfile(cand):
+            try:
+                spec = importlib.util.spec_from_file_location("sitecustomize", cand)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    "sofa_tpu: chained sitecustomize %s failed: %r\\n" % (cand, e)
+                )
+            return
+
+
+_chain_next_sitecustomize()
+
+
+def _snapshot_topology(jax, logdir):
+    try:
+        devs = []
+        for d in jax.devices():
+            devs.append({
+                "id": d.id,
+                "process_index": d.process_index,
+                "platform": d.platform,
+                "device_kind": getattr(d, "device_kind", ""),
+                "coords": list(getattr(d, "coords", []) or []),
+                "core_on_chip": getattr(d, "core_on_chip", -1),
+            })
+        info = {
+            "platform": jax.default_backend(),
+            "process_count": jax.process_count(),
+            "process_index": jax.process_index(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "devices": devs,
+        }
+        with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+            json.dump(info, f, indent=1)
+    except Exception as e:  # noqa: BLE001 - never break the profiled app
+        sys.stderr.write("sofa_tpu: topology snapshot failed: %r\\n" % (e,))
+
+
+def _stop(jax):
+    if _DONE["stopped"] or not _DONE["started"]:
+        return
+    _DONE["stopped"] = True
+    try:
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write("sofa_tpu: stop_trace failed: %r\\n" % (e,))
+
+
+def _start(jax):
+    logdir = _OPTS["logdir"]
+    delay = float(_OPTS.get("delay_s", 0) or 0)
+    if delay > 0:
+        time.sleep(delay)
+    try:
+        jax.profiler.start_trace(
+            os.path.join(logdir, "xprof"),
+            create_perfetto_link=False,
+            create_perfetto_trace=False,
+        )
+        _DONE["started"] = True
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write("sofa_tpu: start_trace failed: %r\\n" % (e,))
+        return
+    # Clock marker: unix time <-> XPlane session time. Two bracketing reads
+    # bound the annotation-entry cost.
+    t0 = time.time_ns()
+    with jax.profiler.TraceAnnotation("sofa_timebase_marker:%d" % t0):
+        t1 = time.time_ns()
+    with open(os.path.join(logdir, "xprof_marker.txt"), "w") as f:
+        f.write("%d %d\\n" % (t0, t1))
+    atexit.register(lambda: _stop(jax))
+    _snapshot_topology(jax, logdir)
+    dur = float(_OPTS.get("duration_s", 0) or 0)
+    if dur > 0:
+        timer = threading.Timer(dur, lambda: _stop(jax))
+        timer.daemon = True
+        timer.start()
+
+
+def _watch():
+    # Poll for the jax module becoming importable-and-initialized. A meta-path
+    # hook cannot easily run *after* a package finishes importing; a 20 ms
+    # poll is robust and costs nothing once armed.
+    deadline = time.time() + float(_OPTS.get("arm_timeout_s", 86400))
+    while time.time() < deadline:
+        jax = sys.modules.get("jax")
+        if jax is not None and getattr(jax, "profiler", None) is not None \\
+                and getattr(jax, "version", None) is not None:
+            _start(jax)
+            return
+        time.sleep(0.02)
+
+
+if _OPTS.get("enable", False):
+    _t = threading.Thread(target=_watch, daemon=True, name="sofa_tpu_xprof_watch")
+    _t.start()
+
+if os.environ.get("SOFA_TPU_PYSTACKS_HZ"):
+    from sofa_tpu_pystacks import start_sampler  # lives beside this file
+    start_sampler(
+        float(os.environ["SOFA_TPU_PYSTACKS_HZ"]),
+        os.environ["SOFA_TPU_PYSTACKS_OUT"],
+    )
+'''
+
+
+class XProfCollector(Collector):
+    name = "xprof"
+
+    def probe(self) -> Optional[str]:
+        if not self.cfg.enable_xprof:
+            return "disabled (--disable_xprof)"
+        return None
+
+    def start(self) -> None:
+        cfg = self.cfg
+        os.makedirs(cfg.inject_dir, exist_ok=True)
+        os.makedirs(cfg.xprof_dir, exist_ok=True)
+        with open(os.path.join(cfg.inject_dir, "sitecustomize.py"), "w") as f:
+            f.write(_SITECUSTOMIZE)
+        from sofa_tpu.collectors.pystacks import write_sampler_module
+
+        write_sampler_module(cfg.inject_dir)
+
+    def child_env(self) -> Dict[str, str]:
+        cfg = self.cfg
+        opts = {
+            "enable": True,
+            "logdir": os.path.abspath(cfg.logdir),
+            "delay_s": cfg.xprof_delay_s,
+            "duration_s": cfg.xprof_duration_s,
+            "host_tracer_level": cfg.xprof_host_tracer_level,
+            "python_tracer": cfg.xprof_python_tracer,
+        }
+        env = {"SOFA_TPU_XPROF_OPTS": json.dumps(opts)}
+        existing = os.environ.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = cfg.inject_dir + (os.pathsep + existing if existing else "")
+        if cfg.enable_py_stacks:
+            env["SOFA_TPU_PYSTACKS_HZ"] = str(cfg.py_stack_rate)
+            env["SOFA_TPU_PYSTACKS_OUT"] = os.path.abspath(cfg.path("pystacks.txt"))
+        return env

@@ -28,27 +28,28 @@ enabled = True
 verbose = False
 
 
-def _use_color() -> bool:
+def _use_color(stream) -> bool:
     if os.environ.get("NO_COLOR"):
         return False
-    return sys.stdout.isatty()
+    return stream.isatty()
 
 
-def _emit(tag: str, color: str, msg: str) -> None:
+def _emit(tag: str, color: str, msg: str, stream=None) -> None:
     if not enabled:
         return
-    if _use_color():
-        print(f"{_COLORS[color]}{tag}{_COLORS['end']} {msg}")
+    stream = stream or sys.stdout
+    if _use_color(stream):
+        print(f"{_COLORS[color]}{tag}{_COLORS['end']} {msg}", file=stream)
     else:
-        print(f"{tag} {msg}")
-    sys.stdout.flush()
+        print(f"{tag} {msg}", file=stream)
+    stream.flush()
 
 
 def print_title(msg: str) -> None:
     if not enabled:
         return
     bar = "=" * max(8, len(msg))
-    if _use_color():
+    if _use_color(sys.stdout):
         print(f"\n{_COLORS['cyan']}{bar}\n{msg}\n{bar}{_COLORS['end']}")
     else:
         print(f"\n{bar}\n{msg}\n{bar}")
@@ -56,11 +57,13 @@ def print_title(msg: str) -> None:
 
 
 def print_error(msg: str) -> None:
-    _emit("[ERROR]", "red", msg)
+    # Errors and warnings go to stderr: stdout may be piped data
+    # (features tables, report output) and must stay parseable.
+    _emit("[ERROR]", "red", msg, stream=sys.stderr)
 
 
 def print_warning(msg: str) -> None:
-    _emit("[WARNING]", "yellow", msg)
+    _emit("[WARNING]", "yellow", msg, stream=sys.stderr)
 
 
 def print_info(msg: str) -> None:

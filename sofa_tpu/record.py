@@ -1,0 +1,201 @@
+"""`sofa record` — run a command under the collector swarm.
+
+Orchestration mirrors the reference's prologue/launch/epilogue structure
+(/root/reference/bin/sofa_record.py:150-524) but each source is a Collector
+object (sofa_tpu/collectors/) rather than inline Popen spaghetti:
+
+  prologue: clean stale logs, write time base + clock anchors, start
+            background collectors (procmon/vmstat/tcpdump/blktrace),
+            stage the JAX injection;
+  launch:   compose [prefix collectors…] + user command, inject child env,
+            run it, stream its output;
+  epilogue: stop collectors in reverse order (kill-all on error, like
+            sofa_record.py:480-523), harvest post-processing, write misc.txt.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+from sofa_tpu.collectors.base import CollectorState, ensure_logdir
+from sofa_tpu.collectors.hostproc import (
+    BlktraceCollector,
+    StraceCollector,
+    TcpdumpCollector,
+    VmstatCollector,
+)
+from sofa_tpu.collectors.perf import PerfCollector
+from sofa_tpu.collectors.procmon import ProcMonCollector
+from sofa_tpu.collectors.timebase import TimebaseCollector
+from sofa_tpu.collectors.xprof import XProfCollector
+from sofa_tpu.printing import (
+    print_error,
+    print_info,
+    print_progress,
+    print_warning,
+)
+
+# Raw collector outputs (kept by `sofa clean`).
+RAW_FILES = [
+    "sofa_time.txt", "timebase.txt", "misc.txt", "mpstat.txt", "diskstat.txt",
+    "netstat.txt", "cpuinfo.txt", "vmstat.txt", "perf.data", "time.txt",
+    "strace.txt", "pystacks.txt", "sofa.pcap", "blktrace.txt", "kallsyms",
+    "tpu_topo.json", "xprof_marker.txt", "sofa.err",
+]
+
+# Derived files (removed by `sofa clean`).
+DERIVED_SUFFIXES = (".csv", ".js", ".html", ".json.gz", ".pdf", ".png")
+DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt"]
+DERIVED_DIRS = ["board"]
+
+
+def build_collectors(cfg):
+    """Collector construction order == start order; stop is the reverse."""
+    return [
+        TimebaseCollector(cfg),
+        ProcMonCollector(cfg),
+        VmstatCollector(cfg),
+        TcpdumpCollector(cfg),
+        BlktraceCollector(cfg),
+        XProfCollector(cfg),
+        # prefix-only collectors last so their probe warnings read near launch
+        StraceCollector(cfg),
+        PerfCollector(cfg),
+    ]
+
+
+def _clean_stale(cfg) -> None:
+    """Remove previous run's files so traces never mix (sofa_record.py:201-213)."""
+    if not os.path.isdir(cfg.logdir):
+        return
+    import shutil
+
+    for name in os.listdir(cfg.logdir):
+        path = cfg.path(name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+        except OSError as e:
+            print_warning(f"cannot clean {path}: {e}")
+
+
+def sofa_record(command: str, cfg) -> int:
+    ensure_logdir(cfg.logdir)
+    _clean_stale(cfg)
+    collectors = build_collectors(cfg)
+
+    started = []
+    prefix = []
+    child_env = dict(os.environ)
+    rc = 1
+    try:
+        for col in collectors:
+            reason = col.probe()
+            if reason is not None:
+                col.unavailable(reason)
+                continue
+            col.start()
+            started.append(col)
+            prefix += col.command_prefix()
+            child_env.update(col.child_env())
+
+        if cfg.pid is not None:
+            rc = _attach(cfg, cfg.pid)
+        else:
+            argv = prefix + ["/bin/sh", "-c", command]
+            print_progress(f"launching: {command}")
+            t0 = time.time()
+            child = subprocess.Popen(argv, env=child_env)
+            try:
+                rc = child.wait()
+            except KeyboardInterrupt:
+                print_warning("interrupted; terminating profiled command")
+                child.terminate()
+                try:
+                    rc = child.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    rc = child.wait()
+            elapsed = time.time() - t0
+            print_progress(f"command finished in {elapsed:.3f} s (rc={rc})")
+            _write_misc(cfg, elapsed, child.pid, rc)
+    except Exception as e:  # kill-all cleanup, reference sofa_record.py:480-523
+        print_error(f"record failed: {e}")
+        for col in reversed(started):
+            try:
+                if hasattr(col, "kill"):
+                    col.kill()
+            except Exception:
+                pass
+        raise
+    finally:
+        for col in reversed(started):
+            try:
+                col.stop()
+            except Exception as e:
+                print_warning(f"{col.name}: stop failed: {e}")
+        for col in started:
+            try:
+                col.harvest()
+            except Exception as e:
+                print_warning(f"{col.name}: harvest failed: {e}")
+
+    if rc != 0:
+        print_warning(f"profiled command exited with rc={rc}")
+    print_progress(f"traces collected in {cfg.logdir}")
+    return 0
+
+
+def _attach(cfg, pid: int) -> int:
+    """Attach mode: sample system state while `pid` runs.
+
+    The reference only plumbs --pid into misc.txt without attaching
+    (sofa_record.py:316-319); we at least wait on the target so the
+    system-wide samplers cover its lifetime.
+    """
+    print_progress(f"attached to pid {pid}; waiting for it to exit")
+    t0 = time.time()
+    try:
+        while os.path.exists(f"/proc/{pid}"):
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        print_warning("detached")
+    _write_misc(cfg, time.time() - t0, pid, 0)
+    return 0
+
+
+def _write_misc(cfg, elapsed: float, pid: int, rc: int) -> None:
+    try:
+        cores = os.cpu_count() or 1
+    except OSError:
+        cores = 1
+    with open(cfg.path("misc.txt"), "w") as f:
+        f.write(f"elapsed_time {elapsed:.6f}\n")
+        f.write(f"cores {cores}\n")
+        f.write(f"pid {pid}\n")
+        f.write(f"rc {rc}\n")
+
+
+def sofa_clean(cfg) -> None:
+    """Remove derived files, keep raw collector output (sofa_record.py:138-147)."""
+    import shutil
+
+    if not os.path.isdir(cfg.logdir):
+        print_info("nothing to clean")
+        return
+    removed = 0
+    for name in list(os.listdir(cfg.logdir)):
+        path = cfg.path(name)
+        if name in DERIVED_FILES or (
+            name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
+        ):
+            os.unlink(path)
+            removed += 1
+        elif name in DERIVED_DIRS or name == "_inject":
+            shutil.rmtree(path)
+            removed += 1
+    print_progress(f"cleaned {removed} derived entries from {cfg.logdir}")

@@ -39,7 +39,7 @@ Extension columns (TPU build):
 from __future__ import annotations
 
 import json
-import os
+import math
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import List, Optional
@@ -113,20 +113,7 @@ class CopyKind(IntEnum):
     COLLECTIVE_BROADCAST = 25
 
 
-CK_NAMES = {
-    int(CopyKind.NA): "NA",
-    int(CopyKind.KERNEL): "KERNEL",
-    int(CopyKind.H2D): "H2D",
-    int(CopyKind.D2H): "D2H",
-    int(CopyKind.D2D): "D2D",
-    int(CopyKind.P2P): "P2P",
-    int(CopyKind.ALL_REDUCE): "ALL_REDUCE",
-    int(CopyKind.ALL_GATHER): "ALL_GATHER",
-    int(CopyKind.REDUCE_SCATTER): "REDUCE_SCATTER",
-    int(CopyKind.ALL_TO_ALL): "ALL_TO_ALL",
-    int(CopyKind.COLLECTIVE_PERMUTE): "COLLECTIVE_PERMUTE",
-    int(CopyKind.COLLECTIVE_BROADCAST): "COLLECTIVE_BROADCAST",
-}
+CK_NAMES = {int(k): k.name for k in CopyKind}
 
 # Map an HLO op/category name onto the taxonomy.
 _COLLECTIVE_KINDS = [
@@ -229,12 +216,19 @@ class SofaSeries:
         if df.empty:
             return []
         ys = df[self.y_axis] if self.y_axis in df.columns else df["event"]
+
+        def _num(v: float, digits: int) -> float:
+            # NaN/Inf would serialize as bare `NaN` tokens — invalid JSON for
+            # the board's JSON.parse — so coerce to 0.
+            v = float(v)
+            return round(v, digits) if math.isfinite(v) else 0.0
+
         pts = [
             {
-                "x": round(float(x), 6),
-                "y": float(y),
+                "x": _num(x, 6),
+                "y": _num(y, 6),
                 "name": str(n),
-                "d": round(float(d), 9),
+                "d": _num(d, 9),
             }
             for x, y, n, d in zip(df["timestamp"], ys, df["name"], df["duration"])
         ]
@@ -285,6 +279,8 @@ def packed_ip(ip: str) -> int:
 
 
 def unpack_ip(value: int) -> str:
+    if value < 0:  # -1 is the schema's "not a packet" sentinel
+        return "n/a"
     octets = []
     v = int(value)
     for i in range(4):

@@ -1,0 +1,94 @@
+import os
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.record import sofa_clean, sofa_record
+
+
+def test_record_smoke_sleep(logdir):
+    """The e2e gate of the reference test matrix is `sofa record "sleep 5"`
+    (reference test/test.py:68); ours uses a shorter sleep."""
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=50)
+    rc = sofa_record("sleep 0.4", cfg)
+    assert rc == 0
+    for f in ("sofa_time.txt", "timebase.txt", "misc.txt", "mpstat.txt",
+              "diskstat.txt", "netstat.txt", "cpuinfo.txt"):
+        assert os.path.isfile(cfg.path(f)), f
+        assert os.path.getsize(cfg.path(f)) > 0, f
+    misc = dict(
+        line.split() for line in open(cfg.path("misc.txt")) if line.strip()
+    )
+    assert float(misc["elapsed_time"]) >= 0.4
+    assert misc["rc"] == "0"
+    # timebase: 4 clock columns, monotonically plausible
+    row = open(cfg.path("timebase.txt")).readline().split()
+    assert len(row) == 4
+    assert int(row[0]) > 1e18  # realtime ns, sane epoch
+
+
+def test_record_failing_command_still_collects(logdir):
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    rc = sofa_record("exit 3", cfg)
+    assert rc == 0  # record itself succeeds; child rc recorded
+    misc = dict(line.split() for line in open(cfg.path("misc.txt")))
+    assert misc["rc"] == "3"
+
+
+def test_record_cleans_stale_files(logdir):
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    stale = cfg.path("mpstat.txt")
+    with open(stale, "w") as f:
+        f.write("stale-run-data\n")
+    sofa_record("true", cfg)
+    assert "stale-run-data" not in open(stale).read()
+
+
+def test_xprof_injection_env(logdir):
+    """With xprof on, the child env must carry the injection PYTHONPATH."""
+    cfg = SofaConfig(logdir=logdir)
+    out = cfg.path("env.txt")
+    sofa_record(f"env > {out}", cfg)
+    env = open(out).read()
+    assert "SOFA_TPU_XPROF_OPTS" in env
+    assert "_inject" in env
+    assert os.path.isfile(os.path.join(cfg.inject_dir, "sitecustomize.py"))
+    assert os.path.isfile(os.path.join(cfg.inject_dir, "sofa_tpu_pystacks.py"))
+
+
+def test_injected_sitecustomize_is_inert_without_jax(logdir):
+    """A plain python child with the injection must run unharmed."""
+    cfg = SofaConfig(logdir=logdir)
+    out = cfg.path("out.txt")
+    rc = sofa_record(f"python -c 'print(6*7)' > {out}", cfg)
+    assert rc == 0
+    assert open(out).read().strip() == "42"
+
+
+def test_pystacks_sampler(logdir):
+    cfg = SofaConfig(logdir=logdir, enable_py_stacks=True, py_stack_rate=200)
+    code = (
+        "import time\n"
+        "def busy_leaf():\n"
+        "    t=time.time()\n"
+        "    while time.time()-t < 0.6: pass\n"
+        "busy_leaf()\n"
+    )
+    script = os.path.join(os.path.dirname(logdir.rstrip("/")), "w.py")
+    with open(script, "w") as f:
+        f.write(code)
+    sofa_record(f"python {script}", cfg)
+    stacks = open(cfg.path("pystacks.txt")).read()
+    assert "busy_leaf" in stacks
+
+
+def test_sofa_clean_keeps_raw(logdir):
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    sofa_record("true", cfg)
+    with open(cfg.path("cputrace.csv"), "w") as f:
+        f.write("derived\n")
+    with open(cfg.path("report.js"), "w") as f:
+        f.write("derived\n")
+    sofa_clean(cfg)
+    assert not os.path.exists(cfg.path("cputrace.csv"))
+    assert not os.path.exists(cfg.path("report.js"))
+    assert os.path.isfile(cfg.path("misc.txt"))
+    assert os.path.isfile(cfg.path("mpstat.txt"))
