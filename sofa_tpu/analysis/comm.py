@@ -1,0 +1,171 @@
+"""Communication profile: data movement by kind + ICI traffic attribution.
+
+comm_profile retarget (reference sofa_common.py:23-177): the CUPTI copyKind
+taxonomy {H2D, D2H, D2D, P2P} extends to XLA collectives (CopyKind >= 20),
+and the src x dst GPU matrix becomes a chip x chip ICI traffic matrix derived
+from collective semantics + mesh topology — per-link hardware counters are
+not exposed in XPlane, so link traffic is estimated from the collective
+algorithm (ring) as the reference estimates nothing at all (it only counts
+NCCL kernel time, sofa_analyze.py:363-368).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_title
+from sofa_tpu.trace import CK_NAMES, CopyKind
+
+
+def load_topology(cfg) -> Optional[dict]:
+    path = cfg.path("tpu_topo.json")
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def comm_profile(frames, cfg, features: Features) -> None:
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    # Collectives live on the sync "XLA Ops" line (category 0); H2D/D2H/D2D
+    # transfer spans live on the async DMA line (category 2), with stub
+    # copy-start/copy-done markers duplicated on the sync line.  Prefer the
+    # async spans for copies and fall back to the sync stubs when a backend
+    # emits no async line.
+    sync = df[df["category"] == 0]
+    async_ = df[df["category"] == 2]
+    coll_rows = sync[sync["copyKind"] >= 20]
+    copies = async_[(async_["copyKind"] > 0) & (async_["copyKind"] < 20)]
+    if copies.empty:
+        copies = sync[(sync["copyKind"] > 0) & (sync["copyKind"] < 20)]
+    moved = pd.concat([coll_rows, copies], ignore_index=True)
+    if moved.empty:
+        features.add("comm_time", 0.0)
+        return
+    rows = []
+    for kind, sel in moved.groupby("copyKind"):
+        kname = CK_NAMES.get(int(kind), str(kind))
+        dur = float(sel["duration"].sum())
+        payload = float(sel["payload"].sum())
+        rows.append(
+            {
+                "copyKind": int(kind),
+                "kind": kname,
+                "count": len(sel),
+                "total_time": dur,
+                "total_bytes": payload,
+                "mean_bandwidth": payload / dur if dur > 0 else 0.0,
+            }
+        )
+        features.add(f"comm_{kname.lower()}_time", dur)
+        features.add(f"comm_{kname.lower()}_bytes", payload)
+    summary = pd.DataFrame(rows).sort_values("total_time", ascending=False)
+    summary.to_csv(cfg.path("comm.csv"), index=False)
+
+    coll = moved[moved["copyKind"] >= 20]
+    comm_time = float(coll["duration"].sum())
+    features.add("comm_time", comm_time)
+    total = float(df[df["category"] == 0]["duration"].sum())
+    features.add("comm_ratio", comm_time / total if total > 0 else 0.0)
+    if cfg.verbose and not summary.empty:
+        print_title("Data movement by kind")
+        print(summary.to_string(index=False))
+
+    topo = load_topology(cfg)
+    matrix = ici_traffic_matrix(coll, topo)
+    if matrix is not None:
+        matrix.to_csv(cfg.path("ici_matrix.csv"))
+        features.add("ici_est_bytes", float(matrix.to_numpy().sum()))
+
+
+def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.DataFrame]:
+    """Estimate per-link ICI traffic from collective ops.
+
+    Model: ring algorithm over devices ordered by topology coords.  For an
+    all-reduce of payload P over n chips, each chip sends ~2P(n-1)/n to its
+    ring neighbor (reduce-scatter + all-gather phases); all-gather/
+    reduce-scatter send P(n-1)/n; collective-permute and P2P send P along the
+    permute edge (approximated as the ring edge here — the permute pairs are
+    not in XPlane stats).  This replaces the reference's CUPTI P2P matrix
+    (sofa_common.py:97-157) with a model-based estimate, and feeds the mesh
+    advice pass.
+    """
+    if topo is None:
+        return None
+    devices = topo.get("devices", [])
+    n = len(devices)
+    if n < 2 or coll is None or coll.empty:
+        return None
+    order = sorted(devices, key=lambda d: (d.get("coords") or [d["id"]], d.get("core_on_chip", 0)))
+    ids = [d["id"] for d in order]
+    index = {d: i for i, d in enumerate(ids)}
+    mat = np.zeros((n, n))
+    for _, row in coll.iterrows():
+        payload = float(row["payload"])
+        if payload <= 0:
+            continue
+        kind = int(row["copyKind"])
+        if kind == int(CopyKind.ALL_REDUCE):
+            per_link = 2.0 * payload * (n - 1) / n
+        elif kind in (int(CopyKind.ALL_GATHER), int(CopyKind.REDUCE_SCATTER)):
+            per_link = payload * (n - 1) / n
+        elif kind == int(CopyKind.ALL_TO_ALL):
+            per_link = payload * (n - 1) / n
+        else:  # permute / broadcast / p2p
+            per_link = payload
+        # Every ring edge carries per_link bytes (each chip sends that much
+        # to its neighbor).
+        for i in range(n):
+            j = (i + 1) % n
+            mat[i, j] += per_link
+    labels = [f"tpu{d}" for d in ids]
+    _ = index
+    return pd.DataFrame(mat, index=labels, columns=labels)
+
+
+def net_profile(frames, cfg, features: Features) -> None:
+    """Host-network (DCN) packet profile (reference sofa_analyze.py:385-493)."""
+    df = frames.get("nettrace")
+    if df is None or df.empty:
+        return
+    from sofa_tpu.trace import unpack_ip
+
+    features.add("net_packets", len(df))
+    features.add("net_total_bytes", float(df["payload"].sum()))
+    features.add("net_total_time", float(df["duration"].sum()))
+    pairs = (
+        df.groupby(["pkt_src", "pkt_dst"])["payload"]
+        .agg(["sum", "count"])
+        .sort_values("sum", ascending=False)
+        .reset_index()
+    )
+    pairs["src"] = pairs["pkt_src"].map(unpack_ip)
+    pairs["dst"] = pairs["pkt_dst"].map(unpack_ip)
+    pairs[["src", "dst", "sum", "count"]].to_csv(cfg.path("netrank.csv"), index=False)
+
+
+def netbandwidth_profile(frames, cfg, features: Features) -> None:
+    """NIC byte-counter profile (reference sofa_analyze.py:531-594)."""
+    df = frames.get("netbandwidth")
+    if df is None or df.empty:
+        return
+    for direction in ("tx", "rx"):
+        rows = df[df["name"].str.endswith("." + direction)]
+        if rows.empty:
+            continue
+        q = rows["event"].quantile([0.25, 0.5, 0.75])
+        features.add(f"net_{direction}_q1", float(q.loc[0.25]))
+        features.add(f"net_{direction}_median", float(q.loc[0.5]))
+        features.add(f"net_{direction}_q3", float(q.loc[0.75]))
+        features.add(f"net_{direction}_total_bytes", float(rows["payload"].sum()))

@@ -1,0 +1,133 @@
+"""TPU-side analysis: HLO-op profile, module profile, utilization, ROI.
+
+The gpu_profile/nvsmi_profile/spotlight retarget (reference
+sofa_analyze.py:343-377,259-341,875-894): kernel/NCCL attribution becomes
+HLO-category and XLA-collective attribution; SM-utilization ROI detection
+becomes TensorCore-duty-cycle ROI detection.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_hint, print_title, print_warning
+from sofa_tpu.trace import CopyKind
+
+
+def tpu_profile(frames, cfg, features: Features) -> None:
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    sync = df[df["category"] == 0]
+    features.add("tpu_devices", df["deviceId"].nunique())
+    features.add("tpu_ops", len(sync))
+
+    for device_id, rows in sync.groupby("deviceId"):
+        total = float(rows["duration"].sum())
+        features.add(f"tpu{device_id}_op_time", total)
+        kern = rows[rows["copyKind"] == int(CopyKind.KERNEL)]
+        features.add(f"tpu{device_id}_kernel_time", float(kern["duration"].sum()))
+        coll = rows[rows["copyKind"] >= 20]
+        features.add(f"tpu{device_id}_collective_time", float(coll["duration"].sum()))
+
+    features.add("tpu_total_flops", float(sync["flops"].sum()))
+    features.add("tpu_total_bytes_accessed", float(sync["bytes_accessed"].sum()))
+
+    # Top ops by total time (the reference's top-k GPU kernel table).
+    top = (
+        sync.groupby("name")
+        .agg(
+            total_time=("duration", "sum"),
+            count=("duration", "count"),
+            mean_time=("duration", "mean"),
+            flops=("flops", "sum"),
+            bytes_accessed=("bytes_accessed", "sum"),
+        )
+        .sort_values("total_time", ascending=False)
+    )
+    top.head(50).to_csv(cfg.path("tpu_top_ops.csv"))
+    if cfg.verbose and not top.empty:
+        print_title("Top-10 HLO ops by total time")
+        print(top.head(10).to_string())
+
+    # Per-category breakdown (convolution / fusion / all-reduce / ...).
+    cat = sync.assign(
+        cat=sync["hlo_category"].where(sync["hlo_category"] != "", "uncategorized")
+    ).groupby("cat")["duration"].sum().sort_values(ascending=False)
+    for name, value in cat.items():
+        features.add(f"hlo_time_{_slug(name)}", float(value))
+    cat.to_csv(cfg.path("tpu_categories.csv"))
+
+    # Per-module (jit function) totals.
+    mods = frames.get("tpumodules")
+    if mods is not None and not mods.empty:
+        per_mod = mods.groupby("name")["duration"].agg(["sum", "count"])
+        per_mod.to_csv(cfg.path("tpu_modules_summary.csv"))
+        features.add("tpu_module_launches", int(per_mod["count"].sum()))
+
+
+def tpuutil_profile(frames, cfg, features: Features) -> None:
+    df = frames.get("tpuutil")
+    if df is None or df.empty:
+        return
+    for metric in ("tc_util", "mxu_util", "hbm_gbps"):
+        rows = df[df["name"] == metric]
+        if rows.empty:
+            continue
+        features.add(f"{metric}_mean", float(rows["event"].mean()))
+        features.add(f"{metric}_max", float(rows["event"].max()))
+        q = rows["event"].quantile([0.25, 0.5, 0.75])
+        features.add(f"{metric}_median", float(q.loc[0.5]))
+
+
+def spotlight_roi(frames, cfg, features: Features) -> None:
+    """Set cfg.roi_begin/roi_end from TensorCore utilization.
+
+    Hysteresis detector ported from the reference's nvsmi SM-util state
+    machine (sofa_analyze.py:875-894): utilization >= high for `up` windows
+    begins the ROI; < low back to 0 ends it.  Manual --profile_region wins.
+    """
+    if cfg.profile_region:
+        try:
+            begin_s, _, end_s = cfg.profile_region.partition(":")
+            cfg.roi_begin = float(begin_s or 0)
+            cfg.roi_end = float(end_s or 0)
+            features.add("roi_begin", cfg.roi_begin)
+            features.add("roi_end", cfg.roi_end)
+            return
+        except ValueError:
+            print_warning(f"bad --profile_region {cfg.profile_region!r}; ignoring")
+    if not cfg.spotlight:
+        return
+    df = frames.get("tpuutil")
+    if df is None or df.empty:
+        return
+    util = df[df["name"] == "tc_util"].sort_values("timestamp")
+    if util.empty:
+        return
+    high, low, up_count = 50.0, 10.0, 3
+    count = 0
+    begin = end = None
+    t_first = float(util["timestamp"].min() - util["duration"].iloc[0])
+    for _, row in util.iterrows():
+        if row["event"] >= high:
+            count += 1
+            if count >= up_count and begin is None:
+                begin = max(row["timestamp"] - row["duration"] * up_count, t_first)
+        elif row["event"] < low:
+            if begin is not None:  # first drop after the ROI began ends it
+                end = row["timestamp"] - row["duration"]
+                break
+            count = 0
+    if begin is not None:
+        if end is None or end <= begin:
+            end = float(util["timestamp"].max())
+        cfg.roi_begin, cfg.roi_end = begin, end
+        features.add("roi_begin", begin)
+        features.add("roi_end", end)
+        print_hint(f"spotlight ROI: {begin:.3f}s .. {end:.3f}s")
+
+
+def _slug(name: str) -> str:
+    return name.strip().lower().replace(" ", "_").replace("-", "_")

@@ -1,0 +1,138 @@
+"""`sofa analyze` — unified CSVs -> features, hints, reports.
+
+Reads the CSVs preprocess wrote (files-on-disk contract, so analyze re-runs
+standalone), executes every analysis pass with per-pass degradation (the
+reference wraps each in try/except IOError, sofa_analyze.py:873-977), prints
+the feature table, emits hints, stages the board GUI, and prints the
+``Complete!!`` sentinel the reference's test matrix greps for
+(test/test.py:68-75, sofa_analyze.py:1055).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict
+
+import pandas as pd
+
+from sofa_tpu.analysis import advice, comm, concurrency, host, tpu
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import read_misc
+from sofa_tpu.printing import print_progress, print_warning
+from sofa_tpu.trace import empty_frame, read_csv
+
+CSV_SOURCES = [
+    "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
+    "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
+]
+
+_PASSES = [
+    ("spotlight", tpu.spotlight_roi),
+    ("cpu_profile", host.cpu_profile),
+    ("mpstat_profile", host.mpstat_profile),
+    ("vmstat_profile", host.vmstat_profile),
+    ("diskstat_profile", host.diskstat_profile),
+    ("strace_profile", host.strace_profile),
+    ("pystacks_profile", host.pystacks_profile),
+    ("netbandwidth_profile", comm.netbandwidth_profile),
+    ("net_profile", comm.net_profile),
+    ("tpu_profile", tpu.tpu_profile),
+    ("tpuutil_profile", tpu.tpuutil_profile),
+    ("comm_profile", comm.comm_profile),
+    ("concurrency_breakdown", concurrency.concurrency_breakdown),
+    ("mesh_advice", advice.mesh_advice),
+]
+
+
+def load_frames(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
+    frames: Dict[str, pd.DataFrame] = {}
+    for name in CSV_SOURCES:
+        path = cfg.path(f"{name}.csv")
+        if os.path.isfile(path):
+            try:
+                frames[name] = read_csv(path)
+            except Exception as e:  # noqa: BLE001
+                print_warning(f"analyze: cannot read {path}: {e}")
+                frames[name] = empty_frame()
+        else:
+            frames[name] = empty_frame()
+    return frames
+
+
+def sofa_analyze(cfg: SofaConfig) -> Features:
+    frames = load_frames(cfg)
+    features = Features()
+    misc = read_misc(cfg)
+    features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))
+
+    for name, fn in _PASSES:
+        try:
+            fn(frames, cfg, features)
+        except Exception as e:  # noqa: BLE001 — per-pass degradation
+            print_warning(f"analyze pass {name}: {e}")
+
+    print(features.render())
+    features.save(cfg.path("features.csv"))
+
+    # Remote advice service, when configured (hint_service is optional).
+    if cfg.hint_server:
+        try:
+            from sofa_tpu.analysis.hint_service import request_hints
+
+            for hint in request_hints(cfg.hint_server, features):
+                from sofa_tpu.printing import print_hint
+
+                print_hint(f"[remote] {hint}")
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"hint server {cfg.hint_server}: {e}")
+    advice.hint_report(features, cfg)
+
+    stage_board(cfg)
+    print("Complete!!")
+    return features
+
+
+def stage_board(cfg: SofaConfig) -> None:
+    """Copy the board GUI beside the data (reference sofa_analyze.py:1050-1052)."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "board")
+    if not os.path.isdir(src):
+        return
+    for name in os.listdir(src):
+        shutil.copy2(os.path.join(src, name), cfg.path(name))
+
+
+def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
+    """Multi-host report: aggregate per-host logdirs ``<logdir>-<host>/``.
+
+    Reference: cluster_analyze (sofa_analyze.py:1057-1137) — per-IP logdirs
+    merged into cluster tables.
+    """
+    import copy as _copy
+
+    results: Dict[str, Features] = {}
+    rows = []
+    for hostname in cfg.cluster_hosts:
+        host_cfg = _copy.deepcopy(cfg)
+        host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
+        host_cfg.__post_init__()
+        if not os.path.isdir(host_cfg.logdir):
+            print_warning(f"cluster: missing logdir {host_cfg.logdir}")
+            continue
+        print_progress(f"cluster: analyzing {hostname}")
+        results[hostname] = sofa_analyze(host_cfg)
+        row = {"host": hostname}
+        for key in ("elapsed_time", "cpu_util", "tpu0_op_time", "comm_ratio",
+                    "net_tx_total_bytes", "net_rx_total_bytes", "tc_util_mean"):
+            value = results[hostname].get(key)
+            if value is not None:
+                row[key] = value
+        rows.append(row)
+    if rows:
+        summary = pd.DataFrame(rows)
+        os.makedirs(cfg.logdir, exist_ok=True)
+        summary.to_csv(cfg.path("cluster_summary.csv"), index=False)
+        print_progress("cluster summary:")
+        print(summary.to_string(index=False))
+    return results

@@ -1,0 +1,109 @@
+"""Pure-Python pcap ingest for DCN/host network traffic.
+
+The reference shells out to `tcpdump -r` and scrapes its text output
+(/root/reference/bin/sofa_preprocess.py:1187-1233); parsing the pcap file
+directly removes the tcpdump dependency at report time (the capture machine
+and the analysis machine are often different).
+
+Supports classic pcap (µs and ns magic, both endians) with link types
+Ethernet(1), RAW-IP(101), Linux SLL(113) and SLL2(276) — tcpdump -i any
+writes SLL/SLL2.  IPv4 TCP/UDP packets become rows:
+
+  payload  = captured original length (bytes)
+  pkt_src/dst = packed IPv4 (trace.packed_ip encoding)
+  duration = payload / 128 MB/s — the reference's fixed service-rate model
+             (sofa_preprocess.py:178-179), kept for comparability
+  name     = "proto sport->dport"
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional
+
+import pandas as pd
+
+from sofa_tpu.trace import empty_frame, make_frame
+
+_NET_MODEL_BYTES_PER_S = 128e6
+
+_MAGICS = {
+    0xA1B2C3D4: ("<", 1e-6), 0xD4C3B2A1: (">", 1e-6),
+    0xA1B23C4D: ("<", 1e-9), 0x4D3CB2A1: (">", 1e-9),
+}
+
+
+def _ipv4_row(ts: float, data: bytes, orig_len: int, time_base: float) -> Optional[dict]:
+    if len(data) < 20 or (data[0] >> 4) != 4:
+        return None
+    ihl = (data[0] & 0x0F) * 4
+    proto = data[9]
+    src = ".".join(str(b) for b in data[12:16])
+    dst = ".".join(str(b) for b in data[16:20])
+    sport = dport = 0
+    pname = {6: "tcp", 17: "udp"}.get(proto, str(proto))
+    if proto in (6, 17) and len(data) >= ihl + 4:
+        sport, dport = struct.unpack("!HH", data[ihl:ihl + 4])
+    from sofa_tpu.trace import packed_ip
+
+    return {
+        "timestamp": ts - time_base,
+        "event": float(dport or proto),
+        "duration": orig_len / _NET_MODEL_BYTES_PER_S,
+        "payload": orig_len,
+        "bandwidth": _NET_MODEL_BYTES_PER_S,
+        "pkt_src": packed_ip(src),
+        "pkt_dst": packed_ip(dst),
+        "name": f"{pname} {src}:{sport}->{dst}:{dport}",
+        "device_kind": "net",
+    }
+
+
+def parse_pcap_bytes(blob: bytes, time_base: float = 0.0) -> pd.DataFrame:
+    if len(blob) < 24:
+        return empty_frame()
+    magic = struct.unpack("<I", blob[:4])[0]
+    if magic not in _MAGICS:
+        magic = struct.unpack(">I", blob[:4])[0]
+    if magic not in _MAGICS:
+        return empty_frame()
+    endian, tick = _MAGICS[magic]
+    linktype = struct.unpack(endian + "I", blob[20:24])[0] & 0x0FFFFFFF
+    rows: List[dict] = []
+    off = 24
+    n = len(blob)
+    while off + 16 <= n:
+        ts_sec, ts_frac, incl, orig = struct.unpack(endian + "IIII", blob[off:off + 16])
+        off += 16
+        if off + incl > n:
+            break
+        data = blob[off:off + incl]
+        off += incl
+        ts = ts_sec + ts_frac * tick
+        ip: Optional[bytes] = None
+        if linktype == 1 and len(data) >= 14:  # Ethernet
+            ethertype = struct.unpack("!H", data[12:14])[0]
+            if ethertype == 0x0800:
+                ip = data[14:]
+        elif linktype == 101:  # raw IP
+            ip = data
+        elif linktype == 113 and len(data) >= 16:  # Linux cooked (SLL)
+            if struct.unpack("!H", data[14:16])[0] == 0x0800:
+                ip = data[16:]
+        elif linktype == 276 and len(data) >= 20:  # SLL2
+            if struct.unpack("!H", data[0:2])[0] == 0x0800:
+                ip = data[20:]
+        if ip is None:
+            continue
+        row = _ipv4_row(ts, ip, orig, time_base)
+        if row:
+            rows.append(row)
+    return make_frame(rows) if rows else empty_frame()
+
+
+def ingest_pcap(path: str, time_base: float = 0.0) -> pd.DataFrame:
+    if not os.path.isfile(path):
+        return empty_frame()
+    with open(path, "rb") as f:
+        return parse_pcap_bytes(f.read(), time_base)

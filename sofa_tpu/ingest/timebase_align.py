@@ -1,0 +1,48 @@
+"""Clock-domain conversion built from timebase.txt.
+
+timebase.txt rows are simultaneous (realtime, monotonic, boottime,
+monotonic_raw) nanosecond samples (sofa_tpu/native/timebase.cc).  A linear
+fit (offset only — the domains tick at the same rate within a run) converts
+any of those clocks into unix time, replacing the reference's
+perf_timebase.txt parsing (/root/reference/bin/sofa_preprocess.py:1765-1784).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+CLOCKS = {"realtime": 0, "monotonic": 1, "boottime": 2, "monotonic_raw": 3}
+
+
+def load_timebase(path: str) -> Optional[np.ndarray]:
+    if not os.path.isfile(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            p = line.split()
+            if len(p) == 4:
+                try:
+                    rows.append([int(v) for v in p])
+                except ValueError:
+                    continue
+    if not rows:
+        return None
+    return np.array(rows, dtype=np.int64)
+
+
+def converter(path: str, source_clock: str = "monotonic") -> Optional[Callable[[float], float]]:
+    """Return f(seconds in source clock) -> unix seconds, or None."""
+    table = load_timebase(path)
+    if table is None:
+        return None
+    col = CLOCKS[source_clock]
+    offset_ns = float(np.mean(table[:, 0] - table[:, col]))
+
+    def f(t_s: float) -> float:
+        return t_s + offset_ns / 1e9
+
+    return f

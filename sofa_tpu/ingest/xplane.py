@@ -1,0 +1,351 @@
+"""XSpace/XPlane trace ingest — the TPU replacement for nvprof CSV parsing.
+
+The reference shells out to `nvprof --csv --print-gpu-trace` and reads CUPTI
+sqlite tables (/root/reference/bin/sofa_preprocess.py:1339-1456); here we
+parse the XSpace protobuf that jax.profiler writes
+(logdir/xprof/plugins/profile/<run>/<host>.xplane.pb) with bindings generated
+from the public xplane.proto schema (sofa_tpu/native/xplane.proto).
+
+Plane semantics (observed from jax.profiler on TPU v5e):
+  /device:TPU:N    — device planes; lines "XLA Modules" (jit program spans,
+                     one event per executed module), "XLA Ops" (per-HLO-op
+                     timeline on the TensorCore), "Async XLA Ops" (DMA /
+                     async copies), "TC Overlay".
+  /host:CPU        — host runtime + python tracer events, one line per thread.
+  plane stats carry peak_teraflops_per_second / peak_hbm_bw_gigabytes_per_second
+  (used for MXU/HBM utilization percentages).
+
+Event time = line.timestamp_ns + event.offset_ps/1e3, in a per-session clock.
+Clock alignment: the injected TraceAnnotation ``sofa_timebase_marker:<unix_ns>``
+(collectors/xprof.py) appears on a host line; unix_offset = its encoded unix
+time minus its session time.  This replaces the reference's cuhello
+known-kernel trick (sofa_preprocess.py:1557-1616).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.ingest import xplane_pb2
+from sofa_tpu.printing import print_info, print_warning
+from sofa_tpu.trace import CopyKind, classify_hlo_kind, empty_frame, make_frame
+
+_MARKER_RE = re.compile(r"sofa_timebase_marker:(\d+)")
+_DEVICE_RE = re.compile(r"/device:TPU:(\d+)")
+_MODULE_NAME_RE = re.compile(r"^(.*?)\(\d+\)$")
+
+
+def find_xplane_files(xprof_dir: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(xprof_dir, "plugins", "profile", "*", "*.xplane.pb")))
+
+
+def load_xspace(path: str) -> xplane_pb2.XSpace:
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def _stat_value(stat, stat_meta) -> Tuple[str, object]:
+    name = stat_meta.get(stat.metadata_id)
+    name = name.name if name is not None else str(stat.metadata_id)
+    which = stat.WhichOneof("value")
+    return name, getattr(stat, which) if which else None
+
+
+def _event_stats(ev, stat_meta) -> Dict[str, object]:
+    return dict(_stat_value(s, stat_meta) for s in ev.stats)
+
+
+def find_marker_offset_ns(xspace) -> Optional[int]:
+    """unix_ns - session_ns, from the injected marker annotation."""
+    for plane in xspace.planes:
+        if not plane.name.startswith("/host:"):
+            continue
+        marker_ids = {}
+        for mid, meta in plane.event_metadata.items():
+            m = _MARKER_RE.search(meta.name)
+            if m:
+                marker_ids[mid] = int(m.group(1))
+        if not marker_ids:
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                if ev.metadata_id in marker_ids:
+                    session_ns = line.timestamp_ns + ev.offset_ps // 1000
+                    return marker_ids[ev.metadata_id] - session_ns
+    return None
+
+
+def _iter_line_events(plane, line) -> Iterable[Tuple[str, str, int, int, Dict]]:
+    """Yield (name, display_name, start_ns, dur_ns, stats) per event."""
+    em = plane.event_metadata
+    sm = plane.stat_metadata
+    base_ns = line.timestamp_ns
+    for ev in line.events:
+        meta = em.get(ev.metadata_id)
+        name = meta.name if meta is not None else ""
+        disp = meta.display_name if meta is not None and meta.display_name else name
+        start_ns = base_ns + ev.offset_ps // 1000
+        dur_ns = ev.duration_ps // 1000
+        yield name, disp, start_ns, dur_ns, _event_stats(ev, sm)
+
+
+def device_plane_meta(plane) -> Dict[str, float]:
+    sm = plane.stat_metadata
+    out = {}
+    for stat in plane.stats:
+        name, value = _stat_value(stat, sm)
+        if isinstance(value, (int, float)):
+            out[name] = float(value)
+    return out
+
+
+def xspace_to_frames(
+    xspace,
+    time_base: float,
+    offset_ns: Optional[int] = None,
+    host: str = "",
+    device_id_base: int = 0,
+) -> Dict[str, pd.DataFrame]:
+    """Convert one XSpace into unified-schema frames.
+
+    Returns keys: tputrace (HLO ops, sync category=0 / async category=2),
+    tpumodules, hosttrace, and device_meta (plane peak-rate stats as a
+    plain dict under key "_meta").
+    """
+    if offset_ns is None:
+        offset_ns = find_marker_offset_ns(xspace)
+    if offset_ns is None:
+        # Degraded alignment: assume the session clock started at the run's
+        # time base. Better than dropping the trace; flagged for the report.
+        print_warning(
+            "xplane: no sofa_timebase_marker found — device timeline aligned "
+            "to record start only (clock skew possible)"
+        )
+        offset_ns = int(time_base * 1e9)
+
+    def to_rel_s(session_ns: int) -> float:
+        return (session_ns + offset_ns) / 1e9 - time_base
+
+    op_rows: List[dict] = []
+    module_rows: List[dict] = []
+    host_rows: List[dict] = []
+    meta: Dict[str, Dict[str, float]] = {}
+
+    for plane in xspace.planes:
+        dev_match = _DEVICE_RE.match(plane.name)
+        if dev_match:
+            # Offset per-host ordinals so multi-host ingest never merges
+            # distinct chips (host i contributes ids i*256 + local ordinal).
+            device_id = device_id_base + int(dev_match.group(1))
+            meta[str(device_id)] = device_plane_meta(plane)
+            module_spans: List[Tuple[float, float, str]] = []
+            for line in plane.lines:
+                if line.name == "XLA Modules":
+                    for name, disp, start_ns, dur_ns, stats in _iter_line_events(plane, line):
+                        mod_match = _MODULE_NAME_RE.match(name)
+                        mod = mod_match.group(1) if mod_match else name
+                        t = to_rel_s(start_ns)
+                        d = dur_ns / 1e9
+                        module_spans.append((t, t + d, mod))
+                        module_rows.append(
+                            {
+                                "timestamp": t,
+                                "event": float(stats.get("run_id", 0) or 0),
+                                "duration": d,
+                                "deviceId": device_id,
+                                "pid": int(stats.get("program_id", -1) or -1),
+                                "name": mod,
+                                "module": mod,
+                                "device_kind": "tpu",
+                            }
+                        )
+            module_spans.sort()
+            span_starts = np.array([s[0] for s in module_spans])
+
+            def module_at(t: float) -> str:
+                if not module_spans:
+                    return ""
+                i = int(np.searchsorted(span_starts, t, side="right")) - 1
+                if i >= 0 and t < module_spans[i][1] + 1e-9:
+                    return module_spans[i][2]
+                return ""
+
+            for line in plane.lines:
+                if line.name not in ("XLA Ops", "Async XLA Ops"):
+                    continue
+                category = 0 if line.name == "XLA Ops" else 2
+                for idx, (name, disp, start_ns, dur_ns, stats) in enumerate(
+                    _iter_line_events(plane, line)
+                ):
+                    hlo_cat = str(stats.get("hlo_category", "") or "")
+                    kind = classify_hlo_kind(disp, hlo_cat)
+                    dur_s = dur_ns / 1e9
+                    nbytes = int(stats.get("bytes_accessed", 0) or 0)
+                    t = to_rel_s(start_ns)
+                    op_rows.append(
+                        {
+                            "timestamp": t,
+                            "event": float(idx),
+                            "duration": dur_s,
+                            "deviceId": device_id,
+                            "copyKind": int(kind),
+                            "payload": nbytes if kind != CopyKind.KERNEL else 0,
+                            "bandwidth": (nbytes / dur_s) if dur_s > 0 else 0.0,
+                            "name": disp,
+                            "category": category,
+                            "device_kind": "tpu",
+                            "hlo_category": hlo_cat,
+                            "module": module_at(t),
+                            "flops": float(stats.get("flops", 0) or 0),
+                            "bytes_accessed": float(nbytes),
+                        }
+                    )
+        elif plane.name.startswith("/host:") and "metadata" not in plane.name:
+            for line in plane.lines:
+                thread_name = line.name or str(line.id)
+                for name, disp, start_ns, dur_ns, stats in _iter_line_events(plane, line):
+                    if _MARKER_RE.search(name):
+                        continue
+                    host_rows.append(
+                        {
+                            "timestamp": to_rel_s(start_ns),
+                            "event": float(len(name) % 97),
+                            "duration": dur_ns / 1e9,
+                            "pid": -1,
+                            "tid": int(line.id),
+                            "name": disp,
+                            "device_kind": "host",
+                            "module": thread_name,
+                        }
+                    )
+
+    frames = {
+        "tputrace": make_frame(op_rows) if op_rows else empty_frame(),
+        "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),
+        "hosttrace": make_frame(host_rows) if host_rows else empty_frame(),
+    }
+    frames["_meta"] = meta  # type: ignore[assignment]
+    return frames
+
+
+def tpu_utilization(
+    tputrace: pd.DataFrame,
+    window_s: float = 0.1,
+    device_meta: Optional[Dict[str, Dict[str, float]]] = None,
+) -> pd.DataFrame:
+    """Windowed device-utilization series derived from the op timeline — the
+    nvidia-smi analogue (reference nvsmi collector, sofa_record.py:300-310).
+
+    Per device and window emits:
+      tc_util   — % of window covered by TensorCore ops (interval union)
+      hbm_gbps  — bytes_accessed rate, GB/s
+      mxu_util  — % of plane-reported peak FLOP/s
+    """
+    if tputrace.empty:
+        return empty_frame()
+    rows = []
+    for device_id, df in tputrace.groupby("deviceId"):
+        sync = df[df["category"] == 0]
+        if sync.empty:
+            continue
+        starts = sync["timestamp"].to_numpy(dtype=float)
+        ends = starts + sync["duration"].to_numpy(dtype=float)
+        t0 = float(starts.min())
+        t1 = float(ends.max())
+        edges = np.arange(t0, t1 + window_s, window_s)
+        # Merge intervals (ops can nest/overlap across fusions).
+        order = np.argsort(starts)
+        merged: List[List[float]] = []
+        for s, e in zip(starts[order], ends[order]):
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        marr = np.array(merged)
+        flops = sync["flops"].to_numpy(dtype=float)
+        nbytes = sync["bytes_accessed"].to_numpy(dtype=float)
+        durs = np.maximum(ends - starts, 1e-12)
+        peaks = (device_meta or {}).get(str(device_id), {})
+        peak_flops = peaks.get("peak_teraflops_per_second", 0.0) * 1e12
+        for w0, w1 in zip(edges[:-1], edges[1:]):
+            lo = np.clip(marr[:, 0], w0, w1)
+            hi = np.clip(marr[:, 1], w0, w1)
+            busy = float(np.maximum(hi - lo, 0).sum())
+            # Pro-rate op flops/bytes into the window by overlap fraction.
+            olo = np.clip(starts, w0, w1)
+            ohi = np.clip(ends, w0, w1)
+            frac = np.maximum(ohi - olo, 0) / durs
+            wflops = float((flops * frac).sum())
+            wbytes = float((nbytes * frac).sum())
+            wlen = w1 - w0
+            rows.append(
+                {
+                    "timestamp": w1, "event": 100.0 * busy / wlen,
+                    "duration": wlen, "deviceId": int(device_id),
+                    "name": "tc_util", "device_kind": "tpu",
+                }
+            )
+            rows.append(
+                {
+                    "timestamp": w1, "event": wbytes / wlen / 1e9,
+                    "duration": wlen, "deviceId": int(device_id),
+                    "name": "hbm_gbps", "bandwidth": wbytes / wlen,
+                    "device_kind": "tpu",
+                }
+            )
+            if peak_flops > 0:
+                rows.append(
+                    {
+                        "timestamp": w1,
+                        "event": 100.0 * (wflops / wlen) / peak_flops,
+                        "duration": wlen, "deviceId": int(device_id),
+                        "name": "mxu_util", "device_kind": "tpu",
+                    }
+                )
+    return make_frame(rows)
+
+
+def ingest_xprof_dir(
+    xprof_dir: str, time_base: float, window_s: float = 0.1
+) -> Dict[str, pd.DataFrame]:
+    """Ingest every XSpace under an xprof dir, concatenating multi-host files."""
+    paths = find_xplane_files(xprof_dir)
+    if not paths:
+        return {}
+    all_frames: Dict[str, List[pd.DataFrame]] = {
+        "tputrace": [], "tpumodules": [], "hosttrace": []
+    }
+    meta: Dict[str, Dict[str, float]] = {}
+    for host_index, path in enumerate(paths):
+        host = os.path.basename(path).replace(".xplane.pb", "")
+        print_info(f"xplane: ingesting {path}")
+        try:
+            xspace = load_xspace(path)
+        except Exception as e:  # noqa: BLE001 — a corrupt trace must not kill the report
+            print_warning(f"xplane: cannot parse {path}: {e}")
+            continue
+        frames = xspace_to_frames(
+            xspace, time_base, host=host, device_id_base=host_index * 256
+        )
+        meta.update(frames.pop("_meta", {}))  # type: ignore[arg-type]
+        for key, df in frames.items():
+            if not df.empty:
+                all_frames[key].append(df)
+    out: Dict[str, pd.DataFrame] = {}
+    for key, dfs in all_frames.items():
+        out[key] = (
+            pd.concat(dfs, ignore_index=True).sort_values("timestamp").reset_index(drop=True)
+            if dfs
+            else empty_frame()
+        )
+    out["tpuutil"] = tpu_utilization(out["tputrace"], window_s, meta)
+    out["_meta"] = meta  # type: ignore[assignment]
+    return out

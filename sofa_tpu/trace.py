@@ -138,7 +138,7 @@ def classify_hlo_kind(name: str, category: str = "") -> CopyKind:
         return CopyKind.D2H
     if "send" in text.split() or text.startswith("send") or "recv" in text.split() or text.startswith("recv"):
         return CopyKind.P2P
-    if text.startswith("copy") or " copy " in text:
+    if text.startswith(("copy", "async-copy")) or " copy " in text:
         return CopyKind.D2D
     return CopyKind.KERNEL
 

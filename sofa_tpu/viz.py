@@ -1,0 +1,54 @@
+"""`sofa viz` — serve the board GUI over the logdir.
+
+Like the reference (sofa_viz.py:18) this is just an HTTP file server rooted
+at logdir (analyze stages the board HTML/JS there), but embedded so we can
+bind/port-retry and print the URL.
+"""
+
+from __future__ import annotations
+
+import functools
+import http.server
+import os
+import socketserver
+
+from sofa_tpu.printing import print_error, print_progress
+
+
+class _QuietHandler(http.server.SimpleHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+
+def sofa_viz(cfg, serve_forever: bool = True):
+    if not os.path.isdir(cfg.logdir):
+        print_error(f"logdir {cfg.logdir} does not exist")
+        return None
+    handler = functools.partial(_QuietHandler, directory=cfg.logdir)
+    socketserver.TCPServer.allow_reuse_address = True
+    httpd = None
+    last_err = None
+    for port_try in range(cfg.viz_port, cfg.viz_port + 20):
+        try:
+            httpd = socketserver.TCPServer(("", port_try), handler)
+            break
+        except OSError as e:
+            last_err = e
+    if httpd is None:
+        print_error(
+            f"cannot bind a port in {cfg.viz_port}..{cfg.viz_port + 19}: {last_err}"
+        )
+        return None
+    port = httpd.server_address[1]
+    print_progress(
+        f"serving {cfg.logdir} at http://localhost:{port}/ (Ctrl-C stops)"
+    )
+    if serve_forever:
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return None
+    return httpd

@@ -1,0 +1,168 @@
+import os
+
+import pandas as pd
+import pytest
+
+from sofa_tpu.analysis import advice, comm, concurrency, tpu
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.trace import CopyKind, make_frame
+
+
+@pytest.fixture
+def cfg(logdir):
+    return SofaConfig(logdir=logdir)
+
+
+def tpu_frame():
+    rows = []
+    t = 0.0
+    for i in range(10):
+        rows.append({"timestamp": t, "duration": 0.008, "deviceId": 0,
+                     "copyKind": int(CopyKind.KERNEL), "name": f"fusion.{i}",
+                     "hlo_category": "convolution", "flops": 1e9,
+                     "bytes_accessed": 1e6, "device_kind": "tpu"})
+        t += 0.008
+        rows.append({"timestamp": t, "duration": 0.002, "deviceId": 0,
+                     "copyKind": int(CopyKind.ALL_REDUCE), "name": "all-reduce.1",
+                     "hlo_category": "all-reduce", "payload": int(4e6),
+                     "bytes_accessed": 4e6, "device_kind": "tpu"})
+        t += 0.002
+    return make_frame(rows)
+
+
+def test_tpu_profile_and_comm(cfg):
+    frames = {"tputrace": tpu_frame(), "tpumodules": make_frame(
+        [{"timestamp": 0.0, "duration": 0.1, "deviceId": 0, "name": "jit_step"}])}
+    f = Features()
+    tpu.tpu_profile(frames, cfg, f)
+    comm.comm_profile(frames, cfg, f)
+    assert f.get("tpu_devices") == 1
+    assert f.get("tpu0_kernel_time") == pytest.approx(0.08)
+    assert f.get("tpu0_collective_time") == pytest.approx(0.02)
+    assert f.get("comm_ratio") == pytest.approx(0.2)
+    assert f.get("comm_all_reduce_bytes") == pytest.approx(4e7)
+    assert os.path.isfile(cfg.path("tpu_top_ops.csv"))
+    assert os.path.isfile(cfg.path("comm.csv"))
+    assert f.get("hlo_time_convolution") == pytest.approx(0.08)
+
+
+def test_ici_matrix_ring_model():
+    coll = make_frame([
+        {"timestamp": 0.0, "duration": 1e-3, "copyKind": int(CopyKind.ALL_REDUCE),
+         "payload": 8_000_000, "name": "all-reduce.0"},
+    ])
+    topo = {"devices": [{"id": i, "coords": [i, 0, 0]} for i in range(4)]}
+    mat = comm.ici_traffic_matrix(coll, topo)
+    assert mat is not None
+    # all-reduce of 8 MB over 4 chips: each of the 4 ring edges carries
+    # 2*P*(n-1)/n = 12 MB.
+    assert mat.to_numpy().max() == pytest.approx(12e6)
+    assert mat.to_numpy().sum() == pytest.approx(48e6)
+    assert comm.ici_traffic_matrix(coll, None) is None
+
+
+def test_spotlight_roi(cfg):
+    rows = []
+    for i in range(40):
+        util = 90.0 if 10 <= i < 30 else 1.0
+        rows.append({"timestamp": 0.1 * i, "duration": 0.1, "event": util,
+                     "deviceId": 0, "name": "tc_util", "device_kind": "tpu"})
+    frames = {"tpuutil": make_frame(rows)}
+    cfg.spotlight = True
+    f = Features()
+    tpu.spotlight_roi(frames, cfg, f)
+    assert 0 < cfg.roi_begin < cfg.roi_end
+    assert cfg.roi_begin == pytest.approx(1.0, abs=0.35)
+    assert cfg.roi_end == pytest.approx(3.0, abs=0.25)
+
+
+def test_profile_region_manual(cfg):
+    cfg.profile_region = "1.5:2.5"
+    f = Features()
+    tpu.spotlight_roi({}, cfg, f)
+    assert cfg.roi_begin == 1.5 and cfg.roi_end == 2.5
+
+
+def test_concurrency_breakdown(cfg):
+    mp_rows = []
+    for i in range(20):
+        for metric, val in (("usr", 80.0 if i < 10 else 5.0),
+                            ("sys", 5.0), ("iow", 1.0 if i < 10 else 60.0),
+                            ("idl", 14.0)):
+            mp_rows.append({"timestamp": 0.1 * i, "duration": 0.1, "event": val,
+                            "deviceId": -1, "name": metric})
+    frames = {"mpstat": make_frame(mp_rows)}
+    f = Features()
+    concurrency.concurrency_breakdown(frames, cfg, f)
+    assert f.get("elapsed_usr_ratio") == pytest.approx(0.5, abs=0.15)
+    assert f.get("elapsed_iow_ratio") == pytest.approx(0.5, abs=0.15)
+    assert os.path.isfile(cfg.path("performance.csv"))
+    perf = pd.read_csv(cfg.path("performance.csv"))
+    assert {"class", "usr", "tpu_util"} <= set(perf.columns)
+
+
+def test_mesh_advice(cfg):
+    import json
+
+    topo = {"devices": [{"id": i, "coords": [i % 2, i // 2, 0],
+                         "core_on_chip": 0} for i in range(8)],
+            "device_count": 8}
+    with open(cfg.path("tpu_topo.json"), "w") as fjson:
+        json.dump(topo, fjson)
+    f = Features()
+    advice.mesh_advice({}, cfg, f)
+    text = open(cfg.path("sofa_hints/mesh_advice.txt")).read()
+    assert "device_count = 8" in text
+    assert "(2, 4)" in text or "(4, 2)" in text  # most-square mesh wins
+    assert "ici_ring_order" in text
+
+
+def test_hint_rules():
+    f = Features()
+    f.add("comm_ratio", 0.4)
+    f.add("tpu_ops", 100)
+    f.add("mxu_util_mean", 5.0)
+    f.add("elapsed_iow_ratio", 0.5)
+    hints = advice.generate_hints(f, SofaConfig())
+    text = " ".join(hints)
+    assert "communication-bound" in text
+    assert "MXU utilization is low" in text
+    assert "I/O-wait" in text
+
+
+def test_analyze_end_to_end(logdir, capsys):
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.record import sofa_record
+
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=50)
+    sofa_record("sleep 0.3", cfg)
+    sofa_preprocess(cfg)
+    features = sofa_analyze(cfg)
+    out = capsys.readouterr().out
+    assert "Complete!!" in out            # the e2e sentinel (reference test/test.py:75)
+    assert "Final Performance Features" in out
+    assert features.get("elapsed_time") >= 0.3
+    assert features.get("num_cores") >= 1
+    assert os.path.isfile(cfg.path("features.csv"))
+    assert os.path.isfile(cfg.path("index.html"))  # board staged
+
+
+def test_cluster_analyze(tmp_path):
+    from sofa_tpu.analyze import cluster_analyze
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.record import sofa_record
+
+    base = str(tmp_path / "clog")
+    hosts = ["host1", "host2"]
+    for h in hosts:
+        cfg = SofaConfig(logdir=f"{base}-{h}/", enable_xprof=False, sys_mon_rate=50)
+        sofa_record("sleep 0.2", cfg)
+        sofa_preprocess(cfg)
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=hosts)
+    results = cluster_analyze(cfg)
+    assert set(results) == set(hosts)
+    summary = pd.read_csv(cfg.path("cluster_summary.csv"))
+    assert list(summary["host"]) == hosts
+    assert (summary["elapsed_time"] >= 0.2).all()

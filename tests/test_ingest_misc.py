@@ -1,0 +1,129 @@
+import math
+import struct
+
+import pytest
+
+from sofa_tpu.ingest.pcap import parse_pcap_bytes
+from sofa_tpu.ingest.perf_script import parse_perf_script
+from sofa_tpu.ingest.strace_parse import parse_pystacks, parse_strace
+from sofa_tpu.ingest.timebase_align import converter
+from sofa_tpu.trace import packed_ip
+
+PERF_SCRIPT_FIXTURE = """\
+# comm pid/tid cpu time period event ip sym dso
+python 1234/1234 [000] 100.500000: 1010101 cycles: ffffffff81000000 do_syscall_64+0x20 ([kernel.kallsyms])
+python 1234/1235 [001] 100.510000: 2020202 cycles: 00007f0000001000 PyEval_EvalFrameDefault+0x1b3 (/usr/bin/python3.12)
+swapper 0/0 [000] 100.520000: 999 cycles: ffffffff81234567 [unknown] ([kernel.kallsyms])
+garbage line that should be ignored
+"""
+
+
+def test_parse_perf_script():
+    df = parse_perf_script(PERF_SCRIPT_FIXTURE, time_base=100.0,
+                           mhz_at=lambda t: 1000.0)
+    assert len(df) == 3
+    row = df.iloc[0]
+    assert row["timestamp"] == pytest.approx(0.5)
+    assert row["deviceId"] == 0
+    assert row["pid"] == 1234
+    assert "do_syscall_64" in row["name"]
+    assert "kernel.kallsyms" in row["name"]
+    # duration = period / MHz*1e6 = 1010101 / 1e9
+    assert row["duration"] == pytest.approx(1010101 / 1e9)
+    # event = log10(ip)
+    assert row["event"] == pytest.approx(math.log10(int("ffffffff81000000", 16)))
+    # [unknown] symbol falls back to the raw address
+    assert df.iloc[2]["name"].startswith("ffffffff81234567")
+
+
+def test_parse_perf_script_clock_bridge():
+    df = parse_perf_script(PERF_SCRIPT_FIXTURE, time_base=1100.0,
+                           mono_to_unix=lambda t: t + 1000.0)
+    assert df.iloc[0]["timestamp"] == pytest.approx(0.5)
+
+
+STRACE_FIXTURE = """\
+77 00:00:01.000000 openat(AT_FDCWD, "/etc/hosts", O_RDONLY) = 3 <0.000123>
+77 00:00:01.100000 clock_gettime(CLOCK_MONOTONIC, {...}) = 0 <0.000004>
+77 00:00:01.200000 read(3, "x"..., 4096) = 4096 <0.000050>
+78 00:00:01.300000 futex(0x7f, FUTEX_WAIT, 0, NULL) = 0 <0.500000>
+77 00:00:01.400000 write(1, "y", 1) = 1 <0.0000001>
+"""
+
+
+def test_parse_strace_noise_and_min_time():
+    df = parse_strace(STRACE_FIXTURE, time_base=0.0, min_time=1e-6, day_origin=0.0)
+    names = [n.split("(")[0] for n in df["name"]]
+    assert "clock_gettime" not in names  # noise list
+    assert "write" not in names          # below min duration
+    assert names == ["openat", "read", "futex"]
+    futex = df[df["pid"] == 78].iloc[0]
+    assert futex["duration"] == pytest.approx(0.5)
+    assert futex["timestamp"] == pytest.approx(1.3)
+
+
+def test_parse_pystacks():
+    text = (
+        "10.5 111 mod.main;mod.step;mod.matmul\n"
+        "10.6 111 mod.main;mod.step\n"
+        "bad line\n"
+    )
+    df = parse_pystacks(text, time_base=10.0)
+    assert len(df) == 2
+    assert df.iloc[0]["name"] == "mod.matmul"
+    assert df.iloc[0]["event"] == 3.0
+    assert df.iloc[0]["module"].startswith("mod.main;")
+
+
+def _pcap(linktype: int, packets):
+    out = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, linktype)
+    for ts, data in packets:
+        out += struct.pack("<IIII", int(ts), int((ts % 1) * 1e6), len(data), len(data))
+        out += data
+    return out
+
+
+def _ipv4(src, dst, proto=6, sport=1234, dport=443, payload=b"x" * 100):
+    hdr = struct.pack(
+        "!BBHHHBBH4s4s", 0x45, 0, 20 + 4 + len(payload), 0, 0, 64, proto, 0,
+        bytes(int(o) for o in src.split(".")),
+        bytes(int(o) for o in dst.split(".")),
+    )
+    l4 = struct.pack("!HH", sport, dport)
+    return hdr + l4 + payload
+
+
+def test_parse_pcap_ethernet():
+    eth = b"\x00" * 12 + struct.pack("!H", 0x0800)
+    pkt = eth + _ipv4("10.0.0.1", "10.0.0.2")
+    df = parse_pcap_bytes(_pcap(1, [(5.25, pkt)]), time_base=5.0)
+    assert len(df) == 1
+    row = df.iloc[0]
+    assert row["pkt_src"] == packed_ip("10.0.0.1")
+    assert row["pkt_dst"] == packed_ip("10.0.0.2")
+    assert row["timestamp"] == pytest.approx(0.25)
+    assert "tcp" in row["name"] and ":443" in row["name"]
+    assert row["duration"] == pytest.approx(row["payload"] / 128e6)
+
+
+def test_parse_pcap_sll():
+    sll = b"\x00" * 14 + struct.pack("!H", 0x0800)
+    pkt = sll + _ipv4("192.168.1.1", "192.168.1.2", proto=17, dport=53)
+    df = parse_pcap_bytes(_pcap(113, [(1.0, pkt)]), time_base=0.0)
+    assert len(df) == 1
+    assert df.iloc[0]["name"].startswith("udp")
+
+
+def test_parse_pcap_garbage():
+    assert parse_pcap_bytes(b"not a pcap at all").empty
+    assert parse_pcap_bytes(b"").empty
+
+
+def test_timebase_converter(tmp_path):
+    p = tmp_path / "timebase.txt"
+    # realtime = monotonic + 1e9 ns exactly
+    rows = [f"{2_000_000_000 + i} {1_000_000_000 + i} 0 0" for i in range(3)]
+    p.write_text("\n".join(rows) + "\n")
+    f = converter(str(p), "monotonic")
+    assert f(1.0) == pytest.approx(2.0)
+    assert converter(str(tmp_path / "missing.txt")) is None

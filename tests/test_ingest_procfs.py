@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from sofa_tpu.ingest import procfs
+
+T0 = 1000.0
+
+
+def _mp(ts, cpu, vals):
+    return f"{ts:.6f} {cpu} " + " ".join(str(v) for v in vals)
+
+
+def test_parse_mpstat_percentages():
+    # 1 s apart: 50 usr jiffies, 25 sys, 25 idle
+    text = "\n".join([
+        _mp(T0, "cpuall", [100, 0, 100, 100, 0, 0, 0, 0]),
+        _mp(T0, "cpu0", [100, 0, 100, 100, 0, 0, 0, 0]),
+        _mp(T0 + 1, "cpuall", [150, 0, 125, 125, 0, 0, 0, 0]),
+        _mp(T0 + 1, "cpu0", [150, 0, 125, 125, 0, 0, 0, 0]),
+    ])
+    df = procfs.parse_mpstat(text, time_base=T0)
+    allcpu = df[df["deviceId"] == -1]
+    usr = allcpu[allcpu["name"] == "usr"].iloc[0]
+    assert usr["event"] == pytest.approx(50.0)
+    assert usr["timestamp"] == pytest.approx(1.0)
+    idl = allcpu[allcpu["name"] == "idl"].iloc[0]
+    assert idl["event"] == pytest.approx(25.0)
+    assert set(df["deviceId"]) == {-1, 0}
+
+
+def test_parse_mpstat_garbage_tolerant():
+    assert procfs.parse_mpstat("bogus\n1.0 cpu0 1 2\n").empty
+
+
+def test_parse_diskstat_rates():
+    # 2048 sectors read in 1 s => 1 MiB/s; 10 reads; 5 ms/read await
+    lines = [
+        f"{T0:.6f} vda 100 4096 500 50 0 0 0",
+        f"{T0 + 1:.6f} vda 110 6144 550 50 0 0 0",
+    ]
+    df = procfs.parse_diskstat("\n".join(lines), time_base=T0)
+    r_bw = df[df["name"] == "vda.r_bw"].iloc[0]
+    assert r_bw["event"] == pytest.approx(2048 * 512)
+    r_iops = df[df["name"] == "vda.r_iops"].iloc[0]
+    assert r_iops["event"] == pytest.approx(10.0)
+    await_ms = df[df["name"] == "vda.r_await_ms"].iloc[0]
+    assert await_ms["event"] == pytest.approx(5.0)
+
+
+def test_parse_diskstat_drops_idle_devices():
+    lines = [
+        f"{T0:.6f} idle0 5 5 5 5 5 5 0",
+        f"{T0 + 1:.6f} idle0 5 5 5 5 5 5 0",
+    ]
+    assert procfs.parse_diskstat("\n".join(lines), time_base=T0).empty
+
+
+def test_parse_netstat_bandwidth():
+    lines = [
+        f"{T0:.6f} eth0 1000 2000 10 20",
+        f"{T0 + 2:.6f} eth0 3000 2000 30 20",
+    ]
+    df = procfs.parse_netstat("\n".join(lines), time_base=T0)
+    rx = df[df["name"] == "eth0.rx"].iloc[0]
+    assert rx["event"] == pytest.approx(1000.0)  # 2000 B / 2 s
+    assert rx["payload"] == 2000
+    tx = df[df["name"] == "eth0.tx"].iloc[0]
+    assert tx["event"] == pytest.approx(0.0)
+
+
+def test_cpuinfo_interpolator():
+    text = f"{T0:.6f} 1000 3000\n{T0 + 10:.6f} 2000 4000\n"
+    df = procfs.parse_cpuinfo(text, time_base=T0)
+    f = procfs.cpu_mhz_interpolator(df)
+    assert f(0.0) == pytest.approx(2000.0)
+    assert f(10.0) == pytest.approx(3000.0)
+    assert f(5.0) == pytest.approx(2500.0)
+
+
+def test_parse_vmstat_with_timestamps():
+    text = (
+        "--procs-- -----memory---------- ---swap-- -----io---- -system-- ------cpu-----\n"
+        " r b swpd free buff cache si so bi bo in cs us sy id wa st "
+        "gu date time\n"
+        # procps prints headers differently; parser keys on the 'r' row:
+        "r b swpd free buff cache si so bi bo in cs us sy id wa st\n"
+        "1 0 0 100 200 300 0 0 5 6 100 200 10 5 84 1 0 2026-07-29 08:00:00\n"
+        "2 0 0 100 200 300 0 0 7 8 110 210 20 6 73 1 0 2026-07-29 08:00:01\n"
+    )
+    df = procfs.parse_vmstat(text, time_base=0.0)
+    bi = df[df["name"] == "vmstat.bi"]
+    assert list(bi["event"]) == [5.0, 7.0]
+    us = df[df["name"] == "vmstat.us"]
+    assert list(us["event"]) == [10.0, 20.0]
+    # timestamps came from the trailing date/time columns
+    assert bi.iloc[1]["timestamp"] - bi.iloc[0]["timestamp"] == pytest.approx(1.0)

@@ -1,0 +1,155 @@
+"""XPlane ingest tests on a synthetic XSpace (no TPU needed)."""
+
+import pytest
+
+from sofa_tpu.ingest import xplane_pb2
+from sofa_tpu.ingest.xplane import (
+    find_marker_offset_ns,
+    tpu_utilization,
+    xspace_to_frames,
+)
+from sofa_tpu.trace import CopyKind
+
+
+def _add_stat(plane, holder, name, value):
+    sid = None
+    for k, v in plane.stat_metadata.items():
+        if v.name == name:
+            sid = k
+    if sid is None:
+        sid = len(plane.stat_metadata) + 1
+        plane.stat_metadata[sid].id = sid
+        plane.stat_metadata[sid].name = name
+    stat = holder.stats.add()
+    stat.metadata_id = sid
+    if isinstance(value, float):
+        stat.double_value = value
+    elif isinstance(value, int):
+        stat.int64_value = value
+    else:
+        stat.str_value = str(value)
+    return stat
+
+
+def _add_event(plane, line, name, offset_ns, dur_ns, display="", stats=()):
+    mid = None
+    for k, v in plane.event_metadata.items():
+        if v.name == name:
+            mid = k
+    if mid is None:
+        mid = len(plane.event_metadata) + 1
+        plane.event_metadata[mid].id = mid
+        plane.event_metadata[mid].name = name
+        if display:
+            plane.event_metadata[mid].display_name = display
+    ev = line.events.add()
+    ev.metadata_id = mid
+    ev.offset_ps = offset_ns * 1000
+    ev.duration_ps = dur_ns * 1000
+    for sname, sval in stats:
+        _add_stat(plane, ev, sname, sval)
+    return ev
+
+
+MARKER_UNIX_NS = 1_700_000_000_000_000_000
+SESSION_MARKER_NS = 1_000_000  # marker occurs 1 ms into the session
+
+
+def build_xspace():
+    xs = xplane_pb2.XSpace()
+    xs.hostnames.append("testhost")
+
+    host = xs.planes.add()
+    host.name = "/host:CPU"
+    hline = host.lines.add()
+    hline.id = 7
+    hline.name = "python"
+    hline.timestamp_ns = 0
+    _add_event(host, hline, f"sofa_timebase_marker:{MARKER_UNIX_NS}",
+               SESSION_MARKER_NS, 1000)
+    _add_event(host, hline, "train_step", 2_000_000, 500_000)
+
+    dev = xs.planes.add()
+    dev.name = "/device:TPU:0"
+    _add_stat(dev, dev, "peak_teraflops_per_second", 100.0)
+    mline = dev.lines.add()
+    mline.name = "XLA Modules"
+    _add_event(dev, mline, "jit_train_step(12345)", 2_000_000, 1_000_000,
+               stats=[("run_id", 1), ("program_id", 9)])
+    oline = dev.lines.add()
+    oline.name = "XLA Ops"
+    _add_event(dev, oline, "%fusion.1 = ...", 2_100_000, 400_000, "fusion.1",
+               stats=[("hlo_category", "convolution"), ("flops", 8_000_000),
+                      ("bytes_accessed", 1_000_000)])
+    _add_event(dev, oline, "%all-reduce.2 = ...", 2_600_000, 200_000,
+               "all-reduce.2",
+               stats=[("hlo_category", "all-reduce"),
+                      ("bytes_accessed", 4_000_000)])
+    return xs
+
+
+TIME_BASE = MARKER_UNIX_NS / 1e9 - 10.0  # marker fired 10 s after record start
+
+
+def test_marker_offset():
+    xs = build_xspace()
+    off = find_marker_offset_ns(xs)
+    assert off == MARKER_UNIX_NS - SESSION_MARKER_NS
+
+
+def test_xspace_to_frames_alignment_and_stats():
+    xs = build_xspace()
+    frames = xspace_to_frames(xs, TIME_BASE)
+    ops = frames["tputrace"]
+    assert len(ops) == 2
+    fusion = ops[ops["name"] == "fusion.1"].iloc[0]
+    # marker at session 1 ms == unix marker time == time_base + 10 s;
+    # fusion starts at session 2.1 ms -> 10.0011 s after time_base.
+    assert fusion["timestamp"] == pytest.approx(10.0011, abs=1e-6)
+    assert fusion["duration"] == pytest.approx(400e-6)
+    assert fusion["copyKind"] == int(CopyKind.KERNEL)
+    assert fusion["hlo_category"] == "convolution"
+    assert fusion["flops"] == 8e6
+    assert fusion["module"] == "jit_train_step"
+
+    ar = ops[ops["name"] == "all-reduce.2"].iloc[0]
+    assert ar["copyKind"] == int(CopyKind.ALL_REDUCE)
+    assert ar["payload"] == 4_000_000
+    assert ar["bandwidth"] == pytest.approx(4_000_000 / 200e-6)
+
+    mods = frames["tpumodules"]
+    assert mods.iloc[0]["name"] == "jit_train_step"
+    assert mods.iloc[0]["pid"] == 9
+
+    host = frames["hosttrace"]
+    assert list(host["name"]) == ["train_step"]  # marker excluded
+    assert frames["_meta"]["0"]["peak_teraflops_per_second"] == 100.0
+
+
+def test_missing_marker_falls_back_to_time_base():
+    xs = build_xspace()
+    # strip the marker event metadata name
+    for plane in xs.planes:
+        for k, v in plane.event_metadata.items():
+            if "sofa_timebase_marker" in v.name:
+                v.name = "not_a_marker"
+    frames = xspace_to_frames(xs, 5.0)
+    ops = frames["tputrace"]
+    # session 2.1 ms aligned to time_base -> timestamp == 0.0021
+    assert ops.iloc[0]["timestamp"] == pytest.approx(0.0021, abs=1e-6)
+
+
+def test_tpu_utilization_windows():
+    xs = build_xspace()
+    frames = xspace_to_frames(xs, TIME_BASE)
+    util = tpu_utilization(frames["tputrace"], window_s=0.001,
+                           device_meta=frames["_meta"])
+    tc = util[util["name"] == "tc_util"]
+    assert not tc.empty
+    # ops cover 600 us of a 1 ms window -> 60 %
+    assert tc["event"].max() == pytest.approx(60.0, rel=0.05)
+    mxu = util[util["name"] == "mxu_util"]
+    # 8 MFLOP in 1 ms = 8 GFLOP/s of a 100 TFLOP/s peak = 0.008 %
+    assert mxu["event"].max() == pytest.approx(0.008, rel=0.05)
+    hbm = util[util["name"] == "hbm_gbps"]
+    assert hbm["event"].max() == pytest.approx(5e6 / 1e-3 / 1e9, rel=0.05)

@@ -1,0 +1,39 @@
+import json
+import os
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import sofa_preprocess
+from sofa_tpu.record import sofa_record
+
+
+def test_preprocess_after_record(logdir):
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=50)
+    sofa_record("sleep 0.3", cfg)
+    frames = sofa_preprocess(cfg)
+    assert not frames["mpstat"].empty
+    for csv in ("mpstat.csv", "netbandwidth.csv", "cputrace.csv", "tputrace.csv"):
+        assert os.path.isfile(cfg.path(csv)), csv
+    text = open(cfg.path("report.js")).read()
+    assert text.startswith("sofa_traces = ")
+    doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+    names = {s["name"] for s in doc["series"]}
+    assert "mpstat" in names
+    assert doc["meta"]["elapsed_time"] >= 0.3
+
+
+def test_preprocess_missing_logdir():
+    cfg = SofaConfig(logdir="/tmp/definitely-not-here-xyz/")
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        sofa_preprocess(cfg)
+
+
+def test_preprocess_empty_logdir(tmp_path):
+    """A logdir with no raw files at all must still produce a report.js."""
+    d = str(tmp_path / "empty") + "/"
+    os.makedirs(d)
+    cfg = SofaConfig(logdir=d)
+    frames = sofa_preprocess(cfg)
+    assert all(df.empty for df in frames.values())
+    assert os.path.isfile(cfg.path("report.js"))
