@@ -73,25 +73,88 @@ def sofa_analyze(cfg: SofaConfig) -> Features:
         except Exception as e:  # noqa: BLE001 — per-pass degradation
             print_warning(f"analyze pass {name}: {e}")
 
+    extra_series = []
+    if cfg.enable_aisi:
+        try:
+            from sofa_tpu.ml.aisi import iteration_series, sofa_aisi
+
+            iters = sofa_aisi(frames, cfg, features)
+            marker = iteration_series(iters)
+            if marker is not None:
+                extra_series.append(marker)
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"aisi: {e}")
+    if cfg.enable_hsg or cfg.enable_swarms:
+        try:
+            from sofa_tpu.ml.hsg import sofa_hsg, swarm_series
+
+            clustered = sofa_hsg(frames, cfg, features)
+            extra_series.extend(swarm_series(clustered, cfg.num_swarms))
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"hsg: {e}")
+    if extra_series:
+        try:
+            _append_report_series(cfg, extra_series)
+        except Exception as e:  # noqa: BLE001 — report.js is not worth aborting for
+            print_warning(f"cannot merge analysis series into report.js: {e}")
+
     print(features.render())
     features.save(cfg.path("features.csv"))
 
-    # Remote advice service, when configured (hint_service is optional).
-    if cfg.hint_server:
-        try:
-            from sofa_tpu.analysis.hint_service import request_hints
+    # Remote advice service, when configured or discoverable from the
+    # environment ($SOFA_HINT_SERVER — the POTATO autodiscovery analogue).
+    try:
+        from sofa_tpu.analysis.hint_service import discover_server, request_hints
 
-            for hint in request_hints(cfg.hint_server, features):
-                from sofa_tpu.printing import print_hint
+        server = discover_server(cfg)
+        if server:
+            from sofa_tpu.printing import print_hint
 
+            for hint in request_hints(server, features):
                 print_hint(f"[remote] {hint}")
-        except Exception as e:  # noqa: BLE001
-            print_warning(f"hint server {cfg.hint_server}: {e}")
+    except Exception as e:  # noqa: BLE001
+        print_warning(f"hint server: {e}")
     advice.hint_report(features, cfg)
 
     stage_board(cfg)
     print("Complete!!")
     return features
+
+
+def _append_report_series(cfg: SofaConfig, series) -> None:
+    """Merge analysis-derived series (iteration markers, swarms) into the
+    report.js preprocess wrote (reference injects these in traces_to_json,
+    sofa_aisi.py:318-345 and sofa_ml.py:289-309)."""
+    import json
+
+    path = cfg.path("report.js")
+    doc = {"series": [], "meta": {}}
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                text = f.read()
+            doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+        except (ValueError, OSError) as e:
+            # Never rewrite a file we could not parse — that would replace
+            # every preprocess-written series with just ours.
+            print_warning(f"cannot merge into report.js (leaving it untouched): {e}")
+            return
+    replace = {s.name for s in series}
+    doc["series"] = [s for s in doc["series"] if s["name"] not in replace]
+    for s in series:
+        doc["series"].append(
+            {
+                "name": s.name,
+                "title": s.title,
+                "color": s.color,
+                "kind": s.kind,
+                "data": s.to_points(cfg.viz_downsample_to),
+            }
+        )
+    with open(path, "w") as f:
+        f.write("sofa_traces = ")
+        json.dump(doc, f)
+        f.write(";\n")
 
 
 def stage_board(cfg: SofaConfig) -> None:

@@ -218,15 +218,17 @@ def main(argv=None) -> int:
                 print_error("diff needs --base_logdir and --match_logdir")
                 return 2
             import copy
+            from sofa_tpu.analysis.features import Features
             from sofa_tpu.ml.diff import sofa_swarm_diff
+            from sofa_tpu.ml.hsg import sofa_hsg
             from sofa_tpu.preprocess import sofa_preprocess
             print_main_progress("SOFA diff")
             for d in (cfg.base_logdir, cfg.match_logdir):
                 c = copy.deepcopy(cfg)
                 c.logdir = d
                 c.__post_init__()
-                c.enable_hsg = True
-                sofa_preprocess(c)
+                frames = sofa_preprocess(c)
+                sofa_hsg(frames, c, Features())  # writes auto_caption.csv
             sofa_swarm_diff(cfg)
             return 0
         if cmd == "viz":

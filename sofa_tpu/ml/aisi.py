@@ -1,0 +1,188 @@
+"""AISI — automatic iteration detection and per-step profiling.
+
+Reference pipeline (sofa_aisi.py:110-136,218-286,413-453): GPU kernel names
+-> symbol string -> suffix-tree repeat mining at num_iterations -> fuzzy
+boundary scan -> KMeans on boundary timestamps -> per-iteration fw/bw/gemm/
+copy/allreduce profile -> compute- vs communication-bound verdict.
+
+TPU retarget: the symbol sequence comes from HLO-op names (or XLA module
+launches, which are already step-granular under jit), repeats are mined with
+the suffix automaton, boundaries are the exact (or fuzzy) occurrence
+positions — no KMeans needed — and the per-step profile attributes time to
+HLO categories and collective kinds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.ml.suffix import SuffixAutomaton, find_occurrences, fuzzy_occurrences
+from sofa_tpu.printing import print_hint, print_progress, print_warning
+from sofa_tpu.trace import CopyKind
+
+COMM_BOUND_RATIO = 0.15  # the reference's verdict threshold (sofa_aisi.py:503-507)
+
+
+def detect_iterations(
+    names: List[str],
+    num_iterations: int,
+    tolerance: int = 2,
+    fuzzy: bool = True,
+) -> Tuple[List[int], int]:
+    """Return (start indices of each detected iteration, pattern length).
+
+    Candidate patterns come from the suffix automaton's overlapping counts,
+    then each is re-verified with a non-overlapping scan: periodic sequences
+    make a k-period pattern "occur" nearly as often as the true period, so
+    the candidate whose non-overlapping count lands closest to the target
+    (best coverage on ties) wins.
+    """
+    if len(names) < num_iterations:
+        return [], 0
+    symbols = {}
+    seq = [symbols.setdefault(n, len(symbols)) for n in names]
+    sa = SuffixAutomaton(seq)
+    candidates = sa.repeat_candidates(num_iterations, tolerance=tolerance)
+    best_occ: List[int] = []
+    best_len = 0
+    best_key = None
+    for start, length, _count in candidates:
+        pattern = seq[start:start + length]
+        occ = find_occurrences(seq, pattern)
+        if abs(len(occ) - num_iterations) > tolerance:
+            continue
+        key = (-abs(len(occ) - num_iterations), length * len(occ), length)
+        if best_key is None or key > best_key:
+            best_key = key
+            best_occ = occ
+            best_len = length
+    if not best_occ and candidates and fuzzy:
+        start, length, _count = candidates[0]
+        best_occ = fuzzy_occurrences(seq, seq[start:start + length], min_ratio=0.9)
+        best_len = length
+    return best_occ, best_len
+
+
+def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
+    """Detect iterations on the busiest TPU device and profile each one.
+
+    Writes iterations.csv; appends per-step features and the
+    compute- vs communication-bound verdict.
+    """
+    source = cfg.iterations_from  # "module" (default) or "op"
+    tputrace = frames.get("tputrace")
+    modules = frames.get("tpumodules")
+    if source == "module" and modules is not None and not modules.empty:
+        seq_df, label = _module_sequence(modules), "module launches"
+    elif tputrace is not None and not tputrace.empty:
+        seq_df, label = _op_sequence(tputrace), "HLO ops"
+    else:
+        return None
+    if seq_df.empty:
+        return None
+
+    names = list(seq_df["name"])
+    starts, pattern_len = detect_iterations(names, cfg.num_iterations)
+    if len(starts) < 2:
+        print_warning(
+            f"aisi: no pattern repeating ~{cfg.num_iterations}x in {label} "
+            f"({len(names)} events)"
+        )
+        return None
+    print_progress(f"aisi: detected {len(starts)} iterations over {label}")
+
+    ts = seq_df["timestamp"].to_numpy(dtype=float)
+    dur = seq_df["duration"].to_numpy(dtype=float)
+    bounds = [float(ts[i]) for i in starts]
+    # Each iteration ends where the next begins; the last ends after its own
+    # pattern_len events (NOT len/num_iterations, which would absorb warmup
+    # or teardown ops into the final step).
+    last_end_idx = min(starts[-1] + pattern_len, len(ts))
+    ends = bounds[1:] + [float((ts + dur)[last_end_idx - 1])]
+
+    rows = []
+    for it, (t0, t1) in enumerate(zip(bounds, ends)):
+        row = {"iteration": it, "begin": t0, "end": t1, "step_time": t1 - t0}
+        if tputrace is not None and not tputrace.empty:
+            ops = tputrace[
+                (tputrace["timestamp"] >= t0)
+                & (tputrace["timestamp"] < t1)
+                & (tputrace["category"] == 0)
+            ]
+            row["op_time"] = float(ops["duration"].sum())
+            row["kernel_time"] = float(
+                ops.loc[ops["copyKind"] == int(CopyKind.KERNEL), "duration"].sum()
+            )
+            coll = ops[ops["copyKind"] >= 20]
+            row["collective_time"] = float(coll["duration"].sum())
+            row["collective_bytes"] = float(coll["payload"].sum())
+            row["flops"] = float(ops["flops"].sum())
+            row["bytes_accessed"] = float(ops["bytes_accessed"].sum())
+            copies = tputrace[
+                (tputrace["timestamp"] >= t0) & (tputrace["timestamp"] < t1)
+                & (tputrace["copyKind"].isin([int(CopyKind.H2D), int(CopyKind.D2H)]))
+            ]
+            row["transfer_time"] = float(copies["duration"].sum())
+        rows.append(row)
+    table = pd.DataFrame(rows)
+    table.to_csv(cfg.path("iterations.csv"), index=False)
+
+    steps = table["step_time"].to_numpy(dtype=float)
+    steps = steps[steps > 0]
+    if len(steps):
+        features.add("aisi_iterations", len(table))
+        features.add("aisi_step_time_mean", float(np.mean(steps)))
+        features.add("aisi_step_time_gmean", float(np.exp(np.mean(np.log(steps)))))
+        features.add("aisi_step_time_std", float(np.std(steps)))
+    if "op_time" in table.columns and table["op_time"].sum() > 0:
+        comm_ratio = float(table["collective_time"].sum() / table["op_time"].sum())
+        features.add("aisi_comm_ratio", comm_ratio)
+        if comm_ratio >= COMM_BOUND_RATIO:
+            print_hint(
+                f"aisi verdict: COMMUNICATION-bound (collectives {comm_ratio:.0%} "
+                "of per-step device time)"
+            )
+        else:
+            print_hint(
+                f"aisi verdict: COMPUTE-bound (collectives {comm_ratio:.0%} "
+                "of per-step device time)"
+            )
+    return table
+
+
+def _module_sequence(modules: pd.DataFrame) -> pd.DataFrame:
+    dev = modules.groupby("deviceId")["duration"].sum().idxmax()
+    return modules[modules["deviceId"] == dev].sort_values("timestamp")
+
+
+def _op_sequence(tputrace: pd.DataFrame) -> pd.DataFrame:
+    sync = tputrace[tputrace["category"] == 0]
+    if sync.empty:
+        return sync
+    dev = sync.groupby("deviceId")["duration"].sum().idxmax()
+    return sync[sync["deviceId"] == dev].sort_values("timestamp")
+
+
+def iteration_series(table: Optional[pd.DataFrame]):
+    """Timeline marker series for the board (reference injects iteration
+    begin/end markers into report.js, sofa_aisi.py:318-345)."""
+    if table is None or table.empty:
+        return None
+    from sofa_tpu.trace import SofaSeries, make_frame
+
+    rows = []
+    for _, r in table.iterrows():
+        rows.append(
+            {
+                "timestamp": r["begin"],
+                "event": 0.0,
+                "duration": r["step_time"],
+                "name": f"iter {int(r['iteration'])}",
+                "device_kind": "tpu",
+            }
+        )
+    return SofaSeries("iterations", "Iterations", "black", make_frame(rows), kind="scatter")

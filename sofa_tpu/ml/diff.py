@@ -1,0 +1,100 @@
+"""`sofa diff` — run-to-run swarm comparison.
+
+Reference sofa_swarm_diff (sofa_ml.py:311-415,417-539): load two
+auto_caption.csv files, concatenate each cluster's function names, fuzzy-
+match clusters across runs, and report per-cluster duration deltas plus the
+match intersection rate.  Same shape here with difflib as the fuzzy matcher.
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+from typing import Dict, Optional
+
+import pandas as pd
+
+from sofa_tpu.printing import print_progress, print_title, print_warning
+
+
+def _cluster_signatures(df: pd.DataFrame) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for cid, rows in df.groupby("cluster_ID"):
+        names = rows["name"].astype(str)
+        out[int(cid)] = {
+            "names": " ".join(sorted(names.unique())[:80]),
+            "name_set": set(names.unique()),
+            "duration": float(rows["duration"].sum()),
+            "samples": len(rows),
+        }
+    return out
+
+
+def match_swarms(base: Dict[int, dict], match: Dict[int, dict]) -> Dict[int, Optional[int]]:
+    """Greedy best-ratio matching of base clusters onto match clusters
+    (reference matching_two_dicts_of_swarm, sofa_ml.py:311-341)."""
+    pairs = []
+    for b, bs in base.items():
+        for m, ms in match.items():
+            ratio = difflib.SequenceMatcher(None, bs["names"], ms["names"]).ratio()
+            pairs.append((ratio, b, m))
+    pairs.sort(reverse=True)
+    used_b, used_m = set(), set()
+    out: Dict[int, Optional[int]] = {b: None for b in base}
+    for ratio, b, m in pairs:
+        if ratio < 0.3:
+            break
+        if b in used_b or m in used_m:
+            continue
+        out[b] = m
+        used_b.add(b)
+        used_m.add(m)
+    return out
+
+
+def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
+    base_path = os.path.join(cfg.base_logdir, "auto_caption.csv")
+    match_path = os.path.join(cfg.match_logdir, "auto_caption.csv")
+    for p in (base_path, match_path):
+        if not os.path.isfile(p):
+            print_warning(f"diff: {p} missing — run with --enable_hsg or `sofa diff`")
+            return None
+    base = _cluster_signatures(pd.read_csv(base_path))
+    match = _cluster_signatures(pd.read_csv(match_path))
+    mapping = match_swarms(base, match)
+
+    rows = []
+    for b, m in mapping.items():
+        bs = base[b]
+        row = {
+            "base_cluster": b,
+            "match_cluster": m if m is not None else -1,
+            "base_duration": bs["duration"],
+            "base_samples": bs["samples"],
+        }
+        if m is not None:
+            ms = match[m]
+            inter = bs["name_set"] & ms["name_set"]
+            union = bs["name_set"] | ms["name_set"]
+            row.update(
+                {
+                    "match_duration": ms["duration"],
+                    "duration_delta": ms["duration"] - bs["duration"],
+                    "duration_ratio": (
+                        ms["duration"] / bs["duration"] if bs["duration"] > 0 else 0.0
+                    ),
+                    "intersection_rate": len(inter) / len(union) if union else 0.0,
+                }
+            )
+        rows.append(row)
+    table = pd.DataFrame(rows).sort_values("base_duration", ascending=False)
+    out_path = os.path.join(cfg.logdir, "swarm_diff.csv")
+    os.makedirs(cfg.logdir, exist_ok=True)
+    table.to_csv(out_path, index=False)
+    print_title("Swarm diff (base vs match)")
+    print(table.to_string(index=False))
+    matched = table[table["match_cluster"] >= 0]
+    print_progress(
+        f"diff: matched {len(matched)}/{len(table)} swarms; wrote {out_path}"
+    )
+    return table

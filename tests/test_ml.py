@@ -1,0 +1,207 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.ml.aisi import detect_iterations, sofa_aisi
+from sofa_tpu.ml.diff import match_swarms, sofa_swarm_diff
+from sofa_tpu.ml.hsg import hsg_cluster, sofa_hsg
+from sofa_tpu.ml.suffix import (
+    SuffixAutomaton,
+    find_occurrences,
+    fuzzy_occurrences,
+)
+from sofa_tpu.trace import CopyKind, make_frame
+
+
+# ---------------------------------------------------------------- suffix
+def test_suffix_automaton_counts():
+    sa = SuffixAutomaton("abcabcabc")
+    cnt = sa.occurrence_counts()
+    # "abc" occurs 3 times; find it via best_repeat
+    hit = sa.best_repeat(3, tolerance=0, min_len=3)
+    assert hit is not None
+    start, length, count = hit
+    assert count == 3
+    assert length == 3
+    assert "abcabcabc"[start:start + length] == "abc"
+    del cnt
+
+
+def test_suffix_automaton_arbitrary_symbols():
+    seq = [10, 20, 30, 10, 20, 30, 10, 20, 30, 99]
+    sa = SuffixAutomaton(seq)
+    hit = sa.best_repeat(3, min_len=2)
+    start, length, count = hit
+    assert seq[start:start + length] == [10, 20, 30]
+
+
+def test_find_occurrences_non_overlapping():
+    assert find_occurrences("aaaa", "aa") == [0, 2]
+    assert find_occurrences("abcabc", "abc") == [0, 3]
+    assert find_occurrences("abc", "") == []
+
+
+def test_fuzzy_occurrences_tolerates_edits():
+    base = list("XYZW")
+    seq = base * 3
+    seq[5] = "Q"  # corrupt one symbol in the middle repetition
+    occ = fuzzy_occurrences(seq, base, min_ratio=0.7)
+    assert len(occ) == 3
+
+
+# ---------------------------------------------------------------- aisi
+def test_detect_iterations():
+    step = [f"op{i}" for i in range(6)]
+    names = []
+    for _ in range(20):
+        names.extend(step)
+    starts, plen = detect_iterations(names, 20)
+    assert len(starts) == 20
+    assert plen == 6
+    assert starts[0] == 0 and starts[1] == 6
+
+
+def test_detect_iterations_with_warmup_and_teardown():
+    step = [f"op{i}" for i in range(6)]
+    names = [f"warm{i}" for i in range(40)]
+    for _ in range(20):
+        names.extend(step)
+    names += [f"tail{i}" for i in range(10)]
+    starts, plen = detect_iterations(names, 20)
+    assert len(starts) == 20
+    assert plen == 6
+    assert starts[0] == 40
+
+
+def test_detect_iterations_too_short():
+    assert detect_iterations(["a", "b"], 20) == ([], 0)
+
+
+def _training_frames(n_steps=20, ops_per_step=5):
+    rows, mod_rows = [], []
+    t = 0.0
+    for s in range(n_steps):
+        mod_rows.append({"timestamp": t, "duration": ops_per_step * 0.01,
+                         "deviceId": 0, "name": "jit_train_step",
+                         "module": "jit_train_step", "device_kind": "tpu"})
+        for i in range(ops_per_step):
+            kind = CopyKind.ALL_REDUCE if i == ops_per_step - 1 else CopyKind.KERNEL
+            rows.append({"timestamp": t, "duration": 0.01, "deviceId": 0,
+                         "copyKind": int(kind), "name": f"op{i}",
+                         "payload": int(1e6) if kind == CopyKind.ALL_REDUCE else 0,
+                         "flops": 1e8, "bytes_accessed": 1e5,
+                         "device_kind": "tpu"})
+            t += 0.01
+    return {"tputrace": make_frame(rows), "tpumodules": make_frame(mod_rows)}
+
+
+def test_sofa_aisi_op_mode(logdir):
+    cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="op")
+    f = Features()
+    table = sofa_aisi(_training_frames(), cfg, f)
+    assert table is not None
+    assert len(table) == 20
+    assert f.get("aisi_step_time_mean") == pytest.approx(0.05, rel=0.1)
+    # 1 of 5 ops is an all-reduce: comm_ratio 0.2 -> communication-bound
+    assert f.get("aisi_comm_ratio") == pytest.approx(0.2, rel=0.05)
+    import os
+
+    assert os.path.isfile(cfg.path("iterations.csv"))
+
+
+def test_sofa_aisi_module_mode(logdir):
+    cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="module")
+    f = Features()
+    table = sofa_aisi(_training_frames(), cfg, f)
+    # 20 identical single-module launches: pattern = the launch itself
+    assert table is not None
+    assert len(table) == 20
+
+
+# ---------------------------------------------------------------- hsg
+def _sample_frame(n=300):
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(n):
+        group = i % 3
+        rows.append({
+            "timestamp": i * 0.001,
+            "event": group * 10.0 + rng.normal(0, 0.1),
+            "duration": 1e-4,
+            "name": f"func_{group}",
+            "device_kind": "cpu",
+        })
+    return make_frame(rows)
+
+
+def test_hsg_cluster_groups_by_event():
+    df = hsg_cluster(_sample_frame(), num_swarms=3)
+    assert df["cluster_ID"].nunique() == 3
+    # All samples of one function land in one cluster
+    for name, rows in df.groupby("name"):
+        assert rows["cluster_ID"].nunique() == 1
+
+
+def test_sofa_hsg_writes_artifacts(logdir):
+    cfg = SofaConfig(logdir=logdir, num_swarms=3)
+    f = Features()
+    clustered = sofa_hsg({"cputrace": _sample_frame()}, cfg, f)
+    assert clustered is not None
+    import os
+
+    assert os.path.isfile(cfg.path("auto_caption.csv"))
+    assert os.path.isfile(cfg.path("swarms_report.csv"))
+    assert f.get("hsg_swarms") == 3
+    report = pd.read_csv(cfg.path("swarms_report.csv"))
+    assert set(report["caption"]) == {"func_0", "func_1", "func_2"}
+
+
+# ---------------------------------------------------------------- diff
+def test_match_swarms():
+    base = {0: {"names": "alpha beta gamma", "name_set": {"a"}, "duration": 1.0, "samples": 5},
+            1: {"names": "delta epsilon", "name_set": {"d"}, "duration": 2.0, "samples": 5}}
+    match = {7: {"names": "delta epsilon zeta", "name_set": {"d"}, "duration": 3.0, "samples": 5},
+             8: {"names": "alpha beta gamma", "name_set": {"a"}, "duration": 1.5, "samples": 5}}
+    mapping = match_swarms(base, match)
+    assert mapping == {0: 8, 1: 7}
+
+
+def test_sofa_swarm_diff_end_to_end(tmp_path):
+    base_dir = str(tmp_path / "base") + "/"
+    match_dir = str(tmp_path / "match") + "/"
+    for d, scale in ((base_dir, 1.0), (match_dir, 2.0)):
+        import os
+
+        os.makedirs(d)
+        cfg = SofaConfig(logdir=d, num_swarms=3)
+        frame = _sample_frame()
+        frame["duration"] = frame["duration"] * scale
+        sofa_hsg({"cputrace": frame}, cfg, Features())
+    cfg = SofaConfig(logdir=str(tmp_path / "out") + "/",
+                     base_logdir=base_dir, match_logdir=match_dir)
+    table = sofa_swarm_diff(cfg)
+    assert table is not None
+    matched = table[table["match_cluster"] >= 0]
+    assert len(matched) == 3
+    # match run is 2x slower everywhere
+    assert matched["duration_ratio"].mean() == pytest.approx(2.0, rel=0.05)
+    assert (matched["intersection_rate"] == 1.0).all()
+
+
+# ---------------------------------------------------------------- hints
+def test_hint_service_round_trip():
+    grpc = pytest.importorskip("grpc")
+    del grpc
+    from sofa_tpu.analysis.hint_service import request_hints, serve
+
+    server, port = serve(port=0, block=False)
+    try:
+        f = Features()
+        f.add("comm_ratio", 0.5)
+        f.add("tpu_ops", 10)
+        hints = request_hints(f"localhost:{port}", f)
+        assert any("communication-bound" in h for h in hints)
+    finally:
+        server.stop(None)
