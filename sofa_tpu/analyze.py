@@ -73,6 +73,9 @@ def sofa_analyze(cfg: SofaConfig) -> Features:
         except Exception as e:  # noqa: BLE001 — per-pass degradation
             print_warning(f"analyze pass {name}: {e}")
 
+    if not features.get("num_cores") and misc.get("cores"):
+        features.add("num_cores", int(misc["cores"]))
+
     extra_series = []
     if cfg.enable_aisi:
         try:

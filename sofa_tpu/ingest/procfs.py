@@ -56,13 +56,21 @@ def parse_mpstat(text: str, time_base: float = 0.0) -> pd.DataFrame:
         for (t0, v0), (t1, v1) in zip(series, series[1:]):
             delta = v1 - v0
             total = delta.sum()
-            if total <= 0 or t1 <= t0:
+            if t1 <= t0 or total < 0:
                 continue
             for metric, d in zip(MPSTAT_METRICS, delta):
+                if total > 0:
+                    pct = 100.0 * float(d) / float(total)
+                else:
+                    # Jiffy counters did not advance this interval (sub-tick
+                    # interval, or a sandboxed /proc/stat that reads all
+                    # zeros): report the core as fully idle rather than
+                    # dropping it, so the core inventory survives.
+                    pct = 100.0 if metric == "idl" else 0.0
                 rows.append(
                     {
                         "timestamp": t1 - time_base,
-                        "event": 100.0 * float(d) / float(total),
+                        "event": pct,
                         "duration": t1 - t0,
                         "deviceId": device,
                         "payload": int(d),
