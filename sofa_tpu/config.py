@@ -105,7 +105,10 @@ class SofaConfig:
     profile_region: str = ""         # "begin:end" manual ROI (seconds)
     spotlight: bool = False          # auto-ROI from TPU utilization
     hint_server: Optional[str] = None  # gRPC advice service host:port
-    iterations_from: str = "module"  # AISI symbol source: module|op
+    # AISI boundary source: auto = explicit sofa_step markers when present,
+    # else module-launch mining; module|op force mining on that symbol
+    # sequence; marker requires explicit markers.
+    iterations_from: str = "auto"
 
     # --- diff --------------------------------------------------------------
     base_logdir: Optional[str] = None

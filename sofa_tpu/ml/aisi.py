@@ -10,10 +10,17 @@ launches, which are already step-granular under jit), repeats are mined with
 the suffix automaton, boundaries are the exact (or fuzzy) occurrence
 positions — no KMeans needed — and the per-step profile attributes time to
 HLO categories and collective kinds.
+
+Explicit markers beat mining: if the profiled program annotated its steps
+with ``jax.profiler.TraceAnnotation("sofa_step_<i>")`` (what the built-in
+workloads' steps_per_sec loop does, sofa_tpu/workloads/common.py), those
+host-plane spans are used as exact iteration boundaries and the fuzzy
+detection never runs.
 """
 
 from __future__ import annotations
 
+import re
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -25,6 +32,64 @@ from sofa_tpu.printing import print_hint, print_progress, print_warning
 from sofa_tpu.trace import CopyKind
 
 COMM_BOUND_RATIO = 0.15  # the reference's verdict threshold (sofa_aisi.py:503-507)
+
+_STEP_MARKER_RE = re.compile(r"^sofa_step_(\d+)$")
+
+
+def _iterations_from_markers(frames) -> Optional[Tuple[List[float], List[float]]]:
+    """Exact (begins, ends) from sofa_step_<i> TraceAnnotations, if present.
+
+    The annotation spans live on the host plane and wrap the host-side step
+    *dispatch*; under JAX async dispatch the device executes each step later
+    than its enqueue.  So markers contribute the step count and order, and the
+    boundaries are re-anchored to the device plane when possible: marker k is
+    matched (greedy, in time order) to the first unclaimed device module
+    launch starting at or after its host begin.  Without a usable device
+    module trace the raw host spans are used, with the documented skew.
+    """
+    host = frames.get("hosttrace")
+    if host is None or host.empty:
+        return None
+    marks = host[host["name"].str.match(_STEP_MARKER_RE)].copy()
+    marks["step"] = marks["name"].str.extract(_STEP_MARKER_RE).astype(int)
+    marks = marks.sort_values(["step", "timestamp"]).drop_duplicates("step")
+    if len(marks) < 2:
+        return None
+    begins = marks["timestamp"].astype(float).tolist()
+    span_ends = (marks["timestamp"] + marks["duration"]).astype(float).tolist()
+
+    anchored = _anchor_to_device(frames, begins)
+    if anchored is not None:
+        return anchored
+    return begins, begins[1:] + [span_ends[-1]]
+
+
+def _anchor_to_device(frames, host_begins: List[float]):
+    """Map host-side marker begins to device-side module-launch windows."""
+    modules = frames.get("tpumodules")
+    if modules is None or modules.empty:
+        return None
+    dev = modules.groupby("deviceId")["duration"].sum().idxmax()
+    mods = modules[modules["deviceId"] == dev]
+    # The step program is the module launched most often (warmup/compile
+    # launches of other modules don't confuse the match).
+    top = mods.groupby("name")["timestamp"].count().idxmax()
+    launches = mods[mods["name"] == top].sort_values("timestamp")
+    lts = launches["timestamp"].to_numpy(dtype=float)
+    lend = lts + launches["duration"].to_numpy(dtype=float)
+
+    begins: List[float] = []
+    last_end = 0.0
+    j = 0
+    for hb in host_begins:
+        while j < len(lts) and lts[j] < max(hb, 0.0):
+            j += 1
+        if j >= len(lts):
+            return None                    # fewer launches than markers
+        begins.append(float(lts[j]))
+        last_end = float(lend[j])
+        j += 1
+    return begins, begins[1:] + [last_end]
 
 
 def detect_iterations(
@@ -73,36 +138,50 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
     Writes iterations.csv; appends per-step features and the
     compute- vs communication-bound verdict.
     """
-    source = cfg.iterations_from  # "module" (default) or "op"
+    source = cfg.iterations_from  # auto | marker | module | op
     tputrace = frames.get("tputrace")
     modules = frames.get("tpumodules")
-    if source == "module" and modules is not None and not modules.empty:
-        seq_df, label = _module_sequence(modules), "module launches"
-    elif tputrace is not None and not tputrace.empty:
-        seq_df, label = _op_sequence(tputrace), "HLO ops"
+
+    marked = None
+    if source in ("auto", "marker"):
+        marked = _iterations_from_markers(frames)
+        if marked is None and source == "marker":
+            print_warning("aisi: iterations_from=marker but no usable "
+                          "sofa_step annotations in the host trace")
+            return None
+    if marked is not None:
+        bounds, ends = marked
+        print_progress(
+            f"aisi: {len(bounds)} iterations from explicit sofa_step markers")
     else:
-        return None
-    if seq_df.empty:
-        return None
+        if source in ("auto", "module") and modules is not None \
+                and not modules.empty:
+            seq_df, label = _module_sequence(modules), "module launches"
+        elif tputrace is not None and not tputrace.empty:
+            seq_df, label = _op_sequence(tputrace), "HLO ops"
+        else:
+            return None
+        if seq_df.empty:
+            return None
 
-    names = list(seq_df["name"])
-    starts, pattern_len = detect_iterations(names, cfg.num_iterations)
-    if len(starts) < 2:
-        print_warning(
-            f"aisi: no pattern repeating ~{cfg.num_iterations}x in {label} "
-            f"({len(names)} events)"
-        )
-        return None
-    print_progress(f"aisi: detected {len(starts)} iterations over {label}")
+        names = list(seq_df["name"])
+        starts, pattern_len = detect_iterations(names, cfg.num_iterations)
+        if len(starts) < 2:
+            print_warning(
+                f"aisi: no pattern repeating ~{cfg.num_iterations}x in {label} "
+                f"({len(names)} events)"
+            )
+            return None
+        print_progress(f"aisi: detected {len(starts)} iterations over {label}")
 
-    ts = seq_df["timestamp"].to_numpy(dtype=float)
-    dur = seq_df["duration"].to_numpy(dtype=float)
-    bounds = [float(ts[i]) for i in starts]
-    # Each iteration ends where the next begins; the last ends after its own
-    # pattern_len events (NOT len/num_iterations, which would absorb warmup
-    # or teardown ops into the final step).
-    last_end_idx = min(starts[-1] + pattern_len, len(ts))
-    ends = bounds[1:] + [float((ts + dur)[last_end_idx - 1])]
+        ts = seq_df["timestamp"].to_numpy(dtype=float)
+        dur = seq_df["duration"].to_numpy(dtype=float)
+        bounds = [float(ts[i]) for i in starts]
+        # Each iteration ends where the next begins; the last ends after its
+        # own pattern_len events (NOT len/num_iterations, which would absorb
+        # warmup or teardown ops into the final step).
+        last_end_idx = min(starts[-1] + pattern_len, len(ts))
+        ends = bounds[1:] + [float((ts + dur)[last_end_idx - 1])]
 
     rows = []
     for it, (t0, t1) in enumerate(zip(bounds, ends)):

@@ -103,6 +103,16 @@ def sofa_record(command: str, cfg) -> int:
             prefix += col.command_prefix()
             child_env.update(col.child_env())
 
+        # The profiled child must be able to import sofa_tpu (built-in
+        # workloads) from any cwd.  Appended AFTER the collector env updates
+        # so the xprof injection dir keeps sys.path position 0 (its
+        # sitecustomize must be the one Python auto-imports).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        parts = [p for p in child_env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        if pkg_root not in parts:
+            parts.append(pkg_root)
+        child_env["PYTHONPATH"] = os.pathsep.join(parts)
+
         if cfg.pid is not None:
             rc = _attach(cfg, cfg.pid)
         else:

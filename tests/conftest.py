@@ -9,12 +9,23 @@ few opt-in tests that want the real chip.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image may force-register a TPU backend via sitecustomize regardless of
+# JAX_PLATFORMS (and that backend's init can hang if the device tunnel is
+# busy), so the env var alone is not enough: pin the platform at the jax
+# config level below, before any backend initializes.  Tests that need the
+# 8-device mesh build it via make_mesh(..., platform="cpu"); the
+# virtual-device flag guarantees the CPU backend always has 8.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if not os.environ.get("SOFA_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:

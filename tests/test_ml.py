@@ -111,6 +111,46 @@ def test_sofa_aisi_op_mode(logdir):
     assert os.path.isfile(cfg.path("iterations.csv"))
 
 
+def test_sofa_aisi_explicit_markers(logdir):
+    # sofa_step_<i> host annotations take precedence over sequence mining and
+    # give exact boundaries even when the op stream has no clean repeat.
+    # Host markers are emitted at dispatch time, 10 ms BEFORE the device
+    # executes (async dispatch skew); anchoring to the device module launches
+    # must recover the true device-side windows.
+    frames = _training_frames(n_steps=4)
+    host_rows = [{"timestamp": 0.05 * s - 0.01, "duration": 0.003, "pid": -1,
+                  "tid": 1, "name": f"sofa_step_{s}", "device_kind": "host"}
+                 for s in range(4)]
+    frames["hosttrace"] = make_frame(host_rows)
+    cfg = SofaConfig(logdir=logdir, num_iterations=99)  # mining would fail
+    f = Features()
+    table = sofa_aisi(frames, cfg, f)
+    assert table is not None
+    assert len(table) == 4
+    # Device-anchored boundaries: module launches are at 0.05*s exactly.
+    assert list(table["begin"]) == pytest.approx([0.0, 0.05, 0.10, 0.15])
+    assert f.get("aisi_step_time_mean") == pytest.approx(0.05, rel=0.01)
+
+
+def test_sofa_aisi_marker_source_required(logdir):
+    # iterations_from="marker" with no annotations: no silent mining fallback.
+    cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="marker")
+    assert sofa_aisi(_training_frames(), cfg, Features()) is None
+
+
+def test_sofa_aisi_markers_skipped_when_mining_forced(logdir):
+    # Explicit iterations_from="op" must ignore markers entirely.
+    frames = _training_frames(n_steps=20)
+    frames["hosttrace"] = make_frame(
+        [{"timestamp": 0.0, "duration": 0.5, "pid": -1, "tid": 1,
+          "name": "sofa_step_0", "device_kind": "host"},
+         {"timestamp": 0.5, "duration": 0.5, "pid": -1, "tid": 1,
+          "name": "sofa_step_1", "device_kind": "host"}])
+    cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="op")
+    table = sofa_aisi(frames, cfg, Features())
+    assert table is not None and len(table) == 20
+
+
 def test_sofa_aisi_module_mode(logdir):
     cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="module")
     f = Features()
