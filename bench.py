@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Headline benchmark: profiling overhead on a ResNet-50 training loop.
+
+Mirrors the reference's only quantitative quality gate — paired runs of a
+resnet50 workload with and without the profiler, overhead = time delta
+(/root/reference/validation/framework_eval.py:195-215) — retargeted to the
+TPU: the "with profiling" leg runs under sofa_tpu.api.profile (XPlane trace +
+clock marker + 10 Hz host samplers), and the run only counts if the captured
+trace actually contains HLO ops (coverage guard, per BASELINE.json's
+"overhead % + HLO-op trace coverage" metric).
+
+Prints ONE JSON line:
+  value       = profiling overhead in percent (lower is better)
+  vs_baseline = value / 5.0, the fraction of the reference's <5 % overhead
+                budget consumed (<1.0 beats the target)
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _time_steps(step, state_maker, n_steps: int, annotate: bool):
+    import jax
+
+    from sofa_tpu.workloads.common import step_annotation
+
+    state = state_maker()
+    state = step(state)                      # compile
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        if annotate:
+            with step_annotation(i):
+                state = step(state)
+        else:
+            state = step(state)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="paired bare/profiled passes; medians are compared")
+    args = p.parse_args()
+
+    import os
+
+    import jax
+
+    # The image's sitecustomize may force-prepend a TPU platform; if the user
+    # explicitly asked for something else (JAX_PLATFORMS=cpu smoke runs),
+    # honor the env var over the injected override.
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+
+    import jax.numpy as jnp
+
+    from sofa_tpu.workloads.resnet import create, make_train_step
+
+    _log(f"bench: backend={jax.default_backend()} devices={jax.devices()}")
+    model, variables, x = create(args.batch, args.image_size)
+    labels = jnp.zeros((args.batch,), jnp.int32)
+    tx, train = make_train_step(model)
+    opt_state = tx.init(variables["params"])
+
+    def state_maker():
+        return (variables["params"], variables["batch_stats"], opt_state, 0.0)
+
+    def step(state):
+        params, stats, opt, _ = state
+        return train(params, stats, opt, x, labels)
+
+    import sofa_tpu.api as sofa
+    from sofa_tpu.ingest.xplane import ingest_xprof_dir
+
+    bare, prof = [], []
+    hlo_rows = 0
+    logdir = tempfile.mkdtemp(prefix="sofa_bench_") + "/"
+    try:
+        for r in range(args.repeats):
+            tb = _time_steps(step, state_maker, args.steps, annotate=False)
+            bare.append(tb)
+            run_dir = f"{logdir}r{r}/"
+            with sofa.profile(run_dir):
+                tp = _time_steps(step, state_maker, args.steps, annotate=True)
+            prof.append(tp)
+            _log(f"bench: pass {r}: bare {tb:.3f}s profiled {tp:.3f}s")
+        frames = ingest_xprof_dir(f"{logdir}r{args.repeats - 1}/xprof/",
+                                  time.time())
+        hlo_rows = len(frames.get("tputrace", []))
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+
+    bare.sort()
+    prof.sort()
+    t_bare = bare[len(bare) // 2]
+    t_prof = prof[len(prof) // 2]
+    overhead = max(0.0, (t_prof - t_bare) / t_bare * 100.0)
+    if hlo_rows == 0:
+        _log("bench: FAILED coverage guard — no HLO ops in captured trace")
+        overhead = 100.0
+    _log(f"bench: images/s bare {args.steps * args.batch / t_bare:.1f}, "
+         f"profiled {args.steps * args.batch / t_prof:.1f}; "
+         f"trace rows {hlo_rows}")
+    print(json.dumps({
+        "metric": "resnet50_profiling_overhead",
+        "value": round(overhead, 3),
+        "unit": "percent",
+        "vs_baseline": round(overhead / 5.0, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
