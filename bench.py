@@ -28,6 +28,77 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _emit(value, error: str | None = None) -> None:
+    """The one JSON line the driver parses — emitted on success AND failure."""
+    out = {
+        "metric": "resnet50_profiling_overhead",
+        "value": value,
+        "unit": "percent",
+        "vs_baseline": None if value is None else round(value / 5.0, 4),
+    }
+    if error:
+        out["error"] = error
+    print(json.dumps(out), flush=True)
+
+
+def _log_chip_holders() -> None:
+    """Best-effort: name the processes holding a TPU/accel device node."""
+    import glob
+    import os
+
+    holders = []
+    for fd in glob.glob("/proc/[0-9]*/fd/*"):
+        try:
+            tgt = os.readlink(fd)
+        except OSError:
+            continue
+        if "/dev/accel" in tgt or "/dev/vfio" in tgt or "libtpu" in tgt:
+            pid = fd.split("/")[2]
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode()[:160]
+            except OSError:
+                cmd = "?"
+            holders.append(f"pid {pid}: {cmd}")
+    if holders:
+        _log("bench: device held by: " + "; ".join(sorted(set(holders))))
+    else:
+        _log("bench: no local process holds an accel device node "
+             "(chip may be held remotely / tunnel busy)")
+
+
+def _init_backend(max_tries: int = 5, backoff_s: float = 20.0):
+    """Initialize the JAX backend, retrying a transiently-unavailable chip.
+
+    Returns the device list.  jax caches a failed backend init, so each retry
+    clears backends first.  Raises the last error after max_tries.
+    """
+    import jax
+
+    last = None
+    for attempt in range(max_tries):
+        if attempt:
+            _log(f"bench: backend init retry {attempt}/{max_tries - 1} "
+                 f"in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+            try:
+                import jax.extend.backend as jeb
+
+                jeb.clear_backends()
+            except Exception:
+                pass
+        try:
+            devs = jax.devices()
+            _log(f"bench: backend={jax.default_backend()} devices={devs}")
+            return devs
+        except Exception as e:  # RuntimeError / JaxRuntimeError
+            last = e
+            _log(f"bench: backend init failed: {type(e).__name__}: "
+                 f"{str(e).splitlines()[0] if str(e) else e!r}")
+            _log_chip_holders()
+    raise last
+
+
 def _time_steps(step, state_maker, n_steps: int, annotate: bool):
     import jax
 
@@ -73,7 +144,14 @@ def main() -> int:
 
     from sofa_tpu.workloads.resnet import create, make_train_step
 
-    _log(f"bench: backend={jax.default_backend()} devices={jax.devices()}")
+    try:
+        _init_backend()
+    except Exception as e:
+        msg = str(e).splitlines()[0] if str(e) else repr(e)
+        _emit(None, error=f"backend init failed after retries: "
+                          f"{type(e).__name__}: {msg}")
+        return 1
+
     model, variables, x = create(args.batch, args.image_size)
     labels = jnp.zeros((args.batch,), jnp.int32)
     tx, train = make_train_step(model)
@@ -104,6 +182,10 @@ def main() -> int:
         frames = ingest_xprof_dir(f"{logdir}r{args.repeats - 1}/xprof/",
                                   time.time())
         hlo_rows = len(frames.get("tputrace", []))
+    except Exception as e:
+        _emit(None, error=f"benchmark run failed: {type(e).__name__}: "
+                          f"{str(e).splitlines()[0] if str(e) else e!r}")
+        return 1
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
 
@@ -118,12 +200,7 @@ def main() -> int:
     _log(f"bench: images/s bare {args.steps * args.batch / t_bare:.1f}, "
          f"profiled {args.steps * args.batch / t_prof:.1f}; "
          f"trace rows {hlo_rows}")
-    print(json.dumps({
-        "metric": "resnet50_profiling_overhead",
-        "value": round(overhead, 3),
-        "unit": "percent",
-        "vs_baseline": round(overhead / 5.0, 4),
-    }))
+    _emit(round(overhead, 3))
     return 0
 
 

@@ -81,6 +81,34 @@ def tpuutil_profile(frames, cfg, features: Features) -> None:
         features.add(f"{metric}_median", float(q.loc[0.5]))
 
 
+def tpumon_profile(frames, cfg, features: Features) -> None:
+    """Live HBM occupancy/liveness features (the nvsmi_profile analogue,
+    reference sofa_analyze.py:259-341) from the in-process sampler — present
+    even when XPlane tracing was off."""
+    df = frames.get("tpumon")
+    if df is None or df.empty:
+        return
+    alive = df[df["name"] == "alive"]
+    if not alive.empty:
+        features.add("tpumon_samples", len(alive))
+        span = float(alive["timestamp"].max() - alive["timestamp"].min())
+        features.add("tpumon_span", span)
+    used = df[df["name"] == "hbm_used_gb"]
+    for device_id, rows in used.groupby("deviceId"):
+        features.add(f"tpu{device_id}_hbm_used_mean_gb",
+                     float(rows["event"].mean()))
+        features.add(f"tpu{device_id}_hbm_used_max_gb",
+                     float(rows["event"].max()))
+        # peak_bytes_in_use is carried in payload of the occupancy rows
+    occ = df[df["name"] == "hbm_occupancy"]
+    for device_id, rows in occ.groupby("deviceId"):
+        features.add(f"tpu{device_id}_hbm_occupancy_mean", float(rows["event"].mean()))
+        features.add(f"tpu{device_id}_hbm_occupancy_max", float(rows["event"].max()))
+        peak = float(rows["payload"].max())
+        if peak > 0:
+            features.add(f"tpu{device_id}_hbm_peak_gb", peak / 1e9)
+
+
 def spotlight_roi(frames, cfg, features: Features) -> None:
     """Set cfg.roi_begin/roi_end from TensorCore utilization.
 

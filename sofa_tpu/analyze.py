@@ -26,6 +26,7 @@ from sofa_tpu.trace import empty_frame, read_csv
 CSV_SOURCES = [
     "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
     "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
+    "tpumon",
 ]
 
 _PASSES = [
@@ -40,6 +41,7 @@ _PASSES = [
     ("net_profile", comm.net_profile),
     ("tpu_profile", tpu.tpu_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
+    ("tpumon_profile", tpu.tpumon_profile),
     ("comm_profile", comm.comm_profile),
     ("concurrency_breakdown", concurrency.concurrency_breakdown),
     ("mesh_advice", advice.mesh_advice),

@@ -37,14 +37,33 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
 
     from sofa_tpu.collectors.procmon import ProcMonCollector
     from sofa_tpu.collectors.timebase import TimebaseCollector
+    from sofa_tpu.collectors.tpumon import start_sampler
 
     timebase = TimebaseCollector(cfg)
     procmon = ProcMonCollector(cfg)
     timebase.start()
     if procmon.probe() is None:
         procmon.start()
+    tpumon_stop = None
+    if cfg.enable_tpu_mon:
+        import threading
 
-    jax.profiler.start_trace(cfg.xprof_dir)
+        try:  # the sampler appends; drop any previous run's samples
+            os.unlink(cfg.path("tpumon.txt"))
+        except OSError:
+            pass
+        tpumon_stop = threading.Event()
+        start_sampler(cfg.tpu_mon_rate, cfg.path("tpumon.txt"), tpumon_stop)
+
+    kwargs = {}
+    try:
+        po = jax.profiler.ProfileOptions()
+        po.host_tracer_level = int(cfg.xprof_host_tracer_level)
+        po.python_tracer_level = 1 if cfg.xprof_python_tracer else 0
+        kwargs["profiler_options"] = po
+    except Exception:
+        pass
+    jax.profiler.start_trace(cfg.xprof_dir, **kwargs)
     t0 = time.time_ns()
     with jax.profiler.TraceAnnotation(f"sofa_timebase_marker:{t0}"):
         t1 = time.time_ns()
@@ -57,6 +76,8 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
         yield cfg
     finally:
         jax.profiler.stop_trace()
+        if tpumon_stop is not None:
+            tpumon_stop.set()
         procmon.stop()
         elapsed = time.time() - start
         with open(cfg.path("misc.txt"), "w") as f:

@@ -60,6 +60,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--perf_events")
     g.add_argument("--no-perf-events", dest="no_perf_events", action="store_true")
     g.add_argument("--cpu_sample_rate", type=int)
+    g.add_argument("--perf_call_graph", choices=["off", "fp", "dwarf"])
     g.add_argument("--sys_mon_rate", type=int)
     g.add_argument("--enable_strace", action="store_true")
     g.add_argument("--strace_min_time", type=float)
@@ -76,6 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--xprof_delay_s", type=float)
     g.add_argument("--xprof_duration_s", type=float)
     g.add_argument("--tpu_mon_rate", type=int)
+    g.add_argument("--disable_tpu_mon", action="store_true")
 
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
@@ -122,7 +124,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
     # Flags that map 1:1 onto SofaConfig fields.
     for name in (
         "logdir", "verbose", "skip_preprocess",
-        "perf_events", "no_perf_events", "cpu_sample_rate", "sys_mon_rate",
+        "perf_events", "no_perf_events", "cpu_sample_rate", "perf_call_graph",
+        "sys_mon_rate",
         "enable_strace", "strace_min_time", "enable_py_stacks", "enable_tcpdump",
         "netstat_interface", "blkdev", "pid",
         "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
@@ -137,6 +140,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
             setattr(cfg, name, passed[name])
     if was_set("disable_xprof"):
         cfg.enable_xprof = not passed["disable_xprof"]
+    if was_set("disable_tpu_mon"):
+        cfg.enable_tpu_mon = not passed["disable_tpu_mon"]
     if was_set("network_filters"):
         cfg.network_filters = [s for s in passed["network_filters"].split(",") if s]
     if was_set("cpu_filters"):
@@ -209,11 +214,11 @@ def main(argv=None) -> int:
             from sofa_tpu.record import sofa_record
             print_main_progress("SOFA stat = record + preprocess + analyze")
             rc = sofa_record(cfg.command, cfg)
-            if rc != 0:
-                return rc
+            # A failed workload still leaves traces worth analyzing; report
+            # anyway but surface the child's rc as our exit status.
             sofa_preprocess(cfg)
             sofa_analyze(cfg)
-            return 0
+            return rc
         if cmd == "diff":
             if not (cfg.base_logdir and cfg.match_logdir):
                 print_error("diff needs --base_logdir and --match_logdir")

@@ -59,22 +59,34 @@ class PerfCollector(Collector):
             return "neither perf nor /usr/bin/time available"
         return None
 
+    def _record_argv(self) -> List[str]:
+        cfg = self.cfg
+        argv = [
+            "perf", "record",
+            "-o", cfg.path("perf.data"),
+            "-F", str(cfg.cpu_sample_rate),
+        ]
+        if cfg.perf_call_graph == "fp":
+            argv += ["--call-graph", "fp"]
+        elif cfg.perf_call_graph == "dwarf":
+            argv += ["--call-graph", "dwarf,16384"]
+        if cfg.perf_events:
+            argv += ["-e", cfg.perf_events]
+        return argv
+
     def command_prefix(self) -> List[str]:
         cfg = self.cfg
         if self.mode == "perf":
-            argv = [
-                "perf", "record",
-                "-o", cfg.path("perf.data"),
-                "-F", str(cfg.cpu_sample_rate),
-                "--call-graph", "dwarf,16384",
-            ]
-            if cfg.perf_events:
-                argv += ["-e", cfg.perf_events]
-            argv.append("--")
-            return argv
+            return self._record_argv() + ["--"]
         if self.mode == "time" and os.path.isfile("/usr/bin/time"):
             return ["/usr/bin/time", "-v", "-o", cfg.path("time.txt")]
         return []
+
+    def attach_argv(self, pid: int) -> List[str]:
+        """`perf record -p <pid>` for attach mode; [] when perf unavailable."""
+        if self.mode != "perf":
+            return []
+        return self._record_argv() + ["-p", str(pid)]
 
     def harvest(self) -> None:
         # Copy kernel symbols for offline `perf script` runs, like the

@@ -1,0 +1,121 @@
+"""Live TPU runtime-metrics sampler — the `nvidia-smi dmon` analogue.
+
+The reference samples GPU utilization/memory with nvidia-smi daemons
+(/root/reference/bin/sofa_record.py:300-310).  libtpu has no external query
+tool and the chip is held by the profiled process, so the sampler lives
+*inside* that process (delivered by the same sitecustomize injection as the
+XPlane collector, or started directly by sofa_tpu.api.profile) and reads
+``device.memory_stats()`` — HBM bytes in use / limit / peak — at
+``tpu_mon_rate`` Hz.
+
+This is the low-rate, always-on complement to the trace-derived tc_util
+series (ingest/xplane.py:tpu_utilization): it keeps working when XPlane
+tracing is off (--disable_xprof), windowed (xprof_duration_s), or lost, and
+it reports *occupancy* (bytes held) which the op trace cannot.
+
+Output format (tpumon.txt), one line per device per tick plus a liveness
+heartbeat (deviceId -1):
+
+    <unix_ns> <device_id> <bytes_in_use> <bytes_limit> <peak_bytes_in_use>
+
+Parsed by sofa_tpu/ingest/tpumon_parse.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Self-contained module text written into the injection directory; it must
+# not import sofa_tpu (see xprof.py for why).  The same text is exec'd below
+# so the in-process API (sofa_tpu.api.profile) shares one implementation.
+_SAMPLER = '''
+"""sofa_tpu in-process TPU runtime-metrics sampler (auto-generated)."""
+import sys
+import threading
+import time
+
+
+def _backend_ready():
+    """jax imported AND a backend actually initialized.
+
+    Touching jax.local_devices() ourselves would *trigger* backend init and
+    could reorder the profiled program's startup; instead poll the bridge's
+    backend table (internal but guarded — on rename we fall back to a grace
+    period after import).
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is not None and hasattr(xb, "_backends"):
+            return jax if xb._backends else None
+    except Exception:
+        pass
+    # Internals moved: wait a grace period after the import instead.
+    if getattr(_backend_ready, "_seen", None) is None:
+        _backend_ready._seen = time.time()
+    return jax if time.time() - _backend_ready._seen > 5.0 else None
+
+
+def _loop(rate_hz, out_path, stop):
+    jax = None
+    while jax is None:
+        if stop is not None and stop.is_set():
+            return
+        jax = _backend_ready()
+        if jax is None:
+            time.sleep(0.1)
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return
+    interval = 1.0 / max(rate_hz, 1e-3)
+    try:
+        out = open(out_path, "a", buffering=1)
+    except OSError:
+        return
+    with out:
+        while stop is None or not stop.is_set():
+            ts = time.time_ns()
+            try:
+                out.write("%d -1 0 0 0\\n" % ts)   # liveness heartbeat
+                for d in devs:
+                    try:
+                        ms = d.memory_stats()
+                    except Exception:
+                        ms = None
+                    if not ms:
+                        continue
+                    out.write("%d %d %d %d %d\\n" % (
+                        ts, d.id,
+                        ms.get("bytes_in_use", 0),
+                        ms.get("bytes_limit", 0),
+                        ms.get("peak_bytes_in_use", 0),
+                    ))
+            except Exception:
+                return
+            time.sleep(interval)
+
+
+def start_sampler(rate_hz, out_path, stop=None):
+    """Start the sampler thread; returns it.  Waits for jax by itself, so it
+    is safe to call before the profiled program imports jax.  Pass a
+    threading.Event as `stop` to end the loop (in-process API use)."""
+    t = threading.Thread(
+        target=_loop, args=(rate_hz, out_path, stop),
+        daemon=True, name="sofa_tpu_tpumon",
+    )
+    t.start()
+    return t
+'''
+
+# One implementation: exec the injected text for in-process callers.
+_ns: dict = {}
+exec(compile(_SAMPLER, "<sofa_tpu_tpumon>", "exec"), _ns)
+start_sampler = _ns["start_sampler"]
+
+
+def write_sampler_module(inject_dir: str) -> None:
+    with open(os.path.join(inject_dir, "sofa_tpu_tpumon.py"), "w") as f:
+        f.write(_SAMPLER)

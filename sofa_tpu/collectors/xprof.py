@@ -119,12 +119,18 @@ def _start(jax):
     delay = float(_OPTS.get("delay_s", 0) or 0)
     if delay > 0:
         time.sleep(delay)
+    kwargs = {"create_perfetto_link": False, "create_perfetto_trace": False}
     try:
-        jax.profiler.start_trace(
-            os.path.join(logdir, "xprof"),
-            create_perfetto_link=False,
-            create_perfetto_trace=False,
-        )
+        # host_tracer_level / python_tracer flags ride ProfileOptions where
+        # this jax has it (>=0.4.32); older jax just gets the defaults.
+        po = jax.profiler.ProfileOptions()
+        po.host_tracer_level = int(_OPTS.get("host_tracer_level", 2))
+        po.python_tracer_level = 1 if _OPTS.get("python_tracer") else 0
+        kwargs["profiler_options"] = po
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        jax.profiler.start_trace(os.path.join(logdir, "xprof"), **kwargs)
         _DONE["started"] = True
     except Exception as e:  # noqa: BLE001
         sys.stderr.write("sofa_tpu: start_trace failed: %r\\n" % (e,))
@@ -169,6 +175,13 @@ if os.environ.get("SOFA_TPU_PYSTACKS_HZ"):
         float(os.environ["SOFA_TPU_PYSTACKS_HZ"]),
         os.environ["SOFA_TPU_PYSTACKS_OUT"],
     )
+
+if os.environ.get("SOFA_TPU_TPUMON_HZ"):
+    from sofa_tpu_tpumon import start_sampler as _tpumon_start
+    _tpumon_start(
+        float(os.environ["SOFA_TPU_TPUMON_HZ"]),
+        os.environ["SOFA_TPU_TPUMON_OUT"],
+    )
 '''
 
 
@@ -176,24 +189,31 @@ class XProfCollector(Collector):
     name = "xprof"
 
     def probe(self) -> Optional[str]:
-        if not self.cfg.enable_xprof:
-            return "disabled (--disable_xprof)"
+        # The injection carries the XPlane trace AND the tpumon/pystacks
+        # samplers; it is only pointless when every in-process collector is
+        # off (--disable_xprof alone must NOT kill the live HBM monitor).
+        if not (self.cfg.enable_xprof or self.cfg.enable_tpu_mon
+                or self.cfg.enable_py_stacks):
+            return "disabled (--disable_xprof and --disable_tpu_mon)"
         return None
 
     def start(self) -> None:
         cfg = self.cfg
         os.makedirs(cfg.inject_dir, exist_ok=True)
-        os.makedirs(cfg.xprof_dir, exist_ok=True)
+        if cfg.enable_xprof:
+            os.makedirs(cfg.xprof_dir, exist_ok=True)
         with open(os.path.join(cfg.inject_dir, "sitecustomize.py"), "w") as f:
             f.write(_SITECUSTOMIZE)
+        from sofa_tpu.collectors import tpumon
         from sofa_tpu.collectors.pystacks import write_sampler_module
 
         write_sampler_module(cfg.inject_dir)
+        tpumon.write_sampler_module(cfg.inject_dir)
 
     def child_env(self) -> Dict[str, str]:
         cfg = self.cfg
         opts = {
-            "enable": True,
+            "enable": bool(cfg.enable_xprof),
             "logdir": os.path.abspath(cfg.logdir),
             "delay_s": cfg.xprof_delay_s,
             "duration_s": cfg.xprof_duration_s,
@@ -206,4 +226,7 @@ class XProfCollector(Collector):
         if cfg.enable_py_stacks:
             env["SOFA_TPU_PYSTACKS_HZ"] = str(cfg.py_stack_rate)
             env["SOFA_TPU_PYSTACKS_OUT"] = os.path.abspath(cfg.path("pystacks.txt"))
+        if cfg.enable_tpu_mon:
+            env["SOFA_TPU_TPUMON_HZ"] = str(cfg.tpu_mon_rate)
+            env["SOFA_TPU_TPUMON_OUT"] = os.path.abspath(cfg.path("tpumon.txt"))
         return env

@@ -71,6 +71,11 @@ class SofaConfig:
     perf_events: str = ""            # extra `perf record -e` events
     no_perf_events: bool = False     # skip perf entirely (fallback to time -v)
     cpu_sample_rate: int = 99        # perf -F (reference: 99 Hz fixed)
+    # Call-graph capture: "off" (default — DWARF unwinding at 99 Hz costs
+    # ~16 KB stack copy per sample, which fights the <5 % overhead budget),
+    # "fp" (frame pointers, cheap but needs -fno-omit-frame-pointer), or
+    # "dwarf" (accurate, expensive).
+    perf_call_graph: str = "off"
     sys_mon_rate: int = 10           # /proc sampler Hz (reference default 10)
     enable_strace: bool = False
     strace_min_time: float = 1e-6    # drop syscalls shorter than this (s)
@@ -88,6 +93,7 @@ class SofaConfig:
     xprof_python_tracer: bool = False
     xprof_delay_s: float = 0.0       # delay trace start after launch
     xprof_duration_s: float = 0.0    # 0 = whole run
+    enable_tpu_mon: bool = True      # live HBM/liveness sampler (in-process)
     tpu_mon_rate: int = 1            # TPU runtime metrics sampler Hz
 
     # --- preprocess --------------------------------------------------------

@@ -35,6 +35,15 @@ _LINE_RE = re.compile(
     r"(?P<ip>[0-9a-fA-F]+)?\s*(?P<sym>.*?)?(?:\s+\((?P<dso>[^)]*)\))?\s*$"
 )
 
+# Callchain frame line emitted under `perf record --call-graph`: the sample
+# header then carries no ip/sym, followed by one indented line per stack
+# frame and a blank separator line.
+_FRAME_RE = re.compile(
+    r"^\s+(?P<ip>[0-9a-fA-F]+)\s+(?P<sym>.*?)(?:\s+\((?P<dso>[^)]*)\))?\s*$"
+)
+
+_MAX_FOLDED_CALLERS = 3  # callers folded into name after the leaf frame
+
 
 def parse_perf_script(
     text: str,
@@ -49,7 +58,11 @@ def parse_perf_script(
     means timestamps are already unix.
     """
     rows = []
-    for line in text.splitlines():
+    lines = text.splitlines()
+    i, n = 0, len(lines)
+    while i < n:
+        line = lines[i]
+        i += 1
         if not line or line.startswith("#"):
             continue
         m = _LINE_RE.match(line)
@@ -65,14 +78,38 @@ def parse_perf_script(
         mhz = mhz_at(t - time_base) if mhz_at else 2000.0
         if mhz <= 0:
             mhz = 2000.0
-        ip_hex = m.group("ip") or "0"
-        try:
-            ip = int(ip_hex, 16)
-        except ValueError:
-            ip = 0
+        ip_hex = m.group("ip") or ""
         sym = (m.group("sym") or "").strip()
         dso = os.path.basename(m.group("dso") or "")
-        name = sym if sym and sym != "[unknown]" else ip_hex
+        if not ip_hex:
+            # Callchain block: header carries no ip/sym — the frames follow,
+            # leaf first.  The leaf provides ip/sym/dso; a few callers are
+            # folded into the name ("leaf<-caller1<-caller2").
+            frames = []
+            while i < n:
+                fm = _FRAME_RE.match(lines[i])
+                if fm is None:
+                    break
+                frames.append(fm)
+                i += 1
+            if not frames:
+                continue
+            ip_hex = frames[0].group("ip")
+            sym = (frames[0].group("sym") or "").strip()
+            dso = os.path.basename(frames[0].group("dso") or "")
+            callers = [
+                (f.group("sym") or "").strip()
+                for f in frames[1:1 + _MAX_FOLDED_CALLERS]
+            ]
+            callers = [c for c in callers if c and c != "[unknown]"]
+            if callers:
+                sym = (sym if sym and sym != "[unknown]" else ip_hex) \
+                    + "<-" + "<-".join(callers)
+        try:
+            ip = int(ip_hex or "0", 16)
+        except ValueError:
+            ip = 0
+        name = sym if sym and sym != "[unknown]" else (ip_hex or "0")
         if dso:
             name = f"{name} @ {dso}"
         rows.append(

@@ -61,7 +61,14 @@ def _iterations_from_markers(frames) -> Optional[Tuple[List[float], List[float]]
     anchored = _anchor_to_device(frames, begins)
     if anchored is not None:
         return anchored
-    return begins, begins[1:] + [span_ends[-1]]
+    # Host-span fallback: the span end is the *enqueue* end, which under
+    # async dispatch undershoots the device completion — pad the final
+    # boundary to at least one median step period.
+    last_end = span_ends[-1]
+    if len(begins) >= 2:
+        period = float(np.median(np.diff(np.asarray(begins))))
+        last_end = max(last_end, begins[-1] + period)
+    return begins, begins[1:] + [last_end]
 
 
 def _anchor_to_device(frames, host_begins: List[float]):
@@ -71,18 +78,27 @@ def _anchor_to_device(frames, host_begins: List[float]):
         return None
     dev = modules.groupby("deviceId")["duration"].sum().idxmax()
     mods = modules[modules["deviceId"] == dev]
-    # The step program is the module launched most often (warmup/compile
-    # launches of other modules don't confuse the match).
-    top = mods.groupby("name")["timestamp"].count().idxmax()
+    # The step program is the module with the largest total device time; a
+    # small per-step helper (scalar readback/convert) can out-COUNT the real
+    # step module, but cannot out-weigh it.  If the heaviest module launches
+    # fewer times than there are markers (e.g. it compiled once), fall back
+    # to the most-launched one.
+    per_name = mods.groupby("name")["duration"].agg(["sum", "count"])
+    top = per_name["sum"].idxmax()
+    if per_name.loc[top, "count"] < len(host_begins):
+        top = per_name["count"].idxmax()
     launches = mods[mods["name"] == top].sort_values("timestamp")
     lts = launches["timestamp"].to_numpy(dtype=float)
     lend = lts + launches["duration"].to_numpy(dtype=float)
 
+    # 100 us of slack: clock-alignment jitter between host and device planes
+    # can place a step's launch marginally before its marker begin.
+    eps = 1e-4
     begins: List[float] = []
     last_end = 0.0
     j = 0
     for hb in host_begins:
-        while j < len(lts) and lts[j] < max(hb, 0.0):
+        while j < len(lts) and lts[j] < max(hb, 0.0) - eps:
             j += 1
         if j >= len(lts):
             return None                    # fewer launches than markers

@@ -39,6 +39,7 @@ _SERIES_STYLE = {
     "tputrace": ("TPU HLO ops", "darkorchid"),
     "tpumodules": ("TPU modules", "mediumvioletred"),
     "tpuutil": ("TPU util", "crimson"),
+    "tpumon": ("TPU HBM", "firebrick"),
 }
 
 
@@ -109,6 +110,11 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     ingest("pystacks", _load_text, cfg.path("pystacks.txt"), parse_pystacks)
     ingest("nettrace", ingest_pcap, cfg.path("sofa.pcap"), time_base)
 
+    # --- live TPU runtime metrics (works even with --disable_xprof) -------
+    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
+
+    ingest("tpumon", ingest_tpumon, cfg.logdir, time_base)
+
     # --- TPU XPlane -------------------------------------------------------
     tpu_meta: Dict[str, Dict[str, float]] = {}
     try:
@@ -154,7 +160,8 @@ def build_series(cfg: SofaConfig, frames: Dict[str, pd.DataFrame]) -> List[SofaS
             continue
         y_axis = "event"
         kind = "scatter"
-        if key in ("mpstat", "vmstat", "diskstat", "netbandwidth", "tpuutil"):
+        if key in ("mpstat", "vmstat", "diskstat", "netbandwidth", "tpuutil",
+                   "tpumon"):
             kind = "line"
         base = df
         if key == "mpstat":

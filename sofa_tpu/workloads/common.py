@@ -108,7 +108,8 @@ def parse_workload_args(argv, defaults: Dict[str, object]):
     p = argparse.ArgumentParser()
     for k, v in defaults.items():
         if isinstance(v, bool):
-            p.add_argument(f"--{k}", action="store_true", default=v)
+            p.add_argument(f"--{k}", action=argparse.BooleanOptionalAction,
+                           default=v)
         else:
             p.add_argument(f"--{k}", type=type(v), default=v)
     return p.parse_args(argv)

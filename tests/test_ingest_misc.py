@@ -42,6 +42,51 @@ def test_parse_perf_script_clock_bridge():
     assert df.iloc[0]["timestamp"] == pytest.approx(0.5)
 
 
+# `perf record --call-graph` output: the header line carries no ip/sym; one
+# indented line per stack frame (leaf first) follows, then a blank line.
+PERF_CALLCHAIN_FIXTURE = """\
+python 1234/1234 [000] 100.500000: 1010101 cycles:
+\tffffffff81000000 do_syscall_64+0x20 ([kernel.kallsyms])
+\t00007f0000002000 __libc_read+0x10 (/usr/lib/libc.so.6)
+\t00007f0000001000 PyEval_EvalFrameDefault+0x1b3 (/usr/bin/python3.12)
+\t00007f0000000500 main+0x45 (/usr/bin/python3.12)
+\t00007f0000000400 __libc_start_main+0x80 (/usr/lib/libc.so.6)
+
+python 1234/1235 [001] 100.510000: 2020202 cycles:
+\t00007f0000001000 PyEval_EvalFrameDefault+0x1b3 (/usr/bin/python3.12)
+
+swapper 0/0 [000] 100.520000: 999 cycles: ffffffff81234567 flat_sample+0x1 ([kernel.kallsyms])
+"""
+
+
+def test_parse_perf_script_callchains():
+    df = parse_perf_script(PERF_CALLCHAIN_FIXTURE, time_base=100.0,
+                           mhz_at=lambda t: 1000.0)
+    # one row per SAMPLE, not per frame; the flat line still parses
+    assert len(df) == 3
+    row = df.iloc[0]
+    assert row["timestamp"] == pytest.approx(0.5)
+    # leaf frame provides ip / sym / dso
+    assert row["event"] == pytest.approx(
+        math.log10(int("ffffffff81000000", 16)))
+    assert row["name"].startswith("do_syscall_64")
+    assert "kernel.kallsyms" in row["name"]
+    # callers folded into the name, capped
+    assert "__libc_read" in row["name"]
+    assert "PyEval_EvalFrameDefault" in row["name"]
+    assert "__libc_start_main" not in row["name"]
+    # single-frame chain
+    assert df.iloc[1]["name"].startswith("PyEval_EvalFrameDefault")
+    # flat sample unaffected
+    assert df.iloc[2]["name"].startswith("flat_sample")
+
+
+def test_parse_perf_script_callchain_mixed_with_garbage():
+    text = PERF_CALLCHAIN_FIXTURE + "garbage\n" + PERF_SCRIPT_FIXTURE
+    df = parse_perf_script(text, time_base=100.0, mhz_at=lambda t: 1000.0)
+    assert len(df) == 6
+
+
 STRACE_FIXTURE = """\
 77 00:00:01.000000 openat(AT_FDCWD, "/etc/hosts", O_RDONLY) = 3 <0.000123>
 77 00:00:01.100000 clock_gettime(CLOCK_MONOTONIC, {...}) = 0 <0.000004>
@@ -117,6 +162,50 @@ def test_parse_pcap_sll():
 def test_parse_pcap_garbage():
     assert parse_pcap_bytes(b"not a pcap at all").empty
     assert parse_pcap_bytes(b"").empty
+
+
+TPUMON_FIXTURE = """\
+1700000001000000000 -1 0 0 0
+1700000001000000000 0 8000000000 16000000000 9000000000
+1700000001000000000 1 4000000000 16000000000 4000000000
+1700000002000000000 -1 0 0 0
+1700000002000000000 0 12000000000 16000000000 12500000000
+garbage
+1700000002000000000 9 1 2
+"""
+
+
+def test_parse_tpumon():
+    from sofa_tpu.ingest.tpumon_parse import parse_tpumon
+
+    df = parse_tpumon(TPUMON_FIXTURE, time_base=1700000000.0)
+    alive = df[df["name"] == "alive"]
+    assert len(alive) == 2
+    assert alive.iloc[0]["timestamp"] == pytest.approx(1.0)
+    used = df[df["name"] == "hbm_used_gb"]
+    assert len(used) == 3
+    dev0 = used[used["deviceId"] == 0]
+    assert dev0.iloc[0]["event"] == pytest.approx(8.0)
+    assert dev0.iloc[1]["event"] == pytest.approx(12.0)
+    occ = df[df["name"] == "hbm_occupancy"]
+    assert occ[occ["deviceId"] == 0].iloc[0]["event"] == pytest.approx(50.0)
+    # peak bytes ride payload
+    assert occ[occ["deviceId"] == 0].iloc[1]["payload"] == 12500000000
+
+
+def test_tpumon_profile_features():
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.analysis.tpu import tpumon_profile
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.ingest.tpumon_parse import parse_tpumon
+
+    frames = {"tpumon": parse_tpumon(TPUMON_FIXTURE, time_base=1700000000.0)}
+    feats = Features()
+    tpumon_profile(frames, SofaConfig(logdir="/tmp/unused/"), feats)
+    assert feats.get("tpumon_samples") == 2
+    assert feats.get("tpu0_hbm_used_max_gb") == pytest.approx(12.0)
+    assert feats.get("tpu0_hbm_occupancy_max") == pytest.approx(75.0)
+    assert feats.get("tpu0_hbm_peak_gb") == pytest.approx(12.5)
 
 
 def test_timebase_converter(tmp_path):
