@@ -90,16 +90,24 @@ def comm_profile(frames, cfg, features: Features) -> None:
 
 
 def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.DataFrame]:
-    """Estimate per-link ICI traffic from collective ops.
+    """Estimate per-link ICI traffic from collective ops, participant-aware.
 
-    Model: ring algorithm over devices ordered by topology coords.  For an
-    all-reduce of payload P over n chips, each chip sends ~2P(n-1)/n to its
-    ring neighbor (reduce-scatter + all-gather phases); all-gather/
-    reduce-scatter send P(n-1)/n; collective-permute and P2P send P along the
-    permute edge (approximated as the ring edge here — the permute pairs are
-    not in XPlane stats).  This replaces the reference's CUPTI P2P matrix
-    (sofa_common.py:97-157) with a model-based estimate, and feeds the mesh
-    advice pass.
+    Each collective op row is recorded *per device*; that device sends bytes
+    only to its successor within its replica group (ring algorithm over the
+    group, ordered by the torus snake order so consecutive participants are
+    ICI neighbors).  Group membership comes from the op's replica_groups
+    (parsed at ingest into the ``groups`` column); ops with no recorded
+    groups are booked against all devices.
+
+    Per-device send volume by kind (P = op payload, g = group size):
+      all-reduce          2 P (g-1)/g   (reduce-scatter + all-gather phases)
+      all-gather / r-s      P (g-1)/g
+      all-to-all            P/g to EACH other participant (direct edges)
+      permute/broadcast     P to the ring successor (true pairs not in stats)
+
+    This replaces the reference's CUPTI P2P matrix (sofa_common.py:97-157);
+    single-chip hardware has no ICI traffic, so the model is validated by the
+    analytic unit tests in tests/test_analyze.py rather than by counters.
     """
     if topo is None:
         return None
@@ -107,31 +115,99 @@ def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.
     n = len(devices)
     if n < 2 or coll is None or coll.empty:
         return None
-    order = sorted(devices, key=lambda d: (d.get("coords") or [d["id"]], d.get("core_on_chip", 0)))
+    from sofa_tpu.analysis.advice import _snake_key
+
+    order = sorted(
+        devices,
+        key=lambda d: (_snake_key(d.get("coords") or [d["id"]]),
+                       d.get("core_on_chip", 0)),
+    )
     ids = [d["id"] for d in order]
-    index = {d: i for i, d in enumerate(ids)}
+    pos = {d: i for i, d in enumerate(ids)}
+    all_ids = ids
     mat = np.zeros((n, n))
-    for _, row in coll.iterrows():
-        payload = float(row["payload"])
-        if payload <= 0:
+    # Aggregate payloads per (device, kind, groups) before booking: one
+    # matrix update per distinct collective shape, not per op instance.
+    agg = coll.groupby(["deviceId", "copyKind", "groups"])["payload"].sum()
+    for (dev, kind, groups_json), payload in agg.items():
+        payload = float(payload)
+        if payload <= 0 or dev not in pos:
             continue
-        kind = int(row["copyKind"])
+        groups: List[List[int]] = []
+        if groups_json:
+            try:
+                groups = json.loads(groups_json)
+            except ValueError:
+                groups = []
+        group = next((g for g in groups if dev in g), None)
+        if group is None:
+            group = all_ids
+        members = [d for d in ids if d in set(group) and d in pos]
+        g = len(members)
+        if g < 2:
+            continue
+        i = pos[dev]
+        kind = int(kind)
+        if kind == int(CopyKind.ALL_TO_ALL):
+            share = payload / g
+            for m in members:
+                if m != dev:
+                    mat[i, pos[m]] += share
+            continue
         if kind == int(CopyKind.ALL_REDUCE):
-            per_link = 2.0 * payload * (n - 1) / n
+            sent = 2.0 * payload * (g - 1) / g
         elif kind in (int(CopyKind.ALL_GATHER), int(CopyKind.REDUCE_SCATTER)):
-            per_link = payload * (n - 1) / n
-        elif kind == int(CopyKind.ALL_TO_ALL):
-            per_link = payload * (n - 1) / n
+            sent = payload * (g - 1) / g
         else:  # permute / broadcast / p2p
-            per_link = payload
-        # Every ring edge carries per_link bytes (each chip sends that much
-        # to its neighbor).
-        for i in range(n):
-            j = (i + 1) % n
-            mat[i, j] += per_link
+            sent = payload
+        succ = members[(members.index(dev) + 1) % g]
+        mat[i, pos[succ]] += sent
     labels = [f"tpu{d}" for d in ids]
-    _ = index
     return pd.DataFrame(mat, index=labels, columns=labels)
+
+
+def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
+    """Pearson correlation between host-network (DCN) tx bandwidth and TPU
+    step activity — the cluster question BASELINE config #5 asks ("is DCN
+    traffic gating the steps?").  Returns None when either signal is absent.
+
+    The reference correlates GPU util against net tx/rx inside
+    concurrency_breakdown (sofa_analyze.py:75-243); here it is computed per
+    host over a common time grid so cluster_analyze can tabulate it.
+    """
+    net = frames.get("netbandwidth")
+    dev = frames.get("tputrace")
+    if net is None or net.empty or dev is None or dev.empty:
+        return None
+    tx = net[net["name"].str.endswith(".tx")]
+    ops = dev[dev["category"] == 0]
+    if tx.empty or ops.empty:
+        return None
+    t0 = float(min(tx["timestamp"].min(), ops["timestamp"].min()))
+    t1 = float(max(tx["timestamp"].max(),
+                   (ops["timestamp"] + ops["duration"]).max()))
+    if t1 <= t0:
+        return None
+    edges = np.linspace(t0, t1, n_bins + 1)
+    # per-bin mean tx bandwidth
+    tx_bins = np.zeros(n_bins)
+    idx = np.clip(np.searchsorted(edges, tx["timestamp"].to_numpy()) - 1,
+                  0, n_bins - 1)
+    counts = np.zeros(n_bins)
+    np.add.at(tx_bins, idx, tx["event"].to_numpy(dtype=float))
+    np.add.at(counts, idx, 1)
+    tx_bins = np.divide(tx_bins, np.maximum(counts, 1))
+    # per-bin device busy time (op durations clipped into each bin)
+    starts = ops["timestamp"].to_numpy(dtype=float)
+    ends = starts + ops["duration"].to_numpy(dtype=float)
+    busy = np.zeros(n_bins)
+    for b in range(n_bins):
+        lo = np.clip(starts, edges[b], edges[b + 1])
+        hi = np.clip(ends, edges[b], edges[b + 1])
+        busy[b] = np.maximum(hi - lo, 0).sum()
+    if tx_bins.std() == 0 or busy.std() == 0:
+        return None
+    return float(np.corrcoef(tx_bins, busy)[0, 1])
 
 
 def net_profile(frames, cfg, features: Features) -> None:

@@ -94,6 +94,31 @@ def diskstat_profile(frames, cfg, features: Features) -> None:
     features.add("disk_total_bytes", float(total_bytes))
 
 
+def blktrace_latency_profile(frames, cfg, features: Features) -> None:
+    """Per-IO D->C latency quartiles + totals (the reference's btt-based
+    pass, sofa_analyze.py:596-638, computed from our own event pairing)."""
+    df = frames.get("blktrace")
+    if df is None or df.empty:
+        return
+    lat = df["duration"]
+    q = lat.quantile([0.25, 0.5, 0.75])
+    features.add("blktrace_ios", len(df))
+    features.add("blktrace_latency_q1", float(q.loc[0.25]))
+    features.add("blktrace_latency_median", float(q.loc[0.5]))
+    features.add("blktrace_latency_q3", float(q.loc[0.75]))
+    features.add("blktrace_latency_max", float(lat.max()))
+    features.add("blktrace_total_bytes", float(df["payload"].sum()))
+    reads = df[df["name"].str.startswith("blk_r")]
+    writes = df[df["name"].str.startswith("blk_w")]
+    features.add("blktrace_read_ios", len(reads))
+    features.add("blktrace_write_ios", len(writes))
+    span = float((df["timestamp"] + df["duration"]).max()
+                 - df["timestamp"].min())
+    if span > 0:
+        features.add("blktrace_iops", len(df) / span)
+        features.add("blktrace_bandwidth", float(df["payload"].sum()) / span)
+
+
 def strace_profile(frames, cfg, features: Features) -> None:
     df = frames.get("strace")
     if df is None or df.empty:

@@ -34,6 +34,15 @@ def tpu_profile(frames, cfg, features: Features) -> None:
     features.add("tpu_total_flops", float(sync["flops"].sum()))
     features.add("tpu_total_bytes_accessed", float(sync["bytes_accessed"].sum()))
 
+    # Training-phase split (reference bin/sofa:284-285 fw/bw kernel filters).
+    fw = float(sync.loc[sync["phase"] == "fw", "duration"].sum())
+    bw = float(sync.loc[sync["phase"] == "bw", "duration"].sum())
+    if fw > 0 or bw > 0:
+        features.add("tpu_fw_time", fw)
+        features.add("tpu_bw_time", bw)
+        if fw > 0:
+            features.add("tpu_bw_fw_ratio", bw / fw)
+
     # Top ops by total time (the reference's top-k GPU kernel table).
     top = (
         sync.groupby("name")

@@ -26,7 +26,7 @@ from sofa_tpu.trace import empty_frame, read_csv
 CSV_SOURCES = [
     "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
     "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
-    "tpumon",
+    "tpumon", "blktrace",
 ]
 
 _PASSES = [
@@ -35,6 +35,7 @@ _PASSES = [
     ("mpstat_profile", host.mpstat_profile),
     ("vmstat_profile", host.vmstat_profile),
     ("diskstat_profile", host.diskstat_profile),
+    ("blktrace_latency_profile", host.blktrace_latency_profile),
     ("strace_profile", host.strace_profile),
     ("pystacks_profile", host.pystacks_profile),
     ("netbandwidth_profile", comm.netbandwidth_profile),
@@ -63,8 +64,9 @@ def load_frames(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     return frames
 
 
-def sofa_analyze(cfg: SofaConfig) -> Features:
-    frames = load_frames(cfg)
+def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None) -> Features:
+    if frames is None:
+        frames = load_frames(cfg)
     features = Features()
     misc = read_misc(cfg)
     features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))
@@ -172,15 +174,26 @@ def stage_board(cfg: SofaConfig) -> None:
 
 
 def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
-    """Multi-host report: aggregate per-host logdirs ``<logdir>-<host>/``.
+    """Multi-host report: per-host analysis + ONE merged cross-host timeline.
 
-    Reference: cluster_analyze (sofa_analyze.py:1057-1137) — per-IP logdirs
-    merged into cluster tables.
+    Reference cluster_analyze (sofa_analyze.py:1057-1137) only aggregated
+    per-host feature tables; here each host's series are additionally shifted
+    onto a common clock (offset = that host's sofa_time.txt time base minus
+    the earliest host's) and written as a single merged report.js in the top
+    logdir, plus the DCN-traffic-vs-step correlation per host (BASELINE
+    config #5's question).
     """
     import copy as _copy
 
+    from sofa_tpu.analysis.comm import dcn_step_correlation
+    from sofa_tpu.preprocess import build_series, read_time_base
+    from sofa_tpu.trace import series_to_report_js
+
     results: Dict[str, Features] = {}
     rows = []
+    merged_series = []
+    host_frames: Dict[str, Dict[str, pd.DataFrame]] = {}
+    time_bases: Dict[str, float] = {}
     for hostname in cfg.cluster_hosts:
         host_cfg = _copy.deepcopy(cfg)
         host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
@@ -189,14 +202,54 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
             print_warning(f"cluster: missing logdir {host_cfg.logdir}")
             continue
         print_progress(f"cluster: analyzing {hostname}")
-        results[hostname] = sofa_analyze(host_cfg)
+        host_frames[hostname] = load_frames(host_cfg)
+        results[hostname] = sofa_analyze(host_cfg, host_frames[hostname])
+        time_bases[hostname] = read_time_base(host_cfg)
         row = {"host": hostname}
         for key in ("elapsed_time", "cpu_util", "tpu0_op_time", "comm_ratio",
                     "net_tx_total_bytes", "net_rx_total_bytes", "tc_util_mean"):
             value = results[hostname].get(key)
             if value is not None:
                 row[key] = value
+        corr = dcn_step_correlation(host_frames[hostname])
+        if corr is not None:
+            row["dcn_step_corr"] = round(corr, 4)
         rows.append(row)
+
+    if host_frames:
+        # Merged timeline: earliest host's time base is the cluster zero;
+        # every other host's series shift right by its clock offset.  A host
+        # whose sofa_time.txt is missing reads 0.0 — excluding it from the
+        # zero keeps one broken fetch from shifting every healthy host by
+        # an epoch.
+        known = [tb for tb in time_bases.values() if tb > 0]
+        tb0 = min(known) if known else 0.0
+        for hostname, frames in host_frames.items():
+            tb = time_bases[hostname]
+            shift = tb - tb0 if tb > 0 else 0.0
+            if tb <= 0:
+                print_warning(
+                    f"cluster: {hostname} has no sofa_time.txt — its series "
+                    "are not clock-aligned on the merged timeline")
+            host_cfg = _copy.deepcopy(cfg)
+            host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
+            for s in build_series(host_cfg, frames):
+                data = s.data.copy()
+                data["timestamp"] = data["timestamp"] + shift
+                s.data = data
+                s.name = f"{hostname}_{s.name}"
+                s.title = f"[{hostname}] {s.title}"
+                merged_series.append(s)
+        os.makedirs(cfg.logdir, exist_ok=True)
+        series_to_report_js(
+            merged_series, cfg.path("report.js"), cfg.viz_downsample_to,
+            {"cluster_hosts": list(host_frames), "time_base": tb0},
+        )
+        stage_board(cfg)
+        print_progress(
+            f"cluster: merged timeline of {len(host_frames)} hosts "
+            f"({len(merged_series)} series) -> {cfg.path('report.js')}")
+
     if rows:
         summary = pd.DataFrame(rows)
         os.makedirs(cfg.logdir, exist_ok=True)

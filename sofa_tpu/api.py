@@ -79,6 +79,7 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
         if tpumon_stop is not None:
             tpumon_stop.set()
         procmon.stop()
+        timebase.stop()  # end-of-run anchor enables the drift fit at ingest
         elapsed = time.time() - start
         with open(cfg.path("misc.txt"), "w") as f:
             f.write(f"elapsed_time {elapsed:.6f}\n")

@@ -172,8 +172,10 @@ def main(argv=None) -> int:
             if not cfg.command and cfg.pid is None:
                 print_error('record needs a command: sofa record "python train.py"')
                 return 2
-            from sofa_tpu.record import sofa_record
+            from sofa_tpu.record import cluster_record, sofa_record
             print_main_progress("SOFA record")
+            if cfg.cluster_hosts:
+                return cluster_record(cfg.command, cfg)
             return sofa_record(cfg.command, cfg)
         if cmd == "preprocess":
             from sofa_tpu.preprocess import sofa_preprocess

@@ -39,22 +39,34 @@ def python_timebase_samples(n: int = 3):
 class TimebaseCollector(Collector):
     name = "timebase"
 
-    def start(self) -> None:
-        cfg = self.cfg
-        cfg.time_base = time.time()
-        with open(cfg.path("sofa_time.txt"), "w") as f:
-            f.write(f"{cfg.time_base:.9f}\n")
+    def _sample_lines(self):
         tool = ensure_built("timebase")
-        lines = []
         if tool:
             try:
                 out = subprocess.run(
                     [tool, "3"], capture_output=True, text=True, timeout=10, check=True
                 ).stdout
                 lines = [ln for ln in out.splitlines() if ln.strip()]
+                if lines:
+                    return lines
             except (subprocess.SubprocessError, OSError):
-                lines = []
-        if not lines:
-            lines = [" ".join(str(v) for v in row) for row in python_timebase_samples()]
+                pass
+        return [" ".join(str(v) for v in row) for row in python_timebase_samples()]
+
+    def start(self) -> None:
+        cfg = self.cfg
+        cfg.time_base = time.time()
+        with open(cfg.path("sofa_time.txt"), "w") as f:
+            f.write(f"{cfg.time_base:.9f}\n")
         with open(cfg.path("timebase.txt"), "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write("\n".join(self._sample_lines()) + "\n")
+
+    def stop(self) -> None:
+        # Second anchor at record end: with samples at both ends of the run,
+        # realtime-vs-monotonic drift becomes observable and ingest can fit a
+        # slope instead of a bare offset (long runs, NTP slew).
+        try:
+            with open(self.cfg.path("timebase.txt"), "a") as f:
+                f.write("\n".join(self._sample_lines()) + "\n")
+        except OSError:
+            pass

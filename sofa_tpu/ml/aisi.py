@@ -217,6 +217,12 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
             row["collective_bytes"] = float(coll["payload"].sum())
             row["flops"] = float(ops["flops"].sum())
             row["bytes_accessed"] = float(ops["bytes_accessed"].sum())
+            # fw/bw split from the provenance-derived phase column (the
+            # reference's _fw_/_bw_ kernel-name split, sofa_aisi.py:34-36).
+            row["fw_time"] = float(
+                ops.loc[ops["phase"] == "fw", "duration"].sum())
+            row["bw_time"] = float(
+                ops.loc[ops["phase"] == "bw", "duration"].sum())
             copies = tputrace[
                 (tputrace["timestamp"] >= t0) & (tputrace["timestamp"] < t1)
                 & (tputrace["copyKind"].isin([int(CopyKind.H2D), int(CopyKind.D2H)]))

@@ -40,6 +40,7 @@ _SERIES_STYLE = {
     "tpumodules": ("TPU modules", "mediumvioletred"),
     "tpuutil": ("TPU util", "crimson"),
     "tpumon": ("TPU HBM", "firebrick"),
+    "blktrace": ("Block IO latency (ms)", "peru"),
 }
 
 
@@ -115,6 +116,11 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
 
     ingest("tpumon", ingest_tpumon, cfg.logdir, time_base)
 
+    # --- block IO latency (blkparse times are already trace-relative) -----
+    from sofa_tpu.ingest.blktrace_parse import ingest_blktrace
+
+    ingest("blktrace", ingest_blktrace, cfg.logdir, 0.0)
+
     # --- TPU XPlane -------------------------------------------------------
     tpu_meta: Dict[str, Dict[str, float]] = {}
     try:
@@ -181,7 +187,16 @@ def build_series(cfg: SofaConfig, frames: Dict[str, pd.DataFrame]) -> List[SofaS
             series.append(
                 SofaSeries(f"cpu_{filt.keyword}", f"CPU: {filt.keyword}", filt.color, sel)
             )
+    # fw/bw phase series — the board filter for training-phase attribution
+    # (reference default GPU filters _fw_/_bw_, bin/sofa:284-285).
     tputrace = frames.get("tputrace", empty_frame())
+    if not tputrace.empty and "phase" in tputrace.columns:
+        for phase, title, color in (("fw", "TPU forward", "mediumseagreen"),
+                                    ("bw", "TPU backward", "crimson")):
+            sel = tputrace[tputrace["phase"] == phase]
+            if not sel.empty:
+                series.append(
+                    SofaSeries(f"tpu_phase_{phase}", title, color, sel))
     for filt in cfg.tpu_filters:
         if tputrace.empty:
             break

@@ -83,6 +83,39 @@ def _clean_stale(cfg) -> None:
             print_warning(f"cannot clean {path}: {e}")
 
 
+def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
+    """Thread the profiling context through a `docker run` boundary.
+
+    The reference's docker mode introspects the image, relaunches it with the
+    logdir volume and profiles the cgroup from outside
+    (/root/reference/bin/sofa_record.py:362-399).  The TPU collectors are
+    *in-process* (sitecustomize injection), so the container instead gets:
+
+      -v <logdir>:<logdir>   same absolute path inside, so the injected
+                             sitecustomize and its output files resolve;
+      -e PYTHONPATH/-e SOFA_TPU_*  the injection env, re-exported explicitly
+                             because docker does not inherit the parent env.
+
+    Host-side samplers (procmon/vmstat/tcpdump) already see the container's
+    processes — same kernel.  Non-`docker run` commands pass through.
+    """
+    import re as _re
+    import shlex
+
+    m = _re.search(r"\bdocker\s+run\b", command)
+    if m is None:
+        return command
+    logdir = os.path.abspath(cfg.logdir)
+    extra = [f"-v {shlex.quote(f'{logdir}:{logdir}')}"]
+    for key in ("PYTHONPATH", "SOFA_TPU_XPROF_OPTS", "SOFA_TPU_TPUMON_HZ",
+                "SOFA_TPU_TPUMON_OUT", "SOFA_TPU_PYSTACKS_HZ",
+                "SOFA_TPU_PYSTACKS_OUT"):
+        if key in child_env:
+            extra.append(f"-e {shlex.quote(f'{key}={child_env[key]}')}")
+    insert_at = m.end()
+    return command[:insert_at] + " " + " ".join(extra) + command[insert_at:]
+
+
 def sofa_record(command: str, cfg) -> int:
     ensure_logdir(cfg.logdir)
     _clean_stale(cfg)
@@ -118,6 +151,7 @@ def sofa_record(command: str, cfg) -> int:
                 (c for c in started if isinstance(c, PerfCollector)), None)
             rc = _attach(cfg, cfg.pid, perf)
         else:
+            command = wrap_docker_command(command, cfg, child_env)
             argv = prefix + ["/bin/sh", "-c", command]
             print_progress(f"launching: {command}")
             t0 = time.time()
@@ -212,6 +246,109 @@ def _write_misc(cfg, elapsed: float, pid: int, rc: int) -> None:
         f.write(f"cores {cores}\n")
         f.write(f"pid {pid}\n")
         f.write(f"rc {rc}\n")
+
+
+def _record_flags(cfg) -> list:
+    """Re-materialize record-relevant config as CLI flags for per-host
+    launches (cluster_record must not silently reset hosts to defaults)."""
+    from sofa_tpu.config import SofaConfig
+
+    base = SofaConfig()
+    flags = []
+    if not cfg.enable_xprof:
+        flags.append("--disable_xprof")
+    if not cfg.enable_tpu_mon:
+        flags.append("--disable_tpu_mon")
+    valued = [
+        ("perf_events", "--perf_events"),
+        ("cpu_sample_rate", "--cpu_sample_rate"),
+        ("perf_call_graph", "--perf_call_graph"),
+        ("sys_mon_rate", "--sys_mon_rate"),
+        ("strace_min_time", "--strace_min_time"),
+        ("netstat_interface", "--netstat_interface"),
+        ("blkdev", "--blkdev"),
+        ("xprof_host_tracer_level", "--xprof_host_tracer_level"),
+        ("xprof_delay_s", "--xprof_delay_s"),
+        ("xprof_duration_s", "--xprof_duration_s"),
+        ("tpu_mon_rate", "--tpu_mon_rate"),
+    ]
+    for name, flag in valued:
+        v = getattr(cfg, name)
+        if v is not None and v != getattr(base, name):
+            flags += [flag, str(v)]
+    boolean = [
+        ("no_perf_events", "--no-perf-events"),
+        ("enable_strace", "--enable_strace"),
+        ("enable_py_stacks", "--enable_py_stacks"),
+        ("enable_tcpdump", "--enable_tcpdump"),
+        ("xprof_python_tracer", "--xprof_python_tracer"),
+        ("verbose", "--verbose"),
+    ]
+    for name, flag in boolean:
+        if getattr(cfg, name) and not getattr(base, name):
+            flags.append(flag)
+    return flags
+
+
+def cluster_record(command: str, cfg) -> int:
+    """One `sofa record` spanning N hosts (SURVEY §7: the reference never
+    solved this — per-IP logdirs were collected out-of-band, bin/sofa:358-367).
+
+    Per host in --cluster_hosts, recording runs concurrently:
+      localhost/127.0.0.1 — a local `sofa record` subprocess;
+      anything else       — `ssh <host> sofa record ...` into a remote temp
+                            logdir, rsync'd/scp'd back afterwards.
+    Each host lands in ``<logdir>-<host>/`` with its own sofa_time.txt, which
+    cluster_analyze uses to align the merged timeline.  Returns the max child
+    rc so CI sees any host's workload failure.
+    """
+    import shlex
+    import sys
+
+    flags = _record_flags(cfg)
+    launches = []
+    for host in cfg.cluster_hosts:
+        host_logdir = cfg.logdir.rstrip("/") + f"-{host}/"
+        if host in ("localhost", "127.0.0.1"):
+            argv = [sys.executable, "-m", "sofa_tpu", "record", command,
+                    "--logdir", host_logdir] + flags
+            remote_dir = None
+        else:
+            remote_dir = f"/tmp/sofa_tpu_record_{os.getpid()}/"
+            remote = " ".join(
+                ["sofa", "record", shlex.quote(command),
+                 "--logdir", shlex.quote(remote_dir)]
+                + [shlex.quote(f) for f in flags])
+            argv = ["ssh", "-o", "BatchMode=yes", host, remote]
+        print_progress(f"cluster: recording on {host}")
+        try:
+            proc = subprocess.Popen(argv)
+        except OSError as e:
+            print_error(f"cluster: cannot launch on {host}: {e}")
+            return 1
+        launches.append((host, proc, host_logdir, remote_dir))
+
+    rc = 0
+    for host, proc, host_logdir, remote_dir in launches:
+        host_rc = proc.wait()
+        rc = max(rc, host_rc)
+        if host_rc != 0:
+            print_warning(f"cluster: {host} record exited rc={host_rc}")
+        if remote_dir is not None:
+            ensure_logdir(host_logdir)
+            fetch = subprocess.run(
+                ["scp", "-q", "-r", "-o", "BatchMode=yes",
+                 f"{host}:{remote_dir.rstrip('/')}/.", host_logdir],
+            )
+            if fetch.returncode != 0:
+                print_warning(f"cluster: could not fetch logs from {host}")
+            subprocess.run(
+                ["ssh", "-o", "BatchMode=yes", host, f"rm -rf {remote_dir}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+    print_progress(
+        f"cluster: recorded {len(launches)} hosts into {cfg.logdir}-<host>/")
+    return rc
 
 
 def sofa_clean(cfg) -> None:

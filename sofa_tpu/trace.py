@@ -34,6 +34,11 @@ Extension columns (TPU build):
   module        str   enclosing XLA module (jit function) name
   flops         float XLA-reported flop count for the op
   bytes_accessed float XLA-reported memory traffic for the op
+  groups        str   JSON replica groups "[[0,1],[2,3]]" for collective ops
+                      (participants of the collective; "" when unknown)
+  phase         str   training-phase attribution: "fw" | "bw" | "" (unknown),
+                      derived from the op's JAX provenance path (transpose(jvp)
+                      marks the backward pass)
 """
 
 from __future__ import annotations
@@ -63,7 +68,8 @@ BASE_COLUMNS = [
     "category",
 ]
 
-EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops", "bytes_accessed"]
+EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops",
+                 "bytes_accessed", "groups", "phase"]
 
 COLUMNS = BASE_COLUMNS + EXTRA_COLUMNS
 
@@ -86,6 +92,8 @@ _DEFAULTS = {
     "module": "",
     "flops": 0.0,
     "bytes_accessed": 0.0,
+    "groups": "",
+    "phase": "",
 }
 
 

@@ -48,18 +48,73 @@ def test_tpu_profile_and_comm(cfg):
 
 
 def test_ici_matrix_ring_model():
+    # One op row per participating device, as XPlane records collectives.
     coll = make_frame([
-        {"timestamp": 0.0, "duration": 1e-3, "copyKind": int(CopyKind.ALL_REDUCE),
-         "payload": 8_000_000, "name": "all-reduce.0"},
+        {"timestamp": 0.0, "duration": 1e-3,
+         "copyKind": int(CopyKind.ALL_REDUCE), "deviceId": i,
+         "payload": 8_000_000, "name": "all-reduce.0"}
+        for i in range(4)
     ])
     topo = {"devices": [{"id": i, "coords": [i, 0, 0]} for i in range(4)]}
     mat = comm.ici_traffic_matrix(coll, topo)
     assert mat is not None
-    # all-reduce of 8 MB over 4 chips: each of the 4 ring edges carries
-    # 2*P*(n-1)/n = 12 MB.
+    # all-reduce of 8 MB over 4 chips: each chip sends 2*P*(n-1)/n = 12 MB
+    # to its ring successor -> 4 directed edges of 12 MB.
     assert mat.to_numpy().max() == pytest.approx(12e6)
     assert mat.to_numpy().sum() == pytest.approx(48e6)
+    assert (mat.to_numpy() > 0).sum() == 4
     assert comm.ici_traffic_matrix(coll, None) is None
+
+
+def test_ici_matrix_respects_replica_groups():
+    """Round-1 verdict: a 2-chip-axis all-reduce on a larger mesh must NOT be
+    booked as full-ring traffic on every edge."""
+    groups = '[[0, 1], [2, 3]]'
+    coll = make_frame([
+        {"timestamp": 0.0, "duration": 1e-3,
+         "copyKind": int(CopyKind.ALL_REDUCE), "deviceId": i,
+         "payload": 4_000_000, "name": "all-reduce.0", "groups": groups}
+        for i in range(4)
+    ])
+    topo = {"devices": [{"id": i, "coords": [i, 0, 0]} for i in range(4)]}
+    mat = comm.ici_traffic_matrix(coll, topo).to_numpy()
+    # pairwise all-reduce: each device sends 2*P*(2-1)/2 = P to its partner
+    assert mat[0, 1] == pytest.approx(4e6)
+    assert mat[1, 0] == pytest.approx(4e6)
+    assert mat[2, 3] == pytest.approx(4e6)
+    assert mat[3, 2] == pytest.approx(4e6)
+    # no traffic crosses the group boundary
+    assert mat[1, 2] == 0 and mat[0, 2] == 0 and mat[0, 3] == 0
+    assert mat.sum() == pytest.approx(16e6)
+
+
+def test_ici_matrix_all_to_all_direct_edges():
+    coll = make_frame([
+        {"timestamp": 0.0, "duration": 1e-3,
+         "copyKind": int(CopyKind.ALL_TO_ALL), "deviceId": i,
+         "payload": 4_000_000, "name": "all-to-all.0",
+         "groups": "[[0, 1, 2, 3]]"}
+        for i in range(4)
+    ])
+    topo = {"devices": [{"id": i, "coords": [i, 0, 0]} for i in range(4)]}
+    mat = comm.ici_traffic_matrix(coll, topo).to_numpy()
+    # each device sends P/g = 1 MB to each of the 3 others
+    assert mat[0, 1] == pytest.approx(1e6)
+    assert mat[0, 3] == pytest.approx(1e6)
+    assert mat.sum() == pytest.approx(12e6)
+    assert (mat > 0).sum() == 12  # full bipartite minus diagonal
+
+
+def test_parse_replica_groups():
+    from sofa_tpu.ingest.xplane import parse_replica_groups
+
+    assert parse_replica_groups("replica_groups={{0,2},{1,3}}") == [[0, 2], [1, 3]]
+    assert parse_replica_groups("replica_groups=[2,4]<=[8]") == [
+        [0, 1, 2, 3], [4, 5, 6, 7]]
+    # iota with transpose: arange(8).reshape(2,2,2).transpose(0,2,1).ravel()
+    assert parse_replica_groups("replica_groups=[4,2]<=[2,2,2]T(0,2,1)") == [
+        [0, 2], [1, 3], [4, 6], [5, 7]]
+    assert parse_replica_groups("no groups here") is None
 
 
 def test_spotlight_roi(cfg):
@@ -166,3 +221,91 @@ def test_cluster_analyze(tmp_path):
     summary = pd.read_csv(cfg.path("cluster_summary.csv"))
     assert list(summary["host"]) == hosts
     assert (summary["elapsed_time"] >= 0.2).all()
+
+
+def test_cluster_merged_timeline_aligns_skewed_clocks(tmp_path):
+    """Two fake host logdirs whose clocks differ by 5 s must land on one
+    merged timeline with the late host's series shifted right by 5 s."""
+    import json
+
+    from sofa_tpu.analyze import cluster_analyze
+    from sofa_tpu.trace import make_frame, write_csv
+
+    base = str(tmp_path / "clog")
+    skews = {"hostA": 0.0, "hostB": 5.0}
+    t0 = 1_700_000_000.0
+    for host, skew in skews.items():
+        d = f"{base}-{host}/"
+        os.makedirs(d)
+        with open(d + "sofa_time.txt", "w") as f:
+            f.write(f"{t0 + skew}\n")
+        with open(d + "misc.txt", "w") as f:
+            f.write("elapsed_time 2.0\ncores 4\npid 1\nrc 0\n")
+        # one op at local t=1.0 on each host
+        frame = make_frame([
+            {"timestamp": 1.0, "duration": 0.5, "deviceId": 0,
+             "name": f"op_{host}", "device_kind": "tpu", "category": 0},
+        ])
+        write_csv(frame, d + "tputrace.csv")
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=list(skews))
+    cluster_analyze(cfg)
+    assert os.path.isfile(cfg.path("report.js"))
+    doc = json.loads(
+        open(cfg.path("report.js")).read()[len("sofa_traces = "):].rstrip(";\n"))
+    by_name = {s["name"]: s for s in doc["series"]}
+    xa = by_name["hostA_tputrace"]["data"][0]["x"]
+    xb = by_name["hostB_tputrace"]["data"][0]["x"]
+    assert xb - xa == pytest.approx(5.0)
+    assert doc["meta"]["cluster_hosts"] == list(skews)
+    assert os.path.isfile(cfg.path("index.html"))  # board staged for viz
+
+
+def test_cluster_record_localhost(tmp_path):
+    from sofa_tpu.record import cluster_record
+
+    base = str(tmp_path / "crec")
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=["localhost"],
+                     enable_xprof=False, enable_tpu_mon=False)
+    rc = cluster_record("sleep 0.2", cfg)
+    assert rc == 0
+    assert os.path.isfile(f"{base}-localhost/misc.txt")
+    assert os.path.isfile(f"{base}-localhost/sofa_time.txt")
+    # non-default config reached the per-host subprocess: xprof + tpumon off
+    # means no injection dir was staged
+    assert not os.path.isdir(f"{base}-localhost/_inject")
+
+
+def test_record_flags_roundtrip():
+    from sofa_tpu.record import _record_flags
+
+    cfg = SofaConfig(enable_xprof=False, tpu_mon_rate=7, sys_mon_rate=25,
+                     enable_tcpdump=True, perf_call_graph="fp")
+    flags = _record_flags(cfg)
+    assert "--disable_xprof" in flags
+    assert "--enable_tcpdump" in flags
+    i = flags.index("--tpu_mon_rate")
+    assert flags[i + 1] == "7"
+    assert flags[flags.index("--sys_mon_rate") + 1] == "25"
+    assert flags[flags.index("--perf_call_graph") + 1] == "fp"
+    # defaults produce no flags
+    assert _record_flags(SofaConfig()) == []
+
+
+def test_dcn_step_correlation():
+    import numpy as np
+
+    from sofa_tpu.analysis.comm import dcn_step_correlation
+    from sofa_tpu.trace import make_frame
+
+    # device busy in bursts; tx bandwidth tracks the bursts exactly
+    ops, net = [], []
+    for i in range(16):
+        busy = 0.4 if i % 2 == 0 else 0.05
+        ops.append({"timestamp": float(i), "duration": busy, "deviceId": 0,
+                    "name": "step", "category": 0, "device_kind": "tpu"})
+        net.append({"timestamp": float(i) + 0.25, "event": busy * 1e9,
+                    "name": "eth0.tx", "device_kind": "net"})
+    frames = {"tputrace": make_frame(ops), "netbandwidth": make_frame(net)}
+    corr = dcn_step_correlation(frames, n_bins=16)
+    assert corr is not None and corr > 0.8
+    assert dcn_step_correlation({"tputrace": make_frame(ops)}) is None

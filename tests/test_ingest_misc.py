@@ -208,6 +208,57 @@ def test_tpumon_profile_features():
     assert feats.get("tpu0_hbm_peak_gb") == pytest.approx(12.5)
 
 
+BLKTRACE_FIXTURE = """\
+  8,0    3        1     0.000100000  1234  D   W 123456 + 8 [python]
+  8,0    3        2     0.000500000  1234  D   R 999000 + 64 [python]
+  8,0    1        3     0.002100000     0  C   W 123456 + 8 [0]
+  8,0    1        4     0.010500000     0  C   R 999000 + 64 [0]
+  8,0    3        5     0.020000000  1234  D   W 555000 + 16 [python]
+  8,0    3        6     0.021000000  1234  Q   W 777000 + 8 [python]
+  8,0    2        7     0.030000000  1234  D  RA 2048 + 256 [python]
+  8,0    2        8     0.031000000     0  C  RA 2048 + 256 [0]
+CPU0 (8,0):
+ Reads Queued:           1,        32KiB
+"""
+
+
+def test_parse_blktrace():
+    from sofa_tpu.ingest.blktrace_parse import parse_blktrace
+
+    df = parse_blktrace(BLKTRACE_FIXTURE)
+    # three completed IOs (incl. the RA readahead); the unmatched D and the
+    # Q/summary lines are dropped
+    assert len(df) == 3
+    ra = df[df["name"].str.startswith("blk_ra")].iloc[0]
+    assert ra["duration"] == pytest.approx(0.001)
+    assert ra["payload"] == 256 * 512
+    w = df[df["name"].str.startswith("blk_w")].iloc[0]
+    assert w["timestamp"] == pytest.approx(0.0001)
+    assert w["duration"] == pytest.approx(0.002)      # D->C latency
+    assert w["event"] == pytest.approx(2.0)           # ms
+    assert w["payload"] == 8 * 512
+    assert w["pid"] == 1234
+    r = df[df["name"].str.startswith("blk_r")].iloc[0]
+    assert r["duration"] == pytest.approx(0.01)
+    assert r["payload"] == 64 * 512
+
+
+def test_blktrace_latency_profile():
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.analysis.host import blktrace_latency_profile
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.ingest.blktrace_parse import parse_blktrace
+
+    frames = {"blktrace": parse_blktrace(BLKTRACE_FIXTURE)}
+    feats = Features()
+    blktrace_latency_profile(frames, SofaConfig(logdir="/tmp/unused/"), feats)
+    assert feats.get("blktrace_ios") == 3
+    assert feats.get("blktrace_read_ios") == 2   # plain read + readahead
+    assert feats.get("blktrace_write_ios") == 1
+    assert feats.get("blktrace_latency_max") == pytest.approx(0.01)
+    assert feats.get("blktrace_total_bytes") == (8 + 64 + 256) * 512
+
+
 def test_timebase_converter(tmp_path):
     p = tmp_path / "timebase.txt"
     # realtime = monotonic + 1e9 ns exactly
@@ -216,3 +267,22 @@ def test_timebase_converter(tmp_path):
     f = converter(str(p), "monotonic")
     assert f(1.0) == pytest.approx(2.0)
     assert converter(str(tmp_path / "missing.txt")) is None
+
+
+def test_timebase_converter_fits_drift(tmp_path):
+    """Samples at record start AND end let the converter model drift: here
+    realtime gains 100 us/s on monotonic (1e-4 drift, NTP-slew scale)."""
+    p = tmp_path / "timebase.txt"
+    rows = []
+    for mono_s in (0.0, 0.001, 100.0, 100.001):  # two anchors 100 s apart
+        mono = int(1_000_000_000 + mono_s * 1e9)
+        real = int(2_000_000_000 + mono_s * 1e9 * 1.0001)
+        rows.append(f"{real} {mono} 0 0")
+    p.write_text("\n".join(rows) + "\n")
+    f = converter(str(p), "monotonic")
+    # mid-run, the drift term matters: offset-only would be off by ~5 ms at
+    # the edges.  f(1+51) -> real at mono_s=51 = 2 + 51*1.0001
+    assert f(1.0 + 51.0) == pytest.approx(2.0 + 51.0 * 1.0001, abs=2e-5)
+    # edge points reproduce exactly
+    assert f(1.0) == pytest.approx(2.0, abs=2e-5)
+    assert f(101.0) == pytest.approx(2.0 + 100.0 * 1.0001, abs=2e-5)

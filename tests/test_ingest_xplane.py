@@ -80,11 +80,20 @@ def build_xspace():
     oline.name = "XLA Ops"
     _add_event(dev, oline, "%fusion.1 = ...", 2_100_000, 400_000, "fusion.1",
                stats=[("hlo_category", "convolution"), ("flops", 8_000_000),
-                      ("bytes_accessed", 1_000_000)])
+                      ("bytes_accessed", 1_000_000),
+                      ("tf_op", "jit(train_step)/jvp(main)/conv_general")])
     _add_event(dev, oline, "%all-reduce.2 = ...", 2_600_000, 200_000,
                "all-reduce.2",
                stats=[("hlo_category", "all-reduce"),
-                      ("bytes_accessed", 4_000_000)])
+                      ("bytes_accessed", 4_000_000),
+                      ("long_name",
+                       "%all-reduce.2 = f32[] all-reduce(...), "
+                       "replica_groups={{0,1},{2,3}}, to_apply=%add")])
+    _add_event(dev, oline, "%fusion.3 = ...", 2_850_000, 100_000, "fusion.3",
+               stats=[("hlo_category", "fusion"), ("flops", 2_000_000),
+                      ("bytes_accessed", 500_000),
+                      ("tf_op",
+                       "jit(train_step)/transpose(jvp(main))/dot_general")])
     return xs
 
 
@@ -101,7 +110,7 @@ def test_xspace_to_frames_alignment_and_stats():
     xs = build_xspace()
     frames = xspace_to_frames(xs, TIME_BASE)
     ops = frames["tputrace"]
-    assert len(ops) == 2
+    assert len(ops) == 3
     fusion = ops[ops["name"] == "fusion.1"].iloc[0]
     # marker at session 1 ms == unix marker time == time_base + 10 s;
     # fusion starts at session 2.1 ms -> 10.0011 s after time_base.
@@ -116,6 +125,14 @@ def test_xspace_to_frames_alignment_and_stats():
     assert ar["copyKind"] == int(CopyKind.ALL_REDUCE)
     assert ar["payload"] == 4_000_000
     assert ar["bandwidth"] == pytest.approx(4_000_000 / 200e-6)
+    # replica groups parsed from the HLO long name into the groups column
+    import json
+
+    assert json.loads(ar["groups"]) == [[0, 1], [2, 3]]
+
+    # fw/bw phase from the JAX provenance path (transpose(jvp) => backward)
+    assert fusion["phase"] == "fw"
+    assert ops[ops["name"] == "fusion.3"].iloc[0]["phase"] == "bw"
 
     mods = frames["tpumodules"]
     assert mods.iloc[0]["name"] == "jit_train_step"
@@ -146,10 +163,10 @@ def test_tpu_utilization_windows():
                            device_meta=frames["_meta"])
     tc = util[util["name"] == "tc_util"]
     assert not tc.empty
-    # ops cover 600 us of a 1 ms window -> 60 %
-    assert tc["event"].max() == pytest.approx(60.0, rel=0.05)
+    # ops cover 700 us of a 1 ms window -> 70 %
+    assert tc["event"].max() == pytest.approx(70.0, rel=0.05)
     mxu = util[util["name"] == "mxu_util"]
-    # 8 MFLOP in 1 ms = 8 GFLOP/s of a 100 TFLOP/s peak = 0.008 %
-    assert mxu["event"].max() == pytest.approx(0.008, rel=0.05)
+    # 10 MFLOP in 1 ms = 10 GFLOP/s of a 100 TFLOP/s peak = 0.01 %
+    assert mxu["event"].max() == pytest.approx(0.01, rel=0.05)
     hbm = util[util["name"] == "hbm_gbps"]
-    assert hbm["event"].max() == pytest.approx(5e6 / 1e-3 / 1e9, rel=0.05)
+    assert hbm["event"].max() == pytest.approx(5.5e6 / 1e-3 / 1e9, rel=0.05)

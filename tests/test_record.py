@@ -140,6 +140,41 @@ def test_real_perf_end_to_end(logdir):
     assert (df["duration"] > 0).all()
 
 
+def test_wrap_docker_command(logdir):
+    from sofa_tpu.record import wrap_docker_command
+
+    cfg = SofaConfig(logdir=logdir)
+    env = {"PYTHONPATH": cfg.inject_dir,
+           "SOFA_TPU_XPROF_OPTS": '{"enable": true}'}
+    out = wrap_docker_command(
+        "docker run --rm myimage python train.py", cfg, env)
+    logdir_abs = os.path.abspath(cfg.logdir)
+    assert out.startswith("docker run -v ")
+    assert f"{logdir_abs}:{logdir_abs}" in out
+    assert "PYTHONPATH=" in out and "SOFA_TPU_XPROF_OPTS=" in out
+    assert out.endswith("--rm myimage python train.py")
+    # non-docker commands pass through untouched
+    assert wrap_docker_command("python train.py", cfg, env) == "python train.py"
+
+
+def test_edr_trigger_fires(tmp_path):
+    from sofa_tpu.tools.edr import run_edr
+
+    log = tmp_path / "train.log"
+    log.write_text("setup...\nstarting epoch 1\n")
+    base = str(tmp_path / "edrlog")
+    rc = run_edr([
+        "--log", str(log),
+        "--trigger", "starting epoch=epoch",
+        "--record_seconds", "0.2",
+        "--logdir", base + "/",
+        "--poll_s", "0.1",
+        "--timeout_s", "60",
+    ])
+    assert rc == 0
+    assert os.path.isfile(f"{base}-epoch/misc.txt")
+
+
 def test_sofa_clean_keeps_raw(logdir):
     cfg = SofaConfig(logdir=logdir, enable_xprof=False)
     sofa_record("true", cfg)

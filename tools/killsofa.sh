@@ -1,0 +1,9 @@
+#!/bin/bash
+# Kill every sofa_tpu process and its collector children (reference
+# tools/killsofa.sh).  Safe to run repeatedly.
+pkill -f "sofa record" || true
+pkill -f "sofa_tpu.*record" || true
+pkill -f "sofa-edr" || true
+pkill tcpdump || true
+pkill blktrace || true
+echo "sofa_tpu processes killed"

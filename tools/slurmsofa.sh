@@ -1,0 +1,9 @@
+#!/bin/bash
+# SLURM wrapper: profile a job step under sofa_tpu (reference
+# tools/slurmsofa.sh).  Usage inside a batch script:
+#   srun tools/slurmsofa.sh python train.py --flags
+# Per-task logdirs keyed by node + proc id so a multi-task step never
+# collides; merge afterwards with `sofa report --cluster_hosts ...`.
+set -euo pipefail
+LOGDIR="${SOFA_LOGDIR:-sofalog}-${SLURMD_NODENAME:-$(hostname)}-${SLURM_PROCID:-0}/"
+exec sofa record "$*" --logdir "$LOGDIR"
