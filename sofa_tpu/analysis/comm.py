@@ -125,12 +125,29 @@ def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.
     ids = [d["id"] for d in order]
     pos = {d: i for i, d in enumerate(ids)}
     all_ids = ids
+
+    # Trace rows carry XPlane-local ordinals encoded as host*256+local
+    # (ingest/xplane.py device_id_base); topology and replica groups use
+    # GLOBAL jax device ids.  Translate via per-process id lists so
+    # multi-host traffic lands on the right chips.
+    by_proc: Dict[int, List[int]] = {}
+    for d in sorted(devices, key=lambda d: d["id"]):
+        by_proc.setdefault(int(d.get("process_index", 0)), []).append(d["id"])
+
+    def to_global(dev: int) -> int:
+        host, local = divmod(int(dev), 256)
+        proc_ids = by_proc.get(host)
+        if proc_ids and local < len(proc_ids):
+            return proc_ids[local]
+        return int(dev)
+
     mat = np.zeros((n, n))
     # Aggregate payloads per (device, kind, groups) before booking: one
     # matrix update per distinct collective shape, not per op instance.
     agg = coll.groupby(["deviceId", "copyKind", "groups"])["payload"].sum()
     for (dev, kind, groups_json), payload in agg.items():
         payload = float(payload)
+        dev = to_global(dev)
         if payload <= 0 or dev not in pos:
             continue
         groups: List[List[int]] = []

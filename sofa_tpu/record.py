@@ -102,7 +102,11 @@ def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
     import re as _re
     import shlex
 
-    m = _re.search(r"\bdocker\s+run\b", command)
+    # Anchor to an actual docker-run invocation (optionally preceded by env
+    # assignments) — "docker run" appearing inside a quoted argument of some
+    # other command must not trigger the rewrite.
+    m = _re.match(r"^\s*(?:[A-Za-z_][A-Za-z0-9_]*=\S*\s+)*(?:sudo\s+)?"
+                  r"docker\s+run\b", command)
     if m is None:
         return command
     logdir = os.path.abspath(cfg.logdir)
@@ -331,6 +335,8 @@ def cluster_record(command: str, cfg) -> int:
     rc = 0
     for host, proc, host_logdir, remote_dir in launches:
         host_rc = proc.wait()
+        if host_rc < 0:  # killed by signal: fold to the shell convention
+            host_rc = 128 - host_rc
         rc = max(rc, host_rc)
         if host_rc != 0:
             print_warning(f"cluster: {host} record exited rc={host_rc}")

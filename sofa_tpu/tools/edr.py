@@ -29,7 +29,12 @@ def parse_trigger(spec: str):
 
 
 def tail_lines(path: str, pos: int):
-    """Read new complete lines past byte offset pos; returns (lines, newpos)."""
+    """Read new complete lines past byte offset pos; returns (lines, newpos).
+
+    The file is read in binary and the offset tracked in raw bytes — decoding
+    first would mis-count whenever the log contains non-UTF-8 bytes (each
+    becomes a 3-byte U+FFFD) and skip real content.
+    """
     try:
         size = os.path.getsize(path)
     except OSError:
@@ -38,15 +43,14 @@ def tail_lines(path: str, pos: int):
         pos = 0
     if size == pos:
         return [], pos
-    with open(path, "r", errors="replace") as f:
+    with open(path, "rb") as f:
         f.seek(pos)
         chunk = f.read()
-    if not chunk.endswith("\n"):
-        last_nl = chunk.rfind("\n")
-        if last_nl < 0:
-            return [], pos
-        chunk = chunk[: last_nl + 1]
-    return chunk.splitlines(), pos + len(chunk.encode(errors="replace"))
+    last_nl = chunk.rfind(b"\n")
+    if last_nl < 0:
+        return [], pos
+    chunk = chunk[: last_nl + 1]
+    return chunk.decode(errors="replace").splitlines(), pos + len(chunk)
 
 
 def run_edr(argv=None) -> int:

@@ -105,6 +105,35 @@ def test_ici_matrix_all_to_all_direct_edges():
     assert (mat > 0).sum() == 12  # full bipartite minus diagonal
 
 
+def test_ici_matrix_multihost_id_translation():
+    """XPlane rows carry host*256+local ordinals; topology and replica
+    groups carry global jax ids — traffic must land on the right chips."""
+    # 2 hosts x 2 chips: global ids 0,1 on process 0 and 2,3 on process 1
+    topo = {"devices": [
+        {"id": 0, "process_index": 0, "coords": [0, 0, 0]},
+        {"id": 1, "process_index": 0, "coords": [1, 0, 0]},
+        {"id": 2, "process_index": 1, "coords": [0, 1, 0]},
+        {"id": 3, "process_index": 1, "coords": [1, 1, 0]},
+    ]}
+    groups = "[[2, 3]]"  # an all-reduce among host 1's chips only
+    coll = make_frame([
+        {"timestamp": 0.0, "duration": 1e-3,
+         "copyKind": int(CopyKind.ALL_REDUCE),
+         "deviceId": 256 + local,       # host_index 1 encoding from ingest
+         "payload": 2_000_000, "name": "all-reduce.0", "groups": groups}
+        for local in (0, 1)
+    ])
+    mat = comm.ici_traffic_matrix(coll, topo)
+    arr = mat.to_numpy()
+    i2 = list(mat.index).index("tpu2")
+    i3 = list(mat.index).index("tpu3")
+    assert arr[i2, i3] == pytest.approx(2e6)   # 2P(g-1)/g with g=2 -> P
+    assert arr[i3, i2] == pytest.approx(2e6)
+    # host 0's chips saw nothing
+    i0 = list(mat.index).index("tpu0")
+    assert arr[i0].sum() == 0 and arr[:, i0].sum() == 0
+
+
 def test_parse_replica_groups():
     from sofa_tpu.ingest.xplane import parse_replica_groups
 

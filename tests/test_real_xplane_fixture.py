@@ -1,0 +1,64 @@
+"""Ingest tests against a REAL jax.profiler capture (tests/fixtures/).
+
+Round-1 verdict: every XPlane test built its own protos, so plane-name and
+stat-name assumptions were validated circularly.  The checked-in fixture is a
+genuine `jax.profiler.start_trace` XSpace (CPU backend host plane, trimmed to
+the marker + step annotations + a sample of runtime events); the TPU device
+planes still need a real-chip capture, but the proto layout, marker
+resolution, and host-plane semantics here come from the real profiler.
+"""
+
+import os
+
+import pytest
+
+from sofa_tpu.ingest.xplane import (
+    find_marker_offset_ns,
+    load_xspace,
+    xspace_to_frames,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "cpu_host.xplane.pb")
+
+
+@pytest.fixture(scope="module")
+def xspace():
+    return load_xspace(FIXTURE)
+
+
+def test_real_capture_marker_resolves(xspace):
+    off = find_marker_offset_ns(xspace)
+    assert off is not None
+    # offset = unix_ns - session_ns must be epoch-scale (the session clock
+    # starts near zero or at boottime, both far below unix time)
+    assert 1e18 < off < 3e18
+
+
+def test_real_capture_host_plane_ingests(xspace):
+    off = find_marker_offset_ns(xspace)
+    time_base = (off or 0) / 1e9  # pretend record started at marker time
+    frames = xspace_to_frames(xspace, time_base)
+    host = frames["hosttrace"]
+    assert not host.empty
+    # step annotations from the profiled loop survive ingest...
+    names = set(host["name"])
+    assert {"sofa_step_0", "sofa_step_1", "sofa_step_2"} <= names
+    # ...the marker annotation itself is excluded
+    assert not any("sofa_timebase_marker" in n for n in names)
+    # timestamps are marker-aligned: everything lands within seconds of it
+    assert host["timestamp"].abs().max() < 60.0
+    # thread lanes are small ordinals, not hashes
+    assert host["event"].max() < len(set(host["tid"]))
+
+
+def test_real_capture_drives_marker_iterations(xspace):
+    from sofa_tpu.ml.aisi import _iterations_from_markers
+
+    off = find_marker_offset_ns(xspace)
+    frames = xspace_to_frames(xspace, (off or 0) / 1e9)
+    out = _iterations_from_markers(frames)
+    assert out is not None
+    begins, ends = out
+    assert len(begins) == 3
+    assert all(e > b for b, e in zip(begins, ends))

@@ -155,6 +155,13 @@ def test_wrap_docker_command(logdir):
     assert out.endswith("--rm myimage python train.py")
     # non-docker commands pass through untouched
     assert wrap_docker_command("python train.py", cfg, env) == "python train.py"
+    # "docker run" inside an argument is NOT an invocation
+    for cmd in ("grep 'docker run' notes.txt",
+                "echo docker run done && python train.py"):
+        assert wrap_docker_command(cmd, cfg, env) == cmd
+    # env assignments / sudo before docker still wrap
+    wrapped = wrap_docker_command("FOO=1 sudo docker run img", cfg, env)
+    assert wrapped.endswith(" img") and "-v " in wrapped
 
 
 def test_edr_trigger_fires(tmp_path):

@@ -1,9 +1,12 @@
 #!/bin/bash
 # Kill every sofa_tpu process and its collector children (reference
-# tools/killsofa.sh).  Safe to run repeatedly.
+# tools/killsofa.sh).  Safe to run repeatedly; collector kills are scoped to
+# sofa-spawned invocations (matched on sofa output filenames), so unrelated
+# tcpdump/blktrace sessions on the host survive.
 pkill -f "sofa record" || true
 pkill -f "sofa_tpu.*record" || true
 pkill -f "sofa-edr" || true
-pkill tcpdump || true
-pkill blktrace || true
+pkill -f "sofa_tpu.tools.edr" || true
+pkill -f "tcpdump.*sofa\.pcap" || true
+pkill -f "blktrace.*-o blktrace" || true
 echo "sofa_tpu processes killed"

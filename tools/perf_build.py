@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Build `perf` from the kernel source matching the running kernel.
+
+The reference downloads the kernel tarball over the network
+(/root/reference/tools/perf_build.py:14-24); many TPU hosts are egress-less,
+so this version looks for already-present sources (/usr/src, apt archives)
+and degrades with actionable instructions instead of failing silently.
+
+Usage: tools/perf_build.py [--jobs N] [--dest DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import platform
+import shutil
+import subprocess
+import sys
+
+
+def find_kernel_source() -> str | None:
+    release = platform.release()
+    base = release.split("-")[0]
+    candidates = sorted(
+        glob.glob(f"/usr/src/linux-source-{base}*")
+        + glob.glob(f"/usr/src/linux-{base}*")
+        + glob.glob("/usr/src/linux-source-*")
+    )
+    for c in candidates:
+        if os.path.isdir(os.path.join(c, "tools", "perf")):
+            return c
+        for tarball in glob.glob(os.path.join(c, "*.tar.*")):
+            out = c
+            subprocess.run(["tar", "-xf", tarball, "-C", out], check=False)
+            inner = glob.glob(os.path.join(out, "linux-*", "tools", "perf"))
+            if inner:
+                return os.path.dirname(os.path.dirname(inner[0]))
+    return None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--jobs", type=int, default=os.cpu_count() or 2)
+    p.add_argument("--dest", default=os.path.dirname(os.path.abspath(__file__)))
+    args = p.parse_args()
+
+    if shutil.which("perf"):
+        print(f"perf already installed at {shutil.which('perf')}; nothing to do")
+        return 0
+    src = find_kernel_source()
+    if src is None:
+        print(
+            "no kernel source found.  On Debian/Ubuntu either:\n"
+            "  apt install linux-tools-$(uname -r)     # prebuilt perf\n"
+            "  apt install linux-source && tools/perf_build.py\n"
+            "On an egress-less host, copy the kernel tarball for "
+            f"{platform.release()} into /usr/src first.",
+            file=sys.stderr,
+        )
+        return 1
+    perf_dir = os.path.join(src, "tools", "perf")
+    print(f"building perf from {perf_dir}")
+    rc = subprocess.run(
+        ["make", f"-j{args.jobs}", "NO_LIBTRACEEVENT=1"], cwd=perf_dir
+    ).returncode
+    if rc != 0:
+        return rc
+    built = os.path.join(perf_dir, "perf")
+    dest = os.path.join(args.dest, "perf")
+    shutil.copy2(built, dest)
+    print(f"perf -> {dest}; put it on PATH to enable the perf collector")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
