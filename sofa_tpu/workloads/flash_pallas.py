@@ -1,0 +1,112 @@
+"""Pallas TPU flash attention — the fused local-attention kernel.
+
+The transformer workload's per-chip attention (plain_causal_attention and
+each ring-attention hop) materializes the [B,H,Tq,Tk] score matrix in HBM;
+this kernel keeps the online-softmax recurrence in VMEM so scores never
+leave the chip: one grid program per (batch*head, q-block), a fori_loop over
+k-blocks up to the causal frontier, f32 accumulators, MXU matmuls via
+jnp.dot(preferred_element_type=f32).
+
+Layout notes (see /opt/skills/guides/pallas_guide.md): last dim = head_dim
+rides the 128-lane axis; K/V stay fully VMEM-resident per (batch, head) —
+T=8192, D=128 in bf16 is 2 MB each, comfortably under the ~16 MB VMEM
+budget; q blocks default to 128 rows (one MXU tile of sublanes in f32).
+
+Falls back to the interpreter off-TPU so numerics are testable anywhere
+(tests/test_workloads.py compares against the reference lax implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  causal: bool, scale: float):
+    # q_ref: [1, block_q, D]; k_ref, v_ref: [1, T, D]; o_ref: [1, block_q, D]
+    iq = pl.program_id(1)
+    t_total = k_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    if causal:
+        # Only k-blocks at or before the causal frontier contribute.
+        n_blocks = (iq * block_q + block_q + block_k - 1) // block_k
+    else:
+        n_blocks = t_total // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                           # [bq, bk]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
+    before calling, as the transformer workload already does)."""
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = d ** -0.5
+
+    # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
+    def to_planes(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, iq: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
