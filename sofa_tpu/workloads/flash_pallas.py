@@ -3,14 +3,16 @@
 The transformer workload's per-chip attention (plain_causal_attention and
 each ring-attention hop) materializes the [B,H,Tq,Tk] score matrix in HBM;
 this kernel keeps the online-softmax recurrence in VMEM so scores never
-leave the chip: one grid program per (batch*head, q-block), a fori_loop over
-k-blocks up to the causal frontier, f32 accumulators, MXU matmuls via
-jnp.dot(preferred_element_type=f32).
+leave the chip.  Grid = (batch*head, q-block, k-block) with the k dimension
+innermost ("arbitrary" semantics): K/V stream through VMEM one block at a
+time while the running (acc, m, l) state lives in VMEM scratch, so per-chip
+sequence length is bounded by HBM, not the ~16 MB VMEM — f32 accumulation,
+MXU matmuls via jnp.dot(preferred_element_type=f32).
 
 Layout notes (see /opt/skills/guides/pallas_guide.md): last dim = head_dim
-rides the 128-lane axis; K/V stay fully VMEM-resident per (batch, head) —
-T=8192, D=128 in bf16 is 2 MB each, comfortably under the ~16 MB VMEM
-budget; q blocks default to 128 rows (one MXU tile of sublanes in f32).
+rides the 128-lane axis; q/k blocks default to 128 rows (MXU tile); the m/l
+softmax state is kept lane-broadcast at [block_q, 128] so every scratch
+buffer respects the (8, 128) f32 tile.
 
 Falls back to the interpreter off-TPU so numerics are testable anywhere
 (tests/test_workloads.py compares against the reference lax implementation).
@@ -24,48 +26,118 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+from sofa_tpu.workloads.ring_attention import NEG_INF
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  causal: bool, scale: float):
-    # q_ref: [1, block_q, D]; k_ref, v_ref: [1, T, D]; o_ref: [1, block_q, D]
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, block_q: int, block_k: int, num_k: int, causal: bool,
+                  scale: float):
+    # q_ref: [1, block_q, D]; k_ref, v_ref: [1, block_k, D] (streamed per ik)
+    # o_ref: [1, block_q, D]; lse_ref: [1, 8, block_q] (sublane-broadcast so
+    # the block satisfies TPU (8, 128) tiling)
+    # scratch: acc [block_q, D] f32; m, l [block_q, 128] f32 lane-broadcast
     iq = pl.program_id(1)
-    t_total = k_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    ik = pl.program_id(2)
 
-    if causal:
-        # Only k-blocks at or before the causal frontier contribute.
-        n_blocks = (iq * block_q + block_q + block_k - 1) // block_k
-    else:
-        n_blocks = t_total // block_k
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # Causal: blocks past the frontier (every k strictly after the last q row
+    # of this block) contribute nothing — skip their compute entirely.
+    contributes = (ik * block_k <= iq * block_q + block_q - 1
+                   if causal else ik >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale         # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                 # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(k_pos > q_pos, NEG_INF, s)
-        m_blk = jnp.max(s, axis=-1, keepdims=True)       # [bq, 1]
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_ref[:, :1]                            # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                           # [bq, bk]
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ik == num_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse = (m_ref[:, 0] + jnp.log(l[:, 0]))           # [bq]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
+
+
+def _flash_forward(
+    q, k, v,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: Optional[bool],
+):
+    """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T])."""
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq len {t}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = d ** -0.5
+    num_k = t // block_k
+
+    # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
+    def to_planes(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
+        causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
+            lse[:, 0, :].reshape(b, h, t))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -79,34 +151,74 @@ def flash_attention(
 ):
     """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
     before calling, as the transformer workload already does)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
+
+
+def supports(t: int, block: int = 128) -> bool:
+    """True when a [.., T, ..] attention can run through the fused kernel.
+
+    Besides divisibility, the q-block (second-to-minor tile dim) must be a
+    sublane multiple — 16 covers bf16 and f32 on current TPUs.
+    """
+    bq = min(block, t)
+    return t % bq == 0 and bq % 16 == 0
+
+
+@jax.custom_vjp
+def flash_causal_attention(q, k, v):
+    """Differentiable fused causal attention, [B, T, H, D] in and out.
+
+    Forward runs the Pallas kernel and keeps only O(B·H·T) residuals (the
+    output and per-row logsumexp) — the FlashAttention recipe.  Backward is
+    an explicit blockwise gradient (one scan over k-blocks, probabilities
+    recomputed per block from the saved lse) in stock lax ops, so the
+    [T, T] score matrix never materializes in either direction and XLA
+    still fuses everything onto the MXU.
+    """
+    out, _ = _flash_forward(q, k, v, True, 128, 128, None)
+    return out
+
+
+def _fwd(q, k, v):
+    out, lse = _flash_forward(q, k, v, True, 128, 128, None)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(res, g, block: int = 128):
+    q, k, v, out, lse = res
     b, t, h, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"seq len {t} must divide block sizes "
-                         f"({block_q}, {block_k})")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    bk = min(block, t)
     scale = d ** -0.5
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # delta_i = sum_d(dout_i * out_i) — the softmax-jacobian diagonal term.
+    delta = jnp.einsum("bqhd,bqhd->bhq", gf, out.astype(jnp.float32))
+    q_pos = jnp.arange(t)[:, None]                     # [T, 1]
+    kb = k.astype(jnp.float32).reshape(b, t // bk, bk, h, d)
+    vb = v.astype(jnp.float32).reshape(b, t // bk, bk, h, d)
 
-    # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
-    def to_planes(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    def body(dq, blk):
+        kj, vj, j = blk
+        # Recompute this k-block's probabilities from the saved lse.
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj) * scale
+        k_pos = j * bk + jnp.arange(bk)[None, :]
+        s = jnp.where((k_pos > q_pos)[None, None], NEG_INF, s)
+        p = jnp.exp(s - lse[..., None])                # [B,H,T,bk]
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vj)
+        ds = p * (dp - delta[..., None])               # [B,H,T,bk]
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kj) * scale
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        return dq, (dk_j, dv_j)
 
-    qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
-    kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, iq: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        interpret=interpret,
-    )(qp, kp, vp)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    dq0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dq, (dk, dv) = jax.lax.scan(
+        body, dq0,
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(t // bk)))
+    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_causal_attention.defvjp(_fwd, _bwd)

@@ -31,6 +31,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sofa_tpu.workloads.flash_pallas import (
+    flash_causal_attention,
+    supports as flash_supports,
+)
 from sofa_tpu.workloads.ring_attention import (
     plain_causal_attention,
     ring_attention,
@@ -48,6 +52,9 @@ class TransformerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     rope_theta: float = 500000.0
+    # None = auto: fused Pallas attention on TPU when the single-chip path
+    # runs and T divides the kernel's block size; True/False force it.
+    flash: Optional[bool] = None
 
     @property
     def d_head(self) -> int:
@@ -141,6 +148,17 @@ def forward(params, tokens, cfg: TransformerConfig,
     if t > cfg.max_seq:
         raise ValueError(f"sequence length {t} exceeds max_seq {cfg.max_seq}")
     use_ring = mesh is not None and mesh.shape.get("seq", 1) > 1
+    if cfg.flash is None:
+        # Auto: fused Pallas kernel on the single-chip TPU path.  Off-TPU the
+        # kernel only runs interpreted (slow), so auto stays off there.
+        use_flash = (not use_ring and flash_supports(t)
+                     and jax.default_backend() == "tpu")
+    else:
+        use_flash = cfg.flash and not use_ring
+        if use_flash and not flash_supports(t):
+            raise ValueError(
+                f"flash=True but seq len {t} is not supported by the fused "
+                f"kernel (needs a 16-multiple block dividing T)")
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
 
     x = params["embed"].astype(cfg.dtype)[tokens]
@@ -161,6 +179,8 @@ def forward(params, tokens, cfg: TransformerConfig,
         v = jnp.repeat(v, rep, axis=2)
         if use_ring:
             o = ring_attention(q, kk, v, mesh)
+        elif use_flash:
+            o = flash_causal_attention(q, kk, v)
         else:
             o = plain_causal_attention(q, kk, v)
         x = x + o.reshape(b, t, -1) @ lp["wo"]

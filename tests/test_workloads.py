@@ -69,6 +69,54 @@ def test_ring_attention_noncausal():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_flash_attention_matches_plain():
+    from sofa_tpu.workloads.flash_pallas import flash_attention
+
+    key = jax.random.PRNGKey(2)
+    b, t, h, d = 2, 128, 2, 16
+    q, k, v = jax.random.normal(key, (3, b, t, h, d), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+        ref = plain_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_flash_attention_grads_match_plain():
+    from sofa_tpu.workloads.flash_pallas import flash_causal_attention
+
+    key = jax.random.PRNGKey(3)
+    b, t, h, d = 1, 64, 2, 8
+    q, k, v = jax.random.normal(key, (3, b, t, h, d), jnp.float32)
+
+    with jax.default_matmul_precision("highest"):
+        gf = jax.grad(lambda *a: (flash_causal_attention(*a) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lambda *a: (plain_causal_attention(*a) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-3)
+
+
+def test_transformer_flash_path_matches_plain():
+    import dataclasses
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(seq=64),
+                              dtype=jnp.float32)
+    key = jax.random.PRNGKey(4)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 64), 0, cfg.vocab)
+    with jax.default_matmul_precision("highest"):
+        # flash=True runs the Pallas kernel interpreted off-TPU.
+        out_f = forward(params, tokens,
+                        dataclasses.replace(cfg, flash=True))
+        out_p = forward(params, tokens,
+                        dataclasses.replace(cfg, flash=False))
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_p),
+                               atol=2e-4, rtol=1e-3)
+
+
 def test_transformer_sharded_matches_unsharded():
     import dataclasses
 
