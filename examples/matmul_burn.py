@@ -1,0 +1,36 @@
+"""A ~5 s matmul burner: the smallest interesting TPU profiling target.
+
+Analogue of the reference's trivial profiled apps (examples/docker-ml/app.py,
+a two-liner sklearn fit): just enough device work that the op trace, module
+attribution, and utilization series all have something to show.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def burn(x):
+    for _ in range(8):
+        x = jnp.tanh(x @ x) + 0.1
+    return x
+
+
+def main(seconds: float = 5.0, n: int = 2048):
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    x = burn(x)          # compile
+    x.block_until_ready()
+    t0 = time.time()
+    steps = 0
+    while time.time() - t0 < seconds:
+        x = burn(x)
+        steps += 1
+    x.block_until_ready()
+    dt = time.time() - t0
+    print(f"{steps} burns in {dt:.2f}s on {jax.default_backend()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""A 20-step tiny-transformer training loop with per-step annotations.
+
+The iteration-detection target: each step is wrapped in the step_annotation
+marker the AISI pass anchors on (sofa_tpu/ml/aisi.py), so
+``sofa stat "python examples/train_tiny.py" --enable_aisi`` yields an
+iterations.csv with step times and fw/bw splits.
+"""
+
+import jax
+
+from sofa_tpu.workloads.common import step_annotation
+from sofa_tpu.workloads.transformer import TransformerConfig, build
+
+
+def main(steps: int = 20):
+    cfg = TransformerConfig.tiny(seq=128)
+    params, opt_state, step, tokens = build(cfg, mesh=None, batch=8, seq=128)
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    for i in range(steps):
+        with step_annotation(i):
+            params, opt_state, loss = step(params, opt_state, tokens)
+    print(f"final loss {float(loss):.4f} after {steps} steps")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,8 @@ CLI (/root/reference/bin/sofa:328-376):
   stat "cmd"        record + preprocess + analyze
   diff              preprocess base/match logdirs + swarm diff
   clean             remove derived files, keep raw collector output
+  setup             host-enablement doctor (sysctls, tool caps) — replaces
+                    the reference's empower.py / enable_strace_perf_pcm.py
 
 Flags are declared once and materialized onto a SofaConfig dataclass
 (sofa_tpu/config.py) rather than the reference's field-by-field copy
@@ -44,7 +46,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
-        "record", "preprocess", "analyze", "report", "stat", "diff", "viz", "clean",
+        "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
+        "clean", "setup",
     ])
     p.add_argument("usr_command", nargs="?", default="", help="command to profile (record/stat)")
 
@@ -108,6 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = p.add_argument_group("cluster")
     g.add_argument("--cluster_hosts", help="comma-joined host list for multi-host runs")
+
+    g = p.add_argument_group("setup")
+    g.add_argument("--apply", action="store_true", default=False,
+                   help="setup: run the fix commands instead of printing them")
+    g.add_argument("--empower", action="append", dest="empower", default=None,
+                   help="setup: utility to grant profiling capabilities "
+                        "(e.g. --empower tcpdump); repeatable")
 
     p.add_argument("--plugin", action="append", dest="plugins",
                    help="module[:func] called with the config at startup")
@@ -248,6 +258,10 @@ def main(argv=None) -> int:
             from sofa_tpu.record import sofa_clean
             sofa_clean(cfg)
             return 0
+        if cmd == "setup":
+            from sofa_tpu.setup_env import sofa_setup
+            print_main_progress("SOFA setup")
+            return sofa_setup(utilities=args.empower, apply=args.apply)
     except KeyboardInterrupt:
         print_error("interrupted")
         return 130

@@ -31,15 +31,22 @@ from jax.experimental.pallas import tpu as pltpu
 from sofa_tpu.workloads.ring_attention import NEG_INF
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                  *, block_q: int, block_k: int, num_k: int, causal: bool,
+def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                  m_ref, l_ref, *, block_q: int, block_k: int, num_k: int,
                   scale: float):
+    # shift_ref: [1] int32 in SMEM — the causal offset: key j is visible to
+    #   query i iff j <= i + shift.  shift=0 is aligned causal attention,
+    #   shift>=T sees everything (non-causal), shift<=-block sees nothing
+    #   (the kernel still runs and emits out=0, lse~NEG_INF).  A *dynamic*
+    #   shift lets one compiled kernel serve every hop of ring attention,
+    #   where the visiting K/V block's global offset is a traced value.
     # q_ref: [1, block_q, D]; k_ref, v_ref: [1, block_k, D] (streamed per ik)
     # o_ref: [1, block_q, D]; lse_ref: [1, 8, block_q] (sublane-broadcast so
     # the block satisfies TPU (8, 128) tiling)
     # scratch: acc [block_q, D] f32; m, l [block_q, 128] f32 lane-broadcast
     iq = pl.program_id(1)
     ik = pl.program_id(2)
+    shift = shift_ref[0]
 
     @pl.when(ik == 0)
     def _init():
@@ -47,10 +54,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: blocks past the frontier (every k strictly after the last q row
-    # of this block) contribute nothing — skip their compute entirely.
-    contributes = (ik * block_k <= iq * block_q + block_q - 1
-                   if causal else ik >= 0)
+    # Blocks past the frontier (every key strictly after the last visible
+    # position for this q-block) contribute nothing — skip their compute.
+    contributes = ik * block_k <= iq * block_q + block_q - 1 + shift
 
     @pl.when(contributes)
     def _step():
@@ -58,16 +64,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         k = k_ref[0].astype(jnp.float32)                 # [bk, D]
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(k_pos > q_pos + shift, NEG_INF, s)
         m_prev = m_ref[:, :1]                            # [bq, 1]
         l_prev = l_ref[:, :1]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
+        # Clamp the softmax reference: a row with every key masked so far
+        # keeps m ~ NEG_INF, and exp(s - m) would be exp(0)=1 garbage
+        # instead of 0.  Clamped, exp(NEG_INF - (-1e29)) underflows to 0, so
+        # fully-masked rows accumulate nothing and emit lse ~ -1e29.
+        m_new = jnp.maximum(jnp.maximum(m_prev, m_blk), -1e29)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                           # [bq, bk]
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -86,35 +95,44 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _flash_forward(
     q, k, v,
-    causal: bool,
+    shift,
     block_q: int,
     block_k: int,
     interpret: Optional[bool],
 ):
-    """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T])."""
+    """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T]).
+
+    ``shift`` is the (possibly traced) causal offset: key j visible to query
+    i iff j <= i + shift.  0 = aligned causal, >= T = full attention,
+    <= -T = fully masked (out 0, lse ~ NEG_INF).
+    """
     b, t, h, d = q.shape
+    tk = k.shape[1]
     block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
-                         f"seq len {t}")
+                         f"seq lens ({t}, {tk})")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = d ** -0.5
-    num_k = t // block_k
+    num_k = tk // block_k
+    shift = jnp.asarray(shift, jnp.int32).reshape(1)
 
     # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
     def to_planes(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        tt = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
 
     qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
-        causal=causal, scale=scale)
+        scale=scale)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, num_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
@@ -135,7 +153,7 @@ def _flash_forward(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(shift, qp, kp, vp)
     return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
             lse[:, 0, :].reshape(b, h, t))
 
@@ -151,7 +169,8 @@ def flash_attention(
 ):
     """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
     before calling, as the transformer workload already does)."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
+    shift = 0 if causal else k.shape[1]
+    return _flash_forward(q, k, v, shift, block_q, block_k, interpret)[0]
 
 
 def supports(t: int, block: int = 128) -> bool:
@@ -175,34 +194,39 @@ def flash_causal_attention(q, k, v):
     [T, T] score matrix never materializes in either direction and XLA
     still fuses everything onto the MXU.
     """
-    out, _ = _flash_forward(q, k, v, True, 128, 128, None)
+    out, _ = _flash_forward(q, k, v, 0, 128, 128, None)
     return out
 
 
 def _fwd(q, k, v):
-    out, lse = _flash_forward(q, k, v, True, 128, 128, None)
+    out, lse = _flash_forward(q, k, v, 0, 128, 128, None)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(res, g, block: int = 128):
-    q, k, v, out, lse = res
+def _grad_block(q, k, v, g, delta, lse, shift, block: int = 128):
+    """Blockwise attention gradients against one visiting K/V block.
+
+    All stock lax ops (one scan over k-chunks, probabilities recomputed from
+    the saved per-row lse) — the [Tq, Tk] matrix never fully materializes.
+    ``shift`` is the same causal offset the forward kernel uses; q rows are
+    local positions, k positions are offset by it.  Returns (dq, dk, dv) in
+    f32 — dq for the local q shard, dk/dv for the *visiting* block.
+    """
     b, t, h, d = q.shape
-    bk = min(block, t)
+    tk = k.shape[1]
+    bk = min(block, tk)
     scale = d ** -0.5
     qf = q.astype(jnp.float32)
     gf = g.astype(jnp.float32)
-    # delta_i = sum_d(dout_i * out_i) — the softmax-jacobian diagonal term.
-    delta = jnp.einsum("bqhd,bqhd->bhq", gf, out.astype(jnp.float32))
     q_pos = jnp.arange(t)[:, None]                     # [T, 1]
-    kb = k.astype(jnp.float32).reshape(b, t // bk, bk, h, d)
-    vb = v.astype(jnp.float32).reshape(b, t // bk, bk, h, d)
+    kb = k.astype(jnp.float32).reshape(b, tk // bk, bk, h, d)
+    vb = v.astype(jnp.float32).reshape(b, tk // bk, bk, h, d)
 
     def body(dq, blk):
         kj, vj, j = blk
-        # Recompute this k-block's probabilities from the saved lse.
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj) * scale
         k_pos = j * bk + jnp.arange(bk)[None, :]
-        s = jnp.where((k_pos > q_pos)[None, None], NEG_INF, s)
+        s = jnp.where((k_pos > q_pos + shift)[None, None], NEG_INF, s)
         p = jnp.exp(s - lse[..., None])                # [B,H,T,bk]
         dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
         dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vj)
@@ -215,9 +239,18 @@ def _bwd(res, g, block: int = 128):
     dq, (dk, dv) = jax.lax.scan(
         body, dq0,
         (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
-         jnp.arange(t // bk)))
-    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
-    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+         jnp.arange(tk // bk)))
+    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
+    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
+    return dq, dk, dv
+
+
+def _bwd(res, g):
+    q, k, v, out, lse = res
+    # delta_i = sum_d(dout_i * out_i) — the softmax-jacobian diagonal term.
+    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    dq, dk, dv = _grad_block(q, k, v, g, delta, lse, 0)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -39,6 +39,7 @@ from sofa_tpu.workloads.ring_attention import (
     plain_causal_attention,
     ring_attention,
 )
+from sofa_tpu.workloads.ring_flash import ring_flash_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,17 +149,18 @@ def forward(params, tokens, cfg: TransformerConfig,
     if t > cfg.max_seq:
         raise ValueError(f"sequence length {t} exceeds max_seq {cfg.max_seq}")
     use_ring = mesh is not None and mesh.shape.get("seq", 1) > 1
+    t_local = t // mesh.shape["seq"] if use_ring else t
     if cfg.flash is None:
-        # Auto: fused Pallas kernel on the single-chip TPU path.  Off-TPU the
-        # kernel only runs interpreted (slow), so auto stays off there.
-        use_flash = (not use_ring and flash_supports(t)
-                     and jax.default_backend() == "tpu")
+        # Auto: fused Pallas kernel on TPU (per-shard inside the ring when
+        # sequence-parallel).  Off-TPU the kernel only runs interpreted
+        # (slow), so auto stays off there.
+        use_flash = flash_supports(t_local) and jax.default_backend() == "tpu"
     else:
-        use_flash = cfg.flash and not use_ring
-        if use_flash and not flash_supports(t):
+        use_flash = cfg.flash
+        if use_flash and not flash_supports(t_local):
             raise ValueError(
-                f"flash=True but seq len {t} is not supported by the fused "
-                f"kernel (needs a 16-multiple block dividing T)")
+                f"flash=True but local seq len {t_local} is not supported by "
+                f"the fused kernel (needs a 16-multiple block dividing it)")
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
 
     x = params["embed"].astype(cfg.dtype)[tokens]
@@ -177,7 +179,9 @@ def forward(params, tokens, cfg: TransformerConfig,
         rep = cfg.n_heads // cfg.n_kv_heads
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-        if use_ring:
+        if use_ring and use_flash:
+            o = ring_flash_attention(q, kk, v, mesh)
+        elif use_ring:
             o = ring_attention(q, kk, v, mesh)
         elif use_flash:
             o = flash_causal_attention(q, kk, v)

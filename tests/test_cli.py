@@ -45,3 +45,34 @@ def test_record_without_command_errors(capsys):
 def test_unknown_subcommand_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["explode"])
+
+
+def test_setup_check_mode(capsys):
+    from sofa_tpu.cli import main
+
+    rc = main(["setup"])
+    out = capsys.readouterr()
+    text = out.out + out.err
+    assert rc in (0, 1)
+    assert "perf_event_paranoid" in text
+
+
+def test_setup_apply_uses_runner(monkeypatch):
+    from sofa_tpu import setup_env
+
+    ran = []
+    monkeypatch.setattr(setup_env, "check",
+                        lambda utilities=None: (["sysctl -w a=b"], 1))
+    rc = setup_env.sofa_setup(apply=True, runner=lambda c: ran.append(c) or 0)
+    assert rc == 0
+    assert ran == ["sysctl -w a=b"]
+
+
+def test_setup_reports_fixes_without_apply(monkeypatch, capsys):
+    from sofa_tpu import setup_env
+
+    monkeypatch.setattr(setup_env, "check",
+                        lambda utilities=None: (["setcap x /bin/tcpdump"], 1))
+    rc = setup_env.sofa_setup(apply=False)
+    assert rc == 1
+    assert "setcap x /bin/tcpdump" in capsys.readouterr().out
