@@ -1,0 +1,9 @@
+#!/bin/bash
+# Build a self-contained demo logdir: profile the disk-churn example and
+# snapshot the fully-analyzed result (board + report.js + CSVs) into demo/.
+# Analogue of the reference's tools/build_demo.sh (dd-based).
+set -e
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-demo}"
+"$ROOT/bin/sofa" stat "python $ROOT/examples/io_churn.py" --logdir "$OUT/sofalog/"
+echo "demo ready: open with  $ROOT/bin/sofa viz --logdir $OUT/sofalog/"
