@@ -22,6 +22,7 @@ import shutil
 import sys
 import tempfile
 import time
+from typing import Optional
 
 
 def _log(msg: str) -> None:
@@ -67,11 +68,77 @@ def _log_chip_holders() -> None:
              "(chip may be held remotely / tunnel busy)")
 
 
-def _init_backend(max_tries: int = 5, backoff_s: float = 20.0):
+# The probe re-applies main()'s env-over-config rule: the image's
+# sitecustomize force-prepends the TPU platform, and a JAX_PLATFORMS=cpu
+# smoke run must probe the CPU backend, not the tunnel.
+_PROBE_SNIPPET = """
+import os
+import jax
+p = os.environ.get("JAX_PLATFORMS", "")
+if p and jax.config.jax_platforms != p:
+    jax.config.update("jax_platforms", p)
+jax.devices()
+print("ok")
+"""
+
+
+def _preflight(timeout_s: float = 60.0) -> Optional[str]:
+    """Probe backend init in a subprocess so a *hanging* tunnel (dead axon
+    service: jax.devices() blocks forever rather than raising) cannot hang
+    the benchmark itself.  Returns None when healthy, else a short reason.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SNIPPET],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f"backend init hung > {timeout_s:.0f}s (device tunnel down?)"
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["?"])[-1]
+        return f"backend init failed: {tail[:200]}"
+    return None
+
+
+class _Hung(Exception):
+    pass
+
+
+def _with_timeout(fn, timeout_s: float):
+    """Run fn on a watchdog thread; raise _Hung if it outlives timeout_s.
+
+    The hung thread cannot be killed — callers must treat _Hung as fatal
+    for in-process backend work (the backend lock may be wedged).
+    """
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except Exception as e:  # noqa: BLE001 — re-raised on the caller side
+            box["error"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        raise _Hung(f"call outlived {timeout_s:.0f}s")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def _init_backend(max_tries: int = 5, backoff_s: float = 30.0,
+                  timeout_s: float = 90.0):
     """Initialize the JAX backend, retrying a transiently-unavailable chip.
 
-    Returns the device list.  jax caches a failed backend init, so each retry
-    clears backends first.  Raises the last error after max_tries.
+    The first attempt runs the real in-process init under a watchdog (no
+    extra subprocess on the happy path); retries preflight in a subprocess
+    first, because a dead device tunnel makes jax.devices() hang rather
+    than raise.  An *in-process* hang is fatal — the wedged backend lock
+    would poison every later attempt — so it stops the loop immediately.
     """
     import jax
 
@@ -84,13 +151,26 @@ def _init_backend(max_tries: int = 5, backoff_s: float = 20.0):
             try:
                 import jax.extend.backend as jeb
 
-                jeb.clear_backends()
+                _with_timeout(jeb.clear_backends, 30.0)
             except Exception:
                 pass
+            reason = _preflight()
+            if reason is not None:
+                last = RuntimeError(reason)
+                _log(f"bench: {reason}")
+                _log_chip_holders()
+                continue
         try:
-            devs = jax.devices()
+            devs = _with_timeout(jax.devices, timeout_s)
             _log(f"bench: backend={jax.default_backend()} devices={devs}")
             return devs
+        except _Hung:
+            last = RuntimeError(
+                f"in-process backend init hung > {timeout_s:.0f}s; "
+                "not retrying against a wedged backend lock")
+            _log(f"bench: {last}")
+            _log_chip_holders()
+            break
         except Exception as e:  # RuntimeError / JaxRuntimeError
             last = e
             _log(f"bench: backend init failed: {type(e).__name__}: "
