@@ -106,6 +106,20 @@ def generate_hints(features: Features, cfg) -> List[str]:
     elif get("tpu_ops") is not None:
         hints.append(f"compute-bound: collectives take {comm_ratio:.0%} of device time")
 
+    eff = get("tpu0_roofline_efficiency")
+    mem_t = get("tpu0_memory_bound_time")
+    cmp_t = get("tpu0_compute_bound_time")
+    if eff is not None and eff < 0.4:
+        dominant = ("memory" if (mem_t or 0) >= (cmp_t or 0) else "compute")
+        fix = ("fuse elementwise chains into matmuls and raise arithmetic"
+               " intensity (larger batch/tiles)" if dominant == "memory" else
+               "check matmul shapes against the 128x128 MXU tile and prefer"
+               " bf16 inputs")
+        hints.append(
+            f"ops run at {eff:.0%} of their roofline bound and"
+            f" {dominant}-bound time dominates — {fix} (see roofline.csv)"
+        )
+
     mxu = get("mxu_util_mean")
     if mxu is not None and mxu < 30.0:
         hints.append(

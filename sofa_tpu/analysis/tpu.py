@@ -76,6 +76,88 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         features.add("tpu_module_launches", int(per_mod["count"].sum()))
 
 
+def roofline_profile(frames, cfg, features: Features) -> None:
+    """Per-op speed-of-light analysis against the chip's peak rates.
+
+    For every HLO kernel op with flops/bytes metadata, the attainable
+    ("speed of light") time is max(flops/peak_flops, bytes/peak_hbm_bw) —
+    the roofline bound under perfect overlap — and efficiency is
+    sol_time/actual_time.  Ops are classed compute- vs memory-bound by
+    which term dominates.  The reference has no equivalent (its closest is
+    nvsmi SM%, sofa_analyze.py:259-341); on TPU the XPlane op trace carries
+    exact per-op flops/bytes, so the bound is computable per op.  Writes
+    roofline.csv and duration-weighted per-device features.
+    """
+    import json
+    import os
+
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    meta_path = cfg.path("tpu_meta.json")
+    if not os.path.isfile(meta_path):
+        return
+    with open(meta_path) as f:
+        meta = json.load(f)
+
+    rows = df[(df["category"] == 0)
+              & (df["copyKind"] == int(CopyKind.KERNEL))
+              & (df["duration"] > 0)
+              & ((df["flops"] > 0) | (df["bytes_accessed"] > 0))]
+    if rows.empty:
+        return
+
+    out = []
+    for device_id, dev in rows.groupby("deviceId"):
+        peaks = meta.get(str(device_id), {})
+        peak_flops = float(peaks.get("peak_teraflops_per_second", 0)) * 1e12
+        peak_bw = float(
+            peaks.get("peak_hbm_bw_gigabytes_per_second", 0)) * 1e9
+        if peak_flops <= 0 or peak_bw <= 0:
+            continue
+        agg = dev.groupby("name").agg(
+            time=("duration", "sum"),
+            count=("duration", "count"),
+            flops=("flops", "sum"),
+            bytes_accessed=("bytes_accessed", "sum"),
+        )
+        t_compute = agg["flops"] / peak_flops
+        t_memory = agg["bytes_accessed"] / peak_bw
+        agg["sol_time"] = pd.concat([t_compute, t_memory], axis=1).max(axis=1)
+        agg["efficiency"] = (agg["sol_time"] / agg["time"]).clip(upper=1.0)
+        agg["bound"] = "memory"
+        agg.loc[t_compute >= t_memory, "bound"] = "compute"
+        agg["deviceId"] = device_id
+        out.append(agg)
+
+        total = float(agg["time"].sum())
+        # Aggregate from the *clipped* per-op efficiencies: an op whose
+        # flops/bytes metadata is overcounted (sol_time > time) must not
+        # push the device aggregate past 1.0 or mask everyone else.
+        sol = float((agg["time"] * agg["efficiency"]).sum())
+        features.add(f"tpu{device_id}_roofline_efficiency",
+                     sol / total if total else 0.0)
+        for bound in ("compute", "memory"):
+            features.add(
+                f"tpu{device_id}_{bound}_bound_time",
+                float(agg.loc[agg["bound"] == bound, "time"].sum()))
+        tf, tb = float(agg["flops"].sum()), float(agg["bytes_accessed"].sum())
+        if tb > 0:
+            features.add(f"tpu{device_id}_arithmetic_intensity", tf / tb)
+
+    if not out:
+        return
+    table = (pd.concat(out)
+             .sort_values("time", ascending=False)
+             .reset_index())
+    table.to_csv(cfg.path("roofline.csv"), index=False)
+    if cfg.verbose:
+        heavy = table.head(20).sort_values("efficiency").head(5)
+        print_title("Furthest-from-roofline heavy ops")
+        print(heavy[["name", "time", "efficiency", "bound"]].to_string(
+            index=False))
+
+
 def tpuutil_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tpuutil")
     if df is None or df.empty:

@@ -152,6 +152,13 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     from sofa_tpu.trace import series_to_report_js
 
     series_to_report_js(series, cfg.path("report.js"), cfg.viz_downsample_to, meta)
+    if tpu_meta:
+        # Device peak rates for the analyze-side roofline pass (analysis
+        # reads CSVs, not report.js, so the peaks get their own file).
+        import json
+
+        with open(cfg.path("tpu_meta.json"), "w") as f:
+            json.dump(tpu_meta, f, indent=1)
     print_progress(
         f"preprocess wrote {n_csv} csv files and report.js ({len(series)} series)"
     )

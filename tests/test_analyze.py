@@ -338,3 +338,51 @@ def test_dcn_step_correlation():
     corr = dcn_step_correlation(frames, n_bins=16)
     assert corr is not None and corr > 0.8
     assert dcn_step_correlation({"tputrace": make_frame(ops)}) is None
+
+
+def test_roofline_profile(cfg):
+    import json
+
+    # Two kernel ops on a 100 TFLOP/s, 100 GB/s device:
+    #   matmul: 1e12 flops / 1e9 bytes in 0.02 s -> sol = max(0.01, 0.01)
+    #           = 0.01 s, compute-bound (tie goes to compute), eff 0.5
+    #   eltwise: 1e9 flops / 5e9 bytes in 0.1 s -> sol = max(1e-5, 0.05)
+    #           = 0.05 s, memory-bound, eff 0.5
+    rows = [
+        {"timestamp": 0.0, "duration": 0.02, "deviceId": 0,
+         "copyKind": int(CopyKind.KERNEL), "name": "dot.1",
+         "hlo_category": "convolution", "flops": 1e12,
+         "bytes_accessed": 1e9, "device_kind": "tpu"},
+        {"timestamp": 0.05, "duration": 0.1, "deviceId": 0,
+         "copyKind": int(CopyKind.KERNEL), "name": "fusion.add",
+         "hlo_category": "fusion", "flops": 1e9,
+         "bytes_accessed": 5e9, "device_kind": "tpu"},
+    ]
+    with open(cfg.path("tpu_meta.json"), "w") as f:
+        json.dump({"0": {"peak_teraflops_per_second": 100.0,
+                         "peak_hbm_bw_gigabytes_per_second": 100.0}}, f)
+    feats = Features()
+    tpu.roofline_profile({"tputrace": make_frame(rows)}, cfg, feats)
+    assert feats.get("tpu0_roofline_efficiency") == pytest.approx(0.5)
+    assert feats.get("tpu0_compute_bound_time") == pytest.approx(0.02)
+    assert feats.get("tpu0_memory_bound_time") == pytest.approx(0.1)
+    assert feats.get("tpu0_arithmetic_intensity") == pytest.approx(
+        (1e12 + 1e9) / 6e9)
+    table = pd.read_csv(cfg.path("roofline.csv"))
+    assert set(table["bound"]) == {"compute", "memory"}
+    byname = table.set_index("name")
+    assert byname.loc["dot.1", "efficiency"] == pytest.approx(0.5)
+
+    # The advice layer should flag sub-40% roofline efficiency.
+    feats2 = Features()
+    feats2.add("tpu0_roofline_efficiency", 0.2)
+    feats2.add("tpu0_memory_bound_time", 1.0)
+    feats2.add("tpu0_compute_bound_time", 0.1)
+    hints = advice.generate_hints(feats2, cfg)
+    assert any("roofline" in h for h in hints)
+
+
+def test_roofline_profile_without_meta_is_noop(cfg):
+    feats = Features()
+    tpu.roofline_profile({"tputrace": tpu_frame()}, cfg, feats)
+    assert feats.get("tpu0_roofline_efficiency") is None
