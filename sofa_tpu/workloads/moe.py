@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sofa_tpu.workloads.ring_attention import plain_causal_attention
+from sofa_tpu.workloads.transformer import _rmsnorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,12 +99,6 @@ def param_specs(cfg: MoEConfig) -> Dict[str, Any]:
     }
 
 
-def _rmsnorm(x, w):
-    xf = x.astype(jnp.float32)
-    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
-    return (y * w).astype(x.dtype)
-
-
 def _dispatch_tensors(logits, n_experts: int, capacity: int):
     """Top-1 routing -> (dispatch [N,E,C] one-hot, combine [N,E,C], aux).
 
@@ -130,8 +125,13 @@ def _expert_ffn(xs, w_up, w_down, dtype):
     """Per-expert gelu MLP over dispatched slots.
 
     xs: [..., E, C, D] in ``dtype`` (bf16 on TPU — the MXU path); matmuls
-    accumulate in f32, activations return to ``dtype``.
+    accumulate in f32, activations return to ``dtype``.  Off-TPU the dots
+    run in f32: XLA:CPU's dot thunk rejects bf16 batched contractions
+    (numerics are covered by the f32 equivalence tests either way).
     """
+    if jax.default_backend() != "tpu" and dtype == jnp.bfloat16:
+        dtype = jnp.float32
+        xs = xs.astype(dtype)
     h = jnp.einsum("...ecd,edf->...ecf", xs, w_up.astype(dtype),
                    preferred_element_type=jnp.float32)
     h = jax.nn.gelu(h).astype(dtype)
@@ -316,12 +316,14 @@ def main(argv=None):
             cfg = dataclasses.replace(cfg, n_experts=bumped)
     params, opt_state, step, tokens = build(cfg, mesh, args.batch, args.seq)
 
-    def run(i):
-        nonlocal params, opt_state
-        params, opt_state, loss = step(params, opt_state, tokens)
-        return loss
+    def one(state):
+        p, o, _ = state
+        return step(p, o, tokens)
 
-    steps_per_sec(run, args.steps, tokens_per_step=args.batch * args.seq)
+    sps, state = steps_per_sec(one, (params, opt_state, 0.0), args.steps)
+    mesh_desc = dict(mesh.shape) if mesh else {"single": 1}
+    print(f"moe: {sps:.3f} steps/s  {sps * args.batch * args.seq:,.0f} "
+          f"tokens/s  loss={float(state[2]):.3f}  mesh={mesh_desc}")
 
 
 if __name__ == "__main__":
