@@ -121,15 +121,17 @@ def _dispatch_tensors(logits, n_experts: int, capacity: int):
     return dispatch, combine, aux
 
 
-def _expert_ffn(xs, w_up, w_down, dtype):
+def _expert_ffn(xs, w_up, w_down, dtype, upcast: bool = False):
     """Per-expert gelu MLP over dispatched slots.
 
     xs: [..., E, C, D] in ``dtype`` (bf16 on TPU — the MXU path); matmuls
-    accumulate in f32, activations return to ``dtype``.  Off-TPU the dots
-    run in f32: XLA:CPU's dot thunk rejects bf16 batched contractions
-    (numerics are covered by the f32 equivalence tests either way).
+    accumulate in f32, activations return to ``dtype``.  With
+    ``upcast=True`` (execution platform is not TPU — the caller checks the
+    *mesh's* devices, not the process default backend) the dots run in
+    f32: XLA:CPU's dot thunk rejects bf16 batched contractions (numerics
+    are covered by the f32 equivalence tests either way).
     """
-    if jax.default_backend() != "tpu" and dtype == jnp.bfloat16:
+    if upcast and dtype == jnp.bfloat16:
         dtype = jnp.float32
         xs = xs.astype(dtype)
     h = jnp.einsum("...ecd,edf->...ecf", xs, w_up.astype(dtype),
@@ -139,7 +141,8 @@ def _expert_ffn(xs, w_up, w_down, dtype):
                       preferred_element_type=jnp.float32)
 
 
-def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig):
+def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig,
+                  upcast: bool = False):
     """Single-device reference: every expert runs on every token's slot.
 
     x: [N, D].  Ground truth for the expert-parallel path in tests; also
@@ -154,13 +157,14 @@ def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig):
                     x.astype(jnp.float32)).astype(cfg.dtype)       # [E, C, D]
     # Round-trip through cfg.dtype exactly like the expert-parallel path
     # does at its return all-to-all, so the two paths stay bit-identical.
-    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype).astype(cfg.dtype)
+    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype,
+                     upcast=upcast).astype(cfg.dtype)
     out = jnp.einsum("nec,ecd->nd", combine, ys.astype(jnp.float32))
     return out.astype(x.dtype), aux
 
 
 def moe_ffn_expert_parallel(x, router_w, w_up, w_down, cfg: MoEConfig,
-                            axis_name: str):
+                            axis_name: str, upcast: bool = False):
     """Expert-parallel MoE block; runs inside shard_map over ``axis_name``.
 
     x: [N_local, D] — this shard's tokens.  w_up/w_down: [E_local, D, F] —
@@ -184,7 +188,8 @@ def moe_ffn_expert_parallel(x, router_w, w_up, w_down, cfg: MoEConfig,
     xs = xs.reshape(shards, e_local, capacity, d)
     xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,
                         tiled=False)                   # [S(src), E_local, C, D]
-    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype).astype(cfg.dtype)
+    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype,
+                     upcast=upcast).astype(cfg.dtype)
     ys = lax.all_to_all(ys, axis_name, split_axis=0, concat_axis=0,
                         tiled=False)                   # [S, E_local, C, D]
     ys = ys.reshape(cfg.n_experts, capacity, d).astype(jnp.float32)
@@ -213,6 +218,14 @@ def forward(params, tokens, cfg: MoEConfig,
     if use_ep and cfg.n_experts % mesh.shape["expert"]:
         raise ValueError(f"n_experts {cfg.n_experts} must divide over the "
                          f"expert axis ({mesh.shape['expert']})")
+    # bf16 fallback keys on the platform the computation actually runs on:
+    # the mesh's devices when given (tests build CPU meshes even on TPU
+    # hosts), else the process default backend.
+    if mesh is not None:
+        platform = next(iter(mesh.devices.flat)).platform
+    else:
+        platform = jax.default_backend()
+    upcast = platform != "tpu"
 
     def moe_block(h2, router_w, w_up, w_down):
         flat = h2.reshape(b * t, cfg.d_model)
@@ -222,7 +235,8 @@ def forward(params, tokens, cfg: MoEConfig,
 
             def fn(xs, up, down):
                 out, aux = moe_ffn_expert_parallel(xs, router_w, up, down,
-                                                   cfg, "expert")
+                                                   cfg, "expert",
+                                                   upcast=upcast)
                 # moe_ffn_* pmeans aux over the expert axis; tokens also
                 # shard over "data", so finish the mean there for a fully
                 # replicated scalar.
@@ -233,7 +247,8 @@ def forward(params, tokens, cfg: MoEConfig,
                 in_specs=(spec_x, spec_w, spec_w),
                 out_specs=(spec_x, P()))(flat, w_up, w_down)
         else:
-            out, aux = moe_ffn_dense(flat, router_w, w_up, w_down, cfg)
+            out, aux = moe_ffn_dense(flat, router_w, w_up, w_down, cfg,
+                                     upcast=upcast)
         return out.reshape(b, t, cfg.d_model), aux
 
     def layer(carry, lp):
