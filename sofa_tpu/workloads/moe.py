@@ -321,7 +321,9 @@ def main(argv=None):
     n = len(jax.devices())
     mesh = None
     if n > 1:
-        sizes = (args.data, args.expert) if args.data or args.expert else None
+        sizes = None
+        if args.data or args.expert:
+            sizes = (args.data or -1, args.expert or -1)
         mesh = make_mesh(("data", "expert"), sizes)
         ep = mesh.shape["expert"]
         if cfg.n_experts % ep:

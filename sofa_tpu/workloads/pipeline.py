@@ -237,7 +237,9 @@ def main(argv=None):
                          layers_per_stage=args.layers_per_stage,
                          n_microbatches=args.n_microbatches,
                          max_seq=args.seq)
-    sizes = (args.data, args.stage) if args.data or args.stage else None
+    sizes = None
+    if args.data or args.stage:
+        sizes = (args.data or -1, args.stage or -1)
     mesh = make_mesh(("data", "stage"), sizes)
     params, opt_state, step, tokens = build(cfg, mesh, args.batch, args.seq)
 

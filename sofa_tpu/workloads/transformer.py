@@ -141,6 +141,30 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
+def layer_body(x, lp, cfg: TransformerConfig, positions, attn):
+    """One decoder layer, parameterized by the attention implementation.
+
+    ``attn(q, kk, v) -> (o, aux)`` receives *unrepeated* KV heads
+    ([B, T, KVH, Dh]) so cache-based attention (workloads/inference.py) can
+    store them compactly; training attention repeats them for GQA itself.
+    The single copy of the layer math keeps training forward() and the
+    inference block numerically identical by construction.
+    """
+    b, t = x.shape[:2]
+    h = _rmsnorm(x, lp["attn_norm"])
+    q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.d_head)
+    kk = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
+    v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
+    q = _rope(q, positions, cfg.rope_theta)
+    kk = _rope(kk, positions, cfg.rope_theta)
+    o, aux = attn(q, kk, v)
+    x = x + o.reshape(b, t, -1) @ lp["wo"]
+    h = _rmsnorm(x, lp["mlp_norm"])
+    gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(cfg.dtype)
+    x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+    return x, aux
+
+
 def forward(params, tokens, cfg: TransformerConfig,
             mesh: Optional[Mesh] = None) -> jax.Array:
     """Logits [B, T, vocab].  With a mesh whose "seq" axis is >1, attention
@@ -168,29 +192,21 @@ def forward(params, tokens, cfg: TransformerConfig,
         x = lax.with_sharding_constraint(
             x, NamedSharding(mesh, P("data", "seq", None)))
 
-    def layer(x, lp):
-        h = _rmsnorm(x, lp["attn_norm"])
-        q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.d_head)
-        kk = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
-        v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
-        q = _rope(q, positions, cfg.rope_theta)
-        kk = _rope(kk, positions, cfg.rope_theta)
+    def attn(q, kk, v):
         # GQA: replicate each KV head over its query-head group.
         rep = cfg.n_heads // cfg.n_kv_heads
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
         if use_ring and use_flash:
-            o = ring_flash_attention(q, kk, v, mesh)
-        elif use_ring:
-            o = ring_attention(q, kk, v, mesh)
-        elif use_flash:
-            o = flash_causal_attention(q, kk, v)
-        else:
-            o = plain_causal_attention(q, kk, v)
-        x = x + o.reshape(b, t, -1) @ lp["wo"]
-        h = _rmsnorm(x, lp["mlp_norm"])
-        gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(cfg.dtype)
-        x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+            return ring_flash_attention(q, kk, v, mesh), None
+        if use_ring:
+            return ring_attention(q, kk, v, mesh), None
+        if use_flash:
+            return flash_causal_attention(q, kk, v), None
+        return plain_causal_attention(q, kk, v), None
+
+    def layer(x, lp):
+        x, _ = layer_body(x, lp, cfg, positions, attn)
         if mesh is not None:
             x = lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P("data", "seq", None)))
