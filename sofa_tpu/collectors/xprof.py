@@ -152,17 +152,38 @@ def _start(jax):
 
 
 def _watch():
-    # Poll for the jax module becoming importable-and-initialized. A meta-path
-    # hook cannot easily run *after* a package finishes importing; a 20 ms
-    # poll is robust and costs nothing once armed.
+    # Poll for the jax module becoming importable-and-initialized, THEN for
+    # the program to initialize a backend itself.  Calling start_trace
+    # before that would make the *profiler* trigger default-backend init —
+    # overriding any platform the program pins in main() (e.g.
+    # jax_platforms=cpu) and hanging outright when a TPU tunnel is dead.
+    # A meta-path hook cannot easily run *after* a package finishes
+    # importing; a 20 ms poll is robust and costs nothing once armed.
     deadline = time.time() + float(_OPTS.get("arm_timeout_s", 86400))
+    jax = None
     while time.time() < deadline:
         jax = sys.modules.get("jax")
         if jax is not None and getattr(jax, "profiler", None) is not None \\
                 and getattr(jax, "version", None) is not None:
-            _start(jax)
-            return
+            break
+        jax = None
         time.sleep(0.02)
+    if jax is None:
+        return             # never saw a usable jax: give up, don't start
+    while True:
+        try:
+            xb = sys.modules.get("jax._src.xla_bridge")
+            if xb is None or not hasattr(xb, "_backends"):
+                break      # internals moved: start immediately (old behavior)
+            if xb._backends:
+                break      # program initialized a backend; safe to attach
+        except Exception:
+            break
+        if time.time() >= deadline:
+            return         # timed out waiting: starting now would trigger
+                           # backend init ourselves — give up instead
+        time.sleep(0.02)
+    _start(jax)
 
 
 if _OPTS.get("enable", False):

@@ -102,8 +102,19 @@ def steps_per_sec(step_fn, state, n_steps: int, warmup: int = 2,
 
 
 def parse_workload_args(argv, defaults: Dict[str, object]):
-    """Tiny ``--key value`` parser so workloads stay dependency-free."""
+    """Tiny ``--key value`` parser so workloads stay dependency-free.
+
+    Also applies the env-over-config platform rule before any backend
+    init: the image's sitecustomize may force-register a TPU platform
+    whose init *hangs* when the device tunnel is down, and a user who set
+    JAX_PLATFORMS=cpu (e.g. `sofa record` smoke runs) must win over it.
+    """
     import argparse
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
 
     p = argparse.ArgumentParser()
     for k, v in defaults.items():
