@@ -39,7 +39,11 @@ from sofa_tpu.workloads.ring_attention import (
     plain_causal_attention,
     ring_attention,
 )
-from sofa_tpu.workloads.ring_flash import ring_flash_attention
+from sofa_tpu.workloads.ring_flash import (
+    ring_flash_attention,
+    zigzag_indices,
+    zigzag_ring_flash_attention,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +60,11 @@ class TransformerConfig:
     # None = auto: fused Pallas attention on TPU when the single-chip path
     # runs and T divides the kernel's block size; True/False force it.
     flash: Optional[bool] = None
+    # Load-balanced causal sequence parallelism: shard r holds zig-zag
+    # chunks (r, 2S-1-r) so every shard does equal work around the ring.
+    # Requires flash; sequences are permuted at the embedding and
+    # un-permuted before the LM head.
+    zigzag: bool = False
 
     @property
     def d_head(self) -> int:
@@ -174,6 +183,10 @@ def forward(params, tokens, cfg: TransformerConfig,
         raise ValueError(f"sequence length {t} exceeds max_seq {cfg.max_seq}")
     use_ring = mesh is not None and mesh.shape.get("seq", 1) > 1
     t_local = t // mesh.shape["seq"] if use_ring else t
+    if cfg.zigzag and use_ring:
+        # Zig-zag runs the kernel per half-chunk, so the tiling gate must
+        # check that size, not the full local length.
+        t_local //= 2
     if cfg.flash is None:
         # Auto: fused Pallas kernel on TPU (per-shard inside the ring when
         # sequence-parallel).  Off-TPU the kernel only runs interpreted
@@ -187,6 +200,15 @@ def forward(params, tokens, cfg: TransformerConfig,
                 f"the fused kernel (needs a 16-multiple block dividing it)")
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
 
+    use_zigzag = cfg.zigzag and use_ring and use_flash
+    if use_zigzag:
+        # Static permutation into the balanced layout, applied to the
+        # token ids (not the d_model-wide activations); rope reads the
+        # permuted *global* positions so the math is order-invariant.
+        perm, inv_perm = zigzag_indices(t, mesh.shape["seq"])
+        positions = positions[:, perm]
+        tokens = tokens[:, perm]
+
     x = params["embed"].astype(cfg.dtype)[tokens]
     if mesh is not None:
         x = lax.with_sharding_constraint(
@@ -197,6 +219,8 @@ def forward(params, tokens, cfg: TransformerConfig,
         rep = cfg.n_heads // cfg.n_kv_heads
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+        if use_zigzag:
+            return zigzag_ring_flash_attention(q, kk, v, mesh), None
         if use_ring and use_flash:
             return ring_flash_attention(q, kk, v, mesh), None
         if use_ring:
@@ -213,6 +237,8 @@ def forward(params, tokens, cfg: TransformerConfig,
         return x, None
 
     x, _ = lax.scan(layer, x, params["layers"])
+    if use_zigzag:
+        x = x[:, inv_perm]
     x = _rmsnorm(x, params["final_norm"])
     return (x @ params["lm_head"]).astype(jnp.float32)
 

@@ -378,3 +378,48 @@ def test_generate_jits_and_runs_on_mesh():
     out = run(params, prompt)
     assert out.shape == (4, 12)
     assert int(out.min()) >= 0 and int(out.max()) < cfg.vocab
+
+
+def test_zigzag_ring_flash_matches_plain():
+    from sofa_tpu.workloads.ring_flash import (
+        zigzag_indices, zigzag_ring_flash_attention)
+
+    key = jax.random.PRNGKey(9)
+    b, t, h, d = 2, 128, 4, 16
+    S = 4
+    mesh = make_mesh(("data", "seq", "model"), (2, S, 1), platform="cpu")
+    spec = NamedSharding(mesh, P("data", "seq", "model", None))
+    perm, inv = zigzag_indices(t, S)
+    with jax.default_matmul_precision("highest"):
+        q, k, v = jax.random.normal(key, (3, b, t, h, d), jnp.float32)
+        qz, kz, vz = (jax.device_put(a[:, perm], spec) for a in (q, k, v))
+        out = np.asarray(zigzag_ring_flash_attention(qz, kz, vz, mesh))[:, inv]
+        ref = np.asarray(plain_causal_attention(q, k, v))
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+        gz = jax.grad(lambda *a: (zigzag_ring_flash_attention(*a, mesh)
+                                  ** 2).sum(), argnums=(0, 1, 2))(qz, kz, vz)
+        gp = jax.grad(lambda *a: (plain_causal_attention(*a) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gz, gp):
+            np.testing.assert_allclose(np.asarray(a)[:, inv], np.asarray(b_),
+                                       atol=1e-4, rtol=1e-3)
+
+
+def test_transformer_zigzag_matches_plain_forward():
+    import dataclasses
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(seq=128),
+                              dtype=jnp.float32, flash=True, zigzag=True)
+    mesh = make_mesh(("data", "seq", "model"), (2, 2, 2), platform="cpu")
+    key = jax.random.PRNGKey(10)
+    params = init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 128), 0, cfg.vocab)
+    with jax.default_matmul_precision("highest"):
+        from sofa_tpu.workloads.transformer import shard_params
+        sp = shard_params(params, cfg, mesh)
+        tk = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        out_z = forward(sp, tk, cfg, mesh=mesh)
+        out_p = forward(params, tokens,
+                        dataclasses.replace(cfg, flash=False, zigzag=False))
+    np.testing.assert_allclose(np.asarray(out_z), np.asarray(out_p),
+                               atol=1e-3, rtol=1e-3)
