@@ -10,7 +10,13 @@ own, so every BASELINE.json config is runnable out of the box with
   collectives   all-reduce/all-gather/ppermute ICI microbench (config #3,
                 the xring.py equivalent: /root/reference/tools/xring.py:34-72)
   transformer   Llama-style decoder, dp/fsdp/tp/sp sharded over a Mesh with
-                ring-attention sequence parallelism          (configs #4, #5)
+                ring/flash/zig-zag attention                 (configs #4, #5)
+  inference     KV-cache prefill + greedy decode             (config #4)
+  moe           Switch-MoE with expert-parallel all-to-all dispatch
+  pipeline      GPipe-style pipeline parallelism over ppermute
+
+Supporting modules: flash_pallas (the streaming Pallas kernel),
+ring_attention / ring_flash (sequence parallelism, plain and fused).
 
 Each module is TPU-first: bfloat16 matmuls, static shapes, `lax.scan` loops,
 shardings declared as `PartitionSpec`s over a `jax.sharding.Mesh` so XLA
