@@ -1,0 +1,37 @@
+"""The examples/ profiling targets must keep running (they are the first
+thing a new user points `sofa stat` at)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _example(name):
+    return os.path.join(_ROOT, "examples", name)
+
+
+def test_io_churn_runs(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, os.path.dirname(_example("io_churn.py")))
+    try:
+        mod = runpy.run_path(_example("io_churn.py"), run_name="not_main")
+        mod["main"](mb=4)
+    finally:
+        sys.path.pop(0)
+    assert "wrote+read 4 MiB" in capsys.readouterr().out
+
+
+def test_train_tiny_runs(capsys):
+    mod = runpy.run_path(_example("train_tiny.py"), run_name="not_main")
+    mod["main"](steps=2)
+    assert "final loss" in capsys.readouterr().out
+
+
+def test_matmul_burn_runs(capsys):
+    mod = runpy.run_path(_example("matmul_burn.py"), run_name="not_main")
+    mod["main"](seconds=0.5, n=128)
+    assert "burns in" in capsys.readouterr().out
