@@ -108,6 +108,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = p.add_argument_group("viz")
     g.add_argument("--viz_port", type=int)
+    g.add_argument("--viz_bind", help='bind address (default 127.0.0.1; '
+                                      'use 0.0.0.0 to serve remotely)')
 
     g = p.add_argument_group("cluster")
     g.add_argument("--cluster_hosts", help="comma-joined host list for multi-host runs")
@@ -144,7 +146,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",
         "enable_swarms", "is_idle_threshold", "profile_region", "spotlight",
         "hint_server", "iterations_from",
-        "base_logdir", "match_logdir", "viz_port", "plugins",
+        "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
     ):
         if was_set(name):
             setattr(cfg, name, passed[name])

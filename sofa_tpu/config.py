@@ -122,6 +122,10 @@ class SofaConfig:
 
     # --- viz ---------------------------------------------------------------
     viz_port: int = 8000
+    # Bind address.  Unlike the reference (http.server on all interfaces,
+    # sofa_viz.py:18) the default is loopback: a logdir holds command
+    # lines, hostnames, and packet metadata.  --viz_bind 0.0.0.0 opens it.
+    viz_bind: str = "127.0.0.1"
 
     # --- cluster (multi-host) ---------------------------------------------
     cluster_hosts: List[str] = field(default_factory=list)

@@ -7,9 +7,11 @@ bind/port-retry and print the URL.
 
 from __future__ import annotations
 
+import errno
 import functools
 import http.server
 import os
+import socket
 import socketserver
 
 from sofa_tpu.printing import print_error, print_progress
@@ -30,18 +32,30 @@ def sofa_viz(cfg, serve_forever: bool = True):
     last_err = None
     for port_try in range(cfg.viz_port, cfg.viz_port + 20):
         try:
-            httpd = socketserver.TCPServer(("", port_try), handler)
+            httpd = socketserver.TCPServer((cfg.viz_bind, port_try), handler)
             break
         except OSError as e:
             last_err = e
+            if getattr(e, "errno", None) != errno.EADDRINUSE:
+                # A bad bind address fails identically on every port —
+                # retrying the range would only bury the real error.
+                break
     if httpd is None:
         print_error(
             f"cannot bind a port in {cfg.viz_port}..{cfg.viz_port + 19}: {last_err}"
         )
         return None
     port = httpd.server_address[1]
+    if cfg.viz_bind == "127.0.0.1":
+        host = "localhost"
+    elif cfg.viz_bind in ("", "0.0.0.0", "::"):
+        # Wildcard bind: print an address a *remote* user can reach.
+        host = socket.gethostname()
+    else:
+        host = cfg.viz_bind
     print_progress(
-        f"serving {cfg.logdir} at http://localhost:{port}/ (Ctrl-C stops)"
+        f"serving {cfg.logdir} at http://{host}:{port}/ (Ctrl-C stops; "
+        f"bound to {cfg.viz_bind or 'all interfaces'})"
     )
     if serve_forever:
         try:

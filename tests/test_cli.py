@@ -76,3 +76,33 @@ def test_setup_reports_fixes_without_apply(monkeypatch, capsys):
     rc = setup_env.sofa_setup(apply=False)
     assert rc == 1
     assert "setcap x /bin/tcpdump" in capsys.readouterr().out
+
+
+def test_viz_bind_default_is_loopback(tmp_path):
+    import urllib.request
+
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.viz import sofa_viz
+
+    d = tmp_path / "log"
+    d.mkdir()
+    (d / "index.html").write_text("<html>ok</html>")
+    cfg = SofaConfig(logdir=str(d) + "/", viz_port=8991)
+    httpd = sofa_viz(cfg, serve_forever=False)
+    assert httpd is not None
+    try:
+        assert httpd.server_address[0] == "127.0.0.1"
+        import threading
+        t = threading.Thread(target=httpd.handle_request, daemon=True)
+        t.start()
+        port = httpd.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/index.html", timeout=5).read()
+        assert b"ok" in body
+    finally:
+        httpd.server_close()
+
+
+def test_viz_bind_flag():
+    cfg = parse(["viz", "--viz_bind", "0.0.0.0"])
+    assert cfg.viz_bind == "0.0.0.0"
