@@ -130,11 +130,28 @@ def _stat_value(stat, stat_meta) -> Tuple[str, object]:
     name = stat_meta.get(stat.metadata_id)
     name = name.name if name is not None else str(stat.metadata_id)
     which = stat.WhichOneof("value")
-    return name, getattr(stat, which) if which else None
+    value = getattr(stat, which) if which else None
+    if which == "ref_value":
+        # String stats may be interned: ref_value points at the
+        # stat_metadata entry whose *name* is the string payload.
+        ref = stat_meta.get(stat.ref_value)
+        value = ref.name if ref is not None else str(stat.ref_value)
+    return name, value
 
 
 def _event_stats(ev, stat_meta) -> Dict[str, object]:
     return dict(_stat_value(s, stat_meta) for s in ev.stats)
+
+
+# Real libtpu captures name XLA-Ops events with the full HLO instruction
+# text ("%fusion.31 = bf16[...] fusion(...), kind=kLoop, ...").  The short
+# op name is the lvalue; the full text is still mined for replica_groups.
+_HLO_INSTR_RE = re.compile(r"^(?:ROOT )?%([\w.\-]+) = ")
+
+
+def _short_op_name(name: str) -> str:
+    m = _HLO_INSTR_RE.match(name)
+    return m.group(1) if m else name
 
 
 def find_marker_offset_ns(xspace) -> Optional[int]:
@@ -158,17 +175,30 @@ def find_marker_offset_ns(xspace) -> Optional[int]:
 
 
 def _iter_line_events(plane, line) -> Iterable[Tuple[str, str, int, int, Dict]]:
-    """Yield (name, display_name, start_ns, dur_ns, stats) per event."""
+    """Yield (name, display_name, start_ns, dur_ns, stats) per event.
+
+    stats merge the event-metadata stats with the per-event stats (event
+    wins).  Real libtpu captures carry flops / bytes_accessed /
+    hlo_category / tf_op on XEventMetadata.stats — only synthetic traces
+    put them on the event — which round 1's self-made protos masked.
+    """
     em = plane.event_metadata
     sm = plane.stat_metadata
     base_ns = line.timestamp_ns
+    md_cache: Dict[int, Dict[str, object]] = {}
     for ev in line.events:
         meta = em.get(ev.metadata_id)
         name = meta.name if meta is not None else ""
         disp = meta.display_name if meta is not None and meta.display_name else name
         start_ns = base_ns + ev.offset_ps // 1000
         dur_ns = ev.duration_ps // 1000
-        yield name, disp, start_ns, dur_ns, _event_stats(ev, sm)
+        md = md_cache.get(ev.metadata_id)
+        if md is None:
+            # XEventMetadata has the same .stats shape as XEvent.
+            md = _event_stats(meta, sm) if meta is not None else {}
+            md_cache[ev.metadata_id] = md
+        stats = {**md, **_event_stats(ev, sm)} if md else _event_stats(ev, sm)
+        yield name, disp, start_ns, dur_ns, stats
 
 
 def device_plane_meta(plane) -> Dict[str, float]:
@@ -259,11 +289,16 @@ def xspace_to_frames(
                 for idx, (name, disp, start_ns, dur_ns, stats) in enumerate(
                     _iter_line_events(plane, line)
                 ):
+                    label = _short_op_name(disp)
                     hlo_cat = str(stats.get("hlo_category", "") or "")
-                    kind = classify_hlo_kind(disp, hlo_cat)
+                    kind = classify_hlo_kind(label, hlo_cat)
                     dur_s = dur_ns / 1e9
                     nbytes = int(stats.get("bytes_accessed", 0) or 0)
                     t = to_rel_s(start_ns)
+                    if kind >= 20 and name != label:
+                        # The metadata name is the full HLO instruction —
+                        # the one place replica_groups always appears.
+                        stats.setdefault("hlo_text", name)
                     op_rows.append(
                         {
                             "timestamp": t,
@@ -273,7 +308,7 @@ def xspace_to_frames(
                             "copyKind": int(kind),
                             "payload": nbytes if kind != CopyKind.KERNEL else 0,
                             "bandwidth": (nbytes / dur_s) if dur_s > 0 else 0.0,
-                            "name": disp,
+                            "name": label,
                             "category": category,
                             "device_kind": "tpu",
                             "hlo_category": hlo_cat,

@@ -209,7 +209,20 @@ def forward(params, tokens, cfg: TransformerConfig,
         positions = positions[:, perm]
         tokens = tokens[:, perm]
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    emb = params["embed"].astype(cfg.dtype)
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        # Iota one-hot contraction instead of a gather: the table is sharded
+        # over vocab ("model" axis) and a cross-shard gather forces the SPMD
+        # partitioner into involuntary full rematerialization (replicate the
+        # table, then re-partition).  A dot contracting over vocab partitions
+        # cleanly — each shard contracts its vocab slice and XLA inserts one
+        # psum over "model" — and the one-hot fuses into the MXU matmul.
+        one_hot = (tokens[..., None] == lax.broadcasted_iota(
+            jnp.int32, (1, 1, cfg.vocab), 2)).astype(cfg.dtype)
+        x = one_hot @ emb
+    else:
+        # Unsharded vocab (model axis 1, or no mesh): the gather is local.
+        x = emb[tokens]
     if mesh is not None:
         x = lax.with_sharding_constraint(
             x, NamedSharding(mesh, P("data", "seq", None)))
