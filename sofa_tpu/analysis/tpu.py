@@ -52,6 +52,7 @@ def tpu_profile(frames, cfg, features: Features) -> None:
             mean_time=("duration", "mean"),
             flops=("flops", "sum"),
             bytes_accessed=("bytes_accessed", "sum"),
+            source=("source", "first"),
         )
         .sort_values("total_time", ascending=False)
     )

@@ -39,6 +39,8 @@ Extension columns (TPU build):
   phase         str   training-phase attribution: "fw" | "bw" | "" (unknown),
                       derived from the op's JAX provenance path (transpose(jvp)
                       marks the backward pass)
+  source        str   user-code provenance "file.py:line" XLA recorded for the
+                      op (real libtpu captures carry it per event metadata)
 """
 
 from __future__ import annotations
@@ -69,7 +71,7 @@ BASE_COLUMNS = [
 ]
 
 EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops",
-                 "bytes_accessed", "groups", "phase"]
+                 "bytes_accessed", "groups", "phase", "source"]
 
 COLUMNS = BASE_COLUMNS + EXTRA_COLUMNS
 
@@ -94,6 +96,7 @@ _DEFAULTS = {
     "bytes_accessed": 0.0,
     "groups": "",
     "phase": "",
+    "source": "",
 }
 
 

@@ -83,6 +83,8 @@ def test_tpu_capture_device_plane_ingests(tpu_frames):
     assert (ops["hlo_category"] != "").any()
     # Sync ops on category 0, async DMA on category 2.
     assert set(ops["category"]) == {0, 2}
+    # User-code provenance XLA recorded for the profiled program.
+    assert ops["source"].str.contains("validate_tpu.py").any()
 
 
 def test_tpu_capture_module_attribution(tpu_frames):
