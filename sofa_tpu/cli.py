@@ -100,7 +100,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--spotlight", action="store_true", help="auto-ROI from TPU utilization")
     g.add_argument("--hint_server", help="gRPC advice service host:port")
     g.add_argument("--iterations_from",
-                   choices=["auto", "marker", "module", "op"])
+                   choices=["auto", "steps", "marker", "module", "op"])
 
     g = p.add_argument_group("diff")
     g.add_argument("--base_logdir")

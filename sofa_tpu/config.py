@@ -111,9 +111,10 @@ class SofaConfig:
     profile_region: str = ""         # "begin:end" manual ROI (seconds)
     spotlight: bool = False          # auto-ROI from TPU utilization
     hint_server: Optional[str] = None  # gRPC advice service host:port
-    # AISI boundary source: auto = explicit sofa_step markers when present,
-    # else module-launch mining; module|op force mining on that symbol
-    # sequence; marker requires explicit markers.
+    # AISI boundary source: auto = device-plane "Steps" spans when traced,
+    # else explicit sofa_step markers, else module-launch mining; steps |
+    # marker require that source; module | op force mining on that symbol
+    # sequence.
     iterations_from: str = "auto"
 
     # --- diff --------------------------------------------------------------

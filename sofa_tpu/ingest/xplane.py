@@ -284,6 +284,7 @@ def xspace_to_frames(
         "bytes_accessed", "groups", "phase", "source")}
     module_rows: List[dict] = []
     host_rows: List[dict] = []
+    step_rows: List[dict] = []
     meta: Dict[str, Dict[str, float]] = {}
 
     for plane in xspace.planes:
@@ -295,6 +296,26 @@ def xspace_to_frames(
             meta[str(device_id)] = device_plane_meta(plane)
             module_spans: List[Tuple[float, float, str]] = []
             for line in plane.lines:
+                if line.name == "Steps":
+                    # XLA's own device-side step demarcation (one span per
+                    # profiler StepMarker) — exact iteration boundaries,
+                    # preferred by aisi over host-marker matching.
+                    for name, disp, start_ns, dur_ns, stats in \
+                            _iter_line_events(plane, line):
+                        try:
+                            step_no = int(name)
+                        except ValueError:
+                            step_no = len(step_rows)
+                        step_rows.append(
+                            {
+                                "timestamp": to_rel_s(start_ns),
+                                "event": float(step_no),
+                                "duration": dur_ns / 1e9,
+                                "deviceId": device_id,
+                                "name": f"step {step_no}",
+                                "device_kind": "tpu",
+                            }
+                        )
                 if line.name == "XLA Modules":
                     for name, disp, start_ns, dur_ns, stats in _iter_line_events(plane, line):
                         mod_match = _MODULE_NAME_RE.match(name)
@@ -411,6 +432,7 @@ def xspace_to_frames(
         "tputrace": make_frame(op_cols) if n_ops else empty_frame(),
         "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),
         "hosttrace": make_frame(host_rows) if host_rows else empty_frame(),
+        "tpusteps": make_frame(step_rows) if step_rows else empty_frame(),
     }
     frames["_meta"] = meta  # type: ignore[assignment]
     return frames
@@ -501,7 +523,7 @@ def ingest_xprof_dir(
     if not paths:
         return {}
     all_frames: Dict[str, List[pd.DataFrame]] = {
-        "tputrace": [], "tpumodules": [], "hosttrace": []
+        "tputrace": [], "tpumodules": [], "hosttrace": [], "tpusteps": []
     }
     meta: Dict[str, Dict[str, float]] = {}
     for host_index, path in enumerate(paths):

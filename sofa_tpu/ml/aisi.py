@@ -36,6 +36,25 @@ COMM_BOUND_RATIO = 0.15  # the reference's verdict threshold (sofa_aisi.py:503-5
 _STEP_MARKER_RE = re.compile(r"^sofa_step_(\d+)$")
 
 
+def _iterations_from_steps(frames) -> Optional[Tuple[List[float], List[float]]]:
+    """Exact (begins, ends) from the device plane's "Steps" line, if traced.
+
+    XLA demarcates profiler steps on the device itself (one span per
+    StepMarker); these are device-anchored and exact, so they beat both
+    host-marker matching and sequence mining whenever present.
+    """
+    steps = frames.get("tpusteps")
+    if steps is None or steps.empty:
+        return None
+    dev = steps.groupby("deviceId")["duration"].sum().idxmax()
+    rows = steps[steps["deviceId"] == dev].sort_values("timestamp")
+    if len(rows) < 2:
+        return None
+    begins = rows["timestamp"].astype(float).tolist()
+    ends = (rows["timestamp"] + rows["duration"]).astype(float).tolist()
+    return begins, ends
+
+
 def _iterations_from_markers(frames) -> Optional[Tuple[List[float], List[float]]]:
     """Exact (begins, ends) from sofa_step_<i> TraceAnnotations, if present.
 
@@ -154,21 +173,29 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
     Writes iterations.csv; appends per-step features and the
     compute- vs communication-bound verdict.
     """
-    source = cfg.iterations_from  # auto | marker | module | op
+    source = cfg.iterations_from  # auto | steps | marker | module | op
     tputrace = frames.get("tputrace")
     modules = frames.get("tpumodules")
 
     marked = None
-    if source in ("auto", "marker"):
+    label = ""
+    if source in ("auto", "steps"):
+        marked = _iterations_from_steps(frames)
+        label = "device-plane step spans"
+        if marked is None and source == "steps":
+            print_warning("aisi: iterations_from=steps but the device trace "
+                          "has fewer than two step spans")
+            return None
+    if marked is None and source in ("auto", "marker"):
         marked = _iterations_from_markers(frames)
+        label = "explicit sofa_step markers"
         if marked is None and source == "marker":
             print_warning("aisi: iterations_from=marker but no usable "
                           "sofa_step annotations in the host trace")
             return None
     if marked is not None:
         bounds, ends = marked
-        print_progress(
-            f"aisi: {len(bounds)} iterations from explicit sofa_step markers")
+        print_progress(f"aisi: {len(bounds)} iterations from {label}")
     else:
         if source in ("auto", "module") and modules is not None \
                 and not modules.empty:

@@ -40,6 +40,7 @@ _SERIES_STYLE = {
     "tpumodules": ("TPU modules", "mediumvioletred"),
     "tpuutil": ("TPU util", "crimson"),
     "tpumon": ("TPU HBM", "firebrick"),
+    "tpusteps": ("TPU steps", "black"),
     "blktrace": ("Block IO latency (ms)", "peru"),
 }
 
@@ -129,7 +130,7 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         frames.update(xframes)
     except Exception as e:  # noqa: BLE001
         print_warning(f"preprocess xplane: {e}")
-    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil"):
+    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil", "tpusteps"):
         frames.setdefault(key, empty_frame())
 
     # --- write CSVs -------------------------------------------------------

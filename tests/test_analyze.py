@@ -386,3 +386,26 @@ def test_roofline_profile_without_meta_is_noop(cfg):
     feats = Features()
     tpu.roofline_profile({"tputrace": tpu_frame()}, cfg, feats)
     assert feats.get("tpu0_roofline_efficiency") is None
+
+
+def test_load_frames_includes_tpusteps(cfg):
+    """The CLI path loads aisi's preferred step-boundary source from CSV
+    (regression: tpusteps.csv was written by preprocess but never read)."""
+    from sofa_tpu.analyze import load_frames
+    from sofa_tpu.trace import write_csv
+
+    steps = make_frame([
+        {"timestamp": 1.0, "event": 0.0, "duration": 0.5, "deviceId": 0,
+         "name": "step 0", "device_kind": "tpu"},
+        {"timestamp": 1.5, "event": 1.0, "duration": 0.5, "deviceId": 0,
+         "name": "step 1", "device_kind": "tpu"},
+    ])
+    write_csv(steps, cfg.path("tpusteps.csv"))
+    frames = load_frames(cfg)
+    assert len(frames["tpusteps"]) == 2
+
+    from sofa_tpu.ml.aisi import _iterations_from_steps
+
+    begins, ends = _iterations_from_steps(frames)
+    assert begins == [1.0, 1.5]
+    assert ends == [1.5, 2.0]

@@ -72,6 +72,10 @@ def build_xspace():
     dev = xs.planes.add()
     dev.name = "/device:TPU:0"
     _add_stat(dev, dev, "peak_teraflops_per_second", 100.0)
+    sline = dev.lines.add()
+    sline.name = "Steps"
+    _add_event(dev, sline, "0", 2_000_000, 1_000_000)
+    _add_event(dev, sline, "1", 3_000_000, 1_000_000)
     mline = dev.lines.add()
     mline.name = "XLA Modules"
     _add_event(dev, mline, "jit_train_step(12345)", 2_000_000, 1_000_000,
@@ -98,6 +102,29 @@ def build_xspace():
 
 
 TIME_BASE = MARKER_UNIX_NS / 1e9 - 10.0  # marker fired 10 s after record start
+
+
+def test_device_step_spans_ingest():
+    xs = build_xspace()
+    frames = xspace_to_frames(xs, TIME_BASE)
+    steps = frames["tpusteps"]
+    assert len(steps) == 2
+    assert list(steps["event"]) == [0.0, 1.0]
+    assert steps.iloc[0]["timestamp"] == pytest.approx(10.001, abs=1e-6)
+    assert steps.iloc[0]["duration"] == pytest.approx(1e-3)
+
+
+def test_aisi_prefers_device_steps():
+    from sofa_tpu.ml.aisi import _iterations_from_steps
+
+    xs = build_xspace()
+    frames = xspace_to_frames(xs, TIME_BASE)
+    out = _iterations_from_steps(frames)
+    assert out is not None
+    begins, ends = out
+    assert len(begins) == 2
+    assert begins[0] == pytest.approx(10.001, abs=1e-6)
+    assert ends[0] == pytest.approx(10.002, abs=1e-6)
 
 
 def test_marker_offset():
