@@ -130,15 +130,17 @@ def _with_timeout(fn, timeout_s: float):
     return box["value"]
 
 
-def _init_backend(max_tries: int = 5, backoff_s: float = 30.0,
+def _init_backend(max_tries: int = 4, backoff_s: float = 30.0,
                   timeout_s: float = 90.0):
     """Initialize the JAX backend, retrying a transiently-unavailable chip.
 
-    The first attempt runs the real in-process init under a watchdog (no
-    extra subprocess on the happy path); retries preflight in a subprocess
-    first, because a dead device tunnel makes jax.devices() hang rather
-    than raise.  An *in-process* hang is fatal — the wedged backend lock
-    would poison every later attempt — so it stops the loop immediately.
+    Every attempt probes backend init in a *subprocess* first: a dead or
+    busy device tunnel makes jax.devices() hang rather than raise, and a
+    probe hang/failure costs us nothing in-process, so it can be retried
+    with backoff (a remotely-held chip frees up when that session ends).
+    Only after a healthy probe does the real in-process init run, under a
+    watchdog; if THAT hangs despite the probe, the backend lock is wedged
+    and retrying in this process is pointless.
     """
     import jax
 
@@ -154,20 +156,20 @@ def _init_backend(max_tries: int = 5, backoff_s: float = 30.0,
                 _with_timeout(jeb.clear_backends, 30.0)
             except Exception:
                 pass
-            reason = _preflight()
-            if reason is not None:
-                last = RuntimeError(reason)
-                _log(f"bench: {reason}")
-                _log_chip_holders()
-                continue
+        reason = _preflight()
+        if reason is not None:
+            last = RuntimeError(reason)
+            _log(f"bench: {reason}")
+            _log_chip_holders()
+            continue
         try:
             devs = _with_timeout(jax.devices, timeout_s)
             _log(f"bench: backend={jax.default_backend()} devices={devs}")
             return devs
         except _Hung:
             last = RuntimeError(
-                f"in-process backend init hung > {timeout_s:.0f}s; "
-                "not retrying against a wedged backend lock")
+                f"in-process backend init hung > {timeout_s:.0f}s despite "
+                "a healthy subprocess probe; backend lock wedged")
             _log(f"bench: {last}")
             _log_chip_holders()
             break
@@ -206,7 +208,9 @@ def main() -> int:
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--repeats", type=int, default=3,
-                   help="paired bare/profiled passes; medians are compared")
+                   help="paired bare/profiled passes; medians are compared "
+                        "(pass 0 sometimes runs anomalously fast right after "
+                        "compile; the median of 3 discards it)")
     args = p.parse_args()
 
     import os

@@ -77,6 +77,52 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         features.add("tpu_module_launches", int(per_mod["count"].sum()))
 
 
+def op_tree_profile(frames, cfg, features: Features) -> None:
+    """Hierarchical time attribution over the JAX program structure.
+
+    Every op carries its provenance path (op_path column, from XPlane's
+    tf_op stat: "jit(train_step)/jvp(main)/dot_general"); each op's time
+    is credited to every prefix of its path, yielding a tree like
+    TensorBoard's op_profile — but over the unified schema, so it composes
+    with phase/device filters.  The reference has no analogue (its closest
+    is the flat top-k kernel table, sofa_analyze.py:343-377).  Writes
+    tpu_op_tree.csv (path, depth, time, count, flops, bytes).
+    """
+    df = frames.get("tputrace")
+    if df is None or df.empty or "op_path" not in df.columns:
+        return
+    sync = df[(df["category"] == 0) & (df["op_path"] != "")]
+    if sync.empty:
+        return
+    agg: dict = {}
+    for path, dur, flops, nbytes in zip(
+            sync["op_path"], sync["duration"], sync["flops"],
+            sync["bytes_accessed"]):
+        parts = path.split("/")
+        for depth in range(1, len(parts) + 1):
+            prefix = "/".join(parts[:depth])
+            a = agg.get(prefix)
+            if a is None:
+                agg[prefix] = a = [depth, 0.0, 0, 0.0, 0.0]
+            a[1] += dur
+            a[2] += 1
+            a[3] += flops
+            a[4] += nbytes
+    total = float(sync["duration"].sum())
+    table = pd.DataFrame(
+        [(p, d, t, c, f, b) for p, (d, t, c, f, b) in agg.items()],
+        columns=["path", "depth", "time", "count", "flops", "bytes_accessed"],
+    ).sort_values(["depth", "time"], ascending=[True, False])
+    table["time_pct"] = 100.0 * table["time"] / total if total > 0 else 0.0
+    table.to_csv(cfg.path("tpu_op_tree.csv"), index=False)
+    features.add("op_tree_paths", len(table))
+    if cfg.verbose and not table.empty:
+        print_title("Op tree (time by program path, depth <= 2)")
+        shallow = table[table["depth"] <= 2].head(12)
+        print(shallow[["path", "time", "time_pct", "count"]]
+              .to_string(index=False))
+
+
 def roofline_profile(frames, cfg, features: Features) -> None:
     """Per-op speed-of-light analysis against the chip's peak rates.
 

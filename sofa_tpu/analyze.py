@@ -41,6 +41,7 @@ _PASSES = [
     ("netbandwidth_profile", comm.netbandwidth_profile),
     ("net_profile", comm.net_profile),
     ("tpu_profile", tpu.tpu_profile),
+    ("op_tree_profile", tpu.op_tree_profile),
     ("roofline_profile", tpu.roofline_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
     ("tpumon_profile", tpu.tpumon_profile),

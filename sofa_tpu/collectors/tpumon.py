@@ -80,6 +80,7 @@ def _loop(rate_hz, out_path, stop):
             ts = time.time_ns()
             try:
                 out.write("%d -1 0 0 0\\n" % ts)   # liveness heartbeat
+                wrote = False
                 for d in devs:
                     try:
                         ms = d.memory_stats()
@@ -87,12 +88,32 @@ def _loop(rate_hz, out_path, stop):
                         ms = None
                     if not ms:
                         continue
+                    wrote = True
                     out.write("%d %d %d %d %d\\n" % (
                         ts, d.id,
                         ms.get("bytes_in_use", 0),
                         ms.get("bytes_limit", 0),
                         ms.get("peak_bytes_in_use", 0),
                     ))
+                if not wrote:
+                    # PJRT clients without memory_stats (e.g. tunneled
+                    # backends): approximate HBM in use with the bytes of
+                    # live arrays this process holds per device.  limit=0
+                    # marks the estimate; ingest emits used-only rows.
+                    per = {}
+                    try:
+                        for a in jax.live_arrays():
+                            try:
+                                for sh in a.addressable_shards:
+                                    did = sh.device.id
+                                    per[did] = per.get(did, 0) + int(
+                                        sh.data.nbytes)
+                            except Exception:
+                                pass
+                    except Exception:
+                        per = {}
+                    for did, used in sorted(per.items()):
+                        out.write("%d %d %d 0 0\\n" % (ts, did, used))
             except Exception:
                 return
             time.sleep(interval)

@@ -169,6 +169,9 @@ def _derive_op_fields(label: str, md: Dict[str, object]) -> dict:
     """
     hlo_cat = str(md.get("hlo_category", "") or "")
     kind = int(classify_hlo_kind(label, hlo_cat))
+    op_path = md.get("tf_op") or md.get("op_name") or ""
+    if isinstance(op_path, bytes):
+        op_path = op_path.decode(errors="replace")
     return {
         "label": label,
         "hlo_cat": hlo_cat,
@@ -178,6 +181,7 @@ def _derive_op_fields(label: str, md: Dict[str, object]) -> dict:
         "groups": _groups_from_stats(md) if kind >= 20 else "",
         "phase": _phase_from_stats(md),
         "source": str(md.get("source", "") or ""),
+        "op_path": str(op_path).rstrip(":"),
         "_md": md,
     }
 
@@ -281,7 +285,7 @@ def xspace_to_frames(
     op_cols: Dict[str, list] = {k: [] for k in (
         "timestamp", "event", "duration", "deviceId", "copyKind", "payload",
         "bandwidth", "name", "category", "hlo_category", "module", "flops",
-        "bytes_accessed", "groups", "phase", "source")}
+        "bytes_accessed", "groups", "phase", "source", "op_path")}
     module_rows: List[dict] = []
     host_rows: List[dict] = []
     step_rows: List[dict] = []
@@ -393,6 +397,7 @@ def xspace_to_frames(
                     op_cols["groups"].append(c["groups"])
                     op_cols["phase"].append(c["phase"])
                     op_cols["source"].append(c["source"])
+                    op_cols["op_path"].append(c["op_path"])
             # Module attribution for this plane's ops, one vectorized
             # searchsorted instead of a binary search per event.
             ts = np.asarray(op_cols["timestamp"][plane_op_start:])

@@ -36,6 +36,12 @@ COMM_BOUND_RATIO = 0.15  # the reference's verdict threshold (sofa_aisi.py:503-5
 _STEP_MARKER_RE = re.compile(r"^sofa_step_(\d+)$")
 
 
+def _busiest_device(df):
+    """The device carrying the most total span time — every boundary and
+    sequence source anchors to the same chip."""
+    return df.groupby("deviceId")["duration"].sum().idxmax()
+
+
 def _iterations_from_steps(frames) -> Optional[Tuple[List[float], List[float]]]:
     """Exact (begins, ends) from the device plane's "Steps" line, if traced.
 
@@ -46,7 +52,7 @@ def _iterations_from_steps(frames) -> Optional[Tuple[List[float], List[float]]]:
     steps = frames.get("tpusteps")
     if steps is None or steps.empty:
         return None
-    dev = steps.groupby("deviceId")["duration"].sum().idxmax()
+    dev = _busiest_device(steps)
     rows = steps[steps["deviceId"] == dev].sort_values("timestamp")
     if len(rows) < 2:
         return None
@@ -95,7 +101,7 @@ def _anchor_to_device(frames, host_begins: List[float]):
     modules = frames.get("tpumodules")
     if modules is None or modules.empty:
         return None
-    dev = modules.groupby("deviceId")["duration"].sum().idxmax()
+    dev = _busiest_device(modules)
     mods = modules[modules["deviceId"] == dev]
     # The step program is the module with the largest total device time; a
     # small per-step helper (scalar readback/convert) can out-COUNT the real
@@ -283,7 +289,7 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
 
 
 def _module_sequence(modules: pd.DataFrame) -> pd.DataFrame:
-    dev = modules.groupby("deviceId")["duration"].sum().idxmax()
+    dev = _busiest_device(modules)
     return modules[modules["deviceId"] == dev].sort_values("timestamp")
 
 
@@ -291,7 +297,7 @@ def _op_sequence(tputrace: pd.DataFrame) -> pd.DataFrame:
     sync = tputrace[tputrace["category"] == 0]
     if sync.empty:
         return sync
-    dev = sync.groupby("deviceId")["duration"].sum().idxmax()
+    dev = _busiest_device(sync)
     return sync[sync["deviceId"] == dev].sort_values("timestamp")
 
 

@@ -41,6 +41,9 @@ Extension columns (TPU build):
                       marks the backward pass)
   source        str   user-code provenance "file.py:line" XLA recorded for the
                       op (real libtpu captures carry it per event metadata)
+  op_path       str   JAX program-structure path for the op (the tf_op stat,
+                      e.g. "jit(train_step)/jvp(main)/dot_general") — feeds
+                      the hierarchical op-tree profile
 """
 
 from __future__ import annotations
@@ -71,7 +74,7 @@ BASE_COLUMNS = [
 ]
 
 EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops",
-                 "bytes_accessed", "groups", "phase", "source"]
+                 "bytes_accessed", "groups", "phase", "source", "op_path"]
 
 COLUMNS = BASE_COLUMNS + EXTRA_COLUMNS
 
@@ -97,6 +100,7 @@ _DEFAULTS = {
     "groups": "",
     "phase": "",
     "source": "",
+    "op_path": "",
 }
 
 

@@ -409,3 +409,32 @@ def test_load_frames_includes_tpusteps(cfg):
     begins, ends = _iterations_from_steps(frames)
     assert begins == [1.0, 1.5]
     assert ends == [1.5, 2.0]
+
+
+def test_op_tree_profile(cfg):
+    frames = {"tputrace": make_frame([
+        {"timestamp": 0.0, "duration": 0.2, "category": 0, "deviceId": 0,
+         "name": "dot.1", "flops": 100.0,
+         "op_path": "jit(step)/jvp(main)/dot_general"},
+        {"timestamp": 0.2, "duration": 0.1, "category": 0, "deviceId": 0,
+         "name": "dot.2", "flops": 50.0,
+         "op_path": "jit(step)/transpose(jvp(main))/dot_general"},
+        {"timestamp": 0.3, "duration": 0.1, "category": 0, "deviceId": 0,
+         "name": "copy.1", "op_path": ""},          # unattributed: excluded
+        {"timestamp": 0.4, "duration": 0.4, "category": 2, "deviceId": 0,
+         "name": "async", "op_path": "jit(step)/x"},  # async: excluded
+    ])}
+    feats = Features()
+    tpu.op_tree_profile(frames, cfg, feats)
+    table = pd.read_csv(cfg.path("tpu_op_tree.csv"))
+    root = table[table["path"] == "jit(step)"].iloc[0]
+    assert root["depth"] == 1
+    assert root["time"] == pytest.approx(0.3)
+    assert root["count"] == 2
+    assert root["flops"] == 150.0
+    assert root["time_pct"] == pytest.approx(100.0)
+    fw = table[table["path"] == "jit(step)/jvp(main)"].iloc[0]
+    assert fw["time"] == pytest.approx(0.2)
+    leaves = table[table["depth"] == 3]
+    assert len(leaves) == 2
+    assert feats.get("op_tree_paths") == len(table)

@@ -286,3 +286,36 @@ def test_timebase_converter_fits_drift(tmp_path):
     # edge points reproduce exactly
     assert f(1.0) == pytest.approx(2.0, abs=2e-5)
     assert f(101.0) == pytest.approx(2.0 + 100.0 * 1.0001, abs=2e-5)
+
+
+def test_tpumon_live_arrays_fallback(tmp_path):
+    """Backends without memory_stats (CPU here, tunneled PJRT in prod) fall
+    back to per-device live-array bytes, emitted with limit=0."""
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from sofa_tpu.collectors.tpumon import start_sampler
+    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
+
+    keep = jnp.ones((512, 512), jnp.float32)  # 1 MiB held across ticks
+    out = str(tmp_path / "tpumon.txt")
+    stop = threading.Event()
+    t = start_sampler(50.0, out, stop)
+    deadline = time.time() + 10.0
+    df = None
+    while time.time() < deadline:
+        time.sleep(0.1)
+        df = ingest_tpumon(str(tmp_path), 0.0)
+        if not df.empty and (df["name"] == "hbm_used_gb").any():
+            break
+    stop.set()
+    t.join(2.0)
+    used = df[df["name"] == "hbm_used_gb"]
+    assert not used.empty
+    assert used["payload"].max() >= keep.nbytes
+    # estimate rows carry no limit, so no occupancy series
+    assert not (df["name"] == "hbm_occupancy").any()
+    del keep
