@@ -120,6 +120,27 @@ def generate_hints(features: Features, cfg) -> List[str]:
             f" {dominant}-bound time dominates — {fix} (see roofline.csv)"
         )
 
+    hidden = get("tpu0_async_hidden_pct")
+    atime = get("tpu0_async_time")
+    optime = get("tpu0_op_time")
+    if (hidden is not None and hidden < 50.0 and atime and optime
+            and atime > 0.05 * optime):
+        hints.append(
+            f"exposed DMA latency: only {hidden:.0f}% of async copy time"
+            " overlaps TensorCore compute — enable/raise prefetching"
+            " (double-buffer inputs, jax.block_until_ready placement) or"
+            " fuse small transfers"
+        )
+
+    skew = get("step_skew_mean")
+    step_mean = get("step_time_mean") or get("aisi_step_time_mean")
+    if skew is not None and step_mean and skew > 0.05 * step_mean:
+        hints.append(
+            f"straggler skew: devices start the same step {skew * 1e3:.2f} ms"
+            " apart on average — check uneven sharding, host input pipelines,"
+            " or DCN interference (see tpu_step_skew.csv)"
+        )
+
     mxu = get("mxu_util_mean")
     if mxu is not None and mxu < 30.0:
         hints.append(

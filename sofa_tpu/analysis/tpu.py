@@ -77,6 +77,87 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         features.add("tpu_module_launches", int(per_mod["count"].sum()))
 
 
+def overlap_profile(frames, cfg, features: Features) -> None:
+    """How much async data movement hides under compute, per device.
+
+    TPU DMA (Async XLA Ops, category 2) is supposed to overlap TensorCore
+    work; time where a DMA runs with no concurrent sync op is exposed
+    latency.  Emits per device:
+
+      tpu<N>_async_time         total async-op span time
+      tpu<N>_async_hidden_pct   % of that time covered by sync compute
+
+    The reference's concurrency_breakdown classifies wall-clock windows
+    (sofa_analyze.py:75-243); this is the op-level complement XPlane's
+    exact spans make possible.
+    """
+    import numpy as np
+
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    for device_id, rows in df.groupby("deviceId"):
+        sync = rows[rows["category"] == 0]
+        asyn = rows[rows["category"] == 2]
+        if sync.empty or asyn.empty:
+            continue
+        from sofa_tpu.trace import merged_intervals
+
+        marr = merged_intervals(
+            sync["timestamp"].to_numpy(float),
+            (sync["timestamp"] + sync["duration"]).to_numpy(float))
+        a0 = asyn["timestamp"].to_numpy(float)
+        a1 = (asyn["timestamp"] + asyn["duration"]).to_numpy(float)
+        total = float((a1 - a0).sum())
+        if total <= 0:
+            continue
+        # Covered length per query via prefix sums over the disjoint sorted
+        # sync intervals (one searchsorted pair per side, no per-op scan).
+        istart, iend = marr[:, 0], marr[:, 1]
+        cum = np.concatenate([[0.0], np.cumsum(iend - istart)])
+        i0 = np.searchsorted(iend, a0, side="right")
+        i1 = np.searchsorted(istart, a1, side="left")
+        full = cum[i1] - cum[i0]
+        n = len(istart)
+        clip_lo = np.clip(a0 - istart[np.minimum(i0, n - 1)], 0.0, None)
+        clip_hi = np.clip(iend[np.maximum(i1 - 1, 0)] - a1, 0.0, None)
+        cover = np.where(i0 < i1, full - clip_lo - clip_hi, 0.0)
+        hidden = float(np.maximum(cover, 0.0).sum())
+        features.add(f"tpu{device_id}_async_time", total)
+        features.add(f"tpu{device_id}_async_hidden_pct",
+                     100.0 * min(hidden / total, 1.0))
+
+
+def step_skew_profile(frames, cfg, features: Features) -> None:
+    """Straggler detection across devices from the per-device step spans.
+
+    With >1 device, step k should begin everywhere at once; the spread
+    (max-min begin over devices, per step index) is collective wait /
+    straggler skew.  Emits step_skew_mean/max features and
+    tpu_step_skew.csv.  Single-device traces are a no-op.
+    """
+    steps = frames.get("tpusteps")
+    if steps is None or steps.empty:
+        return
+    # Baseline for "how bad is the skew": mean device step duration.  Own
+    # feature (not aisi's) so the hint works in default runs where the
+    # optional aisi pass is off.
+    features.add("step_time_mean", float(steps["duration"].mean()))
+    if steps["deviceId"].nunique() < 2:
+        return
+    per = steps.groupby("event")["timestamp"].agg(["min", "max", "count"])
+    per = per[per["count"] >= 2]
+    if per.empty:
+        return
+    skew = per["max"] - per["min"]
+    out = per.reset_index().rename(columns={"event": "step"})
+    out["skew"] = skew.values
+    out[["step", "skew", "count"]].to_csv(
+        cfg.path("tpu_step_skew.csv"), index=False)
+    features.add("step_skew_mean", float(skew.mean()))
+    features.add("step_skew_max", float(skew.max()))
+
+
 def op_tree_profile(frames, cfg, features: Features) -> None:
     """Hierarchical time attribution over the JAX program structure.
 

@@ -42,6 +42,8 @@ _PASSES = [
     ("net_profile", comm.net_profile),
     ("tpu_profile", tpu.tpu_profile),
     ("op_tree_profile", tpu.op_tree_profile),
+    ("overlap_profile", tpu.overlap_profile),
+    ("step_skew_profile", tpu.step_skew_profile),
     ("roofline_profile", tpu.roofline_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
     ("tpumon_profile", tpu.tpumon_profile),

@@ -469,14 +469,9 @@ def tpu_utilization(
         t1 = float(ends.max())
         edges = np.arange(t0, t1 + window_s, window_s)
         # Merge intervals (ops can nest/overlap across fusions).
-        order = np.argsort(starts)
-        merged: List[List[float]] = []
-        for s, e in zip(starts[order], ends[order]):
-            if merged and s <= merged[-1][1]:
-                merged[-1][1] = max(merged[-1][1], e)
-            else:
-                merged.append([s, e])
-        marr = np.array(merged)
+        from sofa_tpu.trace import merged_intervals
+
+        marr = merged_intervals(starts, ends)
         flops = sync["flops"].to_numpy(dtype=float)
         nbytes = sync["bytes_accessed"].to_numpy(dtype=float)
         durs = np.maximum(ends - starts, 1e-12)

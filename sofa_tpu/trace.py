@@ -104,6 +104,24 @@ _DEFAULTS = {
 }
 
 
+def merged_intervals(starts, ends) -> np.ndarray:
+    """Union of possibly-overlapping [start, end) intervals, as an (n, 2)
+    array sorted by start.  Vectorized: running-max of ends, split where a
+    start exceeds every prior end."""
+    starts = np.asarray(starts, dtype=float)
+    ends = np.asarray(ends, dtype=float)
+    if starts.size == 0:
+        return np.empty((0, 2))
+    order = np.argsort(starts, kind="stable")
+    s, e = starts[order], ends[order]
+    emax = np.maximum.accumulate(e)
+    new = np.concatenate([[True], s[1:] > emax[:-1]])
+    idx = np.flatnonzero(new)
+    ms = s[idx]
+    me = np.concatenate([emax[idx[1:] - 1], emax[-1:]])
+    return np.stack([ms, me], axis=1)
+
+
 class CopyKind(IntEnum):
     """Data-movement taxonomy.
 

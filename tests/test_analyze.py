@@ -438,3 +438,66 @@ def test_op_tree_profile(cfg):
     leaves = table[table["depth"] == 3]
     assert len(leaves) == 2
     assert feats.get("op_tree_paths") == len(table)
+
+
+def test_overlap_profile(cfg):
+    frames = {"tputrace": make_frame([
+        # sync compute 0.0-1.0
+        {"timestamp": 0.0, "duration": 1.0, "category": 0, "deviceId": 0,
+         "name": "fusion.1"},
+        # async copy 0.5-1.5: half hidden under compute
+        {"timestamp": 0.5, "duration": 1.0, "category": 2, "deviceId": 0,
+         "name": "copy-start.1"},
+    ])}
+    feats = Features()
+    tpu.overlap_profile(frames, cfg, feats)
+    assert feats.get("tpu0_async_time") == pytest.approx(1.0)
+    assert feats.get("tpu0_async_hidden_pct") == pytest.approx(50.0)
+
+
+def test_step_skew_profile(cfg):
+    rows = []
+    for dev, delay in ((0, 0.0), (1, 0.02), (2, 0.01)):
+        for k in range(3):
+            rows.append({"timestamp": k * 1.0 + delay, "event": float(k),
+                         "duration": 0.9, "deviceId": dev,
+                         "name": f"step {k}", "device_kind": "tpu"})
+    frames = {"tpusteps": make_frame(rows)}
+    feats = Features()
+    tpu.step_skew_profile(frames, cfg, feats)
+    assert feats.get("step_skew_max") == pytest.approx(0.02)
+    assert feats.get("step_skew_mean") == pytest.approx(0.02)
+    assert feats.get("step_time_mean") == pytest.approx(0.9)
+    table = pd.read_csv(cfg.path("tpu_step_skew.csv"))
+    assert len(table) == 3
+
+
+def test_step_skew_single_device_noop(cfg):
+    frames = {"tpusteps": make_frame([
+        {"timestamp": 0.0, "event": 0.0, "duration": 1.0, "deviceId": 0,
+         "name": "step 0"}])}
+    feats = Features()
+    tpu.step_skew_profile(frames, cfg, feats)
+    assert feats.get("step_skew_max") is None
+
+
+def test_advice_overlap_and_skew_hints(cfg):
+    feats = Features()
+    feats.add("tpu0_async_hidden_pct", 20.0)
+    feats.add("tpu0_async_time", 1.0)
+    feats.add("tpu0_op_time", 2.0)
+    feats.add("step_skew_mean", 0.01)
+    feats.add("aisi_step_time_mean", 0.1)
+    hints = advice.generate_hints(feats, cfg)
+    assert any("exposed DMA latency" in h for h in hints)
+    assert any("straggler skew" in h for h in hints)
+
+    # well-overlapped + tight skew -> neither hint
+    feats2 = Features()
+    feats2.add("tpu0_async_hidden_pct", 95.0)
+    feats2.add("tpu0_async_time", 1.0)
+    feats2.add("tpu0_op_time", 2.0)
+    feats2.add("step_skew_mean", 0.001)
+    feats2.add("aisi_step_time_mean", 0.1)
+    hints2 = advice.generate_hints(feats2, cfg)
+    assert not any("exposed DMA" in h or "straggler" in h for h in hints2)
