@@ -287,7 +287,8 @@ def xspace_to_frames(
         "bandwidth", "name", "category", "hlo_category", "module", "flops",
         "bytes_accessed", "groups", "phase", "source", "op_path")}
     module_rows: List[dict] = []
-    host_rows: List[dict] = []
+    host_cols: Dict[str, list] = {k: [] for k in (
+        "timestamp", "event", "duration", "tid", "name", "module")}
     step_rows: List[dict] = []
     meta: Dict[str, Dict[str, float]] = {}
 
@@ -413,30 +414,35 @@ def xspace_to_frames(
             # y-value = thread lane ordinal: events of one thread share a
             # lane, like the reference's per-metric lanes (round-1 verdict
             # flagged the old len(name)%97 hash as meaningless).
+            em = plane.event_metadata
+            sm = plane.stat_metadata
             for lane, line in enumerate(plane.lines):
                 thread_name = line.name or str(line.id)
-                for name, disp, start_ns, dur_ns, stats in _iter_line_events(plane, line):
+                base_ns = line.timestamp_ns
+                tid = int(line.id)
+                cache: Dict[int, tuple] = {}
+                for ev in line.events:
+                    name, disp, _md = _resolve_event_meta(
+                        em, sm, ev.metadata_id, cache)
                     if _MARKER_RE.search(name):
                         continue
-                    host_rows.append(
-                        {
-                            "timestamp": to_rel_s(start_ns),
-                            "event": float(lane),
-                            "duration": dur_ns / 1e9,
-                            "pid": -1,
-                            "tid": int(line.id),
-                            "name": disp,
-                            "device_kind": "host",
-                            "module": thread_name,
-                        }
-                    )
+                    host_cols["timestamp"].append(
+                        to_rel_s(base_ns + ev.offset_ps // 1000))
+                    host_cols["event"].append(float(lane))
+                    host_cols["duration"].append(ev.duration_ps / 1e12)
+                    host_cols["tid"].append(tid)
+                    host_cols["name"].append(disp)
+                    host_cols["module"].append(thread_name)
 
     n_ops = len(op_cols["timestamp"])
     op_cols["device_kind"] = ["tpu"] * n_ops
+    n_host = len(host_cols["timestamp"])
+    host_cols["device_kind"] = ["host"] * n_host
+    host_cols["pid"] = [-1] * n_host
     frames = {
         "tputrace": make_frame(op_cols) if n_ops else empty_frame(),
         "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),
-        "hosttrace": make_frame(host_rows) if host_rows else empty_frame(),
+        "hosttrace": make_frame(host_cols) if n_host else empty_frame(),
         "tpusteps": make_frame(step_rows) if step_rows else empty_frame(),
     }
     frames["_meta"] = meta  # type: ignore[assignment]
