@@ -54,17 +54,16 @@ _PASSES = [
 
 
 def load_frames(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
+    from sofa_tpu.trace import read_frame
+
     frames: Dict[str, pd.DataFrame] = {}
     for name in CSV_SOURCES:
-        path = cfg.path(f"{name}.csv")
-        if os.path.isfile(path):
-            try:
-                frames[name] = read_csv(path)
-            except Exception as e:  # noqa: BLE001
-                print_warning(f"analyze: cannot read {path}: {e}")
-                frames[name] = empty_frame()
-        else:
-            frames[name] = empty_frame()
+        try:
+            df = read_frame(cfg.path(name))  # .parquet preferred, else .csv
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"analyze: cannot read {cfg.path(name)}: {e}")
+            df = empty_frame()
+        frames[name] = df if df is not None else empty_frame()
     return frames
 
 

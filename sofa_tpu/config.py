@@ -99,6 +99,7 @@ class SofaConfig:
     # --- preprocess --------------------------------------------------------
     cpu_time_offset_ms: int = 0      # manual host-clock fudge (bin/sofa:111)
     viz_downsample_to: int = 10000   # max points per _viz series
+    trace_format: str = "csv"        # csv | parquet (columnar, for big traces)
     network_filters: List[str] = field(default_factory=list)
 
     # --- analyze -----------------------------------------------------------

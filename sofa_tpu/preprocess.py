@@ -22,7 +22,8 @@ from sofa_tpu.ingest.strace_parse import parse_pystacks, parse_strace
 from sofa_tpu.ingest.timebase_align import converter
 from sofa_tpu.ingest.xplane import ingest_xprof_dir
 from sofa_tpu.printing import print_progress, print_warning
-from sofa_tpu.trace import SofaSeries, empty_frame, write_csv
+from sofa_tpu.trace import (SofaSeries, downsample, empty_frame, write_csv,
+                            write_frame)
 
 # Distinct default colors for the master timeline (CSS color names the board
 # understands; reference picks similar fixed palette per series).
@@ -133,12 +134,27 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil", "tpusteps"):
         frames.setdefault(key, empty_frame())
 
-    # --- write CSVs -------------------------------------------------------
+    # --- write frames -----------------------------------------------------
+    trace_format = cfg.trace_format
+    if trace_format == "parquet":
+        try:
+            import pyarrow  # noqa: F401 — pandas' default parquet engine
+        except ImportError:
+            print_warning("trace_format=parquet needs pyarrow (pip install "
+                          "'sofa-tpu[parquet]'); falling back to csv")
+            trace_format = "csv"
     n_csv = 0
     for name, df in frames.items():
         if name == "cpuinfo":
             continue  # internal helper series
-        write_csv(df, cfg.path(f"{name}.csv"))
+        write_frame(df, cfg.path(name), trace_format)
+        if trace_format == "parquet":
+            # The board's detail pages fetch <name>.csv; keep a downsampled
+            # viz copy beside the full-fidelity parquet (analyze prefers
+            # the parquet — trace.read_frame).  write_csv directly: the
+            # csv mode of write_frame would unlink the parquet just written.
+            write_csv(downsample(df, cfg.viz_downsample_to),
+                      cfg.path(f"{name}.csv"))
         n_csv += 1
 
     # --- assemble the timeline series -> report.js ------------------------

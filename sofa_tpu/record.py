@@ -46,7 +46,8 @@ RAW_FILES = [
 ]
 
 # Derived files (removed by `sofa clean`).
-DERIVED_SUFFIXES = (".csv", ".js", ".html", ".json.gz", ".pdf", ".png")
+DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".json.gz", ".pdf",
+                    ".png")
 DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt"]
 DERIVED_DIRS = ["board"]
 

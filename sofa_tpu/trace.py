@@ -204,8 +204,7 @@ def write_csv(df: pd.DataFrame, path: str) -> None:
     df.to_csv(path, index=False)
 
 
-def read_csv(path: str) -> pd.DataFrame:
-    df = pd.read_csv(path)
+def _conform(df: pd.DataFrame) -> pd.DataFrame:
     for col in COLUMNS:
         if col not in df.columns:
             df[col] = _DEFAULTS[col]
@@ -213,6 +212,45 @@ def read_csv(path: str) -> pd.DataFrame:
         if isinstance(default, str) and col in df.columns:
             df[col] = df[col].fillna("").astype(str)
     return df[COLUMNS]
+
+
+def read_csv(path: str) -> pd.DataFrame:
+    return _conform(pd.read_csv(path))
+
+
+def write_frame(df: pd.DataFrame, base_path: str, fmt: str = "csv") -> str:
+    """Write a unified-schema frame as <base_path>.<fmt>; returns the path.
+
+    Parquet keeps big HLO-op traces columnar and ~5-10x smaller than CSV
+    (the reference's CSV-everywhere contract does not survive pod-scale
+    traces — SURVEY §7 "trace volume").
+    """
+    import os
+
+    if fmt == "parquet":
+        path = base_path + ".parquet"
+        df.to_parquet(path, index=False)
+    else:
+        path = base_path + ".csv"
+        write_csv(df, path)
+        # read_frame prefers .parquet; a stale one from an earlier
+        # parquet-mode run must not shadow this fresh csv.
+        try:
+            os.unlink(base_path + ".parquet")
+        except OSError:
+            pass
+    return path
+
+
+def read_frame(base_path: str) -> Optional[pd.DataFrame]:
+    """Read <base_path>.parquet if present, else <base_path>.csv, else None."""
+    import os
+
+    if os.path.isfile(base_path + ".parquet"):
+        return _conform(pd.read_parquet(base_path + ".parquet"))
+    if os.path.isfile(base_path + ".csv"):
+        return read_csv(base_path + ".csv")
+    return None
 
 
 def downsample(df: pd.DataFrame, max_points: int) -> pd.DataFrame:

@@ -37,3 +37,32 @@ def test_preprocess_empty_logdir(tmp_path):
     frames = sofa_preprocess(cfg)
     assert all(df.empty for df in frames.values())
     assert os.path.isfile(cfg.path("report.js"))
+
+
+def test_parquet_trace_format(logdir):
+    """--trace_format parquet drives preprocess itself: full-fidelity
+    parquet + downsampled viz CSV sibling; analyze prefers the parquet;
+    a later csv-mode run unlinks the stale parquet."""
+    import pandas as pd
+
+    from sofa_tpu.analyze import load_frames
+    from sofa_tpu.trace import read_frame
+
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=200,
+                     trace_format="parquet", viz_downsample_to=5)
+    sofa_record("sleep 0.3", cfg)
+    sofa_preprocess(cfg)
+
+    assert os.path.isfile(cfg.path("mpstat.parquet"))
+    assert os.path.isfile(cfg.path("mpstat.csv"))
+    full = read_frame(cfg.path("mpstat"))       # parquet preferred
+    viz = pd.read_csv(cfg.path("mpstat.csv"))
+    assert len(viz) <= 5 < len(full)
+    loaded = load_frames(cfg)["mpstat"]
+    assert len(loaded) == len(full)
+
+    # Switching back to csv mode must not leave stale parquet shadowing it.
+    cfg.trace_format = "csv"
+    sofa_preprocess(cfg)
+    assert not os.path.isfile(cfg.path("mpstat.parquet"))
+    assert len(load_frames(cfg)["mpstat"]) == len(full)
