@@ -501,3 +501,21 @@ def test_advice_overlap_and_skew_hints(cfg):
     feats2.add("aisi_step_time_mean", 0.1)
     hints2 = advice.generate_hints(feats2, cfg)
     assert not any("exposed DMA" in h or "straggler" in h for h in hints2)
+
+
+def test_board_pages_staged_and_linked(cfg):
+    """Every board page is staged into the logdir and the nav on each page
+    links every other page (a new page must be added to all navs)."""
+    import re
+
+    from sofa_tpu.analyze import stage_board
+
+    stage_board(cfg)
+    pages = ["index.html", "tpu-report.html", "op-tree.html",
+             "cpu-report.html", "comm-report.html", "disk.html",
+             "net.html", "run-report.html"]
+    for page in pages:
+        assert os.path.isfile(cfg.path(page)), page
+        html = open(cfg.path(page)).read()
+        linked = set(re.findall(r'href="([\w.-]+\.html)"', html))
+        assert set(pages) <= linked, (page, set(pages) - linked)
