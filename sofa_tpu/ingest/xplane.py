@@ -521,10 +521,29 @@ def tpu_utilization(
     return make_frame(rows)
 
 
+def _ingest_one(args) -> Tuple[Dict[str, pd.DataFrame], Dict]:
+    """(path, host_index, time_base) -> (frames, meta); module-level so a
+    process pool can pickle it."""
+    path, host_index, time_base = args
+    host = os.path.basename(path).replace(".xplane.pb", "")
+    xspace = load_xspace(path)
+    frames = xspace_to_frames(
+        xspace, time_base, host=host, device_id_base=host_index * 256
+    )
+    meta = frames.pop("_meta", {})
+    return frames, meta
+
+
 def ingest_xprof_dir(
     xprof_dir: str, time_base: float, window_s: float = 0.1
 ) -> Dict[str, pd.DataFrame]:
-    """Ingest every XSpace under an xprof dir, concatenating multi-host files."""
+    """Ingest every XSpace under an xprof dir, concatenating multi-host files.
+
+    Multi-host logdirs (one .xplane.pb per host on a pod) parse in a
+    process pool — proto decode + frame building is CPU-bound Python, so
+    this is the mp.Pool.map the reference used for its per-GPU nvvp files
+    (sofa_preprocess.py:1343-1456).  Single files stay in-process.
+    """
     paths = find_xplane_files(xprof_dir)
     if not paths:
         return {}
@@ -532,18 +551,48 @@ def ingest_xprof_dir(
         "tputrace": [], "tpumodules": [], "hosttrace": [], "tpusteps": []
     }
     meta: Dict[str, Dict[str, float]] = {}
-    for host_index, path in enumerate(paths):
-        host = os.path.basename(path).replace(".xplane.pb", "")
-        print_info(f"xplane: ingesting {path}")
+    jobs = [(p, i, time_base) for i, p in enumerate(paths)]
+    results: List = []
+    if len(jobs) > 1:
         try:
-            xspace = load_xspace(path)
-        except Exception as e:  # noqa: BLE001 — a corrupt trace must not kill the report
-            print_warning(f"xplane: cannot parse {path}: {e}")
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            # Never fork: the caller may hold sampler/collector threads and
+            # a forked child of a threaded process can deadlock.
+            methods = mp.get_all_start_methods()
+            ctx = mp.get_context(
+                "forkserver" if "forkserver" in methods else "spawn")
+            print_info(f"xplane: ingesting {len(jobs)} host files in "
+                       f"parallel")
+            with ProcessPoolExecutor(max_workers=min(len(jobs), 8),
+                                     mp_context=ctx) as ex:
+                futures = [ex.submit(_ingest_one, job) for job in jobs]
+                for job, fut in zip(jobs, futures):
+                    try:
+                        results.append(fut.result())
+                        print_info(f"xplane: ingested {job[0]}")
+                    except Exception as e:  # noqa: BLE001 — one corrupt trace must not kill the rest
+                        print_warning(f"xplane: cannot parse {job[0]}: {e}")
+                        results.append(None)
+        except (ImportError, OSError, ValueError) as e:
+            # Pool creation itself failed (sandboxed /dev/shm, no spawn).
+            print_warning(f"xplane: parallel ingest unavailable ({e}); "
+                          "falling back to serial")
+            results = []
+    if not results:
+        for job in jobs:
+            print_info(f"xplane: ingesting {job[0]}")
+            try:
+                results.append(_ingest_one(job))
+            except Exception as e:  # noqa: BLE001 — a corrupt trace must not kill the report
+                print_warning(f"xplane: cannot parse {job[0]}: {e}")
+                results.append(None)
+    for res in results:
+        if res is None:
             continue
-        frames = xspace_to_frames(
-            xspace, time_base, host=host, device_id_base=host_index * 256
-        )
-        meta.update(frames.pop("_meta", {}))  # type: ignore[arg-type]
+        frames, m = res
+        meta.update(m)
         for key, df in frames.items():
             if not df.empty:
                 all_frames[key].append(df)

@@ -118,3 +118,40 @@ def test_real_capture_drives_marker_iterations(xspace):
     begins, ends = out
     assert len(begins) == 3
     assert all(e > b for b, e in zip(begins, ends))
+
+
+def test_multihost_parallel_ingest(tmp_path, capsys):
+    """N per-host .xplane.pb files ingest through the process pool with
+    per-host deviceId offsets; a corrupt file degrades without killing the
+    pool's completed work."""
+    import shutil
+    import time
+
+    from sofa_tpu.ingest.xplane import ingest_xprof_dir
+
+    prof = tmp_path / "xprof" / "plugins" / "profile" / "run1"
+    prof.mkdir(parents=True)
+    for host in ("hostA", "hostB", "hostC"):
+        shutil.copy(TPU_FIXTURE, prof / f"{host}.xplane.pb")
+    (prof / "hostD.xplane.pb").write_bytes(b"\xff\xfe not a proto" * 100)
+
+    import sofa_tpu.printing as printing
+    old_verbose = printing.verbose
+    printing.verbose = True
+    try:
+        frames = ingest_xprof_dir(str(tmp_path / "xprof"), time.time() - 5)
+    finally:
+        printing.verbose = old_verbose
+    cap = capsys.readouterr()
+    out = cap.out + cap.err
+    # the pool path actually ran (a regression falling back to serial
+    # would silently lose parallelism on every pod-scale report)
+    assert "in parallel" in out
+    assert "parallel ingest unavailable" not in out
+    assert "cannot parse" in out            # the corrupt host degraded alone
+    ops = frames["tputrace"]
+    # three good hosts' chips stay distinct: ordinals 0, 256, 512
+    assert sorted(ops["deviceId"].unique()) == [0, 256, 512]
+    one_host = ops[ops["deviceId"] == 0]
+    assert len(ops) == 3 * len(one_host)
+    assert "512" in frames["_meta"] and "0" in frames["_meta"]
