@@ -35,8 +35,15 @@ def load_topology(cfg) -> Optional[dict]:
 
 
 def comm_profile(frames, cfg, features: Features) -> None:
+    from sofa_tpu.trace import roi_clip
+
     df = frames.get("tputrace")
     if df is None or df.empty:
+        return
+    # Same ROI window as tpu_profile, so comm_ratio's numerator and
+    # denominator come from one clock interval.
+    df = roi_clip(df, cfg)
+    if df.empty:
         return
     # Collectives live on the sync "XLA Ops" line (category 0); H2D/D2H/D2D
     # transfer spans live on the async DMA line (category 2), with stub

@@ -144,7 +144,6 @@ def pystacks_profile(frames, cfg, features: Features) -> None:
 
 
 def _roi(df: pd.DataFrame, cfg) -> pd.DataFrame:
-    """Clip a frame to the region of interest when one is set."""
-    if cfg.roi_end > cfg.roi_begin > 0 or (cfg.roi_begin == 0 and cfg.roi_end > 0):
-        return df[(df["timestamp"] >= cfg.roi_begin) & (df["timestamp"] <= cfg.roi_end)]
-    return df
+    from sofa_tpu.trace import roi_clip
+
+    return roi_clip(df, cfg)

@@ -12,12 +12,17 @@ import pandas as pd
 
 from sofa_tpu.analysis.features import Features
 from sofa_tpu.printing import print_hint, print_title, print_warning
-from sofa_tpu.trace import CopyKind
+from sofa_tpu.trace import CopyKind, roi_clip
 
 
 def tpu_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty:
+        return
+    # Spotlight/manual ROI clips warmup+teardown like the reference's
+    # profile_region did for its GPU profile (bin/sofa:302-309).
+    df = roi_clip(df, cfg)
+    if df.empty:
         return
     sync = df[df["category"] == 0]
     features.add("tpu_devices", df["deviceId"].nunique())
@@ -72,6 +77,8 @@ def tpu_profile(frames, cfg, features: Features) -> None:
     # Per-module (jit function) totals.
     mods = frames.get("tpumodules")
     if mods is not None and not mods.empty:
+        mods = roi_clip(mods, cfg)
+    if mods is not None and not mods.empty:
         per_mod = mods.groupby("name")["duration"].agg(["sum", "count"])
         per_mod.to_csv(cfg.path("tpu_modules_summary.csv"))
         features.add("tpu_module_launches", int(per_mod["count"].sum()))
@@ -96,6 +103,7 @@ def overlap_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty:
         return
+    df = roi_clip(df, cfg)
     for device_id, rows in df.groupby("deviceId"):
         sync = rows[rows["category"] == 0]
         asyn = rows[rows["category"] == 2]
@@ -172,6 +180,7 @@ def op_tree_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty or "op_path" not in df.columns:
         return
+    df = roi_clip(df, cfg)
     sync = df[(df["category"] == 0) & (df["op_path"] != "")]
     if sync.empty:
         return
@@ -228,6 +237,7 @@ def roofline_profile(frames, cfg, features: Features) -> None:
     with open(meta_path) as f:
         meta = json.load(f)
 
+    df = roi_clip(df, cfg)
     rows = df[(df["category"] == 0)
               & (df["copyKind"] == int(CopyKind.KERNEL))
               & (df["duration"] > 0)

@@ -104,6 +104,21 @@ _DEFAULTS = {
 }
 
 
+def roi_clip(df: pd.DataFrame, cfg) -> pd.DataFrame:
+    """Clip a frame to the region of interest when one is set.
+
+    Selection is by *overlap*, not start time: a long op straddling the
+    ROI boundary still contributes (un-prorated) — dropping it would
+    undercount kernel time and misreport DMA overlap inside the window.
+    """
+    begin, end = cfg.roi_begin, cfg.roi_end
+    if end > begin > 0 or (begin == 0 and end > 0):
+        starts = df["timestamp"]
+        ends = starts + df["duration"]
+        return df[(starts <= end) & (ends >= begin)]
+    return df
+
+
 def merged_intervals(starts, ends) -> np.ndarray:
     """Union of possibly-overlapping [start, end) intervals, as an (n, 2)
     array sorted by start.  Vectorized: running-max of ends, split where a

@@ -519,3 +519,14 @@ def test_board_pages_staged_and_linked(cfg):
         html = open(cfg.path(page)).read()
         linked = set(re.findall(r'href="([\w.-]+\.html)"', html))
         assert set(pages) <= linked, (page, set(pages) - linked)
+
+
+def test_tpu_profile_respects_roi(cfg):
+    frames = {"tputrace": tpu_frame()}
+    cfg.roi_begin, cfg.roi_end = 0.0, 0.05   # first half of the 0.1s trace
+    f = Features()
+    tpu.tpu_profile(frames, cfg, f)
+    full = Features()
+    cfg2 = SofaConfig(logdir=cfg.logdir)
+    tpu.tpu_profile(frames, cfg2, full)
+    assert f.get("tpu0_kernel_time") < full.get("tpu0_kernel_time")
