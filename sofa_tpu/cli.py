@@ -241,7 +241,7 @@ def main(argv=None) -> int:
                 return 2
             import copy
             from sofa_tpu.analysis.features import Features
-            from sofa_tpu.ml.diff import sofa_swarm_diff
+            from sofa_tpu.ml.diff import sofa_swarm_diff, sofa_tpu_diff
             from sofa_tpu.ml.hsg import sofa_hsg
             from sofa_tpu.preprocess import sofa_preprocess
             print_main_progress("SOFA diff")
@@ -252,6 +252,7 @@ def main(argv=None) -> int:
                 frames = sofa_preprocess(c)
                 sofa_hsg(frames, c, Features())  # writes auto_caption.csv
             sofa_swarm_diff(cfg)
+            sofa_tpu_diff(cfg)
             return 0
         if cmd == "viz":
             from sofa_tpu.viz import sofa_viz

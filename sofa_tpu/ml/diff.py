@@ -52,6 +52,59 @@ def match_swarms(base: Dict[int, dict], match: Dict[int, dict]) -> Dict[int, Opt
     return out
 
 
+def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
+    """Run-to-run HLO-op diff — the TPU-side complement to the swarm diff.
+
+    The reference could only diff CPU swarms (its GPU table had no
+    cross-run matching); HLO op names are stable across runs of the same
+    program, so an exact name join gives per-op time deltas directly.
+    Reads both runs' tputrace frames, writes tpu_diff.csv sorted by
+    |delta|, and flags ops whose time moved more than 20 %.
+    """
+    import numpy as np
+
+    from sofa_tpu.trace import read_frame, roi_clip
+
+    base = read_frame(os.path.join(cfg.base_logdir, "tputrace"))
+    match = read_frame(os.path.join(cfg.match_logdir, "tputrace"))
+    if base is None or match is None or base.empty or match.empty:
+        print_warning("diff: no tputrace in one of the runs — skipping "
+                      "TPU op diff")
+        return None
+
+    def per_op(df):
+        sync = roi_clip(df, cfg)        # same window as every other pass
+        sync = sync[sync["category"] == 0]
+        return sync.groupby("name").agg(
+            time=("duration", "sum"), count=("duration", "count"))
+
+    joined = per_op(base).join(per_op(match), how="outer",
+                               lsuffix="_base", rsuffix="_match").fillna(0.0)
+    joined["delta"] = joined["time_match"] - joined["time_base"]
+    # New ops (no base time) get ratio=inf so the >20% mover filter —
+    # and the reader — can't miss a regression that only exists in match.
+    joined["ratio"] = np.where(
+        joined["time_base"] > 0,
+        joined["time_match"] / joined["time_base"].replace(0, np.nan),
+        np.inf)
+    table = joined.reindex(
+        joined["delta"].abs().sort_values(ascending=False).index
+    ).reset_index()
+    out_path = os.path.join(cfg.logdir, "tpu_diff.csv")
+    os.makedirs(cfg.logdir, exist_ok=True)
+    table.to_csv(out_path, index=False)
+
+    tb, tm = float(joined["time_base"].sum()), float(joined["time_match"].sum())
+    print_title("TPU op diff (base vs match)")
+    print(table.head(15).to_string(index=False))
+    moved = table[(table["ratio"] > 1.2) | (table["ratio"] < 1 / 1.2)]
+    print_progress(
+        f"diff: device time {tb:.4f}s -> {tm:.4f}s "
+        f"({(tm / tb - 1) * 100 if tb else 0:+.1f}%); "
+        f"{len(moved)} ops moved >20%; wrote {out_path}")
+    return table
+
+
 def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
     base_path = os.path.join(cfg.base_logdir, "auto_caption.csv")
     match_path = os.path.join(cfg.match_logdir, "auto_caption.csv")

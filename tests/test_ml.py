@@ -245,3 +245,42 @@ def test_hint_service_round_trip():
         assert any("communication-bound" in h for h in hints)
     finally:
         server.stop(None)
+
+
+def test_sofa_tpu_diff(tmp_path):
+    """HLO op-name join across two runs: deltas, ratios, and new/vanished
+    ops surviving with zero on the missing side."""
+    import pandas as pd
+
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.ml.diff import sofa_tpu_diff
+    from sofa_tpu.trace import make_frame, write_csv
+
+    def run_dir(name, ops):
+        d = tmp_path / name
+        d.mkdir()
+        rows = [{"timestamp": i * 0.01, "duration": dur, "category": 0,
+                 "deviceId": 0, "name": op, "device_kind": "tpu"}
+                for i, (op, dur) in enumerate(ops)]
+        write_csv(make_frame(rows), str(d / "tputrace.csv"))
+        return str(d) + "/"
+
+    base = run_dir("base", [("fusion.1", 0.010), ("dot.2", 0.005),
+                            ("gone.3", 0.002)])
+    match = run_dir("match", [("fusion.1", 0.020), ("dot.2", 0.005),
+                              ("new.4", 0.001)])
+    out = tmp_path / "out"
+    cfg = SofaConfig(logdir=str(out) + "/", base_logdir=base,
+                     match_logdir=match)
+    table = sofa_tpu_diff(cfg)
+    byname = table.set_index("name")
+    assert byname.loc["fusion.1", "delta"] == pytest.approx(0.010)
+    assert byname.loc["fusion.1", "ratio"] == pytest.approx(2.0)
+    assert byname.loc["gone.3", "time_match"] == 0.0
+    assert byname.loc["gone.3", "ratio"] == 0.0
+    assert byname.loc["new.4", "time_base"] == 0.0
+    import numpy as np
+    assert np.isinf(byname.loc["new.4", "ratio"])
+    # biggest mover first
+    assert table.iloc[0]["name"] == "fusion.1"
+    assert (out / "tpu_diff.csv").is_file()
