@@ -9,7 +9,9 @@ so these were last verified on the pre-streaming kernel):
   3. long-context: T=16384 forward (the old full-KV kernel OOM'd VMEM here)
   4. fwd/bwd timing vs the unfused path (expect ~10-30 % wins)
   5. entry() compile check with the fused path active
-  6. optionally captures a real device-plane XPlane fixture
+  6. profiled train loop end-to-end: device Steps spans, fw/bw phase
+     attribution, op_path provenance, live tpumon HBM series
+  7. optionally captures a real device-plane XPlane fixture
      (--capture-fixture) trimmed into tests/fixtures/
 
 Exits non-zero on any failure; prints one PASS/FAIL line per check.
@@ -136,6 +138,55 @@ def entry_compiles_fused():
     return f"out {out.shape}"
 
 
+@check("trace_pipeline_train")
+def trace_pipeline_train():
+    """One profiled train loop must yield: device Steps spans, fw/bw phase
+    attribution, op_path provenance, and a live tpumon HBM series —
+    everything round 2 added on top of the raw op trace."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+
+    import sofa_tpu.api as sofa
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
+    from sofa_tpu.ingest.xplane import ingest_xprof_dir
+    from sofa_tpu.workloads.common import step_annotation
+    from sofa_tpu.workloads.transformer import TransformerConfig, build
+
+    cfg = TransformerConfig.tiny(seq=128)
+    params, opt, step, tokens = build(cfg, None, batch=4, seq=128)
+    params, opt, loss = step(params, opt, tokens)
+    jax.block_until_ready(loss)
+
+    logdir = tempfile.mkdtemp(prefix="sofa_val_train_") + "/"
+    try:
+        # profile() runs the built-in tpumon sampler; 20 Hz so even this
+        # sub-second loop collects several HBM samples.
+        with sofa.profile(logdir, cfg=SofaConfig(logdir=logdir,
+                                                 tpu_mon_rate=20)):
+            for i in range(5):
+                with step_annotation(i):
+                    params, opt, loss = step(params, opt, tokens)
+            jax.block_until_ready(loss)
+        frames = ingest_xprof_dir(logdir + "xprof/", time.time())
+        ops = frames["tputrace"]
+        sync = ops[ops["category"] == 0]
+        assert len(frames["tpusteps"]) >= 5, "no device Steps spans"
+        fw = (sync["phase"] == "fw").sum()
+        bw = (sync["phase"] == "bw").sum()
+        assert fw > 0 and bw > 0, f"phase split missing (fw={fw} bw={bw})"
+        assert (sync["op_path"] != "").mean() > 0.3, "op_path mostly empty"
+        mon = ingest_tpumon(logdir, time.time() - 30)
+        assert (mon["name"] == "hbm_used_gb").any(), "no live HBM series"
+        return (f"steps={len(frames['tpusteps'])} fw={fw} bw={bw} "
+                f"hbm_pts={(mon['name'] == 'hbm_used_gb').sum()}")
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
 @check("capture_fixture")
 def capture_fixture():
     import glob
@@ -198,6 +249,7 @@ def main() -> int:
     long_context_16k()
     fwd_bwd_vs_unfused()
     entry_compiles_fused()
+    trace_pipeline_train()
     if args.capture_fixture:
         capture_fixture()
 
