@@ -99,12 +99,20 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: Optional[bool],
+    static_causal: bool = False,
 ):
     """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T]).
 
     ``shift`` is the (possibly traced) causal offset: key j visible to query
     i iff j <= i + shift.  0 = aligned causal, >= T = full attention,
     <= -T = fully masked (out 0, lse ~ NEG_INF).
+
+    ``static_causal`` promises shift <= 0 at trace time.  Then no k-block
+    past the q-block's diagonal can ever contribute, so the K/V index maps
+    clamp to the diagonal: skipped iterations re-request the previous
+    block, and the Pallas pipeline elides the copy — the upper-triangle
+    half of K/V HBM traffic disappears.  Must stay False for ring hops,
+    whose traced shift can be positive.
     """
     b, t, h, d = q.shape
     tk = k.shape[1]
@@ -128,14 +136,21 @@ def _flash_forward(
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         scale=scale)
+    if static_causal:
+        def kv_index(bh, iq, ik):
+            last = (iq * block_q + block_q - 1) // block_k
+            return (bh, jnp.minimum(ik, last), 0)
+    else:
+        def kv_index(bh, iq, ik):
+            return (bh, ik, 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, num_k),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -170,7 +185,8 @@ def flash_attention(
     """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
     before calling, as the transformer workload already does)."""
     shift = 0 if causal else k.shape[1]
-    return _flash_forward(q, k, v, shift, block_q, block_k, interpret)[0]
+    return _flash_forward(q, k, v, shift, block_q, block_k, interpret,
+                          static_causal=causal)[0]
 
 
 def supports(t: int, block: int = 128) -> bool:
@@ -194,12 +210,12 @@ def flash_causal_attention(q, k, v):
     [T, T] score matrix never materializes in either direction and XLA
     still fuses everything onto the MXU.
     """
-    out, _ = _flash_forward(q, k, v, 0, 128, 128, None)
+    out, _ = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
     return out
 
 
 def _fwd(q, k, v):
-    out, lse = _flash_forward(q, k, v, 0, 128, 128, None)
+    out, lse = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
     return out, (q, k, v, out, lse)
 
 

@@ -172,8 +172,11 @@ def trace_pipeline_train():
                     params, opt, loss = step(params, opt, tokens)
             jax.block_until_ready(loss)
         frames = ingest_xprof_dir(logdir + "xprof/", time.time())
+        assert frames, "no xplane files captured (profiler failed to flush?)"
         ops = frames["tputrace"]
         sync = ops[ops["category"] == 0]
+        # This libtpu emits device Steps spans for annotated loops (verified
+        # on the real chip 2026-07-30); their absence is a regression.
         assert len(frames["tpusteps"]) >= 5, "no device Steps spans"
         fw = (sync["phase"] == "fw").sum()
         bw = (sync["phase"] == "bw").sum()
