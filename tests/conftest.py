@@ -33,6 +33,56 @@ if _ROOT not in sys.path:
 
 import pytest  # noqa: E402
 
+# Shared synthetic-XSpace builders (used by test_ingest_xplane and
+# test_multichip_report): stat-metadata interning + oneof dispatch must
+# match how the real profiler writes protos, in exactly one place.
+MARKER_UNIX_NS = 1_700_000_000_000_000_000
+
+
+def add_stat(plane, holder, name, value):
+    sid = None
+    for k, v in plane.stat_metadata.items():
+        if v.name == name:
+            sid = k
+    if sid is None:
+        sid = len(plane.stat_metadata) + 1
+        plane.stat_metadata[sid].id = sid
+        plane.stat_metadata[sid].name = name
+    stat = holder.stats.add()
+    stat.metadata_id = sid
+    if isinstance(value, float):
+        stat.double_value = value
+    elif isinstance(value, int):
+        stat.int64_value = value
+    else:
+        stat.str_value = str(value)
+    return stat
+
+
+def add_event(plane, line, name, offset_ns, dur_ns, display="", stats=(),
+              mstats=()):
+    """Append an event; ``stats`` go on the event, ``mstats`` on its
+    metadata (where real libtpu puts flops/categories/tf_op)."""
+    mid = None
+    for k, v in plane.event_metadata.items():
+        if v.name == name:
+            mid = k
+    if mid is None:
+        mid = len(plane.event_metadata) + 1
+        plane.event_metadata[mid].id = mid
+        plane.event_metadata[mid].name = name
+        if display:
+            plane.event_metadata[mid].display_name = display
+        for sname, sval in mstats:
+            add_stat(plane, plane.event_metadata[mid], sname, sval)
+    ev = line.events.add()
+    ev.metadata_id = mid
+    ev.offset_ps = offset_ns * 1000
+    ev.duration_ps = dur_ns * 1000
+    for sname, sval in stats:
+        add_stat(plane, ev, sname, sval)
+    return ev
+
 
 @pytest.fixture
 def logdir(tmp_path):

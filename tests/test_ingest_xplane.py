@@ -11,47 +11,9 @@ from sofa_tpu.ingest.xplane import (
 from sofa_tpu.trace import CopyKind
 
 
-def _add_stat(plane, holder, name, value):
-    sid = None
-    for k, v in plane.stat_metadata.items():
-        if v.name == name:
-            sid = k
-    if sid is None:
-        sid = len(plane.stat_metadata) + 1
-        plane.stat_metadata[sid].id = sid
-        plane.stat_metadata[sid].name = name
-    stat = holder.stats.add()
-    stat.metadata_id = sid
-    if isinstance(value, float):
-        stat.double_value = value
-    elif isinstance(value, int):
-        stat.int64_value = value
-    else:
-        stat.str_value = str(value)
-    return stat
+from conftest import MARKER_UNIX_NS, add_event as _add_event, \
+    add_stat as _add_stat
 
-
-def _add_event(plane, line, name, offset_ns, dur_ns, display="", stats=()):
-    mid = None
-    for k, v in plane.event_metadata.items():
-        if v.name == name:
-            mid = k
-    if mid is None:
-        mid = len(plane.event_metadata) + 1
-        plane.event_metadata[mid].id = mid
-        plane.event_metadata[mid].name = name
-        if display:
-            plane.event_metadata[mid].display_name = display
-    ev = line.events.add()
-    ev.metadata_id = mid
-    ev.offset_ps = offset_ns * 1000
-    ev.duration_ps = dur_ns * 1000
-    for sname, sval in stats:
-        _add_stat(plane, ev, sname, sval)
-    return ev
-
-
-MARKER_UNIX_NS = 1_700_000_000_000_000_000
 SESSION_MARKER_NS = 1_000_000  # marker occurs 1 ms into the session
 
 
