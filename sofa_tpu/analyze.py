@@ -26,7 +26,7 @@ from sofa_tpu.trace import empty_frame, read_csv
 CSV_SOURCES = [
     "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
     "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
-    "tpumon", "tpusteps", "blktrace",
+    "tpumon", "tpusteps", "customtrace", "blktrace",
 ]
 
 _PASSES = [

@@ -290,6 +290,7 @@ def xspace_to_frames(
     host_cols: Dict[str, list] = {k: [] for k in (
         "timestamp", "event", "duration", "tid", "name", "module")}
     step_rows: List[dict] = []
+    custom_rows: List[dict] = []
     meta: Dict[str, Dict[str, float]] = {}
 
     for plane in xspace.planes:
@@ -410,6 +411,32 @@ def xspace_to_frames(
                     for j, ok in zip(i, valid))
             else:
                 op_cols["module"].extend([""] * len(ts))
+        elif plane.name.startswith("/device:CUSTOM:"):
+            # Runtime-defined planes (e.g. "Megascale Trace" — the DCN
+            # collective engine on multi-host pods).  Semantics are
+            # runtime-version-specific, so events are preserved verbatim:
+            # one lane per line, module = plane label.  They render as
+            # their own timeline series and feed no derived pass.
+            label = plane.name.split(":", 2)[-1]
+            if host:
+                label = f"{host}:{label}"
+            for lane, line in enumerate(plane.lines):
+                for name, disp, start_ns, dur_ns, stats in \
+                        _iter_line_events(plane, line):
+                    custom_rows.append(
+                        {
+                            "timestamp": to_rel_s(start_ns),
+                            "event": float(lane),
+                            "duration": dur_ns / 1e9,
+                            # Host ordinal base keeps multi-host events
+                            # attributable, like the device planes.
+                            "deviceId": device_id_base,
+                            "tid": int(line.id),
+                            "name": disp,
+                            "device_kind": "custom",
+                            "module": label,
+                        }
+                    )
         elif plane.name.startswith("/host:") and "metadata" not in plane.name:
             # y-value = thread lane ordinal: events of one thread share a
             # lane, like the reference's per-metric lanes (round-1 verdict
@@ -444,6 +471,8 @@ def xspace_to_frames(
         "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),
         "hosttrace": make_frame(host_cols) if n_host else empty_frame(),
         "tpusteps": make_frame(step_rows) if step_rows else empty_frame(),
+        "customtrace": make_frame(custom_rows) if custom_rows
+        else empty_frame(),
     }
     frames["_meta"] = meta  # type: ignore[assignment]
     return frames
@@ -548,7 +577,8 @@ def ingest_xprof_dir(
     if not paths:
         return {}
     all_frames: Dict[str, List[pd.DataFrame]] = {
-        "tputrace": [], "tpumodules": [], "hosttrace": [], "tpusteps": []
+        "tputrace": [], "tpumodules": [], "hosttrace": [], "tpusteps": [],
+        "customtrace": [],
     }
     meta: Dict[str, Dict[str, float]] = {}
     jobs = [(p, i, time_base) for i, p in enumerate(paths)]

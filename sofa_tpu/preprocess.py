@@ -42,6 +42,7 @@ _SERIES_STYLE = {
     "tpuutil": ("TPU util", "crimson"),
     "tpumon": ("TPU HBM", "firebrick"),
     "tpusteps": ("TPU steps", "black"),
+    "customtrace": ("Runtime (megascale/DCN)", "teal"),
     "blktrace": ("Block IO latency (ms)", "peru"),
 }
 
@@ -131,7 +132,8 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         frames.update(xframes)
     except Exception as e:  # noqa: BLE001
         print_warning(f"preprocess xplane: {e}")
-    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil", "tpusteps"):
+    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
+                "tpusteps", "customtrace"):
         frames.setdefault(key, empty_frame())
 
     # --- write frames -----------------------------------------------------

@@ -38,6 +38,13 @@ def build_multichip_xspace() -> xplane_pb2.XSpace:
     add_event(host, hline, f"sofa_timebase_marker:{MARKER_UNIX_NS}", 1_000_000,
            1000)
 
+    mega = xs.planes.add()
+    mega.name = "/device:CUSTOM:Megascale Trace"
+    gline = mega.lines.add()
+    gline.id = 3
+    gline.name = "dcn"
+    add_event(mega, gline, "send_reduce.4", 2_500_000, 400_000)
+
     ar_text = ("%all-reduce.7 = bf16[1024]{0} all-reduce(%x), "
                "replica_groups={{0,1,2,3}}, to_apply=%add")
     for d in range(N_DEV):
@@ -137,3 +144,14 @@ def test_multichip_features_and_iterations(report_dir):
     # op tree got both fw and bw paths
     tree = pd.read_csv(os.path.join(logdir, "tpu_op_tree.csv"))
     assert any("transpose" in p for p in tree["path"])
+
+
+def test_multichip_custom_plane_preserved(report_dir):
+    logdir, _ = report_dir
+    custom = pd.read_csv(os.path.join(logdir, "customtrace.csv"))
+    assert len(custom) == 1
+    row = custom.iloc[0]
+    assert row["name"] == "send_reduce.4"
+    assert row["module"] == "host:Megascale Trace"
+    assert row["device_kind"] == "custom"
+    assert row["deviceId"] == 0          # host 0's ordinal base
