@@ -186,6 +186,42 @@ def _watch():
     _start(jax)
 
 
+def _platform_guard():
+    # Env-over-config: an image-level site hook may force-prepend its own
+    # platform, overriding an explicit JAX_PLATFORMS (and hanging backend
+    # init when that platform's tunnel is dead).  jax itself honors the
+    # env var, so a mismatch right after import means a hook defeated the
+    # user's choice — restore it before the program initializes a backend.
+    # Best-effort by design: a program whose own config.update races our
+    # first poll can be re-overridden (hence the stderr breadcrumb), and
+    # later program updates always win because we write exactly once.
+    p = os.environ.get("JAX_PLATFORMS", "")
+    if not p:
+        return
+    deadline = time.time() + float(_OPTS.get("arm_timeout_s", 86400))
+    while time.time() < deadline:
+        jax = sys.modules.get("jax")
+        if jax is not None and getattr(jax, "config", None) is not None \\
+                and getattr(jax, "version", None) is not None:
+            try:
+                if jax.config.jax_platforms != p:
+                    jax.config.update("jax_platforms", p)
+                    print("sofa_tpu: restored JAX_PLATFORMS=%s over a "
+                          "site-hook platform override" % p,
+                          file=sys.stderr)
+            except Exception as e:
+                print("sofa_tpu: platform restore failed: %r" % (e,),
+                      file=sys.stderr)
+            return
+        time.sleep(0.005)
+
+
+# The guard runs whenever the injection is present (tpumon/pystacks-only
+# runs included), not just when XPlane tracing is enabled.
+_g = threading.Thread(target=_platform_guard, daemon=True,
+                      name="sofa_tpu_platform_guard")
+_g.start()
+
 if _OPTS.get("enable", False):
     _t = threading.Thread(target=_watch, daemon=True, name="sofa_tpu_xprof_watch")
     _t.start()
