@@ -106,28 +106,41 @@ def generate_hints(features: Features, cfg) -> List[str]:
     elif get("tpu_ops") is not None:
         hints.append(f"compute-bound: collectives take {comm_ratio:.0%} of device time")
 
-    eff = get("tpu0_roofline_efficiency")
-    mem_t = get("tpu0_memory_bound_time")
-    cmp_t = get("tpu0_compute_bound_time")
-    if eff is not None and eff < 0.4:
-        dominant = ("memory" if (mem_t or 0) >= (cmp_t or 0) else "compute")
-        fix = ("fuse elementwise chains into matmuls and raise arithmetic"
-               " intensity (larger batch/tiles)" if dominant == "memory" else
-               "check matmul shapes against the 128x128 MXU tile and prefer"
-               " bf16 inputs")
-        hints.append(
-            f"ops run at {eff:.0%} of their roofline bound and"
-            f" {dominant}-bound time dominates — {fix} (see roofline.csv)"
-        )
+    # Per-device rules scan tpu<N>_* (NOT hardcoded tpu0): multi-host device
+    # ids start at host_index*256, so there may be no device 0.  The worst
+    # device drives each hint.
+    effs = features.by_regex(r"tpu\d+_roofline_efficiency")
+    if effs:
+        name, eff = min(effs, key=lambda nv: nv[1])
+        dev = name.split("_", 1)[0]
+        if eff < 0.4:
+            mem_t = get(f"{dev}_memory_bound_time")
+            cmp_t = get(f"{dev}_compute_bound_time")
+            dominant = ("memory" if (mem_t or 0) >= (cmp_t or 0)
+                        else "compute")
+            fix = ("fuse elementwise chains into matmuls and raise arithmetic"
+                   " intensity (larger batch/tiles)" if dominant == "memory"
+                   else
+                   "check matmul shapes against the 128x128 MXU tile and"
+                   " prefer bf16 inputs")
+            hints.append(
+                f"ops on {dev} run at {eff:.0%} of their roofline bound and"
+                f" {dominant}-bound time dominates — {fix} (see roofline.csv)"
+            )
 
-    hidden = get("tpu0_async_hidden_pct")
-    atime = get("tpu0_async_time")
-    optime = get("tpu0_op_time")
-    if (hidden is not None and hidden < 50.0 and atime and optime
-            and atime > 0.05 * optime):
+    exposed = []
+    for name, hidden in features.by_regex(r"tpu\d+_async_hidden_pct"):
+        dev = name.split("_", 1)[0]
+        atime = get(f"{dev}_async_time")
+        optime = get(f"{dev}_op_time")
+        if (hidden < 50.0 and atime and optime
+                and atime > 0.05 * optime):
+            exposed.append((hidden, dev))
+    if exposed:
+        hidden, dev = min(exposed)
         hints.append(
-            f"exposed DMA latency: only {hidden:.0f}% of async copy time"
-            " overlaps TensorCore compute — enable/raise prefetching"
+            f"exposed DMA latency on {dev}: only {hidden:.0f}% of async copy"
+            " time overlaps TensorCore compute — enable/raise prefetching"
             " (double-buffer inputs, jax.block_until_ready placement) or"
             " fuse small transfers"
         )

@@ -8,7 +8,8 @@ metadata goes in `info` rows rendered alongside.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import re
+from typing import Dict, List, Optional, Tuple
 
 import pandas as pd
 
@@ -29,6 +30,20 @@ class Features:
             if n == name:
                 return v
         return None
+
+    def by_regex(self, pattern: str) -> List[Tuple[str, float]]:
+        """Latest value of every feature whose full name matches pattern.
+
+        For per-device features (tpu<N>_...) rules must scan rather than
+        hardcode tpu0: multi-host captures offset device ids by
+        host_index*256, so device 0 may not exist at all.
+        """
+        rx = re.compile(pattern)
+        latest: Dict[str, float] = {}
+        for n, v in self._rows:
+            if rx.fullmatch(n):
+                latest[n] = v
+        return sorted(latest.items())
 
     def to_frame(self) -> pd.DataFrame:
         return pd.DataFrame(self._rows, columns=["name", "value"])

@@ -306,12 +306,16 @@ def xspace_to_frames(
                     # XLA's own device-side step demarcation (one span per
                     # profiler StepMarker) — exact iteration boundaries,
                     # preferred by aisi over host-marker matching.
-                    for name, disp, start_ns, dur_ns, stats in \
-                            _iter_line_events(plane, line):
+                    for ev_idx, (name, disp, start_ns, dur_ns, stats) in \
+                            enumerate(_iter_line_events(plane, line)):
                         try:
                             step_no = int(name)
                         except ValueError:
-                            step_no = len(step_rows)
+                            # Per-line ordinal, NOT a global counter: the
+                            # same logical step must get the same event id
+                            # on every device or step_skew_profile's
+                            # groupby(event) finds no cross-device groups.
+                            step_no = ev_idx
                         step_rows.append(
                             {
                                 "timestamp": to_rel_s(start_ns),
@@ -583,10 +587,11 @@ def ingest_xprof_dir(
     meta: Dict[str, Dict[str, float]] = {}
     jobs = [(p, i, time_base) for i, p in enumerate(paths)]
     results: List = []
+    serial_from = 0 if len(jobs) <= 1 else None
     if len(jobs) > 1:
         try:
             import multiprocessing as mp
-            from concurrent.futures import ProcessPoolExecutor
+            from concurrent.futures import BrokenExecutor, ProcessPoolExecutor
 
             # Never fork: the caller may hold sampler/collector threads and
             # a forked child of a threaded process can deadlock.
@@ -602,16 +607,29 @@ def ingest_xprof_dir(
                     try:
                         results.append(fut.result())
                         print_info(f"xplane: ingested {job[0]}")
+                    except BrokenExecutor:
+                        raise  # handled below — NOT a per-file decode error
                     except Exception as e:  # noqa: BLE001 — one corrupt trace must not kill the rest
                         print_warning(f"xplane: cannot parse {job[0]}: {e}")
                         results.append(None)
+        except BrokenExecutor as e:
+            # A crashed/OOM-killed worker poisons every pending future (and
+            # can surface from submit itself) — an environment failure, not
+            # a decode failure.  Keep completed results, finish the rest
+            # serially; "cannot parse" stays reserved for files that
+            # actually failed to decode.
+            print_warning(
+                f"xplane: process pool broke ({e!r}); ingesting remaining "
+                f"{len(jobs) - len(results)} files serially")
+            serial_from = len(results)
         except (ImportError, OSError, ValueError) as e:
             # Pool creation itself failed (sandboxed /dev/shm, no spawn).
             print_warning(f"xplane: parallel ingest unavailable ({e}); "
                           "falling back to serial")
             results = []
-    if not results:
-        for job in jobs:
+            serial_from = 0
+    if serial_from is not None:
+        for job in jobs[serial_from:]:
             print_info(f"xplane: ingesting {job[0]}")
             try:
                 results.append(_ingest_one(job))

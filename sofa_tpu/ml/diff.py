@@ -86,7 +86,9 @@ def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
     joined["ratio"] = np.where(
         joined["time_base"] > 0,
         joined["time_match"] / joined["time_base"].replace(0, np.nan),
-        np.inf)
+        # inf only for ops that actually exist in match: an op with zero
+        # time in BOTH runs is unchanged (ratio 1), not a >20% mover.
+        np.where(joined["time_match"] > 0, np.inf, 1.0))
     table = joined.reindex(
         joined["delta"].abs().sort_values(ascending=False).index
     ).reset_index()

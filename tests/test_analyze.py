@@ -503,6 +503,28 @@ def test_advice_overlap_and_skew_hints(cfg):
     assert not any("exposed DMA" in h or "straggler" in h for h in hints2)
 
 
+def test_advice_hints_fire_without_device_zero(cfg):
+    """Multi-host captures offset device ids (host 1 -> tpu256); per-device
+    rules must scan, not hardcode tpu0 (round-2 advisor finding)."""
+    feats = Features()
+    feats.add("tpu256_async_hidden_pct", 20.0)
+    feats.add("tpu256_async_time", 1.0)
+    feats.add("tpu256_op_time", 2.0)
+    feats.add("tpu256_roofline_efficiency", 0.2)
+    feats.add("tpu256_memory_bound_time", 1.0)
+    feats.add("tpu256_compute_bound_time", 0.1)
+    hints = advice.generate_hints(feats, cfg)
+    assert any("exposed DMA latency on tpu256" in h for h in hints)
+    assert any("ops on tpu256" in h and "roofline" in h for h in hints)
+
+    # The worst device drives the hint when several report.
+    feats.add("tpu512_async_hidden_pct", 5.0)
+    feats.add("tpu512_async_time", 1.0)
+    feats.add("tpu512_op_time", 2.0)
+    hints = advice.generate_hints(feats, cfg)
+    assert any("exposed DMA latency on tpu512" in h for h in hints)
+
+
 def test_board_pages_staged_and_linked(cfg):
     """Every board page is staged into the logdir and the nav on each page
     links every other page (a new page must be added to all navs)."""

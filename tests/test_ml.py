@@ -266,9 +266,9 @@ def test_sofa_tpu_diff(tmp_path):
         return str(d) + "/"
 
     base = run_dir("base", [("fusion.1", 0.010), ("dot.2", 0.005),
-                            ("gone.3", 0.002)])
+                            ("gone.3", 0.002), ("zero.5", 0.0)])
     match = run_dir("match", [("fusion.1", 0.020), ("dot.2", 0.005),
-                              ("new.4", 0.001)])
+                              ("new.4", 0.001), ("zero.5", 0.0)])
     out = tmp_path / "out"
     cfg = SofaConfig(logdir=str(out) + "/", base_logdir=base,
                      match_logdir=match)
@@ -281,6 +281,8 @@ def test_sofa_tpu_diff(tmp_path):
     assert byname.loc["new.4", "time_base"] == 0.0
     import numpy as np
     assert np.isinf(byname.loc["new.4", "ratio"])
+    # zero time on BOTH sides is unchanged (ratio 1), not an inf "mover"
+    assert byname.loc["zero.5", "ratio"] == pytest.approx(1.0)
     # biggest mover first
     assert table.iloc[0]["name"] == "fusion.1"
     assert (out / "tpu_diff.csv").is_file()

@@ -192,35 +192,78 @@ def trace_pipeline_train():
 
 @check("capture_fixture")
 def capture_fixture():
+    """Capture tests/fixtures/tpu_device.xplane.pb from the real chip.
+
+    v2 capture: ONE trace holding both the 1024^3 bf16 matmul (keeps the
+    flops/bytes metadata-stats assertions meaningful) and a 5-step
+    StepTraceAnnotation'd tiny-transformer train loop, so the fixture has a
+    real device "Steps" line and fw/bw provenance — the round-2 fixture had
+    neither, leaving the Steps-span and CUSTOM-plane ingest validated only
+    by self-made protos.  A sidecar .meta.json records what the capture
+    contains so fixture tests can gate their assertions on it.
+    """
     import glob
+    import json
     import os
     import shutil
     import tempfile
+    import time as _time
 
     import jax
     import jax.numpy as jnp
 
     import sofa_tpu.api as sofa
+    from sofa_tpu.ingest.xplane import ingest_xprof_dir
+    from sofa_tpu.workloads.common import step_annotation
+    from sofa_tpu.workloads.transformer import TransformerConfig, build
+
+    cfg = TransformerConfig.tiny(seq=128)
+    params, opt, step, tokens = build(cfg, None, batch=4, seq=128)
+    params, opt, loss = step(params, opt, tokens)   # compile outside trace
+    x = jax.random.normal(jax.random.PRNGKey(0), (1024, 1024), jnp.bfloat16)
+    mm = jax.jit(lambda x: (x @ x).sum())
+    jax.block_until_ready((mm(x), loss))
 
     logdir = tempfile.mkdtemp(prefix="sofa_val_") + "/"
     try:
         with sofa.profile(logdir):
-            x = jax.random.normal(jax.random.PRNGKey(0), (1024, 1024),
-                                  jnp.bfloat16)
-            y = jax.jit(lambda x: (x @ x).sum())(x)
-            jax.block_until_ready(y)
+            y = mm(x)
+            for i in range(5):
+                with step_annotation(i):
+                    params, opt, loss = step(params, opt, tokens)
+            jax.block_until_ready((y, loss))
         pbs = glob.glob(os.path.join(logdir, "xprof", "**", "*.xplane.pb"),
                         recursive=True)
         assert pbs, "no xplane.pb captured"
         size = os.path.getsize(pbs[0])
-        # Validate size BEFORE replacing the committed fixture; a matmul-
-        # only trace should be well under 5 MB.
+        # Validate size BEFORE replacing the committed fixture; this trace
+        # should be well under 8 MB.
         assert size < 8_000_000, f"capture too large ({size} B), trim first"
-        dest = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "tests", "fixtures",
-            "tpu_device.xplane.pb")
+        # Ingest the candidate BEFORE replacing the committed fixture — a
+        # capture that lost the Steps line or the matmul must not demote
+        # the fixture.
+        frames = ingest_xprof_dir(os.path.join(logdir, "xprof"), _time.time())
+        n_steps = len(frames["tpusteps"])
+        assert n_steps >= 5, f"capture has {n_steps} Steps spans, need >= 5"
+        assert frames["tputrace"]["flops"].max() > 1e9, "matmul flops lost"
+        fixdir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tests", "fixtures")
+        dest = os.path.join(fixdir, "tpu_device.xplane.pb")
         shutil.copy(pbs[0], dest)
-        return f"{dest} ({size // 1024} KiB)"
+        meta = {
+            "version": 2,
+            "captured_utc": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           _time.gmtime()),
+            "steps_spans": int(n_steps),
+            "has_fw_bw": bool((frames["tputrace"]["phase"] == "bw").any()),
+            "custom_planes": sorted(
+                frames["customtrace"]["module"].unique().tolist())
+            if len(frames.get("customtrace", [])) else [],
+        }
+        with open(os.path.join(fixdir, "tpu_device.xplane.meta.json"),
+                  "w") as f:
+            json.dump(meta, f, indent=1)
+        return f"{dest} ({size // 1024} KiB, steps={n_steps})"
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
 
