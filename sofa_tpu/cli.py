@@ -84,6 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
+    g.add_argument("--tpu_time_offset_ms", type=float,
+                   help="shift device/XPlane timestamps by this many ms when "
+                        "automatic marker/timebase alignment is wrong")
     g.add_argument("--viz_downsample_to", type=int)
     g.add_argument("--trace_format", choices=["csv", "parquet"],
                    help="columnar parquet keeps pod-scale op traces small")
@@ -144,7 +147,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "netstat_interface", "blkdev", "pid",
         "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
         "xprof_duration_s", "tpu_mon_rate",
-        "cpu_time_offset_ms", "viz_downsample_to", "trace_format",
+        "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
+        "trace_format",
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",
         "enable_swarms", "is_idle_threshold", "profile_region", "spotlight",
         "hint_server", "iterations_from",

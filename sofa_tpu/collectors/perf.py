@@ -88,6 +88,18 @@ class PerfCollector(Collector):
             return []
         return self._record_argv() + ["-p", str(pid)]
 
+    def scoped_argv(self, cgroup: Optional[str] = None,
+                    pid: Optional[int] = None) -> List[str]:
+        """Container-scoped sampling: system-wide filtered to the
+        container's cgroup (`-a -G`, like the reference's
+        --cgroup=docker/<cid>, sofa_record.py:380-399), or attached to its
+        init pid when the cgroup cannot be resolved."""
+        if self.mode != "perf":
+            return []
+        if cgroup:
+            return self._record_argv() + ["-a", "-G", cgroup]
+        return self._record_argv() + ["-p", str(pid)]
+
     def harvest(self) -> None:
         # Copy kernel symbols for offline `perf script` runs, like the
         # reference snapshots /proc/kallsyms (sofa_record.py:231-233).

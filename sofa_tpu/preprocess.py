@@ -129,6 +129,14 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     try:
         xframes = ingest_xprof_dir(cfg.xprof_dir, time_base)
         tpu_meta = xframes.pop("_meta", {})  # type: ignore[assignment]
+        # Manual escape hatch mirroring cpu_time_offset_ms for the device
+        # side: when the marker/timebase alignment is wrong (bad marker, NTP
+        # step mid-run), the trace can be salvaged without re-recording.
+        tpu_off = cfg.tpu_time_offset_ms / 1e3
+        if tpu_off:
+            for df in xframes.values():
+                if not df.empty:
+                    df["timestamp"] = df["timestamp"] + tpu_off
         frames.update(xframes)
     except Exception as e:  # noqa: BLE001
         print_warning(f"preprocess xplane: {e}")

@@ -16,6 +16,7 @@ object (sofa_tpu/collectors/) rather than inline Popen spaghetti:
 from __future__ import annotations
 
 import os
+import re
 import subprocess
 import time
 
@@ -84,6 +85,145 @@ def _clean_stale(cfg) -> None:
             print_warning(f"cannot clean {path}: {e}")
 
 
+# Anchor to an actual docker-run invocation (optionally preceded by env
+# assignments) — "docker run" appearing inside a quoted argument of some
+# other command must not trigger the rewrite.
+_DOCKER_RUN_RE = re.compile(r"^\s*(?:[A-Za-z_][A-Za-z0-9_]*=\S*\s+)*"
+                            r"(?:sudo\s+)?docker\s+run\b")
+
+
+def _add_cidfile(command: str, cidfile: str) -> str:
+    """Insert --cidfile so docker publishes the container id for scoping."""
+    import shlex
+
+    m = _DOCKER_RUN_RE.match(command)
+    if m is None:
+        return command
+    return (command[:m.end()] + " --cidfile " + shlex.quote(cidfile)
+            + command[m.end():])
+
+
+def _perf_cgroup_rel(cgroup_text: str) -> "str | None":
+    """The perf-relevant cgroup path (relative, no leading /) from a
+    /proc/<pid>/cgroup dump: the perf_event controller's path on cgroup v1
+    (dockerd's cgroupfs driver puts containers at docker/<cid>), else the
+    v2 unified path (systemd driver: system.slice/docker-<cid>.scope)."""
+    v2 = None
+    for line in cgroup_text.splitlines():
+        parts = line.split(":", 2)
+        if len(parts) != 3:
+            continue
+        if "perf_event" in parts[1].split(","):
+            return parts[2].lstrip("/")
+        if parts[0] == "0" and parts[1] == "":
+            v2 = parts[2].lstrip("/")
+    return v2
+
+
+class _DockerPerfScope:
+    """Scope CPU sampling to the container, not the docker CLI.
+
+    `docker run` is an RPC client: wrapping it in `perf record` samples the
+    CLI's event loop while the workload runs under dockerd, so cputrace for
+    a containerized run is garbage (the reference instead profiles the
+    container's cgroup, /root/reference/bin/sofa_record.py:380-399).  The
+    rewritten command publishes its container id via --cidfile; this watcher
+    resolves the container's init pid and perf_event cgroup, then launches
+    system-wide `perf record -a -G <cgroup>` (pid-scoped attach when the
+    cgroup cannot be resolved).
+    """
+
+    def __init__(self, cfg, perf: PerfCollector, cidfile: str):
+        import threading
+
+        self.cfg, self.perf, self.cidfile = cfg, perf, cidfile
+        self.proc: "subprocess.Popen | None" = None
+        self._stop = threading.Event()
+        # Serializes launch vs stop: after stop() holds the lock and sets
+        # _stop, a late-waking watcher can never launch an orphan perf.
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _wait_cid(self, timeout_s: float = 60.0) -> "str | None":
+        t0 = time.time()
+        while not self._stop.is_set() and time.time() - t0 < timeout_s:
+            try:
+                with open(self.cidfile) as f:
+                    cid = f.read().strip()
+                if cid:
+                    return cid
+            except OSError:
+                pass
+            time.sleep(0.1)
+        return None
+
+    def _container_pid(self, cid: str, timeout_s: float = 30.0) -> int:
+        # The cidfile appears at create time; State.Pid is 0 until Running.
+        # Deadline-based with a per-call timeout so a wedged dockerd cannot
+        # pin this thread past stop()'s join window.
+        t0 = time.time()
+        while not self._stop.is_set() and time.time() - t0 < timeout_s:
+            try:
+                out = subprocess.run(
+                    ["docker", "inspect", "--format", "{{.State.Pid}}", cid],
+                    capture_output=True, text=True, timeout=5)
+            except subprocess.TimeoutExpired:
+                continue
+            if out.returncode == 0:
+                try:
+                    pid = int(out.stdout.strip())
+                except ValueError:
+                    pid = 0
+                if pid > 0:
+                    return pid
+            time.sleep(0.1)
+        return 0
+
+    def _run(self) -> None:
+        cid = self._wait_cid()
+        if cid is None:
+            print_warning("docker: no container id appeared; container CPU "
+                          "samples unavailable for this run")
+            return
+        pid = self._container_pid(cid)
+        if not pid:
+            print_warning(f"docker: cannot resolve init pid of {cid[:12]}; "
+                          "container CPU samples unavailable")
+            return
+        try:
+            with open(f"/proc/{pid}/cgroup") as f:
+                cgroup = _perf_cgroup_rel(f.read())
+        except OSError:
+            cgroup = None
+        argv = self.perf.scoped_argv(cgroup=cgroup, pid=pid)
+        with self._lock:
+            if self._stop.is_set():
+                return  # the run already ended; do not launch an orphan
+            try:
+                self.proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                             stderr=subprocess.DEVNULL)
+                print_progress(
+                    f"perf scoped to container {cid[:12]} "
+                    + (f"(cgroup {cgroup})" if cgroup else f"(pid {pid})"))
+            except OSError as e:
+                print_warning(f"docker-scoped perf failed to launch: {e}")
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+        self._thread.join(timeout=70)
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
 def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
     """Thread the profiling context through a `docker run` boundary.
 
@@ -98,16 +238,14 @@ def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
                              because docker does not inherit the parent env.
 
     Host-side samplers (procmon/vmstat/tcpdump) already see the container's
-    processes — same kernel.  Non-`docker run` commands pass through.
+    processes — same kernel.  CPU sampling is handled separately: the perf
+    prefix is dropped and _DockerPerfScope re-scopes `perf record` to the
+    container's cgroup (it would otherwise profile the docker CLI).
+    Non-`docker run` commands pass through.
     """
-    import re as _re
     import shlex
 
-    # Anchor to an actual docker-run invocation (optionally preceded by env
-    # assignments) — "docker run" appearing inside a quoted argument of some
-    # other command must not trigger the rewrite.
-    m = _re.match(r"^\s*(?:[A-Za-z_][A-Za-z0-9_]*=\S*\s+)*(?:sudo\s+)?"
-                  r"docker\s+run\b", command)
+    m = _DOCKER_RUN_RE.match(command)
     if m is None:
         return command
     logdir = os.path.abspath(cfg.logdir)
@@ -130,6 +268,8 @@ def sofa_record(command: str, cfg) -> int:
     prefix = []
     child_env = dict(os.environ)
     rc = 1
+    is_docker = cfg.pid is None and _DOCKER_RUN_RE.match(command) is not None
+    docker_perf = None
     try:
         for col in collectors:
             reason = col.probe()
@@ -138,7 +278,14 @@ def sofa_record(command: str, cfg) -> int:
                 continue
             col.start()
             started.append(col)
-            prefix += col.command_prefix()
+            if (is_docker and isinstance(col, PerfCollector)
+                    and col.mode == "perf"):
+                # A perf prefix would sample the docker *client*; the
+                # collector is instead rescoped to the container by
+                # _DockerPerfScope below (its harvest still runs normally).
+                docker_perf = col
+            else:
+                prefix += col.command_prefix()
             child_env.update(col.child_env())
 
         # The profiled child must be able to import sofa_tpu (built-in
@@ -156,10 +303,21 @@ def sofa_record(command: str, cfg) -> int:
                 (c for c in started if isinstance(c, PerfCollector)), None)
             rc = _attach(cfg, cfg.pid, perf)
         else:
+            docker_scope = None
+            if docker_perf is not None:
+                cidfile = cfg.path("docker.cid")
+                try:
+                    os.unlink(cidfile)  # docker refuses a stale cidfile
+                except OSError:
+                    pass
+                command = _add_cidfile(command, cidfile)
+                docker_scope = _DockerPerfScope(cfg, docker_perf, cidfile)
             command = wrap_docker_command(command, cfg, child_env)
             argv = prefix + ["/bin/sh", "-c", command]
             print_progress(f"launching: {command}")
             t0 = time.time()
+            if docker_scope is not None:
+                docker_scope.start()
             child = subprocess.Popen(argv, env=child_env)
             try:
                 rc = child.wait()
@@ -171,6 +329,9 @@ def sofa_record(command: str, cfg) -> int:
                 except subprocess.TimeoutExpired:
                     child.kill()
                     rc = child.wait()
+            finally:
+                if docker_scope is not None:
+                    docker_scope.stop()
             elapsed = time.time() - t0
             print_progress(f"command finished in {elapsed:.3f} s (rc={rc})")
             _write_misc(cfg, elapsed, child.pid, rc)

@@ -159,3 +159,27 @@ def test_tpu_utilization_windows():
     assert mxu["event"].max() == pytest.approx(0.01, rel=0.05)
     hbm = util[util["name"] == "hbm_gbps"]
     assert hbm["event"].max() == pytest.approx(5.5e6 / 1e-3 / 1e9, rel=0.05)
+
+
+def test_windowed_integral_matches_bruteforce():
+    """The O(N+W) difference-array windowing must agree exactly with the
+    per-window interval clipping it replaced (VERDICT r2 weak #7)."""
+    import numpy as np
+
+    from sofa_tpu.ingest.xplane import _windowed_integral
+
+    rng = np.random.default_rng(7)
+    for window_s in (0.37, 0.05):
+        n = 300
+        starts = rng.uniform(0.0, 10.0, n)
+        ends = starts + rng.uniform(1e-5, 3.0, n)
+        rates = rng.uniform(0.0, 5.0, n)
+        t0 = float(starts.min())
+        edges = np.arange(t0, float(ends.max()) + window_s, window_s)
+        n_win = len(edges) - 1
+        got = _windowed_integral(starts, ends, rates, t0, n_win, window_s)
+        exp = np.array([
+            (rates * np.maximum(
+                np.minimum(ends, w1) - np.maximum(starts, w0), 0.0)).sum()
+            for w0, w1 in zip(edges[:-1], edges[1:])])
+        np.testing.assert_allclose(got, exp, rtol=1e-9, atol=1e-9)

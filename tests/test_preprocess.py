@@ -21,6 +21,32 @@ def test_preprocess_after_record(logdir):
     assert doc["meta"]["elapsed_time"] >= 0.3
 
 
+def test_tpu_time_offset_knob(tmp_path):
+    """--tpu_time_offset_ms shifts the device/XPlane-side frames (and ONLY
+    those): the manual escape hatch for a wrong marker/timebase alignment
+    (reference --cpu_time_offset_ms, bin/sofa:111-112, extended to the
+    device clock domain)."""
+    import shutil
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "cpu_host.xplane.pb")
+    base = {}
+    for name, off_ms in (("a", 0.0), ("b", 250.0)):
+        d = str(tmp_path / name) + "/"
+        prof = os.path.join(d, "xprof", "plugins", "profile", "run1")
+        os.makedirs(prof)
+        shutil.copy(fixture, os.path.join(prof, "host.xplane.pb"))
+        with open(os.path.join(d, "sofa_time.txt"), "w") as f:
+            f.write("1700000000.0\n")
+        cfg = SofaConfig(logdir=d, tpu_time_offset_ms=off_ms)
+        frames = sofa_preprocess(cfg)
+        assert not frames["hosttrace"].empty
+        base[name] = frames
+    shift = (base["b"]["hosttrace"]["timestamp"].to_numpy()
+             - base["a"]["hosttrace"]["timestamp"].to_numpy())
+    assert shift == __import__("pytest").approx(0.25)
+
+
 def test_preprocess_missing_logdir():
     cfg = SofaConfig(logdir="/tmp/definitely-not-here-xyz/")
     import pytest

@@ -190,6 +190,51 @@ def trace_pipeline_train():
         shutil.rmtree(logdir, ignore_errors=True)
 
 
+@check("clock_residual")
+def clock_residual():
+    """Marker-vs-timebase agreement: the in-trace marker alignment and the
+    native timebase table are two independent clock bridges; they must agree
+    to ~1 ms or drift fitting / the marker read is broken (VERDICT r2 next
+    #7 — the --tpu_time_offset_ms escape hatch exists for when this fails
+    in the field)."""
+    import shutil
+    import tempfile
+
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    import sofa_tpu.api as sofa
+    from sofa_tpu.ingest.timebase_align import load_timebase
+    from sofa_tpu.ingest.xplane import find_marker_offset_ns, load_xspace
+
+    logdir = tempfile.mkdtemp(prefix="sofa_val_clk_") + "/"
+    try:
+        f = jax.jit(lambda v: v @ v)
+        x = jnp.ones((256, 256))
+        jax.block_until_ready(f(x))
+        with sofa.profile(logdir):
+            jax.block_until_ready(f(x))
+            time.sleep(3.0)
+            jax.block_until_ready(f(x))
+        pbs = glob.glob(logdir + "xprof/**/*.xplane.pb", recursive=True)
+        assert pbs, "no capture"
+        off = find_marker_offset_ns(load_xspace(pbs[0]))
+        assert off is not None, "marker missing from capture"
+        table = load_timebase(logdir + "timebase.txt")
+        assert table is not None, "timebase.txt missing"
+        # The profiler session clock counts from one of the posix clocks
+        # sampled in the table; the residual vs the best-matching one is
+        # the end-to-end alignment error.
+        res = min(abs(off - float((table[:, 0] - table[:, c]).mean()))
+                  for c in (1, 2, 3))
+        assert res < 1e6, f"residual {res / 1e6:.3f} ms >= 1 ms"
+        return f"residual {res / 1e6:.4f} ms over {len(table)} samples"
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
 @check("capture_fixture")
 def capture_fixture():
     """Capture tests/fixtures/tpu_device.xplane.pb from the real chip.
@@ -296,6 +341,7 @@ def main() -> int:
     fwd_bwd_vs_unfused()
     entry_compiles_fused()
     trace_pipeline_train()
+    clock_residual()
     if args.capture_fixture:
         capture_fixture()
 
