@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Dict
+from typing import Dict, List
 
 import pandas as pd
 
@@ -53,11 +53,15 @@ _PASSES = [
 ]
 
 
-def load_frames(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
+def load_frames(cfg: SofaConfig,
+                only: "List[str] | None" = None) -> Dict[str, pd.DataFrame]:
+    """Read trace frames from the logdir; ``only`` restricts to a subset so
+    narrow consumers (sofa export) skip deserializing pod-scale traces they
+    never chart."""
     from sofa_tpu.trace import read_frame
 
     frames: Dict[str, pd.DataFrame] = {}
-    for name in CSV_SOURCES:
+    for name in (only if only is not None else CSV_SOURCES):
         try:
             df = read_frame(cfg.path(name))  # .parquet preferred, else .csv
         except Exception as e:  # noqa: BLE001

@@ -10,6 +10,7 @@ CLI (/root/reference/bin/sofa:328-376):
   report            [preprocess] + analyze [+ --with-gui viz]
   stat "cmd"        record + preprocess + analyze
   diff              preprocess base/match logdirs + swarm diff
+  export            static sofa_report.pdf/overview.png for headless sharing
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -47,7 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "clean", "setup",
+        "export", "clean", "setup",
     ])
     p.add_argument("usr_command", nargs="?", default="", help="command to profile (record/stat)")
 
@@ -225,6 +226,10 @@ def main(argv=None) -> int:
                 from sofa_tpu.viz import sofa_viz
                 sofa_viz(cfg)
             return 0
+        if cmd == "export":
+            from sofa_tpu.export_static import export_static
+            print_main_progress("SOFA export")
+            return 0 if export_static(cfg) else 1
         if cmd == "stat":
             if not cfg.command:
                 print_error('stat needs a command: sofa stat "python train.py"')

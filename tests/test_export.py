@@ -1,0 +1,48 @@
+"""Static chart export (`sofa export`) — reference parity for
+network_report.pdf / blktrace scatter (sofa_analyze.py:531-638), rendered
+from the unified-schema frames without serving HTTP."""
+
+import os
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.record import sofa_record
+
+
+def test_export_static_renders_pdf(logdir):
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.export_static import export_static
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=50)
+    sofa_record("sleep 1.2", cfg)  # long enough for >=2 netstat samples
+    sofa_preprocess(cfg)
+    sofa_analyze(cfg)
+    written = export_static(cfg)
+    assert cfg.path("sofa_report.pdf") in written
+    assert cfg.path("overview.png") in written
+    assert os.path.getsize(cfg.path("sofa_report.pdf")) > 2000
+    assert os.path.getsize(cfg.path("overview.png")) > 2000
+    # PDF really is multi-page (overview + host-network at minimum)
+    import re
+
+    raw = open(cfg.path("sofa_report.pdf"), "rb").read()
+    assert raw.startswith(b"%PDF")
+    counts = [int(m) for m in re.findall(rb"/Count (\d+)", raw)]
+    assert counts and max(counts) >= 2, counts
+
+    # `sofa clean` treats the exports as derived artifacts
+    from sofa_tpu.record import sofa_clean
+
+    sofa_clean(cfg)
+    assert not os.path.exists(cfg.path("sofa_report.pdf"))
+    assert not os.path.exists(cfg.path("overview.png"))
+
+
+def test_export_empty_logdir_degrades(tmp_path):
+    from sofa_tpu.export_static import export_static
+
+    d = str(tmp_path / "empty") + "/"
+    os.makedirs(d)
+    written = export_static(SofaConfig(logdir=d))
+    assert written == []
+    assert not os.path.exists(os.path.join(d, "sofa_report.pdf"))
