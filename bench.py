@@ -118,7 +118,7 @@ def _next_round_tag(root: str) -> str:
     return f"r{max(ns, default=0) + 1:02d}"
 
 
-def _run_validate_checklist() -> bool:
+def _run_validate_checklist(root: Optional[str] = None) -> bool:
     """Run tools/validate_tpu.py in the SAME healthy tunnel window the bench
     just found, so one window yields both the on-chip checklist (and a fresh
     real-capture fixture) and the overhead number.  Best-effort: a failing or
@@ -132,7 +132,8 @@ def _run_validate_checklist() -> bool:
         return False
     if _probed_backend != "tpu":
         return False  # CPU smoke run: the checklist requires the real chip
-    root = os.path.dirname(os.path.abspath(__file__))
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))
     script = os.path.join(root, "tools", "validate_tpu.py")
     if not os.path.isfile(script):
         return False

@@ -1,4 +1,5 @@
 import os
+import re
 
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.record import sofa_clean, sofa_record
@@ -291,6 +292,75 @@ def test_cluster_record_two_localhost_hosts(tmp_path):
     cfg2 = SofaConfig(logdir=str(tmp_path / "clog2") + "/",
                       cluster_hosts=["localhost"], enable_xprof=False)
     assert cluster_record("exit 3", cfg2) == 3
+
+
+def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
+    """The ssh/scp remote leg of cluster_record: launch over `ssh`, fetch
+    with `scp`, clean the remote tmp dir — driven end to end with PATH
+    stubs (this image has no sshd), asserting command quoting, fetch
+    placement, and remote cleanup."""
+    import stat
+    import sys
+    import textwrap
+
+    from sofa_tpu.record import cluster_record
+
+    stubs = tmp_path / "stubs"
+    stubs.mkdir()
+    seen = tmp_path / "ssh_calls.txt"
+    # "Remote" filesystem root: the ssh stub executes the remote sofa
+    # record by materializing its logdir; scp copies it back.
+    (stubs / "ssh").write_text(textwrap.dedent(f"""\
+        #!{sys.executable}
+        import os, shlex, subprocess, sys
+        args = sys.argv[1:]
+        host, remote = args[-2], args[-1]
+        with open({str(seen)!r}, "a") as f:
+            f.write(host + " :: " + remote + chr(10))
+        if remote.startswith("rm -rf"):
+            # guard: only the expected remote tmp dir may ever be deleted
+            target = remote[len("rm -rf"):].strip()
+            assert target.startswith("/tmp/sofa_tpu_record_"), target
+            subprocess.call(remote, shell=True)
+            sys.exit(0)
+        argv = shlex.split(remote)
+        assert argv[0:2] == ["sofa", "record"], argv
+        logdir = argv[argv.index("--logdir") + 1]
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir, "sofa_time.txt"), "w") as f:
+            f.write("1700000000.0 remote\\n")
+        with open(os.path.join(logdir, "misc.txt"), "w") as f:
+            f.write("rc 0\\n")
+        sys.exit(0)
+        """))
+    (stubs / "scp").write_text(textwrap.dedent(f"""\
+        #!{sys.executable}
+        import subprocess, sys
+        src, dst = sys.argv[-2], sys.argv[-1]
+        host, path = src.split(":", 1)
+        sys.exit(subprocess.call(["cp", "-r", path, dst]))
+        """))
+    for s in ("ssh", "scp"):
+        os.chmod(stubs / s, os.stat(stubs / s).st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{stubs}:{os.environ['PATH']}")
+
+    base = str(tmp_path / "clog") + "/"
+    cfg = SofaConfig(logdir=base, cluster_hosts=["tpu-host-7"],
+                     enable_xprof=False)
+    rc = cluster_record("sleep 0.1", cfg)
+    assert rc == 0
+    hdir = base.rstrip("/") + "-tpu-host-7/"
+    fetched = open(os.path.join(hdir, "sofa_time.txt")).read()
+    assert "remote" in fetched
+    calls = open(seen).read().splitlines()
+    # launch first, cleanup after fetch — both addressed to the host
+    assert len(calls) == 2
+    assert calls[0].startswith("tpu-host-7 :: sofa record")
+    assert "sleep 0.1" in calls[0]
+    assert calls[1].startswith("tpu-host-7 :: rm -rf")
+    # the remote tmp dir was cleaned
+    m = re.search(r"rm -rf (\S+)", calls[1])
+    assert m and not os.path.exists(m.group(1))
 
 
 def test_edr_trigger_fires(tmp_path):
