@@ -30,7 +30,8 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _emit(value, error: str | None = None) -> None:
+def _emit(value, error: str | None = None,
+          p_value: "float | None" = None) -> None:
     """The one JSON line the driver parses — emitted on success AND failure."""
     out = {
         "metric": "resnet50_profiling_overhead",
@@ -38,6 +39,10 @@ def _emit(value, error: str | None = None) -> None:
         "unit": "percent",
         "vs_baseline": None if value is None else round(value / 5.0, 4),
     }
+    if p_value is not None:
+        # paired-run significance, mirroring the reference's t-test
+        # (validation/framework_eval.py:144-145,208-215)
+        out["p_value"] = round(p_value, 4)
     if error:
         out["error"] = error
     print(json.dumps(out), flush=True)
@@ -367,6 +372,14 @@ def main() -> int:
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
 
+    p_value = None
+    if len(bare) >= 2:
+        try:
+            from scipy import stats
+
+            p_value = float(stats.ttest_rel(prof, bare).pvalue)
+        except Exception:  # noqa: BLE001 — significance is optional
+            pass
     bare.sort()
     prof.sort()
     t_bare = bare[len(bare) // 2]
@@ -378,7 +391,7 @@ def main() -> int:
     _log(f"bench: images/s bare {args.steps * args.batch / t_bare:.1f}, "
          f"profiled {args.steps * args.batch / t_prof:.1f}; "
          f"trace rows {hlo_rows}")
-    _emit(round(overhead, 3))
+    _emit(round(overhead, 3), p_value=p_value)
     return 0
 
 

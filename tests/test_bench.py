@@ -128,6 +128,41 @@ def test_validate_checklist_writes_round_log(tmp_path, monkeypatch):
     assert "rc=0" in out and "PASS all" in out
 
 
+def test_emit_includes_p_value(capsys):
+    """Paired-run significance mirrors the reference's t-test
+    (framework_eval.py:208-215) as an extra JSON key the driver can ignore."""
+    import json
+
+    bench._emit(1.5, p_value=0.04231)
+    out = json.loads(capsys.readouterr().out)
+    assert out["p_value"] == 0.0423
+    assert out["vs_baseline"] == 0.3
+    bench._emit(None, error="x")
+    out = json.loads(capsys.readouterr().out)
+    assert "p_value" not in out
+
+
+def test_overhead_budget_smoke(tmp_path, monkeypatch):
+    """tools/overhead_budget.py runs end to end on CPU: every config row
+    present, marginals computed, markdown written (the real numbers come
+    from the validate_tpu run on chip)."""
+    import os
+
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import overhead_budget as mod
+
+    out = tmp_path / "OVERHEAD_BUDGET.md"
+    table = mod.run_budget(steps=2, reps=1, out=str(out))
+    assert out.is_file() and out.read_text() == table
+    assert "baseline" in table
+    for row in ("procmon @ 10 Hz", "tpumon @ 20 Hz", "xprof trace",
+                "full sofa.profile() stack"):
+        assert row in table, row
+    # every non-baseline row carries a marginal or an explicit unavailable
+    assert table.count(" % |") + table.count("unavailable") >= 7
+
+
 def test_validate_checklist_skips_cpu_smoke(tmp_path, monkeypatch):
     import subprocess
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""Per-collector overhead budget table (VERDICT r2 next #8).
+
+SURVEY §6 lists the overhead *knobs* (sampler rates, tracer levels); the
+reference substantiates its <5 % budget with measured paired runs
+(/root/reference/validation/framework_eval.py) but never publishes the
+marginal cost of each collector.  This measures exactly that: a tiny
+transformer train loop is timed bare, then once per collector config, and
+the marginal overhead of each lands in a markdown table
+(docs/OVERHEAD_BUDGET.md).
+
+Run on the real chip whenever the tunnel is healthy (validate_tpu's
+``overhead_budget`` check calls this); on CPU it still runs end to end so
+the mechanics stay tested, but the numbers only matter on TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _timed(step, state, tokens, n_steps: int, reps: int) -> float:
+    import jax
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        params, opt = state
+        for _ in range(n_steps):
+            params, opt, loss = step(params, opt, tokens)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    return _median(times)
+
+
+def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
+               out: Optional[str] = None) -> str:
+    """Measure marginal per-collector overhead; return the markdown table."""
+    import jax
+
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.workloads.transformer import TransformerConfig, build
+
+    cfg_t = TransformerConfig.tiny(seq=seq)
+    params, opt, step, tokens = build(cfg_t, None, batch=batch, seq=seq)
+    params, opt, loss = step(params, opt, tokens)  # compile once
+    jax.block_until_ready(loss)
+    state = (params, opt)
+
+    scratch = tempfile.mkdtemp(prefix="sofa_budget_") + "/"
+
+    def with_procmon(rate: int):
+        from sofa_tpu.collectors.procmon import ProcMonCollector
+
+        col = ProcMonCollector(SofaConfig(logdir=scratch,
+                                          sys_mon_rate=rate))
+        reason = col.probe()
+        if reason is not None:
+            raise RuntimeError(f"procmon unavailable: {reason}")
+        col.start()
+        return col.stop
+
+    def with_tpumon(rate: int):
+        from sofa_tpu.collectors.tpumon import start_sampler
+
+        ev = threading.Event()
+        start_sampler(rate, scratch + "tpumon.txt", ev)
+        return ev.set
+
+    def with_xprof(python_tracer: bool = False):
+        kwargs = {}
+        try:
+            po = jax.profiler.ProfileOptions()
+            po.host_tracer_level = 2
+            po.python_tracer_level = 1 if python_tracer else 0
+            kwargs["profiler_options"] = po
+        except Exception:  # noqa: BLE001 — older jax: defaults
+            pass
+        d = tempfile.mkdtemp(prefix="xprof_", dir=scratch)
+        jax.profiler.start_trace(d, **kwargs)
+        return jax.profiler.stop_trace
+
+    def with_full_profile():
+        import sofa_tpu.api as sofa
+
+        cm = sofa.profile(scratch + "full/")
+        cm.__enter__()
+        return lambda: cm.__exit__(None, None, None)
+
+    configs: List[Tuple[str, Callable[[], Callable[[], None]]]] = [
+        ("procmon @ 10 Hz (default)", lambda: with_procmon(10)),
+        ("procmon @ 100 Hz", lambda: with_procmon(100)),
+        ("tpumon @ 1 Hz (default)", lambda: with_tpumon(1)),
+        ("tpumon @ 20 Hz", lambda: with_tpumon(20)),
+        ("xprof trace (host_tracer=2)", lambda: with_xprof()),
+        ("xprof + python tracer", lambda: with_xprof(python_tracer=True)),
+        ("full sofa.profile() stack", with_full_profile),
+    ]
+
+    rows = []
+    try:
+        # The bare timing IS the baseline: if it cannot be measured there
+        # is no valid table — never silently promote a collector-laden run.
+        t_bare = _timed(step, state, tokens, steps, reps)
+        rows.append(("bare (no collectors)", t_bare, "baseline"))
+        for name, setup in configs:
+            teardown = None
+            try:
+                teardown = setup()
+                t = _timed(step, state, tokens, steps, reps)
+            except Exception as e:  # noqa: BLE001 — per-config degradation
+                rows.append((name, None, f"unavailable: {e}"))
+                continue
+            finally:
+                if teardown is not None:
+                    try:
+                        teardown()
+                    except Exception:  # noqa: BLE001
+                        pass
+            # signed on purpose: a marginal below the noise floor should
+            # read as such, not as a fake exact zero
+            rows.append((name, t, f"{(t - t_bare) / t_bare * 100:+.2f} %"))
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    lines = [
+        "# Per-collector overhead budget",
+        "",
+        f"Measured {stamp} on backend **{jax.default_backend()}** "
+        f"({len(jax.devices())} device(s)); tiny transformer train loop, "
+        f"batch={batch} seq={seq}, {steps} steps x {reps} reps "
+        "(median), marginal vs bare.",
+        "",
+        "| Collector config | median loop time (s) | marginal overhead |",
+        "|---|---|---|",
+    ]
+    for name, t, note in rows:
+        ts = f"{t:.3f}" if t is not None else "—"
+        lines.append(f"| {name} | {ts} | {note} |")
+    lines.append("")
+    lines.append("Knobs: `--sys_mon_rate`, `--tpu_mon_rate`, "
+                 "`--xprof_host_tracer_level`, `--xprof_python_tracer`; "
+                 "see SURVEY §6.")
+    table = "\n".join(lines) + "\n"
+    if out:
+        os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+        with open(out, "w") as f:
+            f.write(table)
+    return table
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--out", default=None,
+                   help="also write the table here (e.g. "
+                        "docs/OVERHEAD_BUDGET.md)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+
+    print(run_budget(args.steps, args.reps, args.batch, args.seq, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.exit(main())
