@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--skip_preprocess", action="store_true")
     g.add_argument("--with-gui", dest="with_gui", action="store_true", default=False,
                    help="serve the board after `report`")
+    g.add_argument("--perfetto", action="store_true", default=False,
+                   help="`export` also writes trace.json.gz "
+                        "(Trace Event Format, opens in ui.perfetto.dev)")
 
     g = p.add_argument_group("record: host")
     g.add_argument("--perf_events")
@@ -227,8 +230,20 @@ def main(argv=None) -> int:
                 sofa_viz(cfg)
             return 0
         if cmd == "export":
-            from sofa_tpu.export_static import export_static
+            from sofa_tpu.export_static import STATIC_FRAMES, export_static
             print_main_progress("SOFA export")
+            if args.perfetto:
+                # One deserialization pass for both exporters — tputrace is
+                # the pod-scale frame; reading it twice is real money.
+                from sofa_tpu.analyze import load_frames
+                from sofa_tpu.export_perfetto import (
+                    PERFETTO_FRAMES, export_perfetto)
+                frames = load_frames(
+                    cfg, only=sorted(set(STATIC_FRAMES) | set(PERFETTO_FRAMES)))
+                ok = bool(export_static(cfg, frames))
+                # both artifact families were requested; both must land
+                ok = bool(export_perfetto(cfg, frames)) and ok
+                return 0 if ok else 1
             return 0 if export_static(cfg) else 1
         if cmd == "stat":
             if not cfg.command:

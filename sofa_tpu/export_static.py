@@ -28,6 +28,8 @@ from sofa_tpu.printing import print_progress, print_warning
 C1, C2, C3, C4, C5 = "#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4"
 INK, INK2, GRID = "#0b0b0b", "#52514e", "#e5e4e0"
 
+STATIC_FRAMES = ["tpuutil", "mpstat", "netbandwidth", "blktrace", "tputrace"]
+
 
 def _style(ax, title: str, xlabel: str = "time (s)", ylabel: str = ""):
     ax.set_title(title, color=INK, fontsize=10, loc="left")
@@ -183,8 +185,7 @@ def export_static(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None
     if frames is None:
         from sofa_tpu.analyze import load_frames
 
-        frames = load_frames(cfg, only=[
-            "tpuutil", "mpstat", "netbandwidth", "blktrace", "tputrace"])
+        frames = load_frames(cfg, only=STATIC_FRAMES)
 
     written: List[str] = []
     pdf_path = cfg.path("sofa_report.pdf")

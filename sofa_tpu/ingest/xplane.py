@@ -255,18 +255,108 @@ def device_plane_meta(plane) -> Dict[str, float]:
     return out
 
 
+_OP_KEYS = (
+    "timestamp", "event", "duration", "deviceId", "copyKind", "payload",
+    "bandwidth", "name", "category", "hlo_category", "module", "flops",
+    "bytes_accessed", "groups", "phase", "source", "op_path")
+
+
+_OP_STR_KEYS = frozenset(
+    {"name", "hlo_category", "module", "groups", "phase", "source",
+     "op_path"})
+_OP_INT_KEYS = frozenset({"deviceId", "copyKind", "category"})
+
+
+def _native_op_chunk(sl, em, sm, meta_cache, device_id: int, category: int,
+                     base_ns: int, offset_ns: int, time_base: float):
+    """One op line from native scan arrays -> a column chunk, vectorized.
+
+    Metadata-derived fields are computed once per metadata id (exactly the
+    Python loop's cache) and gathered through np.unique's inverse index;
+    per-event work is pure array arithmetic.
+    """
+    mids = sl.metadata_ids
+    uniq, inv = np.unique(mids, return_inverse=True)
+    fields = []
+    for mid in uniq.tolist():
+        name, disp, md = _resolve_event_meta(em, sm, mid, meta_cache)
+        label = _short_op_name(disp)
+        if name != label:
+            # The metadata name is the full HLO instruction — the one
+            # place replica_groups always appears.
+            md = dict(md)
+            md.setdefault("hlo_text", name)
+        fields.append(_derive_op_fields(label, md))
+    n = len(mids)
+    dur_s = sl.durations_ps.astype(np.float64) / 1e12
+    ts = ((base_ns + sl.offsets_ps // 1000 + offset_ns) / 1e9) - time_base
+    kind = np.fromiter((f["kind"] for f in fields), np.int64,
+                       len(fields))[inv]
+    flops = np.fromiter((f["flops"] for f in fields), np.float64,
+                        len(fields))[inv]
+    nbytes = np.fromiter((float(f["nbytes"]) for f in fields), np.float64,
+                         len(fields))[inv]
+
+    def gather(key):
+        return np.asarray([f[key] for f in fields], dtype=object)[inv]
+
+    return {
+        "timestamp": ts,
+        "event": np.arange(n, dtype=np.float64),
+        "duration": dur_s,
+        "deviceId": np.full(n, device_id, np.int64),
+        "copyKind": kind,
+        "payload": np.where(kind != int(CopyKind.KERNEL), nbytes, 0.0),
+        "bandwidth": np.where(dur_s > 0, nbytes / np.where(dur_s > 0,
+                                                           dur_s, 1.0), 0.0),
+        "name": gather("label"),
+        "category": np.full(n, category, np.int64),
+        "hlo_category": gather("hlo_cat"),
+        "flops": flops,
+        "bytes_accessed": nbytes,
+        "groups": gather("groups"),
+        "phase": gather("phase"),
+        "source": gather("source"),
+        "op_path": gather("op_path"),
+    }
+
+
+def _concat_op_chunks(op_chunks: List[Dict[str, object]]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k in _OP_KEYS:
+        parts = []
+        for c in op_chunks:
+            v = c[k]
+            if isinstance(v, np.ndarray):
+                parts.append(v)
+            elif k in _OP_STR_KEYS:
+                parts.append(np.asarray(v, dtype=object))
+            elif k in _OP_INT_KEYS:
+                parts.append(np.asarray(v, dtype=np.int64))
+            else:
+                parts.append(np.asarray(v, dtype=np.float64))
+        out[k] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    return out
+
+
 def xspace_to_frames(
     xspace,
     time_base: float,
     offset_ns: Optional[int] = None,
     host: str = "",
     device_id_base: int = 0,
+    pb_path: Optional[str] = None,
 ) -> Dict[str, pd.DataFrame]:
     """Convert one XSpace into unified-schema frames.
 
     Returns keys: tputrace (HLO ops, sync category=0 / async category=2),
     tpumodules, hosttrace, and device_meta (plane peak-rate stats as a
     plain dict under key "_meta").
+
+    When ``pb_path`` names the serialized source, the native columnar
+    scanner (native/xplane_scan.cc) supplies per-line event arrays and the
+    op frame assembles vectorized; its absence or any layout mismatch
+    falls back to the per-event Python loop with identical output.
     """
     if offset_ns is None:
         offset_ns = find_marker_offset_ns(xspace)
@@ -282,10 +372,17 @@ def xspace_to_frames(
     def to_rel_s(session_ns: int) -> float:
         return (session_ns + offset_ns) / 1e9 - time_base
 
-    op_cols: Dict[str, list] = {k: [] for k in (
-        "timestamp", "event", "duration", "deviceId", "copyKind", "payload",
-        "bandwidth", "name", "category", "hlo_category", "module", "flops",
-        "bytes_accessed", "groups", "phase", "source", "op_path")}
+    native_planes = None
+    if pb_path is not None:
+        from sofa_tpu.ingest import native_scan
+
+        if native_scan.enabled():
+            native_planes = native_scan.scan_file(pb_path, _DERIVED_STAT_KEYS)
+
+    # The op frame accumulates per-line CHUNKS (numpy arrays from the
+    # native path, plain lists from the Python loop); columns concatenate
+    # once at the end.
+    op_chunks: List[Dict[str, object]] = []
     module_rows: List[dict] = []
     host_cols: Dict[str, list] = {k: [] for k in (
         "timestamp", "event", "duration", "tid", "name", "module")}
@@ -349,7 +446,7 @@ def xspace_to_frames(
             span_starts = np.array([s[0] for s in module_spans])
             span_ends = np.array([s[1] for s in module_spans])
             span_names = [s[2] for s in module_spans]
-            plane_op_start = len(op_cols["timestamp"])
+            plane_chunk_start = len(op_chunks)
             sm = plane.stat_metadata
             em = plane.event_metadata
             # Stat ids whose value would change a metadata-derived field;
@@ -358,13 +455,35 @@ def xspace_to_frames(
             # timing stats per event) hit the per-metadata cache.
             derived_ids = {mid for mid, m in sm.items()
                            if m.name in _DERIVED_STAT_KEYS}
-            for line in plane.lines:
+            scan_lines = None
+            if native_planes is not None:
+                for sp in native_planes:
+                    if sp.name == plane.name:
+                        scan_lines = {i: sl for i, sl in enumerate(sp.lines)}
+                        break
+            for line_idx, line in enumerate(plane.lines):
                 if line.name not in ("XLA Ops", "Async XLA Ops"):
                     continue
                 category = 0 if line.name == "XLA Ops" else 2
                 base_ns = line.timestamp_ns
                 meta_cache: Dict[int, tuple] = {}
                 derive_cache: Dict[int, dict] = {}
+
+                sl = scan_lines.get(line_idx) if scan_lines else None
+                if (sl is not None and sl.name == line.name
+                        and len(sl.metadata_ids) == len(line.events)
+                        and not (sl.flags & 1).any()):
+                    # Native fast path: derive once per metadata id, gather
+                    # with the inverse index, no per-event Python objects.
+                    # (flag bit0 = derived per-event stats -> Python loop.)
+                    chunk = _native_op_chunk(
+                        sl, em, sm, meta_cache, device_id, category,
+                        base_ns, offset_ns, time_base)
+                    if chunk is not None:
+                        op_chunks.append(chunk)
+                        continue
+                cols: Dict[str, list] = {k: [] for k in _OP_KEYS
+                                         if k != "module"}
                 for idx, ev in enumerate(line.events):
                     c = derive_cache.get(ev.metadata_id)
                     if c is None:
@@ -386,35 +505,39 @@ def xspace_to_frames(
                     dur_s = ev.duration_ps / 1e12
                     t = to_rel_s(base_ns + ev.offset_ps // 1000)
                     nbytes = c["nbytes"]
-                    op_cols["timestamp"].append(t)
-                    op_cols["event"].append(float(idx))
-                    op_cols["duration"].append(dur_s)
-                    op_cols["deviceId"].append(device_id)
-                    op_cols["copyKind"].append(c["kind"])
-                    op_cols["payload"].append(
+                    cols["timestamp"].append(t)
+                    cols["event"].append(float(idx))
+                    cols["duration"].append(dur_s)
+                    cols["deviceId"].append(device_id)
+                    cols["copyKind"].append(c["kind"])
+                    cols["payload"].append(
                         nbytes if c["kind"] != int(CopyKind.KERNEL) else 0)
-                    op_cols["bandwidth"].append(
+                    cols["bandwidth"].append(
                         (nbytes / dur_s) if dur_s > 0 else 0.0)
-                    op_cols["name"].append(c["label"])
-                    op_cols["category"].append(category)
-                    op_cols["hlo_category"].append(c["hlo_cat"])
-                    op_cols["flops"].append(c["flops"])
-                    op_cols["bytes_accessed"].append(float(nbytes))
-                    op_cols["groups"].append(c["groups"])
-                    op_cols["phase"].append(c["phase"])
-                    op_cols["source"].append(c["source"])
-                    op_cols["op_path"].append(c["op_path"])
+                    cols["name"].append(c["label"])
+                    cols["category"].append(category)
+                    cols["hlo_category"].append(c["hlo_cat"])
+                    cols["flops"].append(c["flops"])
+                    cols["bytes_accessed"].append(float(nbytes))
+                    cols["groups"].append(c["groups"])
+                    cols["phase"].append(c["phase"])
+                    cols["source"].append(c["source"])
+                    cols["op_path"].append(c["op_path"])
+                if cols["timestamp"]:
+                    op_chunks.append(cols)
             # Module attribution for this plane's ops, one vectorized
-            # searchsorted instead of a binary search per event.
-            ts = np.asarray(op_cols["timestamp"][plane_op_start:])
-            if len(ts) and len(span_starts):
-                i = np.searchsorted(span_starts, ts, side="right") - 1
-                valid = (i >= 0) & (ts < span_ends[np.clip(i, 0, None)] + 1e-9)
-                op_cols["module"].extend(
-                    span_names[j] if ok else ""
-                    for j, ok in zip(i, valid))
-            else:
-                op_cols["module"].extend([""] * len(ts))
+            # searchsorted per chunk instead of a binary search per event.
+            for chunk in op_chunks[plane_chunk_start:]:
+                ts = np.asarray(chunk["timestamp"], dtype=np.float64)
+                if len(ts) and len(span_starts):
+                    i = np.searchsorted(span_starts, ts, side="right") - 1
+                    valid = ((i >= 0)
+                             & (ts < span_ends[np.clip(i, 0, None)] + 1e-9))
+                    chunk["module"] = [
+                        span_names[j] if ok else ""
+                        for j, ok in zip(i, valid)]
+                else:
+                    chunk["module"] = [""] * len(ts)
         elif plane.name.startswith("/device:CUSTOM:"):
             # Runtime-defined planes (e.g. "Megascale Trace" — the DCN
             # collective engine on multi-host pods).  Semantics are
@@ -465,11 +588,17 @@ def xspace_to_frames(
                     host_cols["name"].append(disp)
                     host_cols["module"].append(thread_name)
 
-    n_ops = len(op_cols["timestamp"])
-    op_cols["device_kind"] = ["tpu"] * n_ops
+    n_ops = sum(len(c["timestamp"]) for c in op_chunks)
+    op_cols: Dict[str, object] = {}
+    if n_ops:
+        op_cols = _concat_op_chunks(op_chunks)
+        op_cols["device_kind"] = ["tpu"] * n_ops
     n_host = len(host_cols["timestamp"])
     host_cols["device_kind"] = ["host"] * n_host
     host_cols["pid"] = [-1] * n_host
+    # Host-plane rows carry their host's ordinal base (like CUSTOM planes)
+    # so multi-host captures keep per-host host timelines separable.
+    host_cols["deviceId"] = [device_id_base] * n_host
     frames = {
         "tputrace": make_frame(op_cols) if n_ops else empty_frame(),
         "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),
@@ -587,7 +716,8 @@ def _ingest_one(args) -> Tuple[Dict[str, pd.DataFrame], Dict]:
     host = os.path.basename(path).replace(".xplane.pb", "")
     xspace = load_xspace(path)
     frames = xspace_to_frames(
-        xspace, time_base, host=host, device_id_base=host_index * 256
+        xspace, time_base, host=host, device_id_base=host_index * 256,
+        pb_path=path,
     )
     meta = frames.pop("_meta", {})
     return frames, meta
@@ -613,6 +743,12 @@ def ingest_xprof_dir(
     meta: Dict[str, Dict[str, float]] = {}
     jobs = [(p, i, time_base) for i, p in enumerate(paths)]
     results: List = []
+    if jobs:
+        # Build the native scanner ONCE in the parent: pool workers racing
+        # g++ on the same output binary would corrupt it.
+        from sofa_tpu.ingest import native_scan
+
+        native_scan.ensure_scanner()
     serial_from = 0 if len(jobs) <= 1 else None
     if len(jobs) > 1:
         try:
