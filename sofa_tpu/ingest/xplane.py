@@ -321,22 +321,65 @@ def _native_op_chunk(sl, em, sm, meta_cache, device_id: int, category: int,
     }
 
 
-def _concat_op_chunks(op_chunks: List[Dict[str, object]]) -> Dict[str, object]:
+def _concat_chunks(chunks: List[Dict[str, object]], keys, str_keys,
+                   int_keys) -> Dict[str, object]:
     out: Dict[str, object] = {}
-    for k in _OP_KEYS:
+    for k in keys:
         parts = []
-        for c in op_chunks:
+        for c in chunks:
             v = c[k]
             if isinstance(v, np.ndarray):
                 parts.append(v)
-            elif k in _OP_STR_KEYS:
+            elif k in str_keys:
                 parts.append(np.asarray(v, dtype=object))
-            elif k in _OP_INT_KEYS:
+            elif k in int_keys:
                 parts.append(np.asarray(v, dtype=np.int64))
             else:
                 parts.append(np.asarray(v, dtype=np.float64))
         out[k] = parts[0] if len(parts) == 1 else np.concatenate(parts)
     return out
+
+
+_HOST_KEYS = ("timestamp", "event", "duration", "tid", "name", "module")
+
+
+def _scan_lines_for(native_planes, plane_name: str):
+    """The native scan's per-line arrays for one plane, indexed by the
+    line's position (wire order == proto repeated-field order)."""
+    if native_planes is None:
+        return None
+    for sp in native_planes:
+        if sp.name == plane_name:
+            return {i: sl for i, sl in enumerate(sp.lines)}
+    return None
+
+
+def _native_host_chunk(sl, em, sm, cache, lane: int, thread_name: str,
+                       tid: int, base_ns: int, offset_ns: int,
+                       time_base: float):
+    """One host line from native scan arrays -> a column chunk (markers
+    filtered per unique metadata id, like the Python loop)."""
+    mids = sl.metadata_ids
+    uniq, inv = np.unique(mids, return_inverse=True)
+    disps, keep = [], []
+    for mid in uniq.tolist():
+        name, disp, _md = _resolve_event_meta(em, sm, mid, cache)
+        disps.append(disp)
+        keep.append(_MARKER_RE.search(name) is None)
+    mask = np.asarray(keep, dtype=bool)[inv]
+    n = int(mask.sum())
+    if n == 0:
+        return None
+    ts = ((base_ns + sl.offsets_ps[mask] // 1000 + offset_ns) / 1e9) \
+        - time_base
+    return {
+        "timestamp": ts,
+        "event": np.full(n, float(lane)),
+        "duration": sl.durations_ps[mask].astype(np.float64) / 1e12,
+        "tid": np.full(n, tid, np.int64),
+        "name": np.asarray(disps, dtype=object)[inv][mask],
+        "module": [thread_name] * n,
+    }
 
 
 def xspace_to_frames(
@@ -384,8 +427,7 @@ def xspace_to_frames(
     # once at the end.
     op_chunks: List[Dict[str, object]] = []
     module_rows: List[dict] = []
-    host_cols: Dict[str, list] = {k: [] for k in (
-        "timestamp", "event", "duration", "tid", "name", "module")}
+    host_chunks: List[Dict[str, object]] = []
     step_rows: List[dict] = []
     custom_rows: List[dict] = []
     meta: Dict[str, Dict[str, float]] = {}
@@ -455,12 +497,7 @@ def xspace_to_frames(
             # timing stats per event) hit the per-metadata cache.
             derived_ids = {mid for mid, m in sm.items()
                            if m.name in _DERIVED_STAT_KEYS}
-            scan_lines = None
-            if native_planes is not None:
-                for sp in native_planes:
-                    if sp.name == plane.name:
-                        scan_lines = {i: sl for i, sl in enumerate(sp.lines)}
-                        break
+            scan_lines = _scan_lines_for(native_planes, plane.name)
             for line_idx, line in enumerate(plane.lines):
                 if line.name not in ("XLA Ops", "Async XLA Ops"):
                     continue
@@ -570,35 +607,53 @@ def xspace_to_frames(
             # flagged the old len(name)%97 hash as meaningless).
             em = plane.event_metadata
             sm = plane.stat_metadata
+            scan_lines = _scan_lines_for(native_planes, plane.name)
             for lane, line in enumerate(plane.lines):
                 thread_name = line.name or str(line.id)
                 base_ns = line.timestamp_ns
                 tid = int(line.id)
                 cache: Dict[int, tuple] = {}
+                sl = scan_lines.get(lane) if scan_lines else None
+                if (sl is not None and sl.name == line.name
+                        and len(sl.metadata_ids) == len(line.events)):
+                    chunk = _native_host_chunk(
+                        sl, em, sm, cache, lane, thread_name, tid, base_ns,
+                        offset_ns, time_base)
+                    if chunk is not None:
+                        host_chunks.append(chunk)
+                    continue
+                cols: Dict[str, list] = {k: [] for k in _HOST_KEYS}
                 for ev in line.events:
                     name, disp, _md = _resolve_event_meta(
                         em, sm, ev.metadata_id, cache)
                     if _MARKER_RE.search(name):
                         continue
-                    host_cols["timestamp"].append(
+                    cols["timestamp"].append(
                         to_rel_s(base_ns + ev.offset_ps // 1000))
-                    host_cols["event"].append(float(lane))
-                    host_cols["duration"].append(ev.duration_ps / 1e12)
-                    host_cols["tid"].append(tid)
-                    host_cols["name"].append(disp)
-                    host_cols["module"].append(thread_name)
+                    cols["event"].append(float(lane))
+                    cols["duration"].append(ev.duration_ps / 1e12)
+                    cols["tid"].append(tid)
+                    cols["name"].append(disp)
+                    cols["module"].append(thread_name)
+                if cols["timestamp"]:
+                    host_chunks.append(cols)
 
     n_ops = sum(len(c["timestamp"]) for c in op_chunks)
     op_cols: Dict[str, object] = {}
     if n_ops:
-        op_cols = _concat_op_chunks(op_chunks)
+        op_cols = _concat_chunks(op_chunks, _OP_KEYS, _OP_STR_KEYS,
+                                 _OP_INT_KEYS)
         op_cols["device_kind"] = ["tpu"] * n_ops
-    n_host = len(host_cols["timestamp"])
-    host_cols["device_kind"] = ["host"] * n_host
-    host_cols["pid"] = [-1] * n_host
-    # Host-plane rows carry their host's ordinal base (like CUSTOM planes)
-    # so multi-host captures keep per-host host timelines separable.
-    host_cols["deviceId"] = [device_id_base] * n_host
+    n_host = sum(len(c["timestamp"]) for c in host_chunks)
+    host_cols: Dict[str, object] = {}
+    if n_host:
+        host_cols = _concat_chunks(host_chunks, _HOST_KEYS,
+                                   {"name", "module"}, {"tid"})
+        host_cols["device_kind"] = ["host"] * n_host
+        host_cols["pid"] = [-1] * n_host
+        # Host-plane rows carry their host's ordinal base (like CUSTOM
+        # planes) so multi-host captures keep per-host timelines separable.
+        host_cols["deviceId"] = [device_id_base] * n_host
     frames = {
         "tputrace": make_frame(op_cols) if n_ops else empty_frame(),
         "tpumodules": make_frame(module_rows) if module_rows else empty_frame(),

@@ -92,6 +92,23 @@ def test_ingest_equivalence_real_fixture(tmp_path, monkeypatch, scanner):
     _assert_frames_equal(frames_native, frames_py)
 
 
+def test_ingest_equivalence_host_plane_fixture(tmp_path, monkeypatch,
+                                               scanner):
+    """The host-plane fast path (marker filtering, thread lanes) against
+    the real CPU capture with step annotations."""
+    prof = tmp_path / "xprof" / "plugins" / "profile" / "run1"
+    prof.mkdir(parents=True)
+    shutil.copy(TPU_FIXTURE.replace("tpu_device", "cpu_host"),
+                prof / "host.xplane.pb")
+    frames_native, frames_py, _ = _ingest_both_ways(
+        str(tmp_path / "xprof"), monkeypatch)
+    assert not frames_native["hosttrace"].empty
+    names = set(frames_native["hosttrace"]["name"])
+    assert "sofa_step_0" in names
+    assert not any("sofa_timebase_marker" in n for n in names)
+    _assert_frames_equal(frames_native, frames_py)
+
+
 def test_event_level_stats_fall_back_identically(tmp_path, monkeypatch,
                                                  scanner):
     """Synthetic traces put derived stats on the EVENT (not its metadata);
