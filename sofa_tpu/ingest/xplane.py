@@ -804,8 +804,23 @@ def ingest_xprof_dir(
         from sofa_tpu.ingest import native_scan
 
         native_scan.ensure_scanner()
-    serial_from = 0 if len(jobs) <= 1 else None
-    if len(jobs) > 1:
+    # Pool policy: worker spawn costs seconds (forkserver + pandas import),
+    # so the pool must EARN it.  With the native scanner a small host file
+    # parses in well under a second — only many files or real pod-scale
+    # bytes amortize the spawn.  SOFA_INGEST_POOL=always|never overrides
+    # (tests force `always` to keep the pool path covered).
+    policy = os.environ.get("SOFA_INGEST_POOL", "auto")
+    total_bytes = 0
+    for p, _, _ in jobs:
+        try:
+            total_bytes += os.path.getsize(p)
+        except OSError:
+            pass
+    use_pool = len(jobs) > 1 and policy != "never" and (
+        policy == "always" or len(jobs) >= 12
+        or total_bytes >= 48 * 2 ** 20)
+    serial_from = None if use_pool else 0
+    if use_pool:
         try:
             import multiprocessing as mp
             from concurrent.futures import BrokenExecutor, ProcessPoolExecutor

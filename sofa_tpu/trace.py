@@ -216,6 +216,20 @@ def make_frame(rows_or_cols) -> pd.DataFrame:
 
 
 def write_csv(df: pd.DataFrame, path: str) -> None:
+    # pyarrow's CSV writer is several times faster than pandas' for the
+    # pod-scale op frame, with the same quoting contract (quote only when
+    # needed — the board's splitCSVLine handles either).  Any conversion
+    # surprise falls back to pandas.
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        pacsv.write_csv(table, path,
+                        pacsv.WriteOptions(quoting_style="needed"))
+        return
+    except Exception:  # noqa: BLE001 — formatting fallback, never fatal
+        pass
     df.to_csv(path, index=False)
 
 
@@ -224,8 +238,14 @@ def _conform(df: pd.DataFrame) -> pd.DataFrame:
         if col not in df.columns:
             df[col] = _DEFAULTS[col]
     for col, default in _DEFAULTS.items():
-        if isinstance(default, str) and col in df.columns:
+        if col not in df.columns:
+            continue
+        if isinstance(default, str):
             df[col] = df[col].fillna("").astype(str)
+        elif isinstance(default, float) and df[col].dtype.kind != "f":
+            # Whole-valued float columns round-trip as ints through CSV
+            # inference; schema dtype wins so save/load never flips dtypes.
+            df[col] = df[col].astype("float64")
     return df[COLUMNS]
 
 

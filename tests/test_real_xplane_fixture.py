@@ -150,14 +150,17 @@ def test_real_capture_drives_marker_iterations(xspace):
     assert all(e > b for b, e in zip(begins, ends))
 
 
-def test_multihost_parallel_ingest(tmp_path, capsys):
+def test_multihost_parallel_ingest(tmp_path, capsys, monkeypatch):
     """N per-host .xplane.pb files ingest through the process pool with
     per-host deviceId offsets; a corrupt file degrades without killing the
-    pool's completed work."""
+    pool's completed work.  (Pool forced on: the auto policy would go
+    serial for four tiny fixture files.)"""
     import shutil
     import time
 
     from sofa_tpu.ingest.xplane import ingest_xprof_dir
+
+    monkeypatch.setenv("SOFA_INGEST_POOL", "always")
 
     prof = tmp_path / "xprof" / "plugins" / "profile" / "run1"
     prof.mkdir(parents=True)
