@@ -11,6 +11,7 @@ CLI (/root/reference/bin/sofa:328-376):
   stat "cmd"        record + preprocess + analyze
   diff              preprocess base/match logdirs + swarm diff
   export            static sofa_report.pdf/overview.png for headless sharing
+  top               live terminal dashboard over a running recording
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -48,7 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "export", "clean", "setup",
+        "export", "top", "clean", "setup",
     ])
     p.add_argument("usr_command", nargs="?", default="", help="command to profile (record/stat)")
 
@@ -62,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--perfetto", action="store_true", default=False,
                    help="`export` also writes trace.json.gz "
                         "(Trace Event Format, opens in ui.perfetto.dev)")
+    g.add_argument("--folded", action="store_true", default=False,
+                   help="`export` also writes *.folded collapsed stacks "
+                        "(speedscope.app / flamegraph.pl)")
+    g.add_argument("--interval", type=float, default=2.0,
+                   help="`top` refresh period in seconds")
+    g.add_argument("--once", action="store_true", default=False,
+                   help="`top` renders one frame and exits")
 
     g = p.add_argument_group("record: host")
     g.add_argument("--perf_events")
@@ -232,19 +240,33 @@ def main(argv=None) -> int:
         if cmd == "export":
             from sofa_tpu.export_static import STATIC_FRAMES, export_static
             print_main_progress("SOFA export")
+            wanted = set(STATIC_FRAMES)
             if args.perfetto:
-                # One deserialization pass for both exporters — tputrace is
-                # the pod-scale frame; reading it twice is real money.
-                from sofa_tpu.analyze import load_frames
                 from sofa_tpu.export_perfetto import (
                     PERFETTO_FRAMES, export_perfetto)
-                frames = load_frames(
-                    cfg, only=sorted(set(STATIC_FRAMES) | set(PERFETTO_FRAMES)))
+                wanted |= set(PERFETTO_FRAMES)
+            if args.folded:
+                from sofa_tpu.export_folded import (
+                    FOLDED_FRAMES, export_folded)
+                wanted |= set(FOLDED_FRAMES)
+            if args.perfetto or args.folded:
+                # One deserialization pass for every exporter — tputrace is
+                # the pod-scale frame; reading it twice is real money.
+                from sofa_tpu.analyze import load_frames
+                frames = load_frames(cfg, only=sorted(wanted))
                 ok = bool(export_static(cfg, frames))
-                # both artifact families were requested; both must land
-                ok = bool(export_perfetto(cfg, frames)) and ok
+                # every requested artifact family must land...
+                if args.perfetto:
+                    ok = bool(export_perfetto(cfg, frames)) and ok
+                if args.folded:
+                    # ...except folded stacks, which are legitimately absent
+                    # when no stack sampler ran
+                    export_folded(cfg, frames)
                 return 0 if ok else 1
             return 0 if export_static(cfg) else 1
+        if cmd == "top":
+            from sofa_tpu.top import sofa_top
+            return sofa_top(cfg, interval=args.interval, once=args.once)
         if cmd == "stat":
             if not cfg.command:
                 print_error('stat needs a command: sofa stat "python train.py"')

@@ -1,0 +1,82 @@
+"""Folded-stack export (`sofa export --folded`) for flame tooling.
+
+Writes Brendan-Gregg-format collapsed stacks — ``frame;frame;leaf count``
+per line — the lingua franca of speedscope.app, flamegraph.pl, and
+inferno, so sampled stacks from a sofa capture drop straight into the
+ecosystem's flame-graph viewers:
+
+  pystacks.folded — the in-process Python sampler's FULL stacks
+                    (collectors/pystacks.py stores them in `module`)
+  cputrace.folded — perf samples; the parser keeps the leaf plus up to 3
+                    callers ("leaf<-c1<-c2"), exported caller-first as a
+                    partial stack
+
+The reference has no flame-graph path at all; its closest artifact is the
+hsg swarm clustering over the same samples.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from sofa_tpu.printing import print_progress, print_warning
+
+FOLDED_FRAMES = ["pystacks", "cputrace"]
+
+
+def _fold_pystacks(df: pd.DataFrame) -> Counter:
+    # module carries the full semicolon stack, root-first
+    return Counter(s for s in df["module"] if s)
+
+
+def _fold_cputrace(df: pd.DataFrame) -> Counter:
+    counts: Counter = Counter()
+    for name in df["name"]:
+        if not name:
+            continue
+        # "leaf<-caller1<-caller2" -> "caller2;caller1;leaf"
+        frames = str(name).split("<-")
+        counts[";".join(reversed(frames))] += 1
+    return counts
+
+
+def _write(counts: Counter, path: str) -> bool:
+    if not counts:
+        return False
+    with open(path, "w") as f:
+        for stack, n in counts.most_common():
+            f.write(f"{stack} {n}\n")
+    return True
+
+
+def export_folded(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None
+                  ) -> List[str]:
+    """Write *.folded files into the logdir; returns the paths written."""
+    if frames is None:
+        from sofa_tpu.analyze import load_frames
+
+        frames = load_frames(cfg, only=FOLDED_FRAMES)
+    written: List[str] = []
+    jobs = (
+        ("pystacks", _fold_pystacks),
+        ("cputrace", _fold_cputrace),
+    )
+    for name, fold in jobs:
+        df = frames.get(name)
+        if df is None or df.empty:
+            continue
+        path = cfg.path(f"{name}.folded")
+        if _write(fold(df), path):
+            written.append(path)
+    if written:
+        print_progress(
+            "folded stacks -> " + ", ".join(written)
+            + "  (open in speedscope.app / flamegraph.pl)")
+    else:
+        print_warning("folded export: no sampled stacks in this capture "
+                      "(--enable_py_stacks / perf)")
+    return written

@@ -1,0 +1,181 @@
+"""`sofa top` — live terminal dashboard over a recording logdir.
+
+The nvidia-smi / `nvidia-smi dmon` habit, TPU-side: while `sofa record`
+(or any sofa.profile-instrumented process) runs, its samplers append
+tpumon.txt (per-device HBM + liveness heartbeat) and the procmon text
+files (mpstat/netstat/diskstat); `sofa top` tails those files and redraws
+a compact ANSI dashboard every --interval seconds.  `--once` renders a
+single frame and exits (what the tests drive).
+
+The reference had no equivalent — nvidia-smi itself played this role and
+sofa only recorded it; on TPU hosts there is no vendor tool to lean on,
+so the dashboard ships with the profiler.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+import pandas as pd
+
+from sofa_tpu.ingest import procfs
+from sofa_tpu.printing import print_error
+
+_BAR_W = 24
+
+
+def _bar(pct: float) -> str:
+    pct = min(max(pct, 0.0), 100.0)
+    fill = int(round(pct / 100.0 * _BAR_W))
+    return "[" + "#" * fill + "-" * (_BAR_W - fill) + "]"
+
+
+def _fmt_bytes_rate(bps: float) -> str:
+    for unit, div in (("GiB/s", 2 ** 30), ("MiB/s", 2 ** 20),
+                      ("KiB/s", 2 ** 10)):
+        if bps >= div:
+            return f"{bps / div:.1f} {unit}"
+    return f"{bps:.0f} B/s"
+
+
+def _latest(df: pd.DataFrame) -> pd.DataFrame:
+    """Rows of the newest sample timestamp (procfs parsers emit absolute
+    timestamps when time_base=0)."""
+    if df.empty:
+        return df
+    return df[df["timestamp"] == df["timestamp"].max()]
+
+
+def _tail_load(path: str, parser, max_bytes: int = 65536) -> pd.DataFrame:
+    """Parse only the file's tail: sampler files grow for the lifetime of
+    a multi-hour recording and a dashboard tick needs just the last two
+    samples per core/iface/device.  The first (possibly partial) line of
+    the window is dropped."""
+    if not os.path.isfile(path):
+        from sofa_tpu.trace import empty_frame
+
+        return empty_frame()
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        text = f.read().decode(errors="replace")
+    if size > max_bytes:
+        text = text.split("\n", 1)[-1]
+    return parser(text, time_base=0.0)
+
+
+def _tpu_lines(logdir: str, now: float) -> List[str]:
+    path = os.path.join(logdir, "tpumon.txt")
+    if not os.path.isfile(path):
+        return ["TPU    no tpumon.txt (enable_tpu_mon off, or nothing "
+                "recording yet)"]
+    # Tail, not full read: the file grows for the lifetime of a long run.
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        f.seek(max(0, f.tell() - 16384))
+        text = f.read().decode(errors="replace")
+    latest = {}
+    beat_ns = None
+    for line in text.splitlines():
+        p = line.split()
+        if len(p) != 5:
+            continue
+        try:
+            ts_ns, dev, used, limit, peak = (int(x) for x in p)
+        except ValueError:
+            continue
+        if dev == -1:
+            beat_ns = ts_ns
+        else:
+            latest[dev] = (ts_ns, used, limit, peak)
+    out = []
+    for dev in sorted(latest):
+        ts_ns, used, limit, peak = latest[dev]
+        if limit:
+            occ = 100.0 * used / limit
+            out.append(
+                f"tpu{dev}   hbm {used / 1e9:6.2f}/{limit / 1e9:.2f} GB "
+                f"{_bar(occ)} {occ:5.1f}%  peak {peak / 1e9:.2f} GB")
+        else:  # CPU backend / runtimes that report no bytes_limit
+            out.append(
+                f"tpu{dev}   hbm {used / 1e9:6.2f} GB (no limit reported)"
+                f"  peak {peak / 1e9:.2f} GB")
+    if beat_ns is not None:
+        age = max(0.0, now - beat_ns / 1e9)
+        health = "live" if age < 5.0 else f"STALE ({age:.0f}s)"
+        out.append(f"tpu    heartbeat {age:4.1f}s ago — {health}")
+    return out or ["TPU    tpumon.txt has no samples yet"]
+
+
+def _cpu_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "mpstat.txt"), procfs.parse_mpstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    vals = {n: float(rows[rows["name"] == n]["event"].mean())
+            for n in ("usr", "sys", "iow", "idl")
+            if not rows[rows["name"] == n].empty}
+    busy = 100.0 - vals.get("idl", 100.0)
+    return (f"cpu    {_bar(busy)} {busy:5.1f}%  "
+            + "  ".join(f"{n} {vals[n]:4.1f}%" for n in ("usr", "sys", "iow")
+                        if n in vals))
+
+
+def _net_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "netstat.txt"),
+                    procfs.parse_netstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    parts = []
+    for name, sel in rows.groupby("name"):
+        parts.append(f"{name} {_fmt_bytes_rate(float(sel['event'].sum()))}")
+    return "net    " + "  ".join(sorted(parts)[:6])
+
+
+def _disk_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "diskstat.txt"),
+                    procfs.parse_diskstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    # parse_diskstat emits <dev>.r_bw / <dev>.w_bw (bytes/s)
+    rd = float(rows[rows["name"].str.endswith(".r_bw")]["event"].sum())
+    wr = float(rows[rows["name"].str.endswith(".w_bw")]["event"].sum())
+    return (f"disk   read {_fmt_bytes_rate(rd)}  "
+            f"write {_fmt_bytes_rate(wr)}")
+
+
+def render_frame(logdir: str, now: Optional[float] = None) -> str:
+    now = time.time() if now is None else now
+    stamp = time.strftime("%H:%M:%S", time.localtime(now))
+    lines = [f"sofa top — {logdir}   {stamp}"]
+    lines += _tpu_lines(logdir, now)
+    for maker in (_cpu_line, _net_line, _disk_line):
+        line = maker(logdir)
+        if line:
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def sofa_top(cfg, interval: float = 2.0, once: bool = False) -> int:
+    interval = max(float(interval), 0.1)  # 0/negative would spin or raise
+    if not os.path.isdir(cfg.logdir):
+        print_error(f"logdir {cfg.logdir} does not exist — start a "
+                    "`sofa record` first")
+        return 1
+    if once:
+        print(render_frame(cfg.logdir))
+        return 0
+    try:
+        while True:
+            frame = render_frame(cfg.logdir)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,111 @@
+"""`sofa top` live dashboard + folded-stack export."""
+
+import os
+import subprocess
+import sys
+import time
+
+from sofa_tpu.config import SofaConfig
+
+
+def _seed_logdir(d):
+    """A logdir mid-recording: tpumon ticks, two mpstat/netstat samples."""
+    now_ns = int(time.time() * 1e9)
+    with open(os.path.join(d, "tpumon.txt"), "w") as f:
+        f.write(f"{now_ns - 1_000_000_000} -1 0 0 0\n")
+        f.write(f"{now_ns - 1_000_000_000} 0 4000000000 16000000000 "
+                f"5000000000\n")
+        f.write(f"{now_ns} -1 0 0 0\n")
+        f.write(f"{now_ns} 0 8000000000 16000000000 9000000000\n")
+    now = time.time()
+    with open(os.path.join(d, "mpstat.txt"), "w") as f:
+        # <ts> cpu<id> usr nice sys idle iowait irq sirq steal (jiffies)
+        f.write(f"{now - 1} cpu0 100 0 50 800 10 0 0 0\n")
+        f.write(f"{now} cpu0 160 0 70 820 12 0 0 0\n")
+    with open(os.path.join(d, "netstat.txt"), "w") as f:
+        # <ts> <iface> rx_bytes tx_bytes rx_pkts tx_pkts
+        f.write(f"{now - 1} eth0 1000000 2000000 10 20\n")
+        f.write(f"{now} eth0 5000000 4000000 40 50\n")
+    with open(os.path.join(d, "diskstat.txt"), "w") as f:
+        # <ts> <dev> rd_ios rd_sec rd_ms wr_ios wr_sec wr_ms inflight
+        f.write(f"{now - 1} sda 10 2048 5 20 4096 9 0\n")
+        f.write(f"{now} sda 30 6144 9 40 12288 15 0\n")
+
+
+def test_top_render_frame(tmp_path):
+    from sofa_tpu.top import render_frame
+
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    _seed_logdir(d)
+    frame = render_frame(d)
+    assert "sofa top" in frame
+    # newest tpumon tick wins: 8/16 GB = 50 %
+    assert "tpu0" in frame and "8.00/16.00 GB" in frame
+    assert "50.0%" in frame and "peak 9.00 GB" in frame
+    assert "heartbeat" in frame and "live" in frame
+    assert "cpu" in frame and "usr" in frame
+    assert "net" in frame and "eth0" in frame
+    # diskstat deltas: (6144-2048)*512 B read over ~1s -> ~2.0 MiB/s
+    assert "disk" in frame and "read 2.0 MiB/s" in frame
+
+
+def test_top_stale_heartbeat_flags(tmp_path):
+    from sofa_tpu.top import render_frame
+
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    old_ns = int((time.time() - 60) * 1e9)
+    with open(os.path.join(d, "tpumon.txt"), "w") as f:
+        f.write(f"{old_ns} -1 0 0 0\n")
+        f.write(f"{old_ns} 0 1000000000 16000000000 1000000000\n")
+    frame = render_frame(d)
+    assert "STALE" in frame
+
+
+def test_top_cli_once(tmp_path):
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    _seed_logdir(d)
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "top", "--logdir", d + "/",
+         "--once"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "tpu0" in r.stdout
+    # missing logdir is a clean error, not a traceback
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "top", "--logdir",
+         str(tmp_path / "nope") + "/", "--once"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 1
+    assert "Traceback" not in r.stderr
+
+
+def test_export_folded(tmp_path):
+    from sofa_tpu.export_folded import export_folded
+    from sofa_tpu.trace import make_frame, write_csv
+
+    d = str(tmp_path / "run") + "/"
+    os.makedirs(d)
+    write_csv(make_frame([
+        {"timestamp": 0.1, "tid": 1, "name": "leaf_a", "event": 3.0,
+         "module": "main;train;leaf_a", "device_kind": "cpu"},
+        {"timestamp": 0.2, "tid": 1, "name": "leaf_a", "event": 3.0,
+         "module": "main;train;leaf_a", "device_kind": "cpu"},
+        {"timestamp": 0.3, "tid": 1, "name": "leaf_b", "event": 2.0,
+         "module": "main;leaf_b", "device_kind": "cpu"},
+    ]), d + "pystacks.csv")
+    write_csv(make_frame([
+        {"timestamp": 0.1, "pid": 9, "name": "do_work<-caller<-outer",
+         "device_kind": "cpu"},
+    ]), d + "cputrace.csv")
+    written = export_folded(SofaConfig(logdir=d))
+    assert d + "pystacks.folded" in written
+    py = open(d + "pystacks.folded").read().splitlines()
+    assert py[0] == "main;train;leaf_a 2"      # most common first
+    assert "main;leaf_b 1" in py
+    cpu = open(d + "cputrace.folded").read().splitlines()
+    assert cpu == ["outer;caller;do_work 1"]   # caller-first order
