@@ -145,6 +145,24 @@ def generate_hints(features: Features, cfg) -> List[str]:
             " fuse small transfers"
         )
 
+    gaps = features.by_regex(r"tpu\d+_step_gap_pct")
+    if gaps:
+        name, gap = max(gaps, key=lambda nv: nv[1])
+        dev = name.split("_", 1)[0]
+        if gap > 15.0:
+            h2d = get(f"{dev}_step_h2d_pct") or 0.0
+            cause = (
+                f"host->device transfers cover {h2d:.0f}% of step time — the"
+                " input pipeline is the likely gate; prefetch batches to"
+                " device (double-buffer) or move preprocessing off the host"
+                if h2d > 0.2 * gap else
+                "little H2D activity fills the gaps — look at collective"
+                " waits, host callbacks, or synchronous eval between steps")
+            hints.append(
+                f"device idle inside steps on {dev}: TensorCore covers only"
+                f" {100.0 - gap:.0f}% of step time — {cause}"
+                " (see tpu_input_pipeline.csv)")
+
     skew = get("step_skew_mean")
     step_mean = get("step_time_mean") or get("aisi_step_time_mean")
     if skew is not None and step_mean and skew > 0.05 * step_mean:

@@ -44,6 +44,7 @@ _PASSES = [
     ("op_tree_profile", tpu.op_tree_profile),
     ("overlap_profile", tpu.overlap_profile),
     ("step_skew_profile", tpu.step_skew_profile),
+    ("input_pipeline_profile", tpu.input_pipeline_profile),
     ("roofline_profile", tpu.roofline_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
     ("tpumon_profile", tpu.tpumon_profile),

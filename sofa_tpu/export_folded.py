@@ -38,8 +38,13 @@ def _fold_cputrace(df: pd.DataFrame) -> Counter:
     for name in df["name"]:
         if not name:
             continue
-        # "leaf<-caller1<-caller2" -> "caller2;caller1;leaf"
-        frames = str(name).split("<-")
+        # perf_script names are "leaf<-caller1<-caller2 @ dso" where the
+        # dso annotates the LEAF; split it off first or it sticks to the
+        # outermost caller and fragments identical stacks.
+        name, _, dso = str(name).partition(" @ ")
+        frames = name.split("<-")
+        if dso:
+            frames[0] = f"{frames[0]} [{dso}]"
         counts[";".join(reversed(frames))] += 1
     return counts
 

@@ -24,16 +24,27 @@ import pandas as pd
 from sofa_tpu.trace import empty_frame, make_frame
 
 
+def parse_tpumon_line(line: str):
+    """One sampler line -> (ts_ns, dev, used, limit, peak) or None.
+
+    The single place that knows the 5-field format — parse_tpumon and the
+    `sofa top` dashboard both go through it."""
+    parts = line.split()
+    if len(parts) != 5:
+        return None
+    try:
+        return tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+
+
 def parse_tpumon(text: str, time_base: float = 0.0) -> pd.DataFrame:
     rows = []
     for line in text.splitlines():
-        parts = line.split()
-        if len(parts) != 5:
+        parsed = parse_tpumon_line(line)
+        if parsed is None:
             continue
-        try:
-            ts_ns, dev, used, limit, peak = (int(p) for p in parts)
-        except ValueError:
-            continue
+        ts_ns, dev, used, limit, peak = parsed
         t = ts_ns / 1e9 - time_base
         if dev == -1:
             rows.append(

@@ -49,15 +49,12 @@ def _latest(df: pd.DataFrame) -> pd.DataFrame:
     return df[df["timestamp"] == df["timestamp"].max()]
 
 
-def _tail_load(path: str, parser, max_bytes: int = 65536) -> pd.DataFrame:
-    """Parse only the file's tail: sampler files grow for the lifetime of
-    a multi-hour recording and a dashboard tick needs just the last two
-    samples per core/iface/device.  The first (possibly partial) line of
-    the window is dropped."""
+def _tail_text(path: str, max_bytes: int = 65536) -> Optional[str]:
+    """The file's tail window, first (possibly partial) line dropped:
+    sampler files grow for the lifetime of a multi-hour recording and a
+    dashboard tick needs just the last samples."""
     if not os.path.isfile(path):
-        from sofa_tpu.trace import empty_frame
-
-        return empty_frame()
+        return None
     with open(path, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
@@ -65,29 +62,32 @@ def _tail_load(path: str, parser, max_bytes: int = 65536) -> pd.DataFrame:
         text = f.read().decode(errors="replace")
     if size > max_bytes:
         text = text.split("\n", 1)[-1]
+    return text
+
+
+def _tail_load(path: str, parser, max_bytes: int = 65536) -> pd.DataFrame:
+    text = _tail_text(path, max_bytes)
+    if text is None:
+        from sofa_tpu.trace import empty_frame
+
+        return empty_frame()
     return parser(text, time_base=0.0)
 
 
 def _tpu_lines(logdir: str, now: float) -> List[str]:
-    path = os.path.join(logdir, "tpumon.txt")
-    if not os.path.isfile(path):
+    from sofa_tpu.ingest.tpumon_parse import parse_tpumon_line
+
+    text = _tail_text(os.path.join(logdir, "tpumon.txt"))
+    if text is None:
         return ["TPU    no tpumon.txt (enable_tpu_mon off, or nothing "
                 "recording yet)"]
-    # Tail, not full read: the file grows for the lifetime of a long run.
-    with open(path, "rb") as f:
-        f.seek(0, os.SEEK_END)
-        f.seek(max(0, f.tell() - 16384))
-        text = f.read().decode(errors="replace")
     latest = {}
     beat_ns = None
     for line in text.splitlines():
-        p = line.split()
-        if len(p) != 5:
+        parsed = parse_tpumon_line(line)
+        if parsed is None:
             continue
-        try:
-            ts_ns, dev, used, limit, peak = (int(x) for x in p)
-        except ValueError:
-            continue
+        ts_ns, dev, used, limit, peak = parsed
         if dev == -1:
             beat_ns = ts_ns
         else:

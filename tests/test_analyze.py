@@ -481,6 +481,58 @@ def test_step_skew_single_device_noop(cfg):
     assert feats.get("step_skew_max") is None
 
 
+def test_input_pipeline_profile(cfg):
+    """Two 1s steps; compute covers 60% of each, an H2D copy sits in the
+    gap -> gap 40%, h2d 30%, and the input-bound hint fires."""
+    steps, ops = [], []
+    for k in range(2):
+        t0 = k * 1.0
+        steps.append({"timestamp": t0, "event": float(k), "duration": 1.0,
+                      "deviceId": 0, "name": f"step {k}",
+                      "device_kind": "tpu"})
+        ops.append({"timestamp": t0, "duration": 0.6, "deviceId": 0,
+                    "category": 0, "name": "fusion.1", "device_kind": "tpu"})
+        ops.append({"timestamp": t0 + 0.65, "duration": 0.3, "deviceId": 0,
+                    "category": 2, "copyKind": 1, "name": "copy.2",
+                    "device_kind": "tpu"})
+    frames = {"tpusteps": make_frame(steps), "tputrace": make_frame(ops)}
+    feats = Features()
+    tpu.input_pipeline_profile(frames, cfg, feats)
+    assert feats.get("tpu0_step_gap_pct") == pytest.approx(40.0, rel=1e-3)
+    assert feats.get("tpu0_step_h2d_pct") == pytest.approx(30.0, rel=1e-3)
+    table = pd.read_csv(cfg.path("tpu_input_pipeline.csv"))
+    assert len(table) == 2
+    assert table["busy_pct"].iloc[0] == pytest.approx(60.0, rel=1e-3)
+    assert table["h2d_ms"].iloc[0] == pytest.approx(300.0, rel=1e-3)
+
+    hints = advice.generate_hints(feats, cfg)
+    assert any("input pipeline" in h and "tpu0" in h for h in hints)
+
+    # steps outside the ROI must not score as pure gap (false input-bound)
+    cfg.roi_begin, cfg.roi_end = 0.0, 0.95
+    try:
+        feats_roi = Features()
+        tpu.input_pipeline_profile(frames, cfg, feats_roi)
+        roi_table = pd.read_csv(cfg.path("tpu_input_pipeline.csv"))
+        assert len(roi_table) == 1
+    finally:
+        cfg.roi_begin = cfg.roi_end = 0.0
+
+    # busy steps -> no gap feature worth hinting
+    feats2 = Features()
+    feats2.add("tpu0_step_gap_pct", 5.0)
+    feats2.add("tpu0_step_h2d_pct", 1.0)
+    assert not any("device idle inside steps" in h
+                   for h in advice.generate_hints(feats2, cfg))
+
+    # gap WITHOUT h2d activity points away from the input pipeline
+    feats3 = Features()
+    feats3.add("tpu0_step_gap_pct", 40.0)
+    feats3.add("tpu0_step_h2d_pct", 1.0)
+    hints3 = advice.generate_hints(feats3, cfg)
+    assert any("collective waits" in h for h in hints3)
+
+
 def test_advice_overlap_and_skew_hints(cfg):
     feats = Features()
     feats.add("tpu0_async_hidden_pct", 20.0)

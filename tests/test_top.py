@@ -101,6 +101,8 @@ def test_export_folded(tmp_path):
     write_csv(make_frame([
         {"timestamp": 0.1, "pid": 9, "name": "do_work<-caller<-outer",
          "device_kind": "cpu"},
+        {"timestamp": 0.2, "pid": 9,
+         "name": "memcpy<-caller<-outer @ libc.so.6", "device_kind": "cpu"},
     ]), d + "cputrace.csv")
     written = export_folded(SofaConfig(logdir=d))
     assert d + "pystacks.folded" in written
@@ -108,4 +110,6 @@ def test_export_folded(tmp_path):
     assert py[0] == "main;train;leaf_a 2"      # most common first
     assert "main;leaf_b 1" in py
     cpu = open(d + "cputrace.folded").read().splitlines()
-    assert cpu == ["outer;caller;do_work 1"]   # caller-first order
+    # caller-first order; the dso annotation stays on the LEAF frame
+    assert "outer;caller;do_work 1" in cpu
+    assert "outer;caller;memcpy [libc.so.6] 1" in cpu
