@@ -12,7 +12,7 @@ import pandas as pd
 
 from sofa_tpu.analysis.features import Features
 from sofa_tpu.printing import print_hint, print_title, print_warning
-from sofa_tpu.trace import CopyKind, roi_clip
+from sofa_tpu.trace import CopyKind, roi_bounds as _roi_bounds, roi_clip
 
 
 def tpu_profile(frames, cfg, features: Features) -> None:
@@ -119,18 +119,7 @@ def overlap_profile(frames, cfg, features: Features) -> None:
         total = float((a1 - a0).sum())
         if total <= 0:
             continue
-        # Covered length per query via prefix sums over the disjoint sorted
-        # sync intervals (one searchsorted pair per side, no per-op scan).
-        istart, iend = marr[:, 0], marr[:, 1]
-        cum = np.concatenate([[0.0], np.cumsum(iend - istart)])
-        i0 = np.searchsorted(iend, a0, side="right")
-        i1 = np.searchsorted(istart, a1, side="left")
-        full = cum[i1] - cum[i0]
-        n = len(istart)
-        clip_lo = np.clip(a0 - istart[np.minimum(i0, n - 1)], 0.0, None)
-        clip_hi = np.clip(iend[np.maximum(i1 - 1, 0)] - a1, 0.0, None)
-        cover = np.where(i0 < i1, full - clip_lo - clip_hi, 0.0)
-        hidden = float(np.maximum(cover, 0.0).sum())
+        hidden = float(np.maximum(_union_coverage(marr, a0, a1), 0.0).sum())
         features.add(f"tpu{device_id}_async_time", total)
         features.add(f"tpu{device_id}_async_hidden_pct",
                      100.0 * min(hidden / total, 1.0))
@@ -189,6 +178,24 @@ def _union_coverage(arr, t0s, t1s):
     return measure_below(np.asarray(t1s)) - measure_below(np.asarray(t0s))
 
 
+def _intersect_intervals(a, b):
+    """Intersection of two DISJOINT sorted interval unions (Mx2 arrays)."""
+    import numpy as np
+
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i, 0], b[j, 0])
+        hi = min(a[i, 1], b[j, 1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i, 1] < b[j, 1]:
+            i += 1
+        else:
+            j += 1
+    return np.asarray(out, dtype=float).reshape(-1, 2)
+
+
 def input_pipeline_profile(frames, cfg, features: Features) -> None:
     """Input-pipeline boundedness: device idle gaps INSIDE steps.
 
@@ -198,8 +205,10 @@ def input_pipeline_profile(frames, cfg, features: Features) -> None:
 
       busy_pct  — % of the step covered by sync compute (interval union)
       gap_ms    — step time with NO sync op running
-      h2d_ms    — host->device transfer time inside the step (async H2D
-                  spans + infeed ops), the tell that gaps are input waits
+      h2d_ms    — EXPOSED host->device transfer time inside the step
+                  (H2D/infeed spans minus their part hidden under sync
+                  compute): well-prefetched copies overlap compute and
+                  must not implicate the input pipeline
 
     and emits tpu<N>_step_gap_pct / tpu<N>_step_h2d_pct features plus
     tpu_input_pipeline.csv.  TensorBoard's input-pipeline analyzer is the
@@ -229,17 +238,26 @@ def input_pipeline_profile(frames, cfg, features: Features) -> None:
         marr = merged_intervals(
             sync["timestamp"].to_numpy(float),
             (sync["timestamp"] + sync["duration"]).to_numpy(float))
-        h2d = dev_ops[(dev_ops["copyKind"] == 1)
-                      | dev_ops["name"].str.contains("infeed", case=False)]
+        # infeed ops classify as CopyKind.H2D at ingest (classify_hlo_kind),
+        # so copyKind == 1 already covers them.
+        h2d = dev_ops[dev_ops["copyKind"] == 1]
         harr = (merged_intervals(
             h2d["timestamp"].to_numpy(float),
             (h2d["timestamp"] + h2d["duration"]).to_numpy(float))
             if not h2d.empty else np.empty((0, 2)))
+        hidden_h2d = _intersect_intervals(harr, marr)
 
         t0s = dev_steps["timestamp"].to_numpy(float)
         t1s = t0s + dev_steps["duration"].to_numpy(float)
+        bounds = _roi_bounds(cfg)
+        if bounds is not None:
+            # ROI-straddling steps keep only their in-window portion, or
+            # the clipped-away ops would read as phantom gap.
+            t0s = np.maximum(t0s, bounds[0])
+            t1s = np.minimum(t1s, bounds[1])
         busy = _union_coverage(marr, t0s, t1s)
-        h2d_s = _union_coverage(harr, t0s, t1s)
+        h2d_s = (_union_coverage(harr, t0s, t1s)
+                 - _union_coverage(hidden_h2d, t0s, t1s))
         for i, srow in enumerate(dev_steps.itertuples(index=False)):
             if t1s[i] <= t0s[i]:
                 continue

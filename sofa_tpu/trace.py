@@ -104,6 +104,14 @@ _DEFAULTS = {
 }
 
 
+def roi_bounds(cfg) -> "Optional[tuple]":
+    """(begin, end) when a region of interest is active, else None."""
+    begin, end = cfg.roi_begin, cfg.roi_end
+    if end > begin > 0 or (begin == 0 and end > 0):
+        return begin, end
+    return None
+
+
 def roi_clip(df: pd.DataFrame, cfg) -> pd.DataFrame:
     """Clip a frame to the region of interest when one is set.
 
@@ -111,8 +119,9 @@ def roi_clip(df: pd.DataFrame, cfg) -> pd.DataFrame:
     ROI boundary still contributes (un-prorated) — dropping it would
     undercount kernel time and misreport DMA overlap inside the window.
     """
-    begin, end = cfg.roi_begin, cfg.roi_end
-    if end > begin > 0 or (begin == 0 and end > 0):
+    bounds = roi_bounds(cfg)
+    if bounds is not None:
+        begin, end = bounds
         starts = df["timestamp"]
         ends = starts + df["duration"]
         return df[(starts <= end) & (ends >= begin)]
