@@ -103,6 +103,47 @@ def test_export_perfetto(tmp_path):
     assert "perfetto export" in r.stdout + r.stderr
 
 
+def test_export_perfetto_multihost_host_processes(tmp_path):
+    """Per-host host timelines stay separate Perfetto processes: host rows
+    carry their host's ordinal base in deviceId (host 1 -> 256), and thread
+    ids from different machines must never share a track."""
+    import gzip
+    import json
+
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.export_perfetto import export_perfetto
+    from sofa_tpu.trace import make_frame, write_csv
+
+    d = str(tmp_path / "plog") + "/"
+    os.makedirs(d)
+    write_csv(make_frame([
+        {"timestamp": 0.0, "duration": 0.001, "deviceId": 0, "tid": 7,
+         "name": "TfOp", "module": "python", "device_kind": "host"},
+        {"timestamp": 0.0, "duration": 0.002, "deviceId": 256, "tid": 7,
+         "name": "TfOp", "module": "python", "device_kind": "host"},
+    ]), d + "hosttrace.csv")
+    write_csv(make_frame([
+        {"timestamp": 0.0, "duration": 0.001, "deviceId": 0, "tid": 3,
+         "name": "send", "module": "Megascale Trace",
+         "device_kind": "custom"},
+        {"timestamp": 0.0, "duration": 0.001, "deviceId": 0, "tid": 3,
+         "name": "recv", "module": "Other Plane", "device_kind": "custom"},
+    ]), d + "customtrace.csv")
+    doc = json.load(gzip.open(export_perfetto(SofaConfig(logdir=d)), "rt"))
+    evs = doc["traceEvents"]
+    host_pids = {e["pid"] for e in evs
+                 if e["ph"] == "X" and e["cat"] == "host"}
+    assert len(host_pids) == 2
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"host0", "host1"} <= names
+    # two CUSTOM planes on one host get distinct processes too
+    custom_pids = {e["pid"] for e in evs
+                   if e["ph"] == "X" and e["cat"] == "custom_plane"}
+    assert len(custom_pids) == 2
+    assert {"Megascale Trace", "Other Plane"} <= names
+
+
 def test_export_empty_logdir_degrades(tmp_path):
     from sofa_tpu.export_static import export_static
 
