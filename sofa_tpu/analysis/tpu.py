@@ -232,14 +232,21 @@ def input_pipeline_profile(frames, cfg, features: Features) -> None:
     rows = []
     for device_id, dev_steps in steps.groupby("deviceId"):
         dev_ops = ops[ops["deviceId"] == device_id]
-        sync = dev_ops[dev_ops["category"] == 0]
-        if sync.empty:
-            continue
-        marr = merged_intervals(
+        # "Busy" means the core computes: sync H2D/D2H waits (a sync infeed
+        # IS the input stall this pass exists to expose) must not count.
+        if dev_ops.empty:
+            continue  # no op capture for this device: gap would be artifact
+        sync = dev_ops[(dev_ops["category"] == 0)
+                       & ~dev_ops["copyKind"].isin(
+                           (int(CopyKind.H2D), int(CopyKind.D2H)))]
+        # A device whose only ops are copies is FULLY input-bound — the
+        # worst case must be scored (100% gap), not skipped.
+        marr = (merged_intervals(
             sync["timestamp"].to_numpy(float),
             (sync["timestamp"] + sync["duration"]).to_numpy(float))
-        # infeed ops classify as CopyKind.H2D at ingest (classify_hlo_kind),
-        # so copyKind == 1 already covers them.
+            if not sync.empty else np.empty((0, 2)))
+        # infeed ops classify as CopyKind.H2D at ingest (classify_hlo_kind)
+        # whichever line they appear on, so copyKind == 1 covers them.
         h2d = dev_ops[dev_ops["copyKind"] == 1]
         harr = (merged_intervals(
             h2d["timestamp"].to_numpy(float),

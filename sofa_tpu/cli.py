@@ -254,15 +254,20 @@ def main(argv=None) -> int:
                 # the pod-scale frame; reading it twice is real money.
                 from sofa_tpu.analyze import load_frames
                 frames = load_frames(cfg, only=sorted(wanted))
-                ok = bool(export_static(cfg, frames))
-                # every requested artifact family must land...
+                # Exit contract: an EXPLICITLY flagged artifact failing is
+                # an error; the implicit static charts contribute success
+                # but (e.g. matplotlib not installed) must not fail a run
+                # whose requested artifacts all landed.  Folded stacks stay
+                # soft — legitimately absent when no stack sampler ran.
+                wrote_any = bool(export_static(cfg, frames))
+                failed_explicit = False
                 if args.perfetto:
-                    ok = bool(export_perfetto(cfg, frames)) and ok
+                    p_ok = bool(export_perfetto(cfg, frames))
+                    wrote_any |= p_ok
+                    failed_explicit |= not p_ok
                 if args.folded:
-                    # ...except folded stacks, which are legitimately absent
-                    # when no stack sampler ran
-                    export_folded(cfg, frames)
-                return 0 if ok else 1
+                    wrote_any |= bool(export_folded(cfg, frames))
+                return 0 if wrote_any and not failed_explicit else 1
             return 0 if export_static(cfg) else 1
         if cmd == "top":
             from sofa_tpu.top import sofa_top

@@ -27,6 +27,23 @@ def _read_int(path: str) -> Optional[int]:
         return None
 
 
+def _count_events(spec: str) -> int:
+    """TOP-LEVEL events in a perf -e list: commas inside raw PMU
+    descriptors (cpu/event=0x3c,umask=0x1/) or {group} syntax separate
+    parameters, not events."""
+    n, depth, in_pmu = 1, 0, False
+    for ch in spec:
+        if ch == "/":
+            in_pmu = not in_pmu
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(depth - 1, 0)
+        elif ch == "," and depth == 0 and not in_pmu:
+            n += 1
+    return n
+
+
 class PerfCollector(Collector):
     name = "perf"
 
@@ -88,17 +105,19 @@ class PerfCollector(Collector):
             return []
         return self._record_argv() + ["-p", str(pid)]
 
-    def scoped_argv(self, cgroup: Optional[str] = None,
-                    pid: Optional[int] = None) -> List[str]:
+    def scoped_argv(self, cgroup: str) -> List[str]:
         """Container-scoped sampling: system-wide filtered to the
         container's cgroup (`-a -G`, like the reference's
-        --cgroup=docker/<cid>, sofa_record.py:380-399), or attached to its
-        init pid when the cgroup cannot be resolved."""
+        --cgroup=docker/<cid>, sofa_record.py:380-399).  Pid-attach
+        fallback is attach_argv."""
         if self.mode != "perf":
             return []
-        if cgroup:
-            return self._record_argv() + ["-a", "-G", cgroup]
-        return self._record_argv() + ["-p", str(pid)]
+        # perf pairs cgroups with events positionally: one -G entry per
+        # -e event, or only the first event gets scoped.
+        n_events = (_count_events(self.cfg.perf_events)
+                    if self.cfg.perf_events else 1)
+        return self._record_argv() + [
+            "-a", "-G", ",".join([cgroup] * n_events)]
 
     def harvest(self) -> None:
         # Copy kernel symbols for offline `perf script` runs, like the

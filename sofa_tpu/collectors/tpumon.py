@@ -123,11 +123,25 @@ def start_sampler(rate_hz, out_path, stop=None):
     """Start the sampler thread; returns it.  Waits for jax by itself, so it
     is safe to call before the profiled program imports jax.  Pass a
     threading.Event as `stop` to end the loop (in-process API use)."""
+    own_stop = stop is None
+    if own_stop:
+        stop = threading.Event()
     t = threading.Thread(
         target=_loop, args=(rate_hz, out_path, stop),
         daemon=True, name="sofa_tpu_tpumon",
     )
     t.start()
+    if own_stop:
+        # A daemon thread mid-PJRT-call during interpreter teardown can
+        # abort the whole process (SIGABRT from the C++ layer); stop and
+        # join the sampler BEFORE shutdown instead.
+        import atexit
+
+        def _shutdown():
+            stop.set()
+            t.join(timeout=2.0)
+
+        atexit.register(_shutdown)
     return t
 '''
 

@@ -48,7 +48,7 @@ RAW_FILES = [
 
 # Derived files (removed by `sofa clean`).
 DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".json.gz", ".pdf",
-                    ".png")
+                    ".png", ".folded")
 DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt"]
 DERIVED_DIRS = ["board"]
 
@@ -198,18 +198,40 @@ class _DockerPerfScope:
                 cgroup = _perf_cgroup_rel(f.read())
         except OSError:
             cgroup = None
-        argv = self.perf.scoped_argv(cgroup=cgroup, pid=pid)
-        with self._lock:
-            if self._stop.is_set():
-                return  # the run already ended; do not launch an orphan
-            try:
-                self.proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
-                                             stderr=subprocess.DEVNULL)
+        # System-wide -a -G needs perf_event_paranoid <= 0 / CAP_PERFMON —
+        # stricter than the plain sampling the probe checked — and perf
+        # exits immediately when denied.  Poll shortly after launch and
+        # fall back to the pid attach, which needs no extra privilege.
+        attempts = []
+        if cgroup:
+            attempts.append((self.perf.scoped_argv(cgroup),
+                             f"cgroup {cgroup}"))
+        attempts.append((self.perf.attach_argv(pid), f"pid {pid}"))
+        tried = []
+        for argv, how in attempts:
+            with self._lock:
+                if self._stop.is_set():
+                    return  # the run already ended; no orphan launches
+                try:
+                    self.proc = subprocess.Popen(
+                        argv, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL)
+                except OSError as e:
+                    print_warning(f"docker-scoped perf failed to launch: "
+                                  f"{e}")
+                    return
+            tried.append(how)
+            time.sleep(0.5)
+            if self.proc.poll() is None:
                 print_progress(
-                    f"perf scoped to container {cid[:12]} "
-                    + (f"(cgroup {cgroup})" if cgroup else f"(pid {pid})"))
-            except OSError as e:
-                print_warning(f"docker-scoped perf failed to launch: {e}")
+                    f"perf scoped to container {cid[:12]} ({how})")
+                return
+            self.proc = None
+        print_warning(
+            f"docker-scoped perf exited immediately for {cid[:12]} "
+            f"(tried {'; '.join(tried)}) — container CPU samples "
+            "unavailable; common causes: perf_event_paranoid too strict "
+            "for system-wide -G, or the container exited at once")
 
     def stop(self) -> None:
         with self._lock:

@@ -537,6 +537,34 @@ def test_input_pipeline_profile(cfg):
     assert any("collective waits" in h for h in hints3)
 
 
+def test_input_pipeline_sync_infeed_counts_as_wait(cfg):
+    """A SYNC infeed (category 0, classified H2D) is the input stall this
+    pass exists to expose — it must read as gap + exposed h2d, never as
+    compute."""
+    steps = [{"timestamp": 0.0, "event": 0.0, "duration": 1.0,
+              "deviceId": 0, "name": "step 0", "device_kind": "tpu"}]
+    ops = [
+        {"timestamp": 0.0, "duration": 0.6, "deviceId": 0, "category": 0,
+         "name": "fusion.1", "device_kind": "tpu"},
+        {"timestamp": 0.65, "duration": 0.3, "deviceId": 0, "category": 0,
+         "copyKind": 1, "name": "infeed.2", "device_kind": "tpu"},
+    ]
+    frames = {"tpusteps": make_frame(steps), "tputrace": make_frame(ops)}
+    feats = Features()
+    tpu.input_pipeline_profile(frames, cfg, feats)
+    assert feats.get("tpu0_step_gap_pct") == pytest.approx(40.0, rel=1e-3)
+    assert feats.get("tpu0_step_h2d_pct") == pytest.approx(30.0, rel=1e-3)
+
+    # copies-only device = fully input-bound: scored as ~100% gap, not
+    # silently skipped
+    frames2 = {"tpusteps": make_frame(steps),
+               "tputrace": make_frame([ops[1]])}
+    feats2 = Features()
+    tpu.input_pipeline_profile(frames2, cfg, feats2)
+    assert feats2.get("tpu0_step_gap_pct") == pytest.approx(100.0, rel=1e-3)
+    assert feats2.get("tpu0_step_h2d_pct") == pytest.approx(30.0, rel=1e-3)
+
+
 def test_advice_overlap_and_skew_hints(cfg):
     feats = Features()
     feats.add("tpu0_async_hidden_pct", 20.0)

@@ -33,9 +33,12 @@ def test_export_static_renders_pdf(logdir):
     # `sofa clean` treats the exports as derived artifacts
     from sofa_tpu.record import sofa_clean
 
+    with open(cfg.path("pystacks.folded"), "w") as f:
+        f.write("a;b 1\n")
     sofa_clean(cfg)
     assert not os.path.exists(cfg.path("sofa_report.pdf"))
     assert not os.path.exists(cfg.path("overview.png"))
+    assert not os.path.exists(cfg.path("pystacks.folded"))
 
 
 def test_export_perfetto(tmp_path):

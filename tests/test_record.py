@@ -246,6 +246,94 @@ def test_docker_mode_scopes_perf_to_container(logdir, tmp_path, monkeypatch):
     assert cid.startswith("c0ffee1234")
 
 
+def test_scoped_argv_repeats_cgroup_per_event(logdir):
+    """perf pairs -G cgroups with -e events positionally; a multi-event
+    config must repeat the cgroup or only the first event is scoped."""
+    from sofa_tpu.collectors.perf import PerfCollector
+
+    cfg = SofaConfig(logdir=logdir, perf_events="cycles,instructions")
+    perf = PerfCollector(cfg)
+    perf.mode = "perf"
+    argv = perf.scoped_argv(cgroup="docker/abc")
+    assert argv[argv.index("-G") + 1] == "docker/abc,docker/abc"
+    cfg2 = SofaConfig(logdir=logdir)
+    perf2 = PerfCollector(cfg2)
+    perf2.mode = "perf"
+    argv2 = perf2.scoped_argv(cgroup="docker/abc")
+    assert argv2[argv2.index("-G") + 1] == "docker/abc"
+    # commas inside raw PMU descriptors / {groups} are parameters, not
+    # event separators
+    cfg3 = SofaConfig(logdir=logdir,
+                      perf_events="cpu/event=0x3c,umask=0x1/,cycles")
+    perf3 = PerfCollector(cfg3)
+    perf3.mode = "perf"
+    argv3 = perf3.scoped_argv(cgroup="cg")
+    assert argv3[argv3.index("-G") + 1] == "cg,cg"
+    cfg4 = SofaConfig(logdir=logdir, perf_events="{cycles,instructions}")
+    perf4 = PerfCollector(cfg4)
+    perf4.mode = "perf"
+    argv4 = perf4.scoped_argv(cgroup="cg")
+    assert argv4[argv4.index("-G") + 1] == "cg"
+
+
+def test_docker_scope_falls_back_to_pid_when_cgroup_perf_dies(
+        tmp_path, monkeypatch):
+    """A perf denied system-wide -a -G (perf_event_paranoid) exits
+    immediately; the watcher must retry with the pid attach instead of
+    reporting success over a dead sampler."""
+    import stat
+    import textwrap
+
+    stubs = tmp_path / "stubs"
+    stubs.mkdir()
+    pidfile = tmp_path / "container.pid"
+    perf_argv = tmp_path / "perf_argv.txt"
+    (stubs / "docker").write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$1" = inspect ]; then cat {pidfile}; exit 0; fi
+        shift
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            --cidfile) printf c0ffee1234beef > "$2"; shift 2;;
+            img) shift; break;;
+            *) shift;;
+          esac
+        done
+        echo $$ > {pidfile}
+        exec "$@"
+        """))
+    # dies instantly when scoped by cgroup (-G); survives on pid attach
+    (stubs / "perf").write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        printf '%s\\n' "$@" >> {perf_argv}
+        for a in "$@"; do [ "$a" = "-G" ] && exit 1; done
+        exec sleep 300
+        """))
+    for s in ("docker", "perf"):
+        os.chmod(stubs / s, os.stat(stubs / s).st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{stubs}:{os.environ['PATH']}")
+    import sofa_tpu.collectors.perf as perfmod
+    import sofa_tpu.record as recmod
+    monkeypatch.setattr(perfmod, "_read_int", lambda path: -1)
+    # this sandbox runs in the root cgroup ("/"); pin a container-like one
+    # so the -G attempt actually happens
+    monkeypatch.setattr(recmod, "_perf_cgroup_rel",
+                        lambda text: "docker/stubcid")
+
+    logdir2 = str(tmp_path / "log") + "/"
+    os.makedirs(logdir2)
+    cfg = SofaConfig(logdir=logdir2, enable_xprof=False)
+    rc = sofa_record("docker run img sleep 2", cfg)
+    assert rc == 0
+    argv = perf_argv.read_text()
+    # the cgroup attempt ran AND the pid fallback followed it
+    assert "-G" in argv
+    assert "-p" in argv
+    pid_line_idx = argv.splitlines().index("-p")
+    assert argv.splitlines()[pid_line_idx + 1] == \
+        pidfile.read_text().strip()
+
+
 def test_cluster_record_two_localhost_hosts(tmp_path):
     """VERDICT r2 weak #4 / next #5: drive the record-side cluster
     orchestration (record.py cluster_record) through the REAL subprocess
