@@ -31,7 +31,8 @@ def _log(msg: str) -> None:
 
 
 def _emit(value, error: str | None = None,
-          p_value: "float | None" = None) -> None:
+          p_value: "float | None" = None,
+          extra: "dict | None" = None) -> None:
     """The one JSON line the driver parses — emitted on success AND failure."""
     out = {
         "metric": "resnet50_profiling_overhead",
@@ -43,6 +44,8 @@ def _emit(value, error: str | None = None,
         # paired-run significance, mirroring the reference's t-test
         # (validation/framework_eval.py:144-145,208-215)
         out["p_value"] = round(p_value, 4)
+    if extra:
+        out.update(extra)  # secondary evidence keys; drivers ignore extras
     if error:
         out["error"] = error
     print(json.dumps(out), flush=True)
@@ -391,7 +394,12 @@ def main() -> int:
     _log(f"bench: images/s bare {args.steps * args.batch / t_bare:.1f}, "
          f"profiled {args.steps * args.batch / t_prof:.1f}; "
          f"trace rows {hlo_rows}")
-    _emit(round(overhead, 3), p_value=p_value)
+    _emit(round(overhead, 3), p_value=p_value, extra={
+        "images_per_sec_bare": round(args.steps * args.batch / t_bare, 1),
+        "images_per_sec_profiled": round(args.steps * args.batch / t_prof, 1),
+        "hlo_rows": int(hlo_rows),
+        "backend": jax.default_backend(),
+    })
     return 0
 
 

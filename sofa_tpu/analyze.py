@@ -72,6 +72,94 @@ def load_frames(cfg: SofaConfig,
     return frames
 
 
+# Frames whose deviceId column is a device/host ordinal that must rebase
+# per host on a cluster merge; every other frame's deviceId means a core /
+# lane index and host identity is carried in `pid` instead.
+_DEVICE_ID_FRAMES = frozenset(
+    {"tputrace", "tpusteps", "tpumodules", "tpuutil", "hosttrace",
+     "customtrace", "tpumon"})
+
+
+def cluster_host_cfgs(cfg: SofaConfig):
+    """(ordinal, hostname, host_cfg) per configured host — THE one place
+    that knows the per-host logdir naming and ordinal assignment.  The
+    ordinal follows the configured host list (like ingest's
+    device_id_base=host_index*256), so a missing logdir never renumbers
+    the hosts after it."""
+    import copy as _copy
+
+    for i, hostname in enumerate(cfg.cluster_hosts):
+        host_cfg = _copy.deepcopy(cfg)
+        host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
+        host_cfg.__post_init__()
+        yield i, hostname, host_cfg
+
+
+def cluster_clock_shifts(time_bases: Dict[str, float]):
+    """(cluster zero, per-host shift) from per-host sofa_time bases; a
+    host with no readable time base gets shift 0 and a warning."""
+    known = [tb for tb in time_bases.values() if tb > 0]
+    tb0 = min(known) if known else 0.0
+    shifts = {}
+    for hostname, tb in time_bases.items():
+        if tb > 0:
+            shifts[hostname] = tb - tb0
+        else:
+            print_warning(
+                f"cluster: {hostname} has no sofa_time.txt — its series "
+                "are not clock-aligned on the merged timeline")
+            shifts[hostname] = 0.0
+    return tb0, shifts
+
+
+def load_cluster_frames(cfg: SofaConfig,
+                        only: "List[str] | None" = None
+                        ) -> Dict[str, pd.DataFrame]:
+    """Per-host frames merged onto the cluster clock, for the exporters.
+
+    Same alignment rule as cluster_analyze's merged report.js (earliest
+    host's time base is zero; each host shifts by its clock offset), plus
+    host-ordinal deviceId keying: device rows rebase by +i*256 (each
+    host's logdir was ingested alone with base 0) and host-sampler rows
+    (deviceId -1: mpstat/netbandwidth/...) are stamped with the host's
+    ordinal base so per-host identity survives the merge.
+    """
+    import numpy as np
+
+    from sofa_tpu.preprocess import read_time_base
+
+    merged: Dict[str, List[pd.DataFrame]] = {}
+    time_bases: Dict[str, float] = {}
+    host_frames = []
+    for i, hostname, host_cfg in cluster_host_cfgs(cfg):
+        if not os.path.isdir(host_cfg.logdir):
+            print_warning(f"cluster: missing logdir {host_cfg.logdir}")
+            continue
+        host_frames.append((i, hostname, load_frames(host_cfg, only=only)))
+        time_bases[hostname] = read_time_base(host_cfg)
+    _, shifts = cluster_clock_shifts(time_bases)
+    for i, hostname, frames in host_frames:
+        shift = shifts[hostname]
+        for key, df in frames.items():
+            if df.empty:
+                continue
+            df = df.copy()
+            df["timestamp"] = df["timestamp"] + shift
+            if key in _DEVICE_ID_FRAMES:
+                if i and "deviceId" in df.columns:
+                    dev = df["deviceId"].to_numpy()
+                    # heartbeat/aggregate rows (-1) stay; real ordinals
+                    # rebase to the host's base
+                    df["deviceId"] = np.where(dev >= 0, dev + i * 256, dev)
+            elif "pid" in df.columns:
+                # Host-sampler frames (mpstat/netbandwidth/...) use
+                # deviceId for the CORE/lane index; host identity rides
+                # the otherwise-unused pid column instead.
+                df["pid"] = i
+            merged.setdefault(key, []).append(df)
+    return {k: pd.concat(v, ignore_index=True) for k, v in merged.items()}
+
+
 def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None) -> Features:
     if frames is None:
         frames = load_frames(cfg)
@@ -191,8 +279,6 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
     logdir, plus the DCN-traffic-vs-step correlation per host (BASELINE
     config #5's question).
     """
-    import copy as _copy
-
     from sofa_tpu.analysis.comm import dcn_step_correlation
     from sofa_tpu.preprocess import build_series, read_time_base
     from sofa_tpu.trace import series_to_report_js
@@ -202,14 +288,13 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
     merged_series = []
     host_frames: Dict[str, Dict[str, pd.DataFrame]] = {}
     time_bases: Dict[str, float] = {}
-    for hostname in cfg.cluster_hosts:
-        host_cfg = _copy.deepcopy(cfg)
-        host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
-        host_cfg.__post_init__()
+    host_cfgs: Dict[str, SofaConfig] = {}
+    for _i, hostname, host_cfg in cluster_host_cfgs(cfg):
         if not os.path.isdir(host_cfg.logdir):
             print_warning(f"cluster: missing logdir {host_cfg.logdir}")
             continue
         print_progress(f"cluster: analyzing {hostname}")
+        host_cfgs[hostname] = host_cfg
         host_frames[hostname] = load_frames(host_cfg)
         results[hostname] = sofa_analyze(host_cfg, host_frames[hostname])
         time_bases[hostname] = read_time_base(host_cfg)
@@ -230,17 +315,10 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
         # whose sofa_time.txt is missing reads 0.0 — excluding it from the
         # zero keeps one broken fetch from shifting every healthy host by
         # an epoch.
-        known = [tb for tb in time_bases.values() if tb > 0]
-        tb0 = min(known) if known else 0.0
+        tb0, shifts = cluster_clock_shifts(time_bases)
         for hostname, frames in host_frames.items():
-            tb = time_bases[hostname]
-            shift = tb - tb0 if tb > 0 else 0.0
-            if tb <= 0:
-                print_warning(
-                    f"cluster: {hostname} has no sofa_time.txt — its series "
-                    "are not clock-aligned on the merged timeline")
-            host_cfg = _copy.deepcopy(cfg)
-            host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
+            shift = shifts[hostname]
+            host_cfg = host_cfgs[hostname]
             for s in build_series(host_cfg, frames):
                 data = s.data.copy()
                 data["timestamp"] = data["timestamp"] + shift

@@ -222,10 +222,8 @@ def main(argv=None) -> int:
             from sofa_tpu.preprocess import sofa_preprocess
             print_main_progress("SOFA report")
             if cfg.cluster_hosts:
-                import copy
-                for host in cfg.cluster_hosts:
-                    host_cfg = copy.deepcopy(cfg)
-                    host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{host}/"
+                from sofa_tpu.analyze import cluster_host_cfgs
+                for _i, _host, host_cfg in cluster_host_cfgs(cfg):
                     if not cfg.skip_preprocess:
                         sofa_preprocess(host_cfg)
                 cluster_analyze(cfg)
@@ -249,11 +247,15 @@ def main(argv=None) -> int:
                 from sofa_tpu.export_folded import (
                     FOLDED_FRAMES, export_folded)
                 wanted |= set(FOLDED_FRAMES)
-            if args.perfetto or args.folded:
+            if args.perfetto or args.folded or cfg.cluster_hosts:
                 # One deserialization pass for every exporter — tputrace is
                 # the pod-scale frame; reading it twice is real money.
-                from sofa_tpu.analyze import load_frames
-                frames = load_frames(cfg, only=sorted(wanted))
+                # --cluster_hosts merges every host's frames onto the
+                # cluster clock first, so one trace/PDF spans the pod.
+                from sofa_tpu.analyze import load_cluster_frames, load_frames
+                frames = (load_cluster_frames(cfg, only=sorted(wanted))
+                          if cfg.cluster_hosts
+                          else load_frames(cfg, only=sorted(wanted)))
                 # Exit contract: an EXPLICITLY flagged artifact failing is
                 # an error; the implicit static charts contribute success
                 # but (e.g. matplotlib not installed) must not fail a run

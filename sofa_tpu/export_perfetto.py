@@ -132,22 +132,29 @@ def _counter_events(util: pd.DataFrame, events: List[dict]) -> None:
         })
 
 
-def _host_counter_events(df: pd.DataFrame, names: List[str], pid: int,
+def _host_counter_events(df: pd.DataFrame, names: List[str],
                          label: str, events: List[dict]) -> None:
-    """Per-timestamp mean of a host sampler series as a Perfetto counter."""
+    """Per-timestamp mean of a host sampler series as a Perfetto counter —
+    per HOST, so a cluster export never averages one saturated machine
+    against its idle neighbors.  Host identity is the `pid` column
+    (stamped by load_cluster_frames; -1 = single-host capture); deviceId
+    in sampler frames is the CPU-core/lane index and is deliberately
+    averaged over."""
     if df.empty:
         return
-    for name in names:
-        rows = df[df["name"] == name]
-        if rows.empty:
-            continue
-        agg = rows.groupby("timestamp")["event"].mean()
-        for ts, v in agg.items():
-            events.append({
-                "name": f"{label}{name}", "ph": "C", "cat": "host_util",
-                "ts": ts * 1e6, "pid": pid,
-                "args": {f"{label}{name}": float(v)},
-            })
+    for hpid, host_rows in df.groupby("pid"):
+        pid = _HOST_PID + max(int(hpid), 0) * 256
+        for name in names:
+            rows = host_rows[host_rows["name"] == name]
+            if rows.empty:
+                continue
+            agg = rows.groupby("timestamp")["event"].mean()
+            for ts, v in agg.items():
+                events.append({
+                    "name": f"{label}{name}", "ph": "C", "cat": "host_util",
+                    "ts": ts * 1e6, "pid": pid,
+                    "args": {f"{label}{name}": float(v)},
+                })
 
 
 def _meta(events: List[dict], pid: int, name: str,
@@ -192,11 +199,10 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
     if not util.empty:
         _counter_events(util, events)
     _host_counter_events(get("mpstat"), ["usr", "sys", "iow"],
-                         _HOST_PID, "cpu_", events)
+                         "cpu_", events)
     net = get("netbandwidth")
     if not net.empty:
-        _host_counter_events(net, sorted(set(net["name"])),
-                             _HOST_PID, "", events)
+        _host_counter_events(net, sorted(set(net["name"])), "", events)
     if not events:
         print_warning("perfetto export: no trace frames — run "
                       "`sofa report` first")
@@ -223,6 +229,7 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
     for (dev, label), pid in plane_pids.items():
         _meta(events, pid, str(label or "custom plane"))
 
+    os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
     path = cfg.path(out_name)
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"producer": "sofa_tpu", "logdir": cfg.logdir}}

@@ -86,12 +86,17 @@ def _page_network(fig, frames: Dict[str, pd.DataFrame]) -> bool:
     drew = False
     # Busiest five series, not the alphabetically-first five: an idle
     # docker0 must not displace the NIC carrying the training traffic.
-    names = list(net.groupby("name")["event"].sum()
-                 .sort_values(ascending=False).head(5).index)
-    for name, color in zip(names, (C1, C2, C3, C4, C5)):
-        rows = net[net["name"] == name]
+    # Cluster-merged frames key hosts in `pid` — each (host, NIC) pair is
+    # its own line, never one concatenated backtracking scribble.
+    multi_host = net["pid"].nunique() > 1
+    keys = list(net.groupby(["pid", "name"])["event"].sum()
+                .sort_values(ascending=False).head(5).index)
+    for (hpid, name), color in zip(keys, (C1, C2, C3, C4, C5)):
+        rows = net[(net["pid"] == hpid)
+                   & (net["name"] == name)].sort_values("timestamp")
+        label = f"h{int(hpid)}:{name}" if multi_host else name
         ax.plot(rows["timestamp"], rows["event"] / 2 ** 20, color=color,
-                linewidth=1.2, label=name)
+                linewidth=1.2, label=label)
         drew = True
     if drew:
         ax.legend(fontsize=7, frameon=False, labelcolor=INK2)
@@ -188,6 +193,7 @@ def export_static(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None
         frames = load_frames(cfg, only=STATIC_FRAMES)
 
     written: List[str] = []
+    os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
     pdf_path = cfg.path("sofa_report.pdf")
     png_path = cfg.path("overview.png")
     pages = [

@@ -494,6 +494,16 @@ def cluster_record(command: str, cfg) -> int:
     import sys
 
     flags = _record_flags(cfg)
+    # Local launches spawn `python -m sofa_tpu`, which must import from
+    # the package checkout regardless of the caller's cwd (the bin/sofa
+    # launcher only bootstraps sys.path in ITS process).
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parts = [p for p in child_env.get("PYTHONPATH", "").split(os.pathsep)
+             if p]
+    if pkg_root not in parts:
+        parts.append(pkg_root)
+    child_env["PYTHONPATH"] = os.pathsep.join(parts)
     launches = []
     for host in cfg.cluster_hosts:
         host_logdir = cfg.logdir.rstrip("/") + f"-{host}/"
@@ -510,7 +520,7 @@ def cluster_record(command: str, cfg) -> int:
             argv = ["ssh", "-o", "BatchMode=yes", host, remote]
         print_progress(f"cluster: recording on {host}")
         try:
-            proc = subprocess.Popen(argv)
+            proc = subprocess.Popen(argv, env=child_env)
         except OSError as e:
             print_error(f"cluster: cannot launch on {host}: {e}")
             return 1
@@ -536,8 +546,8 @@ def cluster_record(command: str, cfg) -> int:
                 ["ssh", "-o", "BatchMode=yes", host, f"rm -rf {remote_dir}"],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             )
-    print_progress(
-        f"cluster: recorded {len(launches)} hosts into {cfg.logdir}-<host>/")
+    print_progress(f"cluster: recorded {len(launches)} hosts into "
+                   f"{cfg.logdir.rstrip('/')}-<host>/")
     return rc
 
 

@@ -147,6 +147,44 @@ def test_export_perfetto_multihost_host_processes(tmp_path):
     assert {"Megascale Trace", "Other Plane"} <= names
 
 
+def test_export_cluster_merged_perfetto(tmp_path):
+    """--cluster_hosts merges per-host logdirs onto the cluster clock for
+    the exporters: host B's series shift by its clock offset and its chips
+    rebase to ordinal 256+, so one trace.json.gz spans the pod."""
+    import gzip
+    import json
+
+    import pytest
+
+    from sofa_tpu.analyze import load_cluster_frames
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.export_perfetto import export_perfetto
+    from sofa_tpu.trace import make_frame, write_csv
+
+    base = str(tmp_path / "clog")
+    for host, tb in (("ha", 1000.0), ("hb", 1002.5)):
+        d = base + f"-{host}/"
+        os.makedirs(d)
+        with open(d + "sofa_time.txt", "w") as f:
+            f.write(f"{tb}\n")
+        write_csv(make_frame([
+            {"timestamp": 1.0, "duration": 0.5, "deviceId": 0,
+             "category": 0, "name": f"fusion.{host}",
+             "device_kind": "tpu"},
+        ]), d + "tputrace.csv")
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=["ha", "hb"])
+    frames = load_cluster_frames(cfg, only=["tputrace"])
+    ops = frames["tputrace"].sort_values("deviceId")
+    assert ops["deviceId"].tolist() == [0, 256]
+    # host b's clock is 2.5s ahead of the cluster zero
+    assert ops["timestamp"].tolist() == pytest.approx([1.0, 3.5])
+
+    path = export_perfetto(cfg, frames)
+    doc = json.load(gzip.open(path, "rt"))
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert pids == {0, 256}
+
+
 def test_export_empty_logdir_degrades(tmp_path):
     from sofa_tpu.export_static import export_static
 
