@@ -150,10 +150,11 @@ def _disk_line(logdir: str) -> Optional[str]:
             f"write {_fmt_bytes_rate(wr)}")
 
 
-def render_frame(logdir: str, now: Optional[float] = None) -> str:
+def render_frame(logdir: str, now: Optional[float] = None,
+                 title: Optional[str] = None) -> str:
     now = time.time() if now is None else now
     stamp = time.strftime("%H:%M:%S", time.localtime(now))
-    lines = [f"sofa top — {logdir}   {stamp}"]
+    lines = [f"sofa top — {title or logdir}   {stamp}"]
     lines += _tpu_lines(logdir, now)
     for maker in (_cpu_line, _net_line, _disk_line):
         line = maker(logdir)
@@ -162,20 +163,47 @@ def render_frame(logdir: str, now: Optional[float] = None) -> str:
     return "\n".join(lines)
 
 
+def render_cluster_frame(cfg, now: Optional[float] = None) -> str:
+    """One stacked frame over every host's logdir of a cluster recording
+    (the `sofa record --cluster_hosts` layout)."""
+    from sofa_tpu.analyze import cluster_host_cfgs
+
+    now = time.time() if now is None else now  # one clock for every block
+    blocks = []
+    seen_any = False
+    for _i, hostname, host_cfg in cluster_host_cfgs(cfg):
+        if not os.path.isdir(host_cfg.logdir):
+            blocks.append(f"sofa top — {hostname}   (no logdir yet)")
+            continue
+        seen_any = True
+        blocks.append(render_frame(host_cfg.logdir, now, title=hostname))
+    if not seen_any:
+        raise FileNotFoundError(
+            f"no host logdirs under {cfg.logdir.rstrip('/')}-<host>/ — "
+            "start a `sofa record --cluster_hosts ...` first")
+    return "\n\n".join(blocks)
+
+
 def sofa_top(cfg, interval: float = 2.0, once: bool = False) -> int:
     interval = max(float(interval), 0.1)  # 0/negative would spin or raise
-    if not os.path.isdir(cfg.logdir):
+    if cfg.cluster_hosts:
+        render = lambda: render_cluster_frame(cfg)  # noqa: E731
+    elif os.path.isdir(cfg.logdir):
+        render = lambda: render_frame(cfg.logdir)   # noqa: E731
+    else:
         print_error(f"logdir {cfg.logdir} does not exist — start a "
                     "`sofa record` first")
         return 1
-    if once:
-        print(render_frame(cfg.logdir))
-        return 0
     try:
+        if once:
+            print(render())
+            return 0
         while True:
-            frame = render_frame(cfg.logdir)
-            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.write("\x1b[2J\x1b[H" + render() + "\n")
             sys.stdout.flush()
             time.sleep(interval)
+    except FileNotFoundError as e:
+        print_error(str(e))
+        return 1
     except KeyboardInterrupt:
         return 0

@@ -145,6 +145,66 @@ def test_event_level_stats_fall_back_identically(tmp_path, monkeypatch,
     assert ops["flops"].tolist() == [111.0, 222.0]
 
 
+def test_scanner_fuzz_random_spaces(tmp_path, scanner):
+    """Randomized XSpaces (many planes/lines, event stats, num_occurrences
+    oneof, negative ids, empty names) — the wire scanner must agree with
+    the proto parse on every event field, every time."""
+    import random
+
+    from sofa_tpu.ingest import xplane_pb2
+
+    rng = random.Random(1234)
+    for trial in range(6):
+        xs = xplane_pb2.XSpace()
+        for p in range(rng.randint(1, 4)):
+            plane = xs.planes.add()
+            plane.name = rng.choice(
+                ["/device:TPU:0", "/host:CPU", "", "/device:CUSTOM:X",
+                 "plane-é"])
+            for s in range(rng.randint(0, 3)):
+                sid = s + 1
+                plane.stat_metadata[sid].id = sid
+                plane.stat_metadata[sid].name = rng.choice(
+                    ["flops", "bytes_accessed", "run_id", "x"])
+            for li in range(rng.randint(0, 3)):
+                line = plane.lines.add()
+                line.id = rng.randint(-2, 2 ** 40)
+                line.name = rng.choice(["XLA Ops", "Steps", "", "weird"])
+                line.timestamp_ns = rng.randint(-5, 2 ** 50)
+                for e in range(rng.randint(0, 30)):
+                    ev = line.events.add()
+                    ev.metadata_id = rng.randint(0, 2 ** 30)
+                    if rng.random() < 0.5:
+                        ev.offset_ps = rng.randint(0, 2 ** 55)
+                    else:
+                        ev.num_occurrences = rng.randint(0, 100)
+                    ev.duration_ps = rng.randint(0, 2 ** 45)
+                    for _ in range(rng.randint(0, 2)):
+                        st = ev.stats.add()
+                        st.metadata_id = rng.randint(0, 4)
+                        st.int64_value = rng.randint(0, 100)
+        path = tmp_path / f"fuzz{trial}.xplane.pb"
+        path.write_bytes(xs.SerializeToString())
+        planes = native_scan.scan_file(
+            str(path), xplane_mod._DERIVED_STAT_KEYS)
+        assert planes is not None, f"trial {trial} failed to scan"
+        assert [p.name for p in planes] == [p.name for p in xs.planes]
+        for sp, plane in zip(planes, xs.planes):
+            derived = {mid for mid, m in plane.stat_metadata.items()
+                       if m.name in xplane_mod._DERIVED_STAT_KEYS}
+            for sl, line in zip(sp.lines, plane.lines):
+                assert sl.line_id == line.id
+                assert sl.timestamp_ns == line.timestamp_ns
+                assert len(sl.metadata_ids) == len(line.events)
+                for i, ev in enumerate(line.events):
+                    assert sl.metadata_ids[i] == ev.metadata_id
+                    assert sl.offsets_ps[i] == ev.offset_ps
+                    assert sl.durations_ps[i] == ev.duration_ps
+                    want_flag = bool(ev.stats) and any(
+                        s.metadata_id in derived for s in ev.stats)
+                    assert bool(sl.flags[i] & 1) == want_flag, (trial, i)
+
+
 def test_scan_disabled_is_none(monkeypatch):
     monkeypatch.setenv("SOFA_NATIVE_SCAN", "0")
     assert native_scan.scan_file(TPU_FIXTURE, ("flops",)) is None

@@ -84,6 +84,33 @@ def test_top_cli_once(tmp_path):
     assert "Traceback" not in r.stderr
 
 
+def test_top_cluster_frame(tmp_path):
+    """--cluster_hosts stacks one block per host logdir; a host whose
+    logdir has not arrived yet is shown, not fatal."""
+    from sofa_tpu.top import render_cluster_frame
+
+    base = str(tmp_path / "clog")
+    d = base + "-ha/"
+    os.makedirs(d)
+    _seed_logdir(d)
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=["ha", "hb"])
+    frame = render_cluster_frame(cfg)
+    assert "sofa top — ha" in frame
+    assert "tpu0" in frame
+    assert "sofa top — hb   (no logdir yet)" in frame
+
+    # NO host logdir at all (typo'd base) is an error, not a silent frame
+    import pytest
+
+    from sofa_tpu.top import sofa_top
+
+    cfg2 = SofaConfig(logdir=str(tmp_path / "typo") + "/",
+                      cluster_hosts=["ha", "hb"])
+    with pytest.raises(FileNotFoundError):
+        render_cluster_frame(cfg2)
+    assert sofa_top(cfg2, once=True) == 1
+
+
 def test_export_folded(tmp_path):
     from sofa_tpu.export_folded import export_folded
     from sofa_tpu.trace import make_frame, write_csv
