@@ -9,7 +9,11 @@ clock marker + 10 Hz host samplers), and the run only counts if the captured
 trace actually contains HLO ops (coverage guard, per BASELINE.json's
 "overhead % + HLO-op trace coverage" metric).
 
-Prints ONE JSON line:
+Output contract: the result is the LAST parseable JSON line on stdout.
+Normally that is the only line, but a run that had to wait on a dead device
+tunnel first prints a provisional line (`"provisional": true, value null`)
+so an uncatchable SIGKILL still leaves something parseable; a completed run
+always prints the real result after it.  Fields:
   value       = profiling overhead in percent (lower is better)
   vs_baseline = value / 5.0, the fraction of the reference's <5 % overhead
                 budget consumed (<1.0 beats the target)
@@ -30,10 +34,27 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# Where the benchmark currently is, for the signal-handler error line; the
+# final _emit flips `done` so a late signal can't print a second JSON line.
+_state = {"phase": "starting", "done": False, "provisional": False}
+
+
 def _emit(value, error: str | None = None,
           p_value: "float | None" = None,
-          extra: "dict | None" = None) -> None:
-    """The one JSON line the driver parses — emitted on success AND failure."""
+          extra: "dict | None" = None,
+          provisional: bool = False) -> None:
+    """The one JSON line the driver parses — emitted on success AND failure.
+
+    A non-provisional emit is final: it marks the process as having spoken,
+    so the SIGTERM/SIGALRM handler stays silent afterwards.  A provisional
+    emit (written when the retry loop starts waiting on a dead tunnel) exists
+    so even SIGKILL — which no handler can catch — leaves a parseable line on
+    stdout; the driver reads the LAST parseable line, so a later real result
+    supersedes it.  (Round 3 regressed to `parsed: null` because the driver's
+    timeout beat the retry budget and _emit only ran at the end of main.)
+    """
+    if not provisional:
+        _state["done"] = True
     out = {
         "metric": "resnet50_profiling_overhead",
         "value": value,
@@ -48,7 +69,45 @@ def _emit(value, error: str | None = None,
         out.update(extra)  # secondary evidence keys; drivers ignore extras
     if error:
         out["error"] = error
+    if provisional:
+        out["provisional"] = True
     print(json.dumps(out), flush=True)
+
+
+def _emit_provisional_once() -> None:
+    """First time the retry loop decides to wait, leave a parseable line so
+    an uncatchable kill (driver SIGKILL) still yields a non-null parse."""
+    if _state["provisional"] or _state["done"]:
+        return
+    _state["provisional"] = True
+    _emit(None, error="provisional: benchmark still running "
+                      "(waiting for a healthy device tunnel); if this is the "
+                      "last line, the process was killed before finishing",
+          provisional=True)
+
+
+def _install_signal_handlers() -> None:
+    """SIGTERM/SIGALRM → emit the error JSON line NOW, then exit.
+
+    `timeout(1)` and most drivers send SIGTERM first; without a handler the
+    process dies mid-retry with nothing on stdout (BENCH_r03.json: rc=124,
+    parsed null).  SIGKILL can't be caught — that's what the provisional
+    line is for.
+    """
+    import signal
+
+    def die(signum, frame):  # noqa: ARG001 — signal handler signature
+        if not _state["done"]:
+            name = signal.Signals(signum).name
+            _emit(None, error=f"killed by {name} while {_state['phase']} "
+                              "(driver timeout beat the retry budget?)")
+        os._exit(1)
+
+    for sig in (signal.SIGTERM, signal.SIGALRM):
+        try:
+            signal.signal(sig, die)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            pass
 
 
 def _log_chip_holders() -> None:
@@ -146,9 +205,10 @@ def _run_validate_checklist(root: Optional[str] = None) -> bool:
     if not os.path.isfile(script):
         return False
     out_path = os.path.join(root, f"VALIDATE_{_next_round_tag(root)}.txt")
-    timeout_s = float(os.environ.get("SOFA_BENCH_VALIDATE_TIMEOUT_S", "1200"))
+    timeout_s = float(os.environ.get("SOFA_BENCH_VALIDATE_TIMEOUT_S", "600"))
     _log(f"bench: running validate_tpu checklist -> {out_path} "
          f"(timeout {timeout_s:.0f}s)")
+    _state["phase"] = "running validate_tpu checklist"
     t0 = time.time()
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
@@ -218,8 +278,10 @@ def _init_backend(budget_s: Optional[float] = None,
     probe hang/failure costs us nothing in-process, so waiting is free and
     safe.  The observed failure mode is a tunnel that dies for HOURS (rounds
     1 and 2 both lost the race with a ~2.5 min retry window), so retries run
-    against a total time budget — SOFA_BENCH_RETRY_BUDGET_S, default 40 min —
-    with capped exponential backoff rather than a fixed attempt count.
+    against a total time budget — SOFA_BENCH_RETRY_BUDGET_S, default 15 min:
+    round 3 proved the driver's own timeout is ~20 min, and a budget that
+    outlives the driver means the driver kills us mid-retry — with capped
+    exponential backoff rather than a fixed attempt count.
 
     On the first healthy probe the validate_tpu checklist runs in the same
     window (subprocess — see _run_validate_checklist), then the real
@@ -230,7 +292,7 @@ def _init_backend(budget_s: Optional[float] = None,
     import jax
 
     if budget_s is None:
-        budget_s = float(os.environ.get("SOFA_BENCH_RETRY_BUDGET_S", "2400"))
+        budget_s = float(os.environ.get("SOFA_BENCH_RETRY_BUDGET_S", "900"))
     deadline = time.monotonic() + budget_s
     backoff, attempt, last, validated = 15.0, 0, None, False
     while True:
@@ -239,6 +301,9 @@ def _init_backend(budget_s: Optional[float] = None,
             if remaining <= 0:
                 raise last or RuntimeError(
                     f"no healthy tunnel window within {budget_s:.0f}s budget")
+            _emit_provisional_once()
+            _state["phase"] = (f"retrying backend init "
+                               f"({remaining:.0f}s budget left)")
             sleep = min(backoff, max(remaining, 1.0))
             _log(f"bench: retry {attempt} in {sleep:.0f}s "
                  f"(budget {remaining:.0f}s left)")
@@ -315,6 +380,8 @@ def main() -> int:
                         "compile; the median of 3 discards it)")
     args = p.parse_args()
 
+    _install_signal_handlers()
+
     import os
 
     import jax
@@ -330,6 +397,7 @@ def main() -> int:
 
     from sofa_tpu.workloads.resnet import create, make_train_step
 
+    _state["phase"] = "initializing backend"
     try:
         _init_backend()
     except Exception as e:
@@ -358,6 +426,7 @@ def main() -> int:
     logdir = tempfile.mkdtemp(prefix="sofa_bench_") + "/"
     try:
         for r in range(args.repeats):
+            _state["phase"] = f"measuring pass {r + 1}/{args.repeats}"
             tb = _time_steps(step, state_maker, args.steps, annotate=False)
             bare.append(tb)
             run_dir = f"{logdir}r{r}/"

@@ -73,11 +73,20 @@ def load_frames(cfg: SofaConfig,
 
 
 # Frames whose deviceId column is a device/host ordinal that must rebase
-# per host on a cluster merge; every other frame's deviceId means a core /
-# lane index and host identity is carried in `pid` instead.
+# per host on a cluster merge.  Every other frame's deviceId means a core /
+# lane index; its host identity is the `host` column stamped on every merged
+# frame, plus — for _HOST_SAMPLER_FRAMES only — the repurposed pid column.
 _DEVICE_ID_FRAMES = frozenset(
     {"tputrace", "tpusteps", "tpumodules", "tpuutil", "hosttrace",
      "customtrace", "tpumon"})
+
+# Host-sampler frames whose pid column is unused (-1): a cluster merge may
+# repurpose it for the host ordinal.  cputrace/strace/pystacks/blktrace carry
+# the REAL sampled process pid there (perf_script.py:121) and must not be
+# overwritten — their host identity rides the `host` column stamped on every
+# merged frame instead.
+_HOST_SAMPLER_FRAMES = frozenset(
+    {"mpstat", "vmstat", "diskstat", "netbandwidth", "nettrace"})
 
 
 def cluster_host_cfgs(cfg: SofaConfig):
@@ -151,11 +160,13 @@ def load_cluster_frames(cfg: SofaConfig,
                     # heartbeat/aggregate rows (-1) stay; real ordinals
                     # rebase to the host's base
                     df["deviceId"] = np.where(dev >= 0, dev + i * 256, dev)
-            elif "pid" in df.columns:
-                # Host-sampler frames (mpstat/netbandwidth/...) use
-                # deviceId for the CORE/lane index; host identity rides
-                # the otherwise-unused pid column instead.
+            elif key in _HOST_SAMPLER_FRAMES and "pid" in df.columns:
+                # Host-sampler frames use deviceId for the CORE/lane index;
+                # host identity rides the otherwise-unused pid column.
+                # Frames with real sampled pids (cputrace/strace/...) are
+                # left intact — consumers use `host` for identity there.
                 df["pid"] = i
+            df["host"] = i
             merged.setdefault(key, []).append(df)
     return {k: pd.concat(v, ignore_index=True) for k, v in merged.items()}
 

@@ -59,7 +59,10 @@ struct Slice {
 
   Slice sub() {  // length-delimited payload
     uint64_t n = varint();
-    if (!ok || p + n > end) {
+    // Compare against the remaining length, never `p + n > end`: n is a
+    // corruption-controlled varint and p + n can overflow (pointer UB),
+    // wrap below `end`, and pass the check with wild subsequent reads.
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
       ok = false;
       return {end, end};
     }
@@ -70,13 +73,15 @@ struct Slice {
 
   void skip(uint32_t wire_type) {
     switch (wire_type) {
+      // Clamp fixed-width skips to `end`: advancing p past end would make
+      // sub()'s `end - p` remaining-length math go negative (huge as
+      // uint64) if a caller raced ahead of the ok flag.
       case 0: varint(); break;
-      case 1: p += 8; break;
+      case 1: if (end - p >= 8) { p += 8; } else { p = end; ok = false; } break;
       case 2: sub(); break;
-      case 5: p += 4; break;
+      case 5: if (end - p >= 4) { p += 4; } else { p = end; ok = false; } break;
       default: ok = false;
     }
-    if (p > end) ok = false;
   }
 };
 

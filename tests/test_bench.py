@@ -40,6 +40,17 @@ class FakeTime:
         return None
 
 
+@pytest.fixture(autouse=True)
+def fresh_bench_state(monkeypatch):
+    """bench._state is a module global the retry loop and signal handler
+    mutate; give every test its own copy so no ordering can leak a stale
+    provisional/done flag (or an unexpected provisional stdout line) into
+    another test."""
+    monkeypatch.setattr(bench, "_state",
+                        {"phase": "starting", "done": False,
+                         "provisional": False})
+
+
 @pytest.fixture
 def fake_time(monkeypatch):
     ft = FakeTime()
@@ -161,6 +172,93 @@ def test_overhead_budget_smoke(tmp_path, monkeypatch):
         assert row in table, row
     # every non-baseline row carries a marginal or an explicit unavailable
     assert table.count(" % |") + table.count("unavailable") >= 7
+
+
+def test_provisional_line_emitted_once_on_retry(fake_time, monkeypatch,
+                                                capsys):
+    """The retry loop's FIRST wait leaves a parseable JSON line on stdout so
+    even an uncatchable SIGKILL (BENCH_r03: rc=124, parsed null) yields a
+    non-null parse; later waits must not repeat it, and the final result
+    line supersedes it as the last line."""
+    import json
+
+    monkeypatch.setattr(bench, "_state",
+                        {"phase": "t", "done": False, "provisional": False})
+    outcomes = ["down", "down", None]
+    monkeypatch.setattr(bench, "_preflight",
+                        lambda timeout_s=60.0: outcomes.pop(0))
+    monkeypatch.setattr(bench, "_probed_backend", "cpu")
+    monkeypatch.setattr(bench, "_log_chip_holders", lambda: None)
+    monkeypatch.setattr(bench, "_with_timeout", lambda fn, t: ["dev"])
+    bench._init_backend(budget_s=3600.0)
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 1  # once, not per-retry
+    row = json.loads(lines[0])
+    assert row["value"] is None and row["provisional"] is True
+    assert "provisional" in row["error"]
+
+
+def test_default_budget_under_driver_window(fake_time, monkeypatch):
+    """Default retry budget must stay under the ~20 min driver timeout —
+    round 3 proved a 40 min budget just means the driver kills us first."""
+    monkeypatch.delenv("SOFA_BENCH_RETRY_BUDGET_S", raising=False)
+    monkeypatch.setattr(bench, "_preflight", lambda timeout_s=60.0: "down")
+    monkeypatch.setattr(bench, "_log_chip_holders", lambda: None)
+    with pytest.raises(RuntimeError):
+        bench._init_backend()
+    assert sum(fake_time.sleeps) <= 900.0 + 150.0
+
+
+def test_sigterm_emits_error_json():
+    """A driver SIGTERM mid-retry must still produce the JSON error line —
+    run a real subprocess, signal it, and parse its stdout."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as real_time
+
+    code = (
+        "import sys, time; sys.path.insert(0, %r); import bench\n"
+        "bench._install_signal_handlers()\n"
+        "bench._state['phase'] = 'retrying backend init (test)'\n"
+        "print('READY', file=sys.stderr, flush=True)\n"
+        "time.sleep(60)\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        # wait for the handler to be installed before signalling
+        assert proc.stderr.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=20)
+    finally:
+        proc.kill()
+    row = json.loads(out.strip().splitlines()[-1])
+    assert row["value"] is None
+    assert "SIGTERM" in row["error"] and "retrying backend init" in row["error"]
+    assert proc.returncode == 1
+
+
+def test_final_emit_silences_signal_handler(monkeypatch, capsys):
+    """After the real result line is printed, a late SIGTERM must NOT print
+    a second JSON line (the driver parses the last line)."""
+    monkeypatch.setattr(bench, "_state",
+                        {"phase": "t", "done": False, "provisional": False})
+    bench._emit(1.23)
+    capsys.readouterr()
+    exits = []
+    monkeypatch.setattr(bench.os, "_exit", lambda rc: exits.append(rc))
+    import signal
+
+    bench._install_signal_handlers()
+    signal.getsignal(signal.SIGTERM)(signal.SIGTERM, None)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGALRM, signal.SIG_DFL)
+    assert capsys.readouterr().out == ""
+    assert exits == [1]
 
 
 def test_validate_checklist_skips_cpu_smoke(tmp_path, monkeypatch):

@@ -185,6 +185,40 @@ def test_export_cluster_merged_perfetto(tmp_path):
     assert pids == {0, 256}
 
 
+def test_cluster_merge_preserves_real_pids(tmp_path):
+    """Frames that carry the REAL sampled process pid (cputrace, strace,
+    blktrace...) must survive a cluster merge intact — only host-sampler
+    frames (mpstat/netbandwidth/...) get pid repurposed as the host ordinal;
+    host identity for everything rides the stamped `host` column (r3 advisor
+    finding, analyze.py load_cluster_frames)."""
+    from sofa_tpu.analyze import load_cluster_frames
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.trace import make_frame, write_csv
+
+    base = str(tmp_path / "clog")
+    for host, tb in (("ha", 1000.0), ("hb", 1000.0)):
+        d = base + f"-{host}/"
+        os.makedirs(d)
+        with open(d + "sofa_time.txt", "w") as f:
+            f.write(f"{tb}\n")
+        write_csv(make_frame([
+            {"timestamp": 1.0, "duration": 0.01, "deviceId": 2,
+             "category": 0, "name": "main", "pid": 4242},
+        ]), d + "cputrace.csv")
+        write_csv(make_frame([
+            {"timestamp": 1.0, "duration": 1.0, "deviceId": 0,
+             "category": 0, "name": "rxkB/s", "event": 5.0, "pid": -1},
+        ]), d + "netbandwidth.csv")
+    cfg = SofaConfig(logdir=base + "/", cluster_hosts=["ha", "hb"])
+    frames = load_cluster_frames(cfg, only=["cputrace", "netbandwidth"])
+    cpu = frames["cputrace"].sort_values("host")
+    assert cpu["pid"].tolist() == [4242, 4242]  # NOT overwritten
+    assert cpu["host"].tolist() == [0, 1]
+    net = frames["netbandwidth"].sort_values("host")
+    assert net["pid"].tolist() == [0, 1]  # sampler: host ordinal in pid
+    assert net["host"].tolist() == [0, 1]
+
+
 def test_export_empty_logdir_degrades(tmp_path):
     from sofa_tpu.export_static import export_static
 

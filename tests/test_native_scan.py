@@ -77,7 +77,7 @@ def _assert_frames_equal(frames_native, frames_py):
                 "customtrace", "tpuutil"):
         pd.testing.assert_frame_equal(
             frames_native[key], frames_py[key], check_dtype=False,
-            check_exact=False, rtol=1e-12, atol=1e-15), key
+            check_exact=False, rtol=1e-12, atol=1e-15, obj=key)
     assert frames_native["_meta"] == frames_py["_meta"]
 
 
