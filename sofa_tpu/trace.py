@@ -18,10 +18,20 @@ Column semantics (base 13, reference-compatible):
   deviceId   int    host = -1; TPU core/chip ordinal otherwise; cpu core for
                     per-core samplers
   copyKind   int    data-movement taxonomy, see CopyKind
-  payload    int    bytes moved (copies/packets) or event-specific magnitude
-  bandwidth  float  bytes/second for transfers
-  pkt_src    int    packed IPv4 of the sender (packets only)
-  pkt_dst    int    packed IPv4 of the receiver (packets only)
+  payload    int    bytes moved (copies/packets) or event-specific magnitude.
+                    NOTE dual semantics: for copies/packets (copyKind < 20)
+                    this is wire bytes; for collectives (copyKind >= 20) it
+                    is bytes_accessed — HBM reads+writes, NOT bytes over
+                    ICI.  comm.csv's ici_bytes column / comm_*_ici_bytes
+                    features carry the wire-byte estimate for collectives
+                    (analysis/comm._wire_bytes).
+  bandwidth  float  bytes/second for transfers — payload/duration, so it
+                    inherits payload's dual semantics (memory-byte rate for
+                    collectives, wire rate for copies)
+  pkt_src    int    sender address id (packets only): packed IPv4 below
+                    V6_ID_BASE, interned IPv6 id at/above it (the literal
+                    lives in the capture's net_addrs.csv side table)
+  pkt_dst    int    receiver address id, same encoding as pkt_src
   pid        int
   tid        int
   name       str    human-readable event name (demangled symbol, HLO op, ...)
@@ -221,6 +231,11 @@ def make_frame(rows_or_cols) -> pd.DataFrame:
     for col in COLUMNS:
         if col not in df.columns:
             df[col] = _DEFAULTS[col]
+        elif df[col].isna().any():
+            # rows that omit a key another row provides must still get the
+            # schema default, not NaN — NaN silently falls out of every
+            # `category == 0`-style filter downstream
+            df[col] = df[col].fillna(_DEFAULTS[col])
     return df[COLUMNS]
 
 
@@ -298,16 +313,26 @@ def read_frame(base_path: str) -> Optional[pd.DataFrame]:
 
 
 def downsample(df: pd.DataFrame, max_points: int) -> pd.DataFrame:
-    """Stride-downsample a frame to at most ``max_points`` rows.
+    """Downsample a frame to ~``max_points`` rows, never dropping stragglers.
 
     The reference downsampled with a fixed iteration stride
     (sofa_preprocess.py:51-57); a target row count adapts to trace volume,
     which matters far more for HLO-op traces (SURVEY §7 "Trace volume").
+    A pure stride keeps every k-th row, so a rare 100 ms straggler op
+    between strides would vanish from exactly the timeline region the user
+    zooms first — the kept set is therefore the UNION of the stride sample
+    and the top-K rows by duration (K = max_points/10), in original order.
     """
     if max_points <= 0 or len(df) <= max_points:
         return df
-    stride = int(np.ceil(len(df) / max_points))
-    return df.iloc[::stride]
+    k = max(1, max_points // 10) if "duration" in df.columns else 0
+    stride = int(np.ceil(len(df) / max(1, max_points - k)))
+    keep = np.zeros(len(df), dtype=bool)
+    keep[::stride] = True
+    if k:
+        dur = pd.to_numeric(df["duration"], errors="coerce").fillna(0.0)
+        keep[np.argsort(dur.to_numpy())[-k:]] = True
+    return df.iloc[np.flatnonzero(keep)]
 
 
 @dataclass
@@ -393,12 +418,48 @@ def packed_ip(ip: str) -> int:
     return value
 
 
-def unpack_ip(value: int) -> str:
+# IPv6 addresses can't ride the 1000-base IPv4 packing (128 bits vs the
+# float64-exact 2^53 ceiling); they are interned instead — ids counted up
+# from V6_ID_BASE, literal addresses in the capture's net_addrs.csv side
+# table.  The base sits above any packed IPv4 (max 255255255255 ≈ 2.6e11)
+# and well below 2^53, so ids stay exact through the float frame columns.
+V6_ID_BASE = 10 ** 12
+
+
+def unpack_ip(value: int, addrs: "dict | None" = None) -> str:
+    """Integer address id -> literal. ``addrs`` is the interned id->literal
+    table (net_addrs.csv) for IPv6 ids; without it a v6 id degrades to a
+    stable placeholder rather than a wrong dotted quad."""
     if value < 0:  # -1 is the schema's "not a packet" sentinel
         return "n/a"
-    octets = []
     v = int(value)
+    if v >= V6_ID_BASE:
+        if addrs:
+            hit = addrs.get(v)
+            if hit:
+                return hit
+        return f"ipv6#{v - V6_ID_BASE}"
+    octets = []
     for i in range(4):
         octets.append(v // 1000 ** (3 - i))
         v %= 1000 ** (3 - i)
     return ".".join(str(o) for o in octets)
+
+
+def read_net_addrs(path: str) -> dict:
+    """Load a capture's interned id->literal address table (net_addrs.csv,
+    written by ingest_pcap when non-IPv4 packets appear). Missing file ->
+    empty dict: every consumer degrades to unpack_ip placeholders."""
+    import csv
+    import os
+
+    table: dict = {}
+    if not os.path.isfile(path):
+        return table
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            try:
+                table[int(row["id"])] = row["address"]
+            except (KeyError, ValueError):
+                continue
+    return table

@@ -69,6 +69,17 @@ def test_read_csv_fills_missing_extension_columns(tmp_path):
     assert df.loc[0, "flops"] == 0.0
 
 
+def test_make_frame_fills_per_row_gaps():
+    """A row omitting a key that ANOTHER row provides must get the schema
+    default, not NaN — NaN silently falls out of `category == 0` filters."""
+    df = make_frame([
+        {"timestamp": 0.0, "name": "a"},
+        {"timestamp": 1.0, "name": "b", "category": 2},
+    ])
+    assert df["category"].tolist() == [0, 2]
+    assert not df.isna().any().any()
+
+
 def test_downsample():
     df = make_frame([{"timestamp": i * 0.01, "name": str(i)} for i in range(1000)])
     out = downsample(df, 100)
@@ -76,6 +87,30 @@ def test_downsample():
     assert out.iloc[0]["name"] == "0"
     assert downsample(df, 0) is df
     assert downsample(df, 2000) is df
+
+
+def test_downsample_keeps_stragglers():
+    """Reduction must be duration-weighted, not pure stride: a rare long op
+    that falls between strides is exactly the event the user zooms to first
+    on a pod-scale timeline (r3 verdict #6). 1M rows -> 10k budget, the
+    single 100ms straggler and the runner-up must both survive, and the
+    budget must hold."""
+    import numpy as np
+
+    n = 1_000_000
+    rows = pd.DataFrame({
+        "timestamp": np.arange(n) * 1e-6,
+        "duration": np.full(n, 1e-7),
+        "name": "op",
+    })
+    rows.loc[123_457, "duration"] = 0.1      # straggler OFF the stride grid
+    rows.loc[777_001, "duration"] = 0.05
+    out = downsample(rows, 10_000)
+    assert len(out) <= 10_000
+    assert 0.1 in out["duration"].values
+    assert 0.05 in out["duration"].values
+    # still time-ordered (iloc selection preserves original order)
+    assert (np.diff(out["timestamp"].to_numpy()) > 0).all()
 
 
 def test_classify_hlo_kind():
