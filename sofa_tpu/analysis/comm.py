@@ -34,6 +34,47 @@ def load_topology(cfg) -> Optional[dict]:
         return None
 
 
+def _wire_bytes(sel: pd.DataFrame, kind: int, n_devices: int) -> float:
+    """Estimated bytes a collective actually moves over ICI links, per
+    device row — the nccl-tests bus-bandwidth factors applied with each
+    op's own replica-group size g (workloads/collectives._bus_factor, the
+    same math tests/test_ici_groundtruth.py reconciles against real lowered
+    XLA collectives):
+
+      all-reduce            2 P (g-1)/g   (reduce-scatter + all-gather)
+      all-gather / r-s        P (g-1)/g
+      all-to-all              P (g-1)/g   (P/g to each of g-1 peers)
+      permute / broadcast     P
+
+    P here is the op's ``payload`` (bytes_accessed — memory traffic), so
+    the estimate inherits that calibration; ops with no recorded groups
+    fall back to the full device count (0 known devices -> factor for the
+    pairwise kinds only).
+    """
+    total = 0.0
+    for groups_json, payload in sel.groupby("groups")["payload"].sum().items():
+        payload = float(payload)
+        g = 0
+        if groups_json:
+            try:
+                parsed = json.loads(groups_json)
+                if parsed and parsed[0]:
+                    g = len(parsed[0])
+            except ValueError:
+                pass
+        if g < 2:
+            g = n_devices
+        if kind in (int(CopyKind.COLLECTIVE_PERMUTE),
+                    int(CopyKind.COLLECTIVE_BROADCAST), int(CopyKind.P2P)):
+            total += payload
+        elif g >= 2:
+            factor = (g - 1) / g
+            if kind == int(CopyKind.ALL_REDUCE):
+                factor *= 2.0
+            total += payload * factor
+    return total
+
+
 def comm_profile(frames, cfg, features: Features) -> None:
     from sofa_tpu.trace import roi_clip
 
@@ -60,23 +101,48 @@ def comm_profile(frames, cfg, features: Features) -> None:
     if moved.empty:
         features.add("comm_time", 0.0)
         return
+    topo = load_topology(cfg)
+    n_devices = len((topo or {}).get("devices", []))
     rows = []
+    total_ici = 0.0
     for kind, sel in moved.groupby("copyKind"):
         kname = CK_NAMES.get(int(kind), str(kind))
         dur = float(sel["duration"].sum())
         payload = float(sel["payload"].sum())
-        rows.append(
-            {
-                "copyKind": int(kind),
-                "kind": kname,
-                "count": len(sel),
-                "total_time": dur,
-                "total_bytes": payload,
-                "mean_bandwidth": payload / dur if dur > 0 else 0.0,
-            }
-        )
+        row = {
+            "copyKind": int(kind),
+            "kind": kname,
+            "count": len(sel),
+            "total_time": dur,
+            "total_bytes": payload,
+            "mean_bandwidth": payload / dur if dur > 0 else 0.0,
+        }
         features.add(f"comm_{kname.lower()}_time", dur)
         features.add(f"comm_{kname.lower()}_bytes", payload)
+        if int(kind) >= 20 or int(kind) == int(CopyKind.P2P):
+            # total_bytes for collectives is MEMORY traffic (bytes_accessed:
+            # HBM reads+writes); ici_bytes is the estimated WIRE traffic —
+            # the nccl-tests bus math applied per op using its replica-group
+            # size (the same model the ici_matrix booking uses, reconciled
+            # in tests/test_ici_groundtruth.py).  P2P send/recv is ICI wire
+            # traffic too, payload == wire bytes.  Host copies (H2D/D2H/D2D)
+            # need no second column: they don't cross ICI.
+            wire = _wire_bytes(sel, int(kind), n_devices)
+            row["ici_bytes"] = wire
+            row["ici_bandwidth"] = wire / dur if dur > 0 else 0.0
+            features.add(f"comm_{kname.lower()}_ici_bytes", wire)
+            total_ici += wire
+        else:
+            row["ici_bytes"] = 0.0
+            row["ici_bandwidth"] = 0.0
+        rows.append(row)
+    if total_ici > 0:
+        features.add("comm_ici_bytes", total_ici)
+        ici_mask = (moved["copyKind"] >= 20) | \
+                   (moved["copyKind"] == int(CopyKind.P2P))
+        ici_dur = float(moved.loc[ici_mask, "duration"].sum())
+        if ici_dur > 0:
+            features.add("comm_ici_bandwidth", total_ici / ici_dur)
     summary = pd.DataFrame(rows).sort_values("total_time", ascending=False)
     summary.to_csv(cfg.path("comm.csv"), index=False)
 
@@ -89,7 +155,6 @@ def comm_profile(frames, cfg, features: Features) -> None:
         print_title("Data movement by kind")
         print(summary.to_string(index=False))
 
-    topo = load_topology(cfg)
     matrix = ici_traffic_matrix(coll, topo)
     if matrix is not None:
         matrix.to_csv(cfg.path("ici_matrix.csv"))
@@ -239,7 +304,10 @@ def net_profile(frames, cfg, features: Features) -> None:
     df = frames.get("nettrace")
     if df is None or df.empty:
         return
-    from sofa_tpu.trace import unpack_ip
+    from sofa_tpu.trace import read_net_addrs, unpack_ip
+
+    # id -> literal for interned (IPv6) addresses; empty when all-v4
+    addrs = read_net_addrs(cfg.path("net_addrs.csv"))
 
     features.add("net_packets", len(df))
     features.add("net_total_bytes", float(df["payload"].sum()))
@@ -250,8 +318,8 @@ def net_profile(frames, cfg, features: Features) -> None:
         .sort_values("sum", ascending=False)
         .reset_index()
     )
-    pairs["src"] = pairs["pkt_src"].map(unpack_ip)
-    pairs["dst"] = pairs["pkt_dst"].map(unpack_ip)
+    pairs["src"] = pairs["pkt_src"].map(lambda v: unpack_ip(v, addrs))
+    pairs["dst"] = pairs["pkt_dst"].map(lambda v: unpack_ip(v, addrs))
     pairs[["src", "dst", "sum", "count"]].to_csv(cfg.path("netrank.csv"), index=False)
 
 

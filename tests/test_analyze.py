@@ -47,6 +47,70 @@ def test_tpu_profile_and_comm(cfg):
     assert f.get("hlo_time_convolution") == pytest.approx(0.08)
 
 
+def test_comm_profile_wire_vs_memory_bytes(cfg, logdir):
+    """comm.csv must report BOTH byte semantics for collectives (r3 verdict
+    #8): total_bytes = bytes_accessed (HBM traffic) and ici_bytes = the
+    bus-math wire estimate using each op's replica-group size; plain copies
+    carry ici_bytes=0 (their payload already IS wire bytes)."""
+    import json
+
+    with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+        json.dump({"devices": [{"id": i, "coords": [i, 0, 0]}
+                               for i in range(8)]}, f)
+    rows = []
+    for i in range(4):  # one row per participant, as XPlane records them
+        rows.append({"timestamp": 0.01 * i, "duration": 1e-3, "deviceId": i,
+                     "copyKind": int(CopyKind.ALL_REDUCE),
+                     "name": "all-reduce.0", "payload": 1_000_000,
+                     "groups": "[[0, 1, 2, 3]]", "device_kind": "tpu"})
+    rows.append({"timestamp": 0.1, "duration": 1e-3, "deviceId": 0,
+                 "copyKind": int(CopyKind.H2D), "name": "infeed",
+                 "payload": 5_000_000, "category": 2, "device_kind": "tpu"})
+    frames = {"tputrace": make_frame(rows)}
+    f = Features()
+    comm.comm_profile(frames, cfg, f)
+    table = pd.read_csv(cfg.path("comm.csv")).set_index("kind")
+    ar = table.loc["ALL_REDUCE"]
+    assert ar["total_bytes"] == pytest.approx(4e6)      # memory semantics
+    # wire: per device 2*P*(g-1)/g = 1.5e6, g=4 from the op's OWN groups
+    assert ar["ici_bytes"] == pytest.approx(4 * 1.5e6)
+    assert ar["ici_bandwidth"] == pytest.approx(6e6 / 4e-3)
+    assert table.loc["H2D"]["ici_bytes"] == 0.0
+    assert f.get("comm_all_reduce_ici_bytes") == pytest.approx(6e6)
+    assert f.get("comm_ici_bytes") == pytest.approx(6e6)
+
+
+def test_comm_profile_p2p_counts_as_ici_wire_bytes(cfg):
+    """P2P send/recv (copyKind 10) IS ICI wire traffic — it must land in
+    ici_bytes/comm_ici_bytes with payload == wire bytes, even though its
+    copyKind sits below the collective range."""
+    frames = {"tputrace": make_frame([
+        {"timestamp": 0.0, "duration": 2e-3, "deviceId": 0, "category": 2,
+         "copyKind": int(CopyKind.P2P), "name": "send.0",
+         "payload": 3_000_000, "device_kind": "tpu"}])}
+    f = Features()
+    comm.comm_profile(frames, cfg, f)
+    table = pd.read_csv(cfg.path("comm.csv")).set_index("kind")
+    assert table.loc["P2P"]["ici_bytes"] == pytest.approx(3e6)
+    assert f.get("comm_ici_bytes") == pytest.approx(3e6)
+    assert f.get("comm_ici_bandwidth") == pytest.approx(3e6 / 2e-3)
+
+
+def test_comm_profile_wire_bytes_no_groups_falls_back_to_topo(cfg, logdir):
+    import json
+
+    with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+        json.dump({"devices": [{"id": i} for i in range(8)]}, f)
+    frames = {"tputrace": make_frame([
+        {"timestamp": 0.0, "duration": 1e-3, "deviceId": 0,
+         "copyKind": int(CopyKind.ALL_GATHER), "name": "all-gather.0",
+         "payload": 8_000_000, "device_kind": "tpu"}])}
+    f = Features()
+    comm.comm_profile(frames, cfg, f)
+    # no groups recorded -> g = 8 known devices; P*(g-1)/g = 7e6
+    assert f.get("comm_all_gather_ici_bytes") == pytest.approx(7e6)
+
+
 def test_ici_matrix_ring_model():
     # One op row per participating device, as XPlane records collectives.
     coll = make_frame([

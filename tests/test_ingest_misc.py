@@ -1,6 +1,8 @@
 import math
 import struct
 
+import pandas as pd
+
 import pytest
 
 from sofa_tpu.ingest.pcap import parse_pcap_bytes
@@ -159,9 +161,141 @@ def test_parse_pcap_sll():
     assert df.iloc[0]["name"].startswith("udp")
 
 
+def _ipv6(src, dst, proto=6, sport=1234, dport=443, payload=b"x" * 100,
+          ext=b"", ext_type=0):
+    """40-byte fixed header (+ optional raw extension-header bytes; the
+    fixed header's next-header then points at ext_type, and ext's own first
+    octet must name the real transport proto)."""
+    import ipaddress
+
+    l4 = struct.pack("!HH", sport, dport) + payload
+    hdr = struct.pack(
+        "!IHBB16s16s", 6 << 28, len(ext) + len(l4),
+        ext_type if ext else proto, 64,
+        ipaddress.IPv6Address(src).packed, ipaddress.IPv6Address(dst).packed)
+    return hdr + ext + l4
+
+
+def test_parse_pcap_ipv6_ethernet():
+    """TPU-pod DCN traffic is commonly IPv6 — ethertype 0x86DD packets must
+    produce nettrace rows with interned address ids (reference parity gap:
+    sofa_preprocess.py is v4-only)."""
+    from sofa_tpu.trace import V6_ID_BASE
+
+    eth = b"\x00" * 12 + struct.pack("!H", 0x86DD)
+    p1 = eth + _ipv6("fd00::1", "fd00::2", dport=8471)
+    p2 = eth + _ipv6("fd00::2", "fd00::1", proto=17, dport=53)
+    df = parse_pcap_bytes(_pcap(1, [(1.0, p1), (2.0, p2)]), time_base=0.0)
+    assert len(df) == 2
+    r1, r2 = df.iloc[0], df.iloc[1]
+    assert r1["pkt_src"] == V6_ID_BASE + 0  # fd00::1 interned first
+    assert r1["pkt_dst"] == V6_ID_BASE + 1
+    assert r2["pkt_src"] == V6_ID_BASE + 1  # same address, same id
+    assert r2["pkt_dst"] == V6_ID_BASE + 0
+    assert r1["name"] == "tcp6 [fd00::1]:1234->[fd00::2]:8471"
+    assert r2["name"].startswith("udp6")
+    assert r1["duration"] == pytest.approx(r1["payload"] / 128e6)
+
+
+def test_parse_pcap_ipv6_extension_headers():
+    """Ports must be read past hop-by-hop / fragment extension headers, not
+    from the raw bytes at offset 40."""
+    # hop-by-hop: next=6 (tcp), len 0 -> 8 bytes total
+    hbh = bytes([6, 0]) + b"\x00" * 6
+    eth = b"\x00" * 12 + struct.pack("!H", 0x86DD)
+    pkt = eth + _ipv6("2001:db8::a", "2001:db8::b", dport=9009, ext=hbh)
+    df = parse_pcap_bytes(_pcap(1, [(1.0, pkt)]))
+    assert len(df) == 1
+    assert df.iloc[0]["name"].endswith(":9009")
+    assert df.iloc[0]["name"].startswith("tcp6")
+
+
+def test_ingest_pcap_writes_net_addrs_table(tmp_path):
+    """End-to-end: a mixed v4/v6 capture file produces nettrace rows AND the
+    net_addrs.csv side table netrank uses to print literal v6 addresses."""
+    from sofa_tpu.ingest.pcap import ingest_pcap
+    from sofa_tpu.trace import read_net_addrs, unpack_ip
+
+    eth4 = b"\x00" * 12 + struct.pack("!H", 0x0800)
+    eth6 = b"\x00" * 12 + struct.pack("!H", 0x86DD)
+    blob = _pcap(1, [
+        (1.0, eth4 + _ipv4("10.0.0.1", "10.0.0.2")),
+        (2.0, eth6 + _ipv6("fd00::1", "fd00::2", dport=8471)),
+    ])
+    path = tmp_path / "sofa.pcap"
+    path.write_bytes(blob)
+    df = ingest_pcap(str(path))
+    assert len(df) == 2
+    addrs = read_net_addrs(str(tmp_path / "net_addrs.csv"))
+    assert sorted(addrs.values()) == ["fd00::1", "fd00::2"]
+    v6row = df[df["name"].str.startswith("tcp6")].iloc[0]
+    assert unpack_ip(v6row["pkt_src"], addrs) == "fd00::1"
+    # without the table the id degrades to a placeholder, not a wrong quad
+    assert unpack_ip(v6row["pkt_src"]).startswith("ipv6#")
+
+
+def test_netrank_prints_literal_v6_addresses(tmp_path):
+    """The comm-report's peers table (netrank.csv) must show real IPv6
+    literals, resolved through the net_addrs.csv side table, not packed-int
+    ids or bogus dotted quads."""
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.analysis.comm import net_profile
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.ingest.pcap import ingest_pcap
+
+    eth6 = b"\x00" * 12 + struct.pack("!H", 0x86DD)
+    blob = _pcap(1, [
+        (1.0, eth6 + _ipv6("fd00::1", "fd00::2", dport=8471)),
+        (1.5, eth6 + _ipv6("fd00::1", "fd00::2", dport=8471)),
+    ])
+    (tmp_path / "sofa.pcap").write_bytes(blob)
+    cfg = SofaConfig(logdir=str(tmp_path) + "/")
+    frames = {"nettrace": ingest_pcap(cfg.path("sofa.pcap"))}
+    net_profile(frames, cfg, Features())
+    rank = pd.read_csv(cfg.path("netrank.csv"))
+    assert rank.iloc[0]["src"] == "fd00::1"
+    assert rank.iloc[0]["dst"] == "fd00::2"
+    assert rank.iloc[0]["count"] == 2
+
+
+def test_ingest_pcap_all_v4_no_table(tmp_path):
+    from sofa_tpu.ingest.pcap import ingest_pcap
+
+    eth4 = b"\x00" * 12 + struct.pack("!H", 0x0800)
+    path = tmp_path / "sofa.pcap"
+    path.write_bytes(_pcap(1, [(1.0, eth4 + _ipv4("10.0.0.1", "10.0.0.2"))]))
+    assert len(ingest_pcap(str(path))) == 1
+    assert not (tmp_path / "net_addrs.csv").exists()
+
+
+def test_parse_pcap_fuzz_random_packets():
+    """Wire-format fuzz: random packet bodies behind valid pcap framing must
+    never raise (rows are best-effort) across all supported link types,
+    including truncated/garbled v6 extension-header chains."""
+    import random
+
+    rng = random.Random(20260730)
+    for linktype in (1, 101, 113, 276):
+        pkts = []
+        for _ in range(60):
+            body = bytes(rng.randrange(256) for _ in range(rng.randrange(80)))
+            if rng.random() < 0.5 and linktype == 1:
+                body = (b"\x00" * 12 + struct.pack("!H", 0x86DD)
+                        + bytes([0x60]) + body)
+            pkts.append((rng.random() * 10, body))
+        df = parse_pcap_bytes(_pcap(linktype, pkts))
+        # whatever rows survive must be schema-complete
+        if not df.empty:
+            assert (df["payload"] >= 0).all()
+
+
 def test_parse_pcap_garbage():
     assert parse_pcap_bytes(b"not a pcap at all").empty
     assert parse_pcap_bytes(b"").empty
+    # truncated v6 headers / unknown versions must be skipped, not crash
+    eth6 = b"\x00" * 12 + struct.pack("!H", 0x86DD)
+    assert parse_pcap_bytes(_pcap(1, [(1.0, eth6 + b"\x60\x00")])).empty
+    assert parse_pcap_bytes(_pcap(1, [(1.0, eth6 + b"\x90" + b"\x00" * 60)])).empty
 
 
 TPUMON_FIXTURE = """\
