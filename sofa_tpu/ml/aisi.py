@@ -152,7 +152,12 @@ def detect_iterations(
     symbols = {}
     seq = [symbols.setdefault(n, len(symbols)) for n in names]
     sa = SuffixAutomaton(seq)
-    candidates = sa.repeat_candidates(num_iterations, tolerance=tolerance)
+    candidates = sa.repeat_candidates(
+        num_iterations, tolerance=tolerance,
+        # the expected period anchors the candidate ordering; without it a
+        # long periodic trace yields thousands of multi-period candidates
+        # and the truncated list never contains the true step pattern
+        prefer_len=len(seq) / max(num_iterations, 1))
     best_occ: List[int] = []
     best_len = 0
     best_key = None

@@ -88,14 +88,20 @@ class SuffixAutomaton:
         tolerance: int = 0,
         min_len: int = 1,
         max_candidates: int = 32,
+        prefer_len: Optional[float] = None,
     ) -> List[Tuple[int, int, int]]:
         """Substrings whose (overlapping) occurrence count is target±tolerance.
 
         Returns up to max_candidates (start, length, count) tuples of first
-        occurrences, longest first.  Overlapping counts over-report periodic
-        patterns (a 2-period pattern in a k-period sequence occurs k-1 times,
-        not k/2), so callers must re-verify candidates with a non-overlapping
-        scan (find_occurrences) before trusting the count.
+        occurrences — nearest ``prefer_len`` first when given (callers that
+        know the expected period, e.g. len(seq)/target, MUST pass it: on a
+        long k-period sequence the in-tolerance candidates number in the
+        thousands and are dominated by multi-period patterns, so a plain
+        longest-first truncation would drop every single-period candidate),
+        longest first otherwise.  Overlapping counts over-report periodic
+        patterns (a 2-period pattern in a k-period sequence occurs k-1
+        times, not k/2), so callers must re-verify candidates with a
+        non-overlapping scan (find_occurrences) before trusting the count.
         """
         cnt = self.occurrence_counts()
         out = []
@@ -103,7 +109,10 @@ class SuffixAutomaton:
             c = cnt[s]
             if abs(c - target) <= tolerance and self.length[s] >= min_len:
                 out.append((self.first_end[s] - self.length[s] + 1, self.length[s], c))
-        out.sort(key=lambda t: -t[1])
+        if prefer_len is not None:
+            out.sort(key=lambda t: (abs(t[1] - prefer_len), -t[1]))
+        else:
+            out.sort(key=lambda t: -t[1])
         return out[:max_candidates]
 
     def best_repeat(
@@ -140,24 +149,70 @@ def fuzzy_occurrences(
     seq: Sequence[Hashable],
     pattern: Sequence[Hashable],
     min_ratio: float = 0.9,
+    max_full_checks: int = 20_000,
 ) -> List[int]:
     """Non-overlapping matches allowing small edits (the reference's
-    fuzzywuzzy ratio>=90 block scan, sofa_aisi.py:259-271), via difflib."""
-    import difflib
+    fuzzywuzzy ratio>=90 block scan, sofa_aisi.py:259-271), via difflib.
 
-    out = []
+    A naive scan runs difflib at every position — O(n·m²) on the degraded
+    captures (no Steps, no markers) where this fallback triggers, which can
+    be ~10^5 events (r3 verdict #6).  Positions are instead pre-screened
+    with an incrementally-maintained multiset bound: difflib's ratio() can
+    never exceed quick_ratio() = 2·Σmin(counts)/(|window|+|pattern|), and
+    that bound updates in O(1) as the window slides, so the full matcher
+    only runs where a match is arithmetically possible.  A hard cap on full
+    checks bounds adversarial inputs; hitting it warns and returns the
+    matches found so far.
+    """
+    import difflib
+    from collections import Counter
+
+    out: List[int] = []
     m = len(pattern)
     if m == 0:
         return out
     pat = list(pattern)
-    i = 0
     n = len(seq)
-    while i + m // 2 <= n:
-        window = list(seq[i:i + m])
-        ratio = difflib.SequenceMatcher(None, window, pat).ratio()
-        if ratio >= min_ratio:
-            out.append(i)
-            i += max(len(window), 1)
-        else:
-            i += 1
+    pcount = Counter(pat)
+
+    i = 0
+    full_checks = 0
+    wc: Optional[Counter] = None     # counts for the window at i
+    common = 0                       # Σ min(wc[x], pcount[x]) for that window
+    # the i < n guard matters for m == 1, where i + m//2 <= n admits i == n
+    # (an empty window that can never match but whose slide would read
+    # seq[n])
+    while i + m // 2 <= n and i < n:
+        j = min(i + m, n)
+        if wc is None:  # (re)build after init or a post-match jump
+            wc = Counter(seq[i:j])
+            common = sum(min(c, pcount[x]) for x, c in wc.items())
+        wlen = j - i
+        if 2.0 * common / (wlen + m) >= min_ratio:  # quick_ratio bound
+            full_checks += 1
+            if full_checks > max_full_checks:
+                from sofa_tpu.printing import print_warning
+
+                print_warning(
+                    f"fuzzy iteration scan capped after {max_full_checks} "
+                    f"window checks ({len(out)} matches kept; sequence of "
+                    f"{n} events is too noisy for the fuzzy fallback)")
+                return out
+            window = list(seq[i:j])
+            if difflib.SequenceMatcher(None, window, pat).ratio() >= min_ratio:
+                out.append(i)
+                i += max(wlen, 1)
+                wc = None  # window jumped; rebuild lazily
+                continue
+        # slide one position: drop seq[i], admit seq[i+m] if it exists
+        x = seq[i]
+        if wc[x] <= pcount[x]:
+            common -= 1
+        wc[x] -= 1
+        if i + m < n:
+            y = seq[i + m]
+            wc[y] += 1
+            if wc[y] <= pcount[y]:
+                common += 1
+        i += 1
     return out
