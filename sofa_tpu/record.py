@@ -459,6 +459,7 @@ def _record_flags(cfg) -> list:
         ("xprof_delay_s", "--xprof_delay_s"),
         ("xprof_duration_s", "--xprof_duration_s"),
         ("tpu_mon_rate", "--tpu_mon_rate"),
+        ("trace_format", "--trace_format"),
     ]
     for name, flag in valued:
         v = getattr(cfg, name)
@@ -513,10 +514,16 @@ def cluster_record(command: str, cfg) -> int:
             remote_dir = None
         else:
             remote_dir = f"/tmp/sofa_tpu_record_{os.getpid()}/"
-            remote = " ".join(
-                ["sofa", "record", shlex.quote(command),
+            tail = " ".join(
+                ["record", shlex.quote(command),
                  "--logdir", shlex.quote(remote_dir)]
                 + [shlex.quote(f) for f in flags])
+            # A host may have the package importable but no `sofa` console
+            # script on a non-interactive ssh PATH — fall back to the module
+            # entry point, mirroring how local launches already work.
+            remote = (f"if command -v sofa >/dev/null 2>&1; "
+                      f"then sofa {tail}; "
+                      f"else python3 -m sofa_tpu {tail}; fi")
             argv = ["ssh", "-o", "BatchMode=yes", host, remote]
         print_progress(f"cluster: recording on {host}")
         try:

@@ -382,25 +382,23 @@ def test_cluster_record_two_localhost_hosts(tmp_path):
     assert cluster_record("exit 3", cfg2) == 3
 
 
-def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
-    """The ssh/scp remote leg of cluster_record: launch over `ssh`, fetch
-    with `scp`, clean the remote tmp dir — driven end to end with PATH
-    stubs (this image has no sshd), asserting command quoting, fetch
-    placement, and remote cleanup."""
+def _write_ssh_stubs(tmp_path, with_sofa: bool):
+    """PATH stubs simulating a remote host (this image has no sshd): `ssh`
+    executes the remote command string through a real shell — so the
+    `command -v sofa` fallback logic actually runs — and `scp` copies the
+    "remote" logdir back.  with_sofa plants a fake `sofa` console script on
+    the stub PATH; without it the remote leg must fall back to
+    `python3 -m sofa_tpu`."""
     import stat
     import sys
     import textwrap
 
-    from sofa_tpu.record import cluster_record
-
     stubs = tmp_path / "stubs"
     stubs.mkdir()
     seen = tmp_path / "ssh_calls.txt"
-    # "Remote" filesystem root: the ssh stub executes the remote sofa
-    # record by materializing its logdir; scp copies it back.
     (stubs / "ssh").write_text(textwrap.dedent(f"""\
         #!{sys.executable}
-        import os, shlex, subprocess, sys
+        import subprocess, sys
         args = sys.argv[1:]
         host, remote = args[-2], args[-1]
         with open({str(seen)!r}, "a") as f:
@@ -409,17 +407,8 @@ def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
             # guard: only the expected remote tmp dir may ever be deleted
             target = remote[len("rm -rf"):].strip()
             assert target.startswith("/tmp/sofa_tpu_record_"), target
-            subprocess.call(remote, shell=True)
-            sys.exit(0)
-        argv = shlex.split(remote)
-        assert argv[0:2] == ["sofa", "record"], argv
-        logdir = argv[argv.index("--logdir") + 1]
-        os.makedirs(logdir, exist_ok=True)
-        with open(os.path.join(logdir, "sofa_time.txt"), "w") as f:
-            f.write("1700000000.0 remote\\n")
-        with open(os.path.join(logdir, "misc.txt"), "w") as f:
-            f.write("rc 0\\n")
-        sys.exit(0)
+        # a remote shell runs the string exactly as sent
+        sys.exit(subprocess.call(remote, shell=True))
         """))
     (stubs / "scp").write_text(textwrap.dedent(f"""\
         #!{sys.executable}
@@ -428,8 +417,32 @@ def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
         host, path = src.split(":", 1)
         sys.exit(subprocess.call(["cp", "-r", path, dst]))
         """))
-    for s in ("ssh", "scp"):
-        os.chmod(stubs / s, os.stat(stubs / s).st_mode | stat.S_IEXEC)
+    if with_sofa:
+        (stubs / "sofa").write_text(textwrap.dedent(f"""\
+            #!{sys.executable}
+            import os, sys
+            argv = sys.argv[1:]
+            assert argv[0] == "record", argv
+            logdir = argv[argv.index("--logdir") + 1]
+            os.makedirs(logdir, exist_ok=True)
+            with open(os.path.join(logdir, "sofa_time.txt"), "w") as f:
+                f.write("1700000000.0 remote\\n")
+            with open(os.path.join(logdir, "misc.txt"), "w") as f:
+                f.write("rc 0\\n")
+            """))
+    for s in stubs.iterdir():
+        os.chmod(s, os.stat(s).st_mode | stat.S_IEXEC)
+    return stubs, seen
+
+
+def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
+    """The ssh/scp remote leg of cluster_record: launch over `ssh`, fetch
+    with `scp`, clean the remote tmp dir — driven end to end with PATH
+    stubs, asserting command quoting, fetch placement, and remote
+    cleanup."""
+    from sofa_tpu.record import cluster_record
+
+    stubs, seen = _write_ssh_stubs(tmp_path, with_sofa=True)
     monkeypatch.setenv("PATH", f"{stubs}:{os.environ['PATH']}")
 
     base = str(tmp_path / "clog") + "/"
@@ -443,12 +456,43 @@ def test_cluster_record_remote_host_via_ssh_stubs(tmp_path, monkeypatch):
     calls = open(seen).read().splitlines()
     # launch first, cleanup after fetch — both addressed to the host
     assert len(calls) == 2
-    assert calls[0].startswith("tpu-host-7 :: sofa record")
+    assert calls[0].startswith("tpu-host-7 :: ")
+    assert "sofa record" in calls[0]
     assert "sleep 0.1" in calls[0]
     assert calls[1].startswith("tpu-host-7 :: rm -rf")
     # the remote tmp dir was cleaned
     m = re.search(r"rm -rf (\S+)", calls[1])
     assert m and not os.path.exists(m.group(1))
+
+
+def test_cluster_record_remote_without_console_script(tmp_path, monkeypatch):
+    """A remote with the package importable but NO `sofa` on its
+    non-interactive ssh PATH must still record, via the `python3 -m
+    sofa_tpu` fallback (r3 verdict #7) — here the fallback runs the REAL
+    record into the stub's 'remote' tmp dir."""
+    import shutil
+
+    from sofa_tpu.record import cluster_record
+
+    stubs, seen = _write_ssh_stubs(tmp_path, with_sofa=False)
+    # keep every PATH entry except ones that would resolve `sofa`
+    keep = [d for d in os.environ["PATH"].split(os.pathsep)
+            if d and not os.path.isfile(os.path.join(d, "sofa"))]
+    monkeypatch.setenv("PATH", os.pathsep.join([str(stubs)] + keep))
+    assert shutil.which("sofa") is None
+
+    base = str(tmp_path / "clog") + "/"
+    cfg = SofaConfig(logdir=base, cluster_hosts=["tpu-host-9"],
+                     enable_xprof=False)
+    rc = cluster_record("sleep 0.1", cfg)
+    assert rc == 0
+    hdir = base.rstrip("/") + "-tpu-host-9/"
+    # written by the real record via the module fallback, not the fake
+    fetched = open(os.path.join(hdir, "sofa_time.txt")).read()
+    assert "remote" not in fetched
+    assert float(fetched.split()[0]) > 0
+    calls = open(seen).read().splitlines()
+    assert "python3 -m sofa_tpu record" in calls[0]
 
 
 def test_edr_trigger_fires(tmp_path):
