@@ -178,6 +178,31 @@ def detect_iterations(
     return best_occ, best_len
 
 
+def _window_time(df: pd.DataFrame, t0: float, t1: float) -> Tuple[float, int]:
+    """(total span time clipped to [t0, t1), number of overlapping spans)."""
+    ts = df["timestamp"].to_numpy(dtype=float)
+    dur = df["duration"].to_numpy(dtype=float)
+    s = np.clip(ts, t0, t1)
+    e = np.clip(ts + dur, t0, t1)
+    ov = np.maximum(e - s, 0.0)
+    # zero-duration spans (strace -T can report <0.000000>) still count as
+    # occurrences when they START inside the window
+    inside = (ts >= t0) & (ts < t1)
+    return float(ov.sum()), int(((ov > 0) | inside).sum())
+
+
+def _sample_period(pystacks: Optional[pd.DataFrame]) -> float:
+    """The py-stack sampler's tick interval, inferred from the capture
+    itself (median gap between distinct sample timestamps) — the frame
+    doesn't carry the configured rate."""
+    if pystacks is None or pystacks.empty:
+        return 0.0
+    ts = np.sort(pystacks["timestamp"].unique())
+    if len(ts) < 2:
+        return 0.0
+    return float(np.median(np.diff(ts)))
+
+
 def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
     """Detect iterations on the busiest TPU device and profile each one.
 
@@ -237,9 +262,32 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
         last_end_idx = min(starts[-1] + pattern_len, len(ts))
         ends = bounds[1:] + [float((ts + dur)[last_end_idx - 1])]
 
+    strace = frames.get("strace")
+    pystacks = frames.get("pystacks")
+    hosttrace = frames.get("hosttrace")
+    py_period = _sample_period(pystacks)
     rows = []
     for it, (t0, t1) in enumerate(zip(bounds, ends)):
         row = {"iteration": it, "begin": t0, "end": t1, "step_time": t1 - t0}
+        # Host-side attribution per step (the reference's iter_profile
+        # credits syscalls and per-iteration payload to each iteration,
+        # sofa_aisi.py:21-59): syscall wall time + count from strace spans
+        # clipped to the step window, Python wall time from pystacks sample
+        # counts x the sampler's own period, runtime-API time from the
+        # host plane.
+        if strace is not None and not strace.empty:
+            t, c = _window_time(strace, t0, t1)
+            row["syscall_time"], row["syscall_count"] = t, c
+        if pystacks is not None and not pystacks.empty and py_period > 0:
+            in_win = pystacks[(pystacks["timestamp"] >= t0)
+                              & (pystacks["timestamp"] < t1)]
+            # samples, not spans: wall time ~= samples x period (per thread
+            # samples double-count the wall clock, so count distinct ticks)
+            row["host_python_time"] = (
+                float(in_win["timestamp"].nunique()) * py_period)
+        if hosttrace is not None and not hosttrace.empty:
+            t, _ = _window_time(hosttrace, t0, t1)
+            row["host_runtime_time"] = t
         if tputrace is not None and not tputrace.empty:
             ops = tputrace[
                 (tputrace["timestamp"] >= t0)

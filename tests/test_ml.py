@@ -190,6 +190,48 @@ def test_sofa_aisi_explicit_markers(logdir):
     assert f.get("aisi_step_time_mean") == pytest.approx(0.05, rel=0.01)
 
 
+def test_sofa_aisi_host_attribution_columns(logdir):
+    """Per-iteration host attribution (reference iter_profile,
+    sofa_aisi.py:21-59): syscall time/count from strace spans clipped to
+    each step, Python wall time from pystacks sample ticks, runtime-API
+    time from the host plane — all joined into iterations.csv."""
+    frames = _training_frames(n_steps=4)   # steps of 0.05s at 0.05*s
+    # strace: one 10ms syscall fully inside step 0, one 20ms syscall
+    # straddling the step 1/2 boundary (clipped 10ms to each side)
+    frames["strace"] = make_frame([
+        {"timestamp": 0.010, "duration": 0.010, "pid": 7, "name": "read"},
+        {"timestamp": 0.090, "duration": 0.020, "pid": 7, "name": "futex"},
+    ])
+    # pystacks: 10ms sampler; steps 0-3 get 5 ticks each
+    frames["pystacks"] = make_frame([
+        {"timestamp": 0.01 * k, "tid": 7, "name": "f", "event": 1.0}
+        for k in range(20)
+    ])
+    # hosttrace: a 5ms runtime call inside step 3
+    frames["hosttrace"] = make_frame([
+        {"timestamp": 0.155, "duration": 0.005, "pid": -1, "tid": 1,
+         "name": "ExecuteProgram", "device_kind": "host"},
+    ])
+    cfg = SofaConfig(logdir=logdir, num_iterations=4, iterations_from="op")
+    table = sofa_aisi(frames, cfg, Features())
+    assert table is not None and len(table) == 4
+    assert table.loc[0, "syscall_time"] == pytest.approx(0.010)
+    assert table.loc[0, "syscall_count"] == 1
+    assert table.loc[1, "syscall_time"] == pytest.approx(0.010)  # clipped
+    assert table.loc[2, "syscall_time"] == pytest.approx(0.010)  # clipped
+    assert table.loc[3, "syscall_time"] == 0.0
+    assert table.loc[0, "host_python_time"] == pytest.approx(0.05, rel=0.01)
+    assert table.loc[3, "host_runtime_time"] == pytest.approx(0.005)
+    assert table.loc[0, "host_runtime_time"] == 0.0
+    # columns persist to the artifact the run-report page renders
+    import pandas as pd
+
+    saved = pd.read_csv(cfg.path("iterations.csv"))
+    for col in ("syscall_time", "syscall_count", "host_python_time",
+                "host_runtime_time"):
+        assert col in saved.columns
+
+
 def test_sofa_aisi_marker_source_required(logdir):
     # iterations_from="marker" with no annotations: no silent mining fallback.
     cfg = SofaConfig(logdir=logdir, num_iterations=20, iterations_from="marker")

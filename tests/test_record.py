@@ -475,10 +475,18 @@ def test_cluster_record_remote_without_console_script(tmp_path, monkeypatch):
     from sofa_tpu.record import cluster_record
 
     stubs, seen = _write_ssh_stubs(tmp_path, with_sofa=False)
-    # keep every PATH entry except ones that would resolve `sofa`
+    # Drop every PATH entry that would resolve `sofa` — but that can also
+    # remove the venv bin holding the only dep-complete python3, so pin
+    # python3 to the running interpreter via a shim dir first on PATH.
+    import sys
+
+    pybin = tmp_path / "pybin"
+    pybin.mkdir()
+    os.symlink(sys.executable, pybin / "python3")
     keep = [d for d in os.environ["PATH"].split(os.pathsep)
             if d and not os.path.isfile(os.path.join(d, "sofa"))]
-    monkeypatch.setenv("PATH", os.pathsep.join([str(stubs)] + keep))
+    monkeypatch.setenv(
+        "PATH", os.pathsep.join([str(stubs), str(pybin)] + keep))
     assert shutil.which("sofa") is None
 
     base = str(tmp_path / "clog") + "/"
