@@ -22,6 +22,52 @@ def _run(argv, **kw):
     return subprocess.run(argv, capture_output=True, text=True, **kw)
 
 
+def _matrix_mod():
+    import importlib
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return importlib.import_module("test_matrix")
+    finally:
+        sys.path.pop(0)
+
+
+def test_matrix_discovers_running_interpreter_first():
+    mod = _matrix_mod()
+    found = mod.discover_interpreters()
+    assert found, "no interpreters discovered"
+    assert found[0][0] == sys.executable
+    # labels are impl+version, deduplicated
+    labels = [key for _, key in found]
+    assert len(set(labels)) == len(labels)
+    assert labels[0].startswith("cpython3.")
+
+
+def test_matrix_log_rows_are_dated_and_appended(tmp_path, monkeypatch):
+    mod = _matrix_mod()
+    log = tmp_path / "INSTALL_MATRIX.log"
+    monkeypatch.setattr(mod, "LOG", str(log))
+    mod._append_log([("debian:stable-slim", "PASS", "ok", 12.3)])
+    mod._append_log([("python:3.11-slim", "SKIP", "no docker", 0.1)])
+    lines = log.read_text().splitlines()
+    assert len(lines) == 2  # appended, not truncated
+    assert "PASS" in lines[0] and "SKIP" in lines[1]
+    assert lines[0].split()[0].endswith("Z")  # dated, UTC
+
+
+def test_matrix_venv_case_skips_bare_interpreter(tmp_path):
+    """An interpreter that cannot host the deps offline must produce an
+    explicit SKIP row with the reason — never a silent pass or a crash."""
+    bare = "/usr/bin/python3.11"
+    if not os.access(bare, os.X_OK) or bare == os.path.realpath(sys.executable):
+        pytest.skip("no second bare interpreter on this host")
+    mod = _matrix_mod()
+    label, status, detail, _dt = mod.venv_case(
+        bare, "bare", wheel="unused.whl", workdir=str(tmp_path))
+    assert status == "SKIP"
+    assert detail
+
+
 def test_fresh_venv_install_and_record(tmp_path):
     src = tmp_path / "src"
     src.mkdir()

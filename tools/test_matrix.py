@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Cross-environment install matrix (reference: /root/reference/test/test.py:37-78).
+
+The reference proves "pip install + record + report" across six distro
+containers and keeps a dated PASS/FAIL log (test/test-06-16.log).  Same
+contract here, adapted to what the host offers:
+
+  docker available   -> build a throwaway image per distro (DISTROS), pip
+                        install the freshly-built wheel inside, run
+                        `sofa record "sleep 5"` + `sofa report`, grep
+                        Complete!!.
+  docker unavailable -> degrade to a venv matrix: every CPython on the host
+                        gets a fresh venv; interpreters that cannot resolve
+                        the scientific deps offline produce an explicit SKIP
+                        row, never a silent pass.
+
+Every run APPENDS dated result rows to tools/INSTALL_MATRIX.log — commit
+that file so each round leaves an auditable trail, like the reference's
+test/test-06-16.log.
+
+Exit code: 0 when every attempted case passed (SKIPs don't fail the run),
+1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tools", "INSTALL_MATRIX.log")
+
+# Distro images for the docker path, mirroring the reference's matrix
+# (test/Dockerfile.*): one Debian-stable, one Ubuntu LTS, one python-slim.
+DISTROS = ["debian:stable-slim", "ubuntu:22.04", "python:3.11-slim"]
+
+DOCKERFILE = """\
+FROM {image}
+RUN (apt-get update && apt-get install -y --no-install-recommends \\
+     python3 python3-pip python3-venv) || true
+COPY {wheel} /tmp/{wheel}
+RUN python3 -m pip install --break-system-packages /tmp/{wheel} \\
+    || python3 -m pip install /tmp/{wheel}
+RUN sofa record "sleep 5" --logdir /tmp/mlog/ --disable_xprof && \\
+    sofa report --logdir /tmp/mlog/ | grep -q 'Complete!!'
+"""
+
+
+def _run(argv, **kw):
+    return subprocess.run(argv, capture_output=True, text=True, **kw)
+
+
+def _append_log(rows):
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        for name, status, detail, dt in rows:
+            f.write(f"{stamp} {name:40s} {status:4s} "
+                    f"({dt:5.1f}s) {detail}\n")
+
+
+def build_wheel(out_dir: str) -> str | None:
+    """Wheel of the current tree via pip (offline: --no-build-isolation
+    resolves setuptools from the running interpreter)."""
+    r = _run([sys.executable, "-m", "pip", "wheel", "--no-deps",
+              "--no-build-isolation", "-w", out_dir, REPO])
+    if r.returncode != 0:
+        print(r.stderr[-800:], file=sys.stderr)
+        return None
+    wheels = glob.glob(os.path.join(out_dir, "sofa_tpu-*.whl"))
+    return wheels[0] if wheels else None
+
+
+def docker_available() -> bool:
+    if not shutil.which("docker"):
+        return False
+    return _run(["docker", "info"], timeout=15).returncode == 0
+
+
+def discover_interpreters() -> list:
+    """Every distinct CPython on the host, the running one first."""
+    seen, out = set(), []
+    candidates = [sys.executable]
+    for pat in ("/usr/bin/python3.*", "/usr/local/bin/python3.*"):
+        candidates += sorted(glob.glob(pat))
+    for c in candidates:
+        if not c or not os.access(c, os.X_OK) or c.endswith("-config"):
+            continue
+        r = _run([c, "-c", "import sys; print(sys.implementation.name,"
+                           "'%d.%d' % sys.version_info[:2])"])
+        if r.returncode != 0:
+            continue
+        key = r.stdout.strip()
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((c, key.replace(" ", "")))
+    return out
+
+
+def _deps_importable(python: str, env: dict) -> str | None:
+    """None when the interpreter can resolve the runtime deps (its own
+    site-packages or the PYTHONPATH overlay); else the failing import."""
+    r = _run([python, "-c", "import numpy, pandas"], env=env)
+    if r.returncode == 0:
+        return None
+    tail = (r.stderr.strip().splitlines() or ["import failed"])[-1]
+    return tail[:120]
+
+
+def venv_case(python: str, label: str, wheel: str, workdir: str):
+    """Fresh venv for `python`; install the wheel; record+report in it."""
+    t0 = time.time()
+    venv = os.path.join(workdir, f"venv-{label}")
+    r = _run([python, "-m", "venv", venv])
+    if r.returncode != 0:
+        return (label, "SKIP", "venv creation unavailable", time.time() - t0)
+    vpy = os.path.join(venv, "bin", "python")
+    # Offline dependency story (same trick as tests/test_install.py): the
+    # running env's site-packages ride PYTHONPATH; the venv's own
+    # site-packages still win for the package under test.  This only works
+    # for same-ABI interpreters — others SKIP below.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=sysconfig.get_paths()["purelib"])
+    missing = _deps_importable(vpy, env)
+    if missing:
+        return (label, "SKIP", f"deps unresolvable offline: {missing}",
+                time.time() - t0)
+    r = _run([vpy, "-m", "pip", "install", "--no-deps", "--quiet", wheel],
+             env=env)
+    if r.returncode != 0:
+        return (label, "FAIL", "pip install: " + r.stderr[-120:].strip(),
+                time.time() - t0)
+    sofa = os.path.join(venv, "bin", "sofa")
+    if not os.path.isfile(sofa):
+        return (label, "FAIL", "console script missing", time.time() - t0)
+    logdir = os.path.join(workdir, f"log-{label}") + "/"
+    r = _run([sofa, "record", "sleep 5", "--logdir", logdir,
+              "--disable_xprof"], env=env, cwd=workdir)
+    if r.returncode != 0:
+        return (label, "FAIL", "record rc=%d" % r.returncode,
+                time.time() - t0)
+    r = _run([sofa, "report", "--logdir", logdir], env=env, cwd=workdir)
+    if r.returncode != 0 or "Complete!!" not in r.stdout:
+        return (label, "FAIL", "report did not Complete!!", time.time() - t0)
+    return (label, "PASS", "record+report Complete!!", time.time() - t0)
+
+
+def docker_case(image: str, wheel: str, workdir: str):
+    t0 = time.time()
+    ctx = os.path.join(workdir, "ctx-" + image.replace(":", "-").replace("/", "-"))
+    os.makedirs(ctx, exist_ok=True)
+    shutil.copy(wheel, ctx)
+    wheel_name = os.path.basename(wheel)
+    with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+        f.write(DOCKERFILE.format(image=image, wheel=wheel_name))
+    tag = "sofa-tpu-matrix:" + image.replace(":", "-").replace("/", "-")
+    r = _run(["docker", "build", "--no-cache", "-t", tag, ctx],
+             timeout=1200)
+    _run(["docker", "rmi", "-f", tag])
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["build failed"])[-1]
+        return (image, "FAIL", tail[:120], time.time() - t0)
+    return (image, "PASS", "image build ran record+report", time.time() - t0)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["auto", "docker", "venv"],
+                   default="auto")
+    args = p.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="sofa_matrix_")
+    try:
+        wheel = build_wheel(workdir)
+        if wheel is None:
+            _append_log([("wheel-build", "FAIL", "pip wheel failed", 0.0)])
+            return 1
+        use_docker = (args.mode == "docker"
+                      or (args.mode == "auto" and docker_available()))
+        rows = []
+        if use_docker:
+            for image in DISTROS:
+                print(f"matrix: docker {image} ...", flush=True)
+                rows.append(docker_case(image, wheel, workdir))
+        else:
+            for python, key in discover_interpreters():
+                label = f"{key}@{python}"
+                print(f"matrix: venv {label} ...", flush=True)
+                rows.append(venv_case(python, label, wheel, workdir))
+        _append_log(rows)
+        width = max(len(r[0]) for r in rows)
+        for name, status, detail, dt in rows:
+            print(f"{name:{width}s}  {status:4s}  ({dt:5.1f}s)  {detail}")
+        return 0 if all(r[1] != "FAIL" for r in rows) else 1
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
