@@ -274,6 +274,194 @@ function renderTable(el, header, rows, maxRows) {
   el.appendChild(t);
 }
 
+/* ---------- parallel coordinates with per-axis brushing ----------
+ * The reference's cpu/gpu reports are d3 parallel-coordinates with a drag
+ * brush on every schema column (sofaboard/cpu-report.html:86-162); this is
+ * the same exploration surface on the board's own canvas renderer (no CDN).
+ * Drag vertically on an axis to brush; click an axis to clear it;
+ * double-click anywhere to clear all brushes.  onSelect(rows) fires after
+ * every brush change with the rows inside every active extent. */
+class ParallelCoords {
+  constructor(canvas, opts) {
+    this.canvas = canvas;
+    this.ctx = canvas.getContext("2d");
+    this.opts = Object.assign({ color: "rgba(121,82,179,0.35)", maxRows: 3000 }, opts || {});
+    this.dims = [];    // [{key,label,min,max,log}]
+    this.rows = [];    // array of objects key->number
+    this.brushes = {}; // key -> [loVal, hiVal] in data space
+    this.margin = { l: 30, r: 30, t: 26, b: 10 };
+    this._drag = null;
+    this._bindEvents();
+  }
+  setData(dims, rows) {
+    if (rows.length > this.opts.maxRows) {
+      // uniform sample for draw responsiveness; brushing filters the sample
+      const stride = Math.ceil(rows.length / this.opts.maxRows);
+      rows = rows.filter((_, i) => i % stride === 0);
+    }
+    this.dims = dims.map((d) => {
+      let min = Infinity, max = -Infinity;
+      for (const r of rows) {
+        const v = this._v(r, d);
+        if (isFinite(v)) { if (v < min) min = v; if (v > max) max = v; }
+      }
+      if (!isFinite(min)) { min = 0; max = 1; }
+      if (min === max) max = min + 1;
+      return Object.assign({ min, max }, d);
+    });
+    this.rows = rows;
+    this.brushes = {};
+    this.draw();
+  }
+  _v(row, dim) {
+    const raw = Number(row[dim.key]);
+    return dim.log ? Math.log10(Math.max(raw, 1e-12)) : raw;
+  }
+  _ax(i) {
+    const w = this.canvas.width - this.margin.l - this.margin.r;
+    return this.margin.l + (this.dims.length < 2 ? w / 2 : (i * w) / (this.dims.length - 1));
+  }
+  _sy(dim, v) {
+    const h = this.canvas.height - this.margin.t - this.margin.b;
+    return this.margin.t + h - ((v - dim.min) / (dim.max - dim.min)) * h;
+  }
+  _yToVal(dim, py) {
+    const h = this.canvas.height - this.margin.t - this.margin.b;
+    return dim.min + ((this.margin.t + h - py) / h) * (dim.max - dim.min);
+  }
+  selected() {
+    const active = this.dims.filter((d) => this.brushes[d.key]);
+    if (!active.length) return this.rows;
+    return this.rows.filter((r) => active.every((d) => {
+      const v = this._v(r, d), [lo, hi] = this.brushes[d.key];
+      return v >= lo && v <= hi;
+    }));
+  }
+  draw() {
+    const ctx = this.ctx, W = this.canvas.width, H = this.canvas.height;
+    ctx.clearRect(0, 0, W, H);
+    const sel = this.selected(); // one filter pass per frame, reused below
+    const keep = new Set(sel);
+    const anyBrush = this.dims.some((d) => this.brushes[d.key]);
+    // dimmed lines first so selected lines stay on top
+    for (const pass of anyBrush ? ["dim", "fg"] : ["fg"]) {
+      ctx.strokeStyle = pass === "dim" ? "rgba(160,160,160,0.08)" : this.opts.color;
+      ctx.beginPath();
+      for (const r of this.rows) {
+        if ((pass === "fg") !== keep.has(r)) continue;
+        for (let i = 0; i < this.dims.length; i++) {
+          const d = this.dims[i];
+          const x = this._ax(i), y = this._sy(d, this._v(r, d));
+          if (i === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+        }
+      }
+      ctx.stroke();
+    }
+    ctx.font = "11px sans-serif";
+    for (let i = 0; i < this.dims.length; i++) {
+      const d = this.dims[i], x = this._ax(i);
+      ctx.strokeStyle = "#999";
+      ctx.beginPath();
+      ctx.moveTo(x, this.margin.t);
+      ctx.lineTo(x, H - this.margin.b);
+      ctx.stroke();
+      ctx.fillStyle = "#555";
+      ctx.textAlign = "center";
+      ctx.fillText(d.label || d.key, x, 12);
+      ctx.fillStyle = "#999";
+      ctx.fillText(fmt(d.log ? Math.pow(10, d.max) : d.max), x, this.margin.t - 3);
+      ctx.fillText(fmt(d.log ? Math.pow(10, d.min) : d.min), x, H - 1);
+      const b = this.brushes[d.key];
+      if (b) {
+        const y0 = this._sy(d, b[1]), y1 = this._sy(d, b[0]);
+        ctx.fillStyle = "rgba(121,82,179,0.18)";
+        ctx.fillRect(x - 7, y0, 14, y1 - y0);
+        ctx.strokeStyle = "#7952b3";
+        ctx.strokeRect(x - 7, y0, 14, y1 - y0);
+      }
+    }
+    if (this.opts.onSelect) this.opts.onSelect(sel, this.rows);
+  }
+  _axisAt(px) {
+    for (let i = 0; i < this.dims.length; i++) {
+      if (Math.abs(px - this._ax(i)) <= 12) return i;
+    }
+    return -1;
+  }
+  _pos(ev) {
+    const rect = this.canvas.getBoundingClientRect();
+    return {
+      x: ((ev.clientX - rect.left) * this.canvas.width) / rect.width,
+      y: ((ev.clientY - rect.top) * this.canvas.height) / rect.height,
+    };
+  }
+  _bindEvents() {
+    this.canvas.addEventListener("mousedown", (ev) => {
+      const p = this._pos(ev);
+      const i = this._axisAt(p.x);
+      if (i < 0) return;
+      this._drag = { dim: this.dims[i], y0: p.y, moved: false };
+    });
+    this.canvas.addEventListener("mousemove", (ev) => {
+      const p = this._pos(ev);
+      if (!this._drag) {
+        this.canvas.style.cursor = this._axisAt(p.x) >= 0 ? "row-resize" : "default";
+        return;
+      }
+      this._drag.moved = true;
+      const d = this._drag.dim;
+      const a = this._yToVal(d, this._drag.y0), b = this._yToVal(d, p.y);
+      this.brushes[d.key] = [Math.min(a, b), Math.max(a, b)];
+      this.draw();
+    });
+    const finish = () => {
+      if (this._drag && !this._drag.moved) { // plain click clears this axis
+        delete this.brushes[this._drag.dim.key];
+        this.draw();
+      }
+      this._drag = null;
+    };
+    this.canvas.addEventListener("mouseup", finish);
+    this.canvas.addEventListener("mouseleave", finish);
+    this.canvas.addEventListener("dblclick", () => {
+      this.brushes = {};
+      this.draw();
+    });
+  }
+}
+
+/* Parallel-coords bootstrap shared by the cpu/tpu report pages: fetch a
+ * trace CSV, map its rows onto the requested dims, wire the count label. */
+async function mountParallelCoords(canvasId, countId, file, dims, filter) {
+  const csv = await fetchCSV(file);
+  const idx = {};
+  for (const d of dims) idx[d.key] = csv.header.indexOf(d.key);
+  let rows = csv.rows;
+  if (filter) {
+    // filter receives a memoized name->index resolver, not the raw header:
+    // header.indexOf per row would scan the header millions of times on a
+    // pod-scale trace
+    const memo = {};
+    const col = (name) =>
+      (name in memo ? memo[name] : (memo[name] = csv.header.indexOf(name)));
+    rows = rows.filter((r) => filter(r, col));
+  }
+  const recs = rows.map((r) => {
+    const o = {};
+    for (const d of dims) o[d.key] = Number(r[idx[d.key]]);
+    return o;
+  });
+  if (!recs.length) throw new Error(file + ": no rows");
+  const countEl = document.getElementById(countId);
+  const pc = new ParallelCoords(document.getElementById(canvasId), {
+    onSelect: (sel, all) => {
+      if (countEl) countEl.textContent = sel.length + " / " + all.length + " rows in brush";
+    },
+  });
+  pc.setData(dims, recs);
+  return pc;
+}
+
 /* ---------- bar chart ---------- */
 function drawBars(canvas, labels, values, color) {
   const ctx = canvas.getContext("2d");
