@@ -280,7 +280,10 @@ def stage_board(cfg: SofaConfig) -> None:
         shutil.copy2(os.path.join(src, name), cfg.path(name))
 
 
-def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
+def cluster_analyze(
+    cfg: SofaConfig,
+    preloaded: "Dict[str, Dict[str, pd.DataFrame]] | None" = None,
+) -> Dict[str, Features]:
     """Multi-host report: per-host analysis + ONE merged cross-host timeline.
 
     Reference cluster_analyze (sofa_analyze.py:1057-1137) only aggregated
@@ -289,6 +292,10 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
     the earliest host's) and written as a single merged report.js in the top
     logdir, plus the DCN-traffic-vs-step correlation per host (BASELINE
     config #5's question).
+
+    ``preloaded`` maps hostname -> frames dict for hosts whose preprocess
+    just ran in this process (the report path hands them through so the
+    pod-scale CSVs written a moment ago aren't re-deserialized).
     """
     from sofa_tpu.analysis.comm import dcn_step_correlation
     from sofa_tpu.preprocess import build_series, read_time_base
@@ -306,7 +313,9 @@ def cluster_analyze(cfg: SofaConfig) -> Dict[str, Features]:
             continue
         print_progress(f"cluster: analyzing {hostname}")
         host_cfgs[hostname] = host_cfg
-        host_frames[hostname] = load_frames(host_cfg)
+        host_frames[hostname] = (
+            preloaded[hostname] if preloaded and hostname in preloaded
+            else load_frames(host_cfg))
         results[hostname] = sofa_analyze(host_cfg, host_frames[hostname])
         time_bases[hostname] = read_time_base(host_cfg)
         row = {"host": hostname}

@@ -92,3 +92,26 @@ def test_parquet_trace_format(logdir):
     sofa_preprocess(cfg)
     assert not os.path.isfile(cfg.path("mpstat.parquet"))
     assert len(load_frames(cfg)["mpstat"]) == len(full)
+
+
+def test_analyze_frames_passthrough_matches_reread(logdir):
+    """`sofa report` hands preprocess's in-memory frames straight to analyze
+    (re-reading the just-written CSVs cost ~25% of pod-scale report time);
+    the passthrough must produce the same features as a disk round-trip."""
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.record import sofa_record
+
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, sys_mon_rate=100)
+    sofa_record("sleep 0.3", cfg)
+    frames = sofa_preprocess(cfg)
+    import pytest
+
+    f_mem = sofa_analyze(cfg, frames=frames)
+    f_disk = sofa_analyze(cfg)           # load_frames round-trip
+    mem, disk = dict(f_mem._rows), dict(f_disk._rows)
+    # elapsed-breakdown features sample wall-clock-dependent windows and
+    # are identical here because both calls see the same misc.txt
+    assert set(mem) == set(disk)
+    for k, v in mem.items():
+        assert disk[k] == pytest.approx(v, rel=1e-6), k
