@@ -286,14 +286,26 @@ def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
     np.add.at(tx_bins, idx, tx["event"].to_numpy(dtype=float))
     np.add.at(counts, idx, 1)
     tx_bins = np.divide(tx_bins, np.maximum(counts, 1))
-    # per-bin device busy time (op durations clipped into each bin)
+    # per-bin device busy time (op durations clipped into each bin) —
+    # O(ops + bins): first/last bins get the partial overlaps, interior
+    # bins get full width via a difference array, instead of clipping the
+    # whole op array once per bin (64 x 1.6M elementwise at pod scale).
     starts = ops["timestamp"].to_numpy(dtype=float)
-    ends = starts + ops["duration"].to_numpy(dtype=float)
+    ends = np.maximum(starts + ops["duration"].to_numpy(dtype=float), starts)
+    width = edges[1] - edges[0]
+    i0 = np.clip(np.searchsorted(edges, starts, "right") - 1, 0, n_bins - 1)
+    i1 = np.clip(np.searchsorted(edges, ends, "left") - 1, 0, n_bins - 1)
     busy = np.zeros(n_bins)
-    for b in range(n_bins):
-        lo = np.clip(starts, edges[b], edges[b + 1])
-        hi = np.clip(ends, edges[b], edges[b + 1])
-        busy[b] = np.maximum(hi - lo, 0).sum()
+    same = i0 == i1
+    np.add.at(busy, i0[same], (ends - starts)[same])
+    sp = ~same
+    np.add.at(busy, i0[sp], (edges[i0[sp] + 1] - starts[sp]))
+    np.add.at(busy, i1[sp], (ends[sp] - edges[i1[sp]]))
+    # interior full bins i0+1 .. i1-1 via prefix-summed diff array
+    diff = np.zeros(n_bins + 1)
+    np.add.at(diff, i0[sp] + 1, width)
+    np.add.at(diff, i1[sp], -width)
+    busy += np.cumsum(diff[:-1])
     if tx_bins.std() == 0 or busy.std() == 0:
         return None
     return float(np.corrcoef(tx_bins, busy)[0, 1])

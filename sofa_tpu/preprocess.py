@@ -153,10 +153,8 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
             print_warning("trace_format=parquet needs pyarrow (pip install "
                           "'sofa-tpu[parquet]'); falling back to csv")
             trace_format = "csv"
-    n_csv = 0
-    for name, df in frames.items():
-        if name == "cpuinfo":
-            continue  # internal helper series
+    def _write_one(item):
+        name, df = item
         write_frame(df, cfg.path(name), trace_format)
         if trace_format == "parquet":
             # The board's detail pages fetch <name>.csv; keep a downsampled
@@ -165,7 +163,16 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
             # csv mode of write_frame would unlink the parquet just written.
             write_csv(downsample(df, cfg.viz_downsample_to),
                       cfg.path(f"{name}.csv"))
-        n_csv += 1
+
+    to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
+    n_csv = len(to_write)
+    # Frames are independent files and the pyarrow CSV/parquet writers
+    # release the GIL, so a small thread pool overlaps the pod-scale
+    # tputrace write with the fifteen small ones.
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        list(pool.map(_write_one, to_write))
 
     # --- assemble the timeline series -> report.js ------------------------
     series = build_series(cfg, frames)
