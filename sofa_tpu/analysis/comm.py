@@ -286,10 +286,18 @@ def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
     np.add.at(tx_bins, idx, tx["event"].to_numpy(dtype=float))
     np.add.at(counts, idx, 1)
     tx_bins = np.divide(tx_bins, np.maximum(counts, 1))
-    # per-bin device busy time (op durations clipped into each bin) —
-    # O(ops + bins): first/last bins get the partial overlaps, interior
-    # bins get full width via a difference array, instead of clipping the
-    # whole op array once per bin (64 x 1.6M elementwise at pod scale).
+    busy = _busy_bins(ops, edges)
+    if tx_bins.std() == 0 or busy.std() == 0:
+        return None
+    return float(np.corrcoef(tx_bins, busy)[0, 1])
+
+
+def _busy_bins(ops: pd.DataFrame, edges: np.ndarray) -> np.ndarray:
+    """Per-bin device busy time (op durations clipped into each bin) —
+    O(ops + bins): first/last bins get the partial overlaps, interior bins
+    get full width via a difference array, instead of clipping the whole op
+    array once per bin (64 x 1.6M elementwise at pod scale)."""
+    n_bins = len(edges) - 1
     starts = ops["timestamp"].to_numpy(dtype=float)
     ends = np.maximum(starts + ops["duration"].to_numpy(dtype=float), starts)
     width = edges[1] - edges[0]
@@ -306,9 +314,7 @@ def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
     np.add.at(diff, i0[sp] + 1, width)
     np.add.at(diff, i1[sp], -width)
     busy += np.cumsum(diff[:-1])
-    if tx_bins.std() == 0 or busy.std() == 0:
-        return None
-    return float(np.corrcoef(tx_bins, busy)[0, 1])
+    return busy
 
 
 def net_profile(frames, cfg, features: Features) -> None:
@@ -332,7 +338,51 @@ def net_profile(frames, cfg, features: Features) -> None:
     )
     pairs["src"] = pairs["pkt_src"].map(lambda v: unpack_ip(v, addrs))
     pairs["dst"] = pairs["pkt_dst"].map(lambda v: unpack_ip(v, addrs))
-    pairs[["src", "dst", "sum", "count"]].to_csv(cfg.path("netrank.csv"), index=False)
+    out_cols = ["src", "dst", "sum", "count"]
+    # Per-PEER step correlation (beyond the reference, which only ranks
+    # peers by bytes): which (src, dst) flow moves bytes in lockstep with
+    # device activity — i.e. WHICH peer is the one gating the steps that
+    # dcn_step_correlation flags in aggregate.
+    dev = frames.get("tputrace")
+    ops = dev[dev["category"] == 0] if dev is not None and not dev.empty \
+        else None
+    if ops is not None and not ops.empty and len(df) >= 8:
+        n_bins = 64
+        t0 = float(min(df["timestamp"].min(), ops["timestamp"].min()))
+        t1 = float(max(df["timestamp"].max(),
+                       (ops["timestamp"] + ops["duration"]).max()))
+        if t1 > t0:
+            edges = np.linspace(t0, t1, n_bins + 1)
+            busy = _busy_bins(ops, edges)
+            if busy.std() > 0:
+                corrs = []
+                top = pairs.head(8)
+                pkt_idx = np.clip(
+                    np.searchsorted(edges, df["timestamp"].to_numpy()) - 1,
+                    0, n_bins - 1)
+                payload = df["payload"].to_numpy(dtype=float)
+                # one row-partition pass for all peers, not a full-array
+                # scan per peer (pod captures are millions of packets)
+                pair_rows = df.groupby(["pkt_src", "pkt_dst"]).indices
+                for r in top.itertuples(index=False):
+                    sel = pair_rows.get((r.pkt_src, r.pkt_dst), [])
+                    bins = np.zeros(n_bins)
+                    np.add.at(bins, pkt_idx[sel], payload[sel])
+                    corrs.append(
+                        round(float(np.corrcoef(bins, busy)[0, 1]), 4)
+                        if bins.std() > 0 else None)
+                pairs["corr_step"] = pd.Series(
+                    corrs + [None] * (len(pairs) - len(corrs)))
+                out_cols.append("corr_step")
+                ranked = [c for c in corrs if c is not None]
+                if ranked:
+                    best = int(np.nanargmax(np.array(
+                        [c if c is not None else -2 for c in corrs])))
+                    features.add("dcn_top_peer_corr", corrs[best])
+                    features.add_info(
+                        "dcn_top_peer",
+                        f"{top.iloc[best]['src']}->{top.iloc[best]['dst']}")
+    pairs[out_cols].to_csv(cfg.path("netrank.csv"), index=False)
 
 
 def netbandwidth_profile(frames, cfg, features: Features) -> None:

@@ -510,5 +510,90 @@ def spotlight_roi(frames, cfg, features: Features) -> None:
         print_hint(f"spotlight ROI: {begin:.3f}s .. {end:.3f}s")
 
 
+def serving_profile(frames, cfg, features: Features) -> None:
+    """Prefill/decode phase split for serving (inference) captures.
+
+    No reference analogue — the reference profiles training only.  On TPU
+    the two serving regimes are architecturally different (prefill is
+    MXU/compute-bound, decode re-reads the whole KV cache per token and is
+    HBM-bound), and BASELINE config #4 asks exactly for "inference HLO-op +
+    HBM-bandwidth attribution".  Phases are recognized from XLA module
+    names (jit_run_prefill / jit_run_decode / *generate* — whatever the
+    program jitted, matched case-insensitively), so any serving stack that
+    jits its prefill and decode separately gets the split for free:
+
+      serving_prefill_time / serving_decode_time     device time per phase
+      serving_prefill_intensity / ..._decode_...     flops per HBM byte
+      serving_ttft                                   first prefill span wall
+      serving_decode_calls                           decode dispatches
+
+    plus a memory-bound hint when decode's arithmetic intensity collapses
+    relative to prefill's (the KV-cache-bound signature).
+    """
+    df = frames.get("tputrace")
+    if df is None or df.empty or "module" not in df.columns:
+        return
+    df = roi_clip(df, cfg)  # spotlight ROI excludes warmup/compile ops
+    sync = df[df["category"] == 0]
+    if sync.empty:
+        return
+    mods = sync["module"].astype(str)
+    uniq = [m for m in mods.unique() if m]
+    pre_names = [m for m in uniq if "prefill" in m.lower()]
+    dec_names = [m for m in uniq
+                 if "decode" in m.lower() or "generate" in m.lower()]
+    if not pre_names or not dec_names:
+        return
+
+    def phase(names):
+        sel = sync[mods.isin(names)]
+        dur = float(sel["duration"].sum())
+        flops = float(sel["flops"].sum())
+        nbytes = float(sel["bytes_accessed"].sum())
+        return sel, dur, flops, nbytes
+
+    pre, pre_t, pre_f, pre_b = phase(pre_names)
+    dec, dec_t, dec_f, dec_b = phase(dec_names)
+    if pre_t <= 0 or dec_t <= 0:
+        return
+    features.add("serving_prefill_time", pre_t)
+    features.add("serving_decode_time", dec_t)
+    pre_i = pre_f / pre_b if pre_b > 0 else 0.0
+    dec_i = dec_f / dec_b if dec_b > 0 else 0.0
+    features.add("serving_prefill_intensity", pre_i)
+    features.add("serving_decode_intensity", dec_i)
+    if dec_b > 0:
+        features.add("serving_decode_hbm_gbps", dec_b / dec_t / 1e9)
+    # TTFT proxy: wall span of the FIRST prefill dispatch only — a steady
+    # serving capture has prefills recurring throughout, so spanning all of
+    # them would approximate the whole capture.  The module-launch line
+    # delimits dispatches exactly; without it, fall back to the prefill ops
+    # that precede the first decode op.
+    launches = frames.get("tpumodules")
+    ttft = None
+    if launches is not None and not launches.empty:
+        launches = roi_clip(launches, cfg)
+        lnames = launches["name"].astype(str)
+        pre_launch = launches[lnames.isin(pre_names)] \
+            .sort_values("timestamp")
+        if not pre_launch.empty:
+            ttft = float(pre_launch.iloc[0]["duration"])
+        features.add("serving_decode_calls", int(lnames.isin(
+            dec_names).sum()))
+    if ttft is None:
+        first_dec = float(dec["timestamp"].min())
+        head = pre[pre["timestamp"] < first_dec]
+        if not head.empty:
+            ttft = float((head["timestamp"] + head["duration"]).max()
+                         - head["timestamp"].min())
+    if ttft is not None:
+        features.add("serving_ttft", ttft)
+    if dec_i > 0 and pre_i / max(dec_i, 1e-12) >= 4.0:
+        print_hint(
+            f"serving: decode is HBM-bound ({dec_i:.1f} flops/byte vs "
+            f"prefill {pre_i:.1f}) — KV-cache reads dominate; consider "
+            "larger decode batches, GQA/MQA, or a quantized cache")
+
+
 def _slug(name: str) -> str:
     return name.strip().lower().replace(" ", "_").replace("-", "_")

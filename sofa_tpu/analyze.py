@@ -46,6 +46,7 @@ _PASSES = [
     ("step_skew_profile", tpu.step_skew_profile),
     ("input_pipeline_profile", tpu.input_pipeline_profile),
     ("roofline_profile", tpu.roofline_profile),
+    ("serving_profile", tpu.serving_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
     ("tpumon_profile", tpu.tpumon_profile),
     ("comm_profile", comm.comm_profile),

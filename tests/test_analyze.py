@@ -47,6 +47,99 @@ def test_tpu_profile_and_comm(cfg):
     assert f.get("hlo_time_convolution") == pytest.approx(0.08)
 
 
+def test_serving_profile_prefill_decode_split(cfg, capsys):
+    """Serving captures (BASELINE config #4) split by XLA module name into
+    the compute-bound prefill and HBM-bound decode regimes, with arithmetic
+    intensity per phase and the KV-cache-bound hint."""
+    rows = []
+    # prefill: heavy flops vs bytes (intensity 100)
+    for i in range(10):
+        rows.append({"timestamp": 0.01 * i, "duration": 0.005,
+                     "deviceId": 0, "name": f"fusion.{i}",
+                     "module": "jit_run_prefill", "flops": 1e10,
+                     "bytes_accessed": 1e8, "device_kind": "tpu"})
+    # decode: re-reads the cache, intensity 0.1
+    for i in range(20):
+        rows.append({"timestamp": 0.2 + 0.01 * i, "duration": 0.008,
+                     "deviceId": 0, "name": f"fusion.d{i}",
+                     "module": "jit_run_decode", "flops": 1e7,
+                     "bytes_accessed": 1e8, "device_kind": "tpu"})
+    mods = make_frame([
+        {"timestamp": 0.0, "duration": 0.05, "deviceId": 0,
+         "name": "jit_run_prefill", "device_kind": "tpu"},
+        {"timestamp": 0.2, "duration": 0.16, "deviceId": 0,
+         "name": "jit_run_decode", "device_kind": "tpu"},
+    ])
+    f = Features()
+    tpu.serving_profile({"tputrace": make_frame(rows), "tpumodules": mods},
+                        cfg, f)
+    assert f.get("serving_prefill_time") == pytest.approx(0.05)
+    assert f.get("serving_decode_time") == pytest.approx(0.16)
+    assert f.get("serving_prefill_intensity") == pytest.approx(100.0)
+    assert f.get("serving_decode_intensity") == pytest.approx(0.1)
+    assert f.get("serving_decode_hbm_gbps") == pytest.approx(
+        20 * 1e8 / 0.16 / 1e9)
+    # launch line present: TTFT is the FIRST prefill dispatch's wall time
+    assert f.get("serving_ttft") == pytest.approx(0.05)
+    assert f.get("serving_decode_calls") == 1
+    assert "HBM-bound" in capsys.readouterr().out
+
+    # without the launch line, TTFT falls back to the prefill ops that
+    # precede the first decode op — still the first request, never the
+    # whole capture
+    f2 = Features()
+    tpu.serving_profile({"tputrace": make_frame(rows)}, cfg, f2)
+    assert f2.get("serving_ttft") == pytest.approx(0.095)
+
+
+def test_serving_profile_ignores_training_capture(cfg):
+    f = Features()
+    tpu.serving_profile({"tputrace": tpu_frame()}, cfg, f)
+    assert f.get("serving_prefill_time") is None
+
+
+def test_netrank_per_peer_step_correlation(cfg):
+    """netrank must name WHICH peer's traffic moves in lockstep with device
+    activity (corr_step column + dcn_top_peer feature) — the aggregate
+    dcn_step_correlation can say 'the network gates steps' but not who."""
+    # device busy in bursts: ops in [0,1), [2,3), [4,5) ...
+    ops = []
+    for k in range(0, 10, 2):
+        for i in range(20):
+            ops.append({"timestamp": k + i * 0.05, "duration": 0.04,
+                        "deviceId": 0, "name": "op", "device_kind": "tpu"})
+    # peer A sends during the busy bursts; peer B sends uniformly
+    pkts = []
+    for k in range(0, 10, 2):
+        for i in range(10):
+            pkts.append({"timestamp": k + i * 0.1, "duration": 1e-6,
+                         "payload": 10_000, "pkt_src": packed("10.0.0.1"),
+                         "pkt_dst": packed("10.0.0.2"),
+                         "name": "tcp A", "device_kind": "net"})
+    for i in range(50):
+        pkts.append({"timestamp": i * 0.2, "duration": 1e-6,
+                     "payload": 9_000, "pkt_src": packed("10.0.0.3"),
+                     "pkt_dst": packed("10.0.0.4"),
+                     "name": "tcp B", "device_kind": "net"})
+    frames = {"nettrace": make_frame(pkts), "tputrace": make_frame(ops)}
+    f = Features()
+    comm.net_profile(frames, cfg, f)
+    rank = pd.read_csv(cfg.path("netrank.csv"))
+    assert "corr_step" in rank.columns
+    by_pair = rank.set_index(["src", "dst"])["corr_step"]
+    corr_a = by_pair[("10.0.0.1", "10.0.0.2")]
+    corr_b = by_pair[("10.0.0.3", "10.0.0.4")]
+    assert corr_a > 0.8          # bursty peer tracks the busy windows
+    assert corr_a > corr_b + 0.3  # and clearly beats the uniform peer
+    assert f.get("dcn_top_peer_corr") == pytest.approx(corr_a)
+
+
+def packed(ip):
+    from sofa_tpu.trace import packed_ip
+
+    return packed_ip(ip)
+
+
 def test_dcn_correlation_busy_bins_match_bruteforce():
     """The O(ops+bins) difference-array busy binning must agree exactly with
     the per-bin clipping it replaced, including ops straddling many bins."""
