@@ -1,0 +1,34 @@
+"""A tiny serving loop: repeated prefill + KV-cache decode requests.
+
+The serving-profile target: prefill and decode are jitted separately
+(``jit_run_prefill`` / ``jit_run_decode`` XLA modules), so
+``sofa stat "python examples/serve_tiny.py"`` yields the
+``serving_*`` features (per-phase device time, arithmetic intensity,
+decode HBM bandwidth, TTFT) and — when decode is KV-cache-bound — the
+HBM-bound hint (sofa_tpu/analysis/tpu.py serving_profile).
+"""
+
+import jax
+
+from sofa_tpu.workloads.inference import make_serving_fns
+from sofa_tpu.workloads.transformer import TransformerConfig, init_params
+
+
+def main(requests: int = 4, prompt: int = 64, new_tokens: int = 32):
+    cfg = TransformerConfig.tiny(seq=prompt + new_tokens)
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    run_prefill, run_decode = make_serving_fns(cfg, prompt, new_tokens)
+    prompts = jax.random.randint(key, (requests, 2, prompt), 0, cfg.vocab)
+    tok, cache = run_prefill(params, prompts[0])      # compile both
+    jax.block_until_ready(run_decode(params, tok, cache))
+    for r in range(requests):
+        tok, cache = run_prefill(params, prompts[r])
+        out = run_decode(params, tok, cache)
+    out.block_until_ready()
+    print(f"served {requests} requests "
+          f"(prompt {prompt}, new {new_tokens}, batch 2)")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,12 @@ def test_train_tiny_runs(capsys):
     assert "final loss" in capsys.readouterr().out
 
 
+def test_serve_tiny_runs(capsys):
+    mod = runpy.run_path(_example("serve_tiny.py"), run_name="not_main")
+    mod["main"](requests=2, prompt=16, new_tokens=4)
+    assert "served 2 requests" in capsys.readouterr().out
+
+
 def test_matmul_burn_runs(capsys):
     mod = runpy.run_path(_example("matmul_burn.py"), run_name="not_main")
     mod["main"](seconds=0.5, n=128)
