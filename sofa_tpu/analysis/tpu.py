@@ -462,6 +462,43 @@ def tpumon_profile(frames, cfg, features: Features) -> None:
             features.add(f"tpu{device_id}_hbm_peak_gb", peak / 1e9)
 
 
+def memprof_profile(frames, cfg, features: Features) -> None:
+    """HBM attribution: which allocation sites held the occupancy peak.
+
+    Consumes the pprof snapshot collectors/tpumon.py captured when the
+    summed bytes-in-use set its high-water mark (ingest/memprof.py), writes
+    the top-site table to tpu_memprof.csv for the board, and promotes the
+    totals to features.  The reference's memory story ends at one used-MB
+    number per GPU from nvsmi (sofa_record.py:300-310); an allocation-site
+    breakdown is the TPU-native answer to "what do I evict to stop OOMing".
+    """
+    from sofa_tpu.ingest.memprof import aggregate_sites, load_memprof
+
+    df, meta = load_memprof(cfg.logdir)
+    if df is None or df.empty:
+        return
+    buffers = df[df["kind"] == "buffer"]
+    features.add("memprof_held_gb", float(buffers["bytes"].sum()) / 1e9)
+    features.add("memprof_buffers", float(buffers["count"].sum()))
+    features.add("memprof_sites", float(buffers["site"].nunique()))
+    n_dev = buffers.loc[buffers["device"] != "", "device"].nunique()
+    if n_dev:
+        features.add("memprof_devices", float(n_dev))
+    sites = aggregate_sites(df)
+    sites.to_csv(cfg.path("tpu_memprof.csv"), index=False)
+    if meta.get("trigger"):
+        features.add_info("memprof_trigger", meta["trigger"])
+    if not sites.empty:
+        top = sites.iloc[0]
+        features.add_info(
+            "memprof_top_site",
+            f"{top['site']} ({top['bytes'] / 1e9:.2f} GB, "
+            f"{top['share']:.0%})")
+    if cfg.verbose:
+        print_title("Top HBM allocation sites")
+        print(sites.head(10).to_string(index=False))
+
+
 def spotlight_roi(frames, cfg, features: Features) -> None:
     """Set cfg.roi_begin/roi_end from TensorCore utilization.
 

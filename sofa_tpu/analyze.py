@@ -49,6 +49,7 @@ _PASSES = [
     ("serving_profile", tpu.serving_profile),
     ("tpuutil_profile", tpu.tpuutil_profile),
     ("tpumon_profile", tpu.tpumon_profile),
+    ("memprof_profile", tpu.memprof_profile),
     ("comm_profile", comm.comm_profile),
     ("concurrency_breakdown", concurrency.concurrency_breakdown),
     ("mesh_advice", advice.mesh_advice),

@@ -44,7 +44,17 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
     timebase.start()
     if procmon.probe() is None:
         procmon.start()
+    memprof_path = cfg.path("memprof.pb.gz") if cfg.enable_mem_prof else None
+    # Drop the previous run's snapshot: the finally-block fallback keys on
+    # file existence, and a stale profile would masquerade as this run's.
+    for stale in (cfg.path("memprof.pb.gz"),
+                  cfg.path("memprof.pb.gz.meta.json")):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     tpumon_stop = None
+    tpumon_thread = None
     if cfg.enable_tpu_mon:
         import threading
 
@@ -53,7 +63,9 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
         except OSError:
             pass
         tpumon_stop = threading.Event()
-        start_sampler(cfg.tpu_mon_rate, cfg.path("tpumon.txt"), tpumon_stop)
+        tpumon_thread = start_sampler(
+            cfg.tpu_mon_rate, cfg.path("tpumon.txt"), tpumon_stop,
+            memprof_path=memprof_path)
 
     kwargs = {}
     try:
@@ -78,6 +90,15 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
         jax.profiler.stop_trace()
         if tpumon_stop is not None:
             tpumon_stop.set()
+            # The sampler shares the snapshot .tmp path; join before the
+            # exists-check below so the two writers never interleave.
+            tpumon_thread.join(timeout=2.0)
+        if memprof_path and not os.path.exists(memprof_path):
+            # Sampler off or the growth gate never fired: final snapshot so
+            # the allocation-site table exists for every profiled run.
+            from sofa_tpu.collectors.tpumon import snapshot_memprof
+
+            snapshot_memprof(jax, memprof_path, "final", 0)
         procmon.stop()
         timebase.stop()  # end-of-run anchor enables the drift fit at ingest
         elapsed = time.time() - start

@@ -94,6 +94,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--xprof_duration_s", type=float)
     g.add_argument("--tpu_mon_rate", type=int)
     g.add_argument("--disable_tpu_mon", action="store_true")
+    g.add_argument("--disable_memprof", action="store_true",
+                   help="skip the peak-HBM allocation-site snapshot")
 
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
@@ -173,6 +175,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         cfg.enable_xprof = not passed["disable_xprof"]
     if was_set("disable_tpu_mon"):
         cfg.enable_tpu_mon = not passed["disable_tpu_mon"]
+    if was_set("disable_memprof"):
+        cfg.enable_mem_prof = not passed["disable_memprof"]
     if was_set("network_filters"):
         cfg.network_filters = [s for s in passed["network_filters"].split(",") if s]
     if was_set("cpu_filters"):

@@ -19,6 +19,15 @@ heartbeat (deviceId -1):
     <unix_ns> <device_id> <bytes_in_use> <bytes_limit> <peak_bytes_in_use>
 
 Parsed by sofa_tpu/ingest/tpumon_parse.py.
+
+The sampler doubles as the trigger for HBM *attribution* snapshots: when the
+summed bytes-in-use sets a new high-water mark, it dumps
+``jax.profiler.device_memory_profile()`` (a gzipped pprof Profile keyed by
+allocation call stack) to ``memprof.pb.gz``.  One total from nvsmi is all the
+reference ever had (sofa_record.py:300-310); the snapshot says *which
+allocation sites* hold the peak — the question OOM debugging actually asks.
+A snapshot is a stop-the-world serialize of every live buffer's stack, so it
+is growth-gated (>2% over the previous mark) and rate-limited, not per-tick.
 """
 
 from __future__ import annotations
@@ -58,7 +67,53 @@ def _backend_ready():
     return jax if time.time() - _backend_ready._seen > 5.0 else None
 
 
-def _loop(rate_hz, out_path, stop):
+_MEMPROF = {"snap": 0, "last": 0.0}   # bytes at / time of last snapshot
+
+
+def snapshot_memprof(jax, path, trigger, total_bytes):
+    """Dump the device memory profile (gzipped pprof) + a meta sidecar.
+
+    Best-effort by contract: the profiled program must never die because an
+    observability snapshot failed (chip mid-teardown, read-only logdir, ...).
+    """
+    import json
+    try:
+        blob = jax.profiler.device_memory_profile()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        import os as _os
+        _os.replace(tmp, path)   # readers never see a half-written profile
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"unix_ns": time.time_ns(), "trigger": trigger,
+                       "total_bytes": int(total_bytes)}, f)
+        return True
+    except Exception as e:
+        sys.stderr.write("sofa_tpu: memprof snapshot failed: %r\\n" % (e,))
+        return False
+
+
+def _maybe_memprof(jax, path, total_bytes):
+    """Growth-gated, rate-limited peak snapshot (see module docstring).
+
+    The gate baseline is the bytes at the last *successful snapshot* — never
+    the per-tick observation — so gradual growth (1% per tick, compounding)
+    still re-triggers once it sums past 2% since the snapshot, and a
+    rate-limited tick re-arms instead of silently raising the bar.
+    """
+    if not path or total_bytes <= 0:
+        return
+    if total_bytes <= _MEMPROF["snap"] * 1.02:
+        return
+    now = time.time()
+    if now - _MEMPROF["last"] < 2.0:
+        return
+    if snapshot_memprof(jax, path, "peak", total_bytes):
+        _MEMPROF["snap"] = total_bytes
+        _MEMPROF["last"] = now
+
+
+def _loop(rate_hz, out_path, stop, memprof_path=None):
     jax = None
     while jax is None:
         if stop is not None and stop.is_set():
@@ -81,6 +136,7 @@ def _loop(rate_hz, out_path, stop):
             try:
                 out.write("%d -1 0 0 0\\n" % ts)   # liveness heartbeat
                 wrote = False
+                total_used = 0
                 for d in devs:
                     try:
                         ms = d.memory_stats()
@@ -89,6 +145,7 @@ def _loop(rate_hz, out_path, stop):
                     if not ms:
                         continue
                     wrote = True
+                    total_used += int(ms.get("bytes_in_use", 0))
                     out.write("%d %d %d %d %d\\n" % (
                         ts, d.id,
                         ms.get("bytes_in_use", 0),
@@ -113,21 +170,24 @@ def _loop(rate_hz, out_path, stop):
                     except Exception:
                         per = {}
                     for did, used in sorted(per.items()):
+                        total_used += used
                         out.write("%d %d %d 0 0\\n" % (ts, did, used))
+                _maybe_memprof(jax, memprof_path, total_used)
             except Exception:
                 return
             time.sleep(interval)
 
 
-def start_sampler(rate_hz, out_path, stop=None):
+def start_sampler(rate_hz, out_path, stop=None, memprof_path=None):
     """Start the sampler thread; returns it.  Waits for jax by itself, so it
     is safe to call before the profiled program imports jax.  Pass a
-    threading.Event as `stop` to end the loop (in-process API use)."""
+    threading.Event as `stop` to end the loop (in-process API use); pass
+    `memprof_path` to arm peak-triggered HBM attribution snapshots."""
     own_stop = stop is None
     if own_stop:
         stop = threading.Event()
     t = threading.Thread(
-        target=_loop, args=(rate_hz, out_path, stop),
+        target=_loop, args=(rate_hz, out_path, stop, memprof_path),
         daemon=True, name="sofa_tpu_tpumon",
     )
     t.start()
@@ -136,10 +196,17 @@ def start_sampler(rate_hz, out_path, stop=None):
         # abort the whole process (SIGABRT from the C++ layer); stop and
         # join the sampler BEFORE shutdown instead.
         import atexit
+        import os
 
         def _shutdown():
             stop.set()
             t.join(timeout=2.0)
+            # No peak ever cleared the gate (or xprof's own exit fallback is
+            # absent because tracing was off): leave a final snapshot.
+            jax = sys.modules.get("jax")
+            if memprof_path and jax is not None \\
+                    and not os.path.exists(memprof_path):
+                snapshot_memprof(jax, memprof_path, "final", 0)
 
         atexit.register(_shutdown)
     return t
@@ -149,6 +216,7 @@ def start_sampler(rate_hz, out_path, stop=None):
 _ns: dict = {}
 exec(compile(_SAMPLER, "<sofa_tpu_tpumon>", "exec"), _ns)
 start_sampler = _ns["start_sampler"]
+snapshot_memprof = _ns["snapshot_memprof"]
 
 
 def write_sampler_module(inject_dir: str) -> None:

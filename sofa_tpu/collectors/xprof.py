@@ -108,6 +108,16 @@ def _stop(jax):
     if _DONE["stopped"] or not _DONE["started"]:
         return
     _DONE["stopped"] = True
+    # HBM attribution fallback: if the tpumon sampler never caught a peak
+    # (sampler off, or memory never grew past the gate), take one final
+    # snapshot so the report always has *some* allocation-site table.
+    mp = os.environ.get("SOFA_TPU_MEMPROF_OUT")
+    if mp and not os.path.exists(mp):
+        try:
+            from sofa_tpu_tpumon import snapshot_memprof
+            snapshot_memprof(jax, mp, "final", 0)
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write("sofa_tpu: final memprof failed: %r\\n" % (e,))
     try:
         jax.profiler.stop_trace()
     except Exception as e:  # noqa: BLE001
@@ -238,6 +248,7 @@ if os.environ.get("SOFA_TPU_TPUMON_HZ"):
     _tpumon_start(
         float(os.environ["SOFA_TPU_TPUMON_HZ"]),
         os.environ["SOFA_TPU_TPUMON_OUT"],
+        memprof_path=os.environ.get("SOFA_TPU_MEMPROF_OUT"),
     )
 '''
 
@@ -278,6 +289,9 @@ class XProfCollector(Collector):
             "python_tracer": cfg.xprof_python_tracer,
         }
         env = {"SOFA_TPU_XPROF_OPTS": json.dumps(opts)}
+        if cfg.enable_mem_prof and (cfg.enable_xprof or cfg.enable_tpu_mon):
+            env["SOFA_TPU_MEMPROF_OUT"] = os.path.abspath(
+                cfg.path("memprof.pb.gz"))
         existing = os.environ.get("PYTHONPATH", "")
         env["PYTHONPATH"] = cfg.inject_dir + (os.pathsep + existing if existing else "")
         if cfg.enable_py_stacks:

@@ -95,6 +95,8 @@ class SofaConfig:
     xprof_duration_s: float = 0.0    # 0 = whole run
     enable_tpu_mon: bool = True      # live HBM/liveness sampler (in-process)
     tpu_mon_rate: int = 1            # TPU runtime metrics sampler Hz
+    enable_mem_prof: bool = True     # HBM attribution snapshot (pprof) at
+                                     # the observed occupancy peak
 
     # --- preprocess --------------------------------------------------------
     cpu_time_offset_ms: int = 0      # manual host-clock fudge (bin/sofa:111)

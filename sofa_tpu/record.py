@@ -44,6 +44,7 @@ RAW_FILES = [
     "netstat.txt", "cpuinfo.txt", "vmstat.txt", "perf.data", "time.txt",
     "strace.txt", "pystacks.txt", "sofa.pcap", "blktrace.txt", "kallsyms",
     "tpu_topo.json", "xprof_marker.txt", "sofa.err", "tpumon.txt",
+    "memprof.pb.gz", "memprof.pb.gz.meta.json",
 ]
 
 # Derived files (removed by `sofa clean`).
@@ -447,6 +448,8 @@ def _record_flags(cfg) -> list:
         flags.append("--disable_xprof")
     if not cfg.enable_tpu_mon:
         flags.append("--disable_tpu_mon")
+    if not cfg.enable_mem_prof:
+        flags.append("--disable_memprof")
     valued = [
         ("perf_events", "--perf_events"),
         ("cpu_sample_rate", "--cpu_sample_rate"),
