@@ -304,7 +304,11 @@ def main(argv=None) -> int:
                 return 2
             import copy
             from sofa_tpu.analysis.features import Features
-            from sofa_tpu.ml.diff import sofa_swarm_diff, sofa_tpu_diff
+            from sofa_tpu.ml.diff import (
+                sofa_mem_diff,
+                sofa_swarm_diff,
+                sofa_tpu_diff,
+            )
             from sofa_tpu.ml.hsg import sofa_hsg
             from sofa_tpu.preprocess import sofa_preprocess
             print_main_progress("SOFA diff")
@@ -316,6 +320,7 @@ def main(argv=None) -> int:
                 sofa_hsg(frames, c, Features())  # writes auto_caption.csv
             sofa_swarm_diff(cfg)
             sofa_tpu_diff(cfg)
+            sofa_mem_diff(cfg)
             return 0
         if cmd == "viz":
             from sofa_tpu.viz import sofa_viz

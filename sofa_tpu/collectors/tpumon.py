@@ -77,12 +77,16 @@ def snapshot_memprof(jax, path, trigger, total_bytes):
     observability snapshot failed (chip mid-teardown, read-only logdir, ...).
     """
     import json
+    import os as _os
     try:
         blob = jax.profiler.device_memory_profile()
-        tmp = path + ".tmp"
+        # Writer-unique tmp name: the sampler thread and the at-exit
+        # fallback may snapshot concurrently (injection atexit order is not
+        # ours to pick); each writes its own tmp and the atomic replace
+        # means the published file is always ONE complete snapshot.
+        tmp = "%s.tmp.%d.%d" % (path, _os.getpid(), threading.get_ident())
         with open(tmp, "wb") as f:
             f.write(blob)
-        import os as _os
         _os.replace(tmp, path)   # readers never see a half-written profile
         with open(path + ".meta.json", "w") as f:
             json.dump({"unix_ns": time.time_ns(), "trigger": trigger,
@@ -186,6 +190,11 @@ def start_sampler(rate_hz, out_path, stop=None, memprof_path=None):
     own_stop = stop is None
     if own_stop:
         stop = threading.Event()
+    if memprof_path:
+        # Re-arm the growth gate: a previous profile() in this process left
+        # its peak as the baseline, which would suppress this run's
+        # snapshots unless it out-allocated the last one by 2%.
+        _MEMPROF.update(snap=0, last=0.0)
     t = threading.Thread(
         target=_loop, args=(rate_hz, out_path, stop, memprof_path),
         daemon=True, name="sofa_tpu_tpumon",

@@ -52,6 +52,34 @@ def match_swarms(base: Dict[int, dict], match: Dict[int, dict]) -> Dict[int, Opt
     return out
 
 
+def _delta_table(base: pd.DataFrame, match: pd.DataFrame, value_col: str,
+                 out_path: str) -> pd.DataFrame:
+    """Outer-join two per-key aggregates into the shared diff shape.
+
+    delta = match - base; ratio uses the one inf convention both diffs rely
+    on: keys new in match get ratio=inf so the mover filter — and the
+    reader — can't miss a regression that only exists in match, while a key
+    with zero value in BOTH runs is unchanged (ratio 1), not a mover.
+    Sorted by |delta| and written to out_path.
+    """
+    import numpy as np
+
+    joined = base.join(match, how="outer",
+                       lsuffix="_base", rsuffix="_match").fillna(0.0)
+    b, m = f"{value_col}_base", f"{value_col}_match"
+    joined["delta"] = joined[m] - joined[b]
+    joined["ratio"] = np.where(
+        joined[b] > 0,
+        joined[m] / joined[b].replace(0, np.nan),
+        np.where(joined[m] > 0, np.inf, 1.0))
+    table = joined.reindex(
+        joined["delta"].abs().sort_values(ascending=False).index
+    ).reset_index()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    table.to_csv(out_path, index=False)
+    return table
+
+
 def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
     """Run-to-run HLO-op diff — the TPU-side complement to the swarm diff.
 
@@ -61,8 +89,6 @@ def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
     Reads both runs' tputrace frames, writes tpu_diff.csv sorted by
     |delta|, and flags ops whose time moved more than 20 %.
     """
-    import numpy as np
-
     from sofa_tpu.trace import read_frame, roi_clip
 
     base = read_frame(os.path.join(cfg.base_logdir, "tputrace"))
@@ -78,25 +104,10 @@ def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
         return sync.groupby("name").agg(
             time=("duration", "sum"), count=("duration", "count"))
 
-    joined = per_op(base).join(per_op(match), how="outer",
-                               lsuffix="_base", rsuffix="_match").fillna(0.0)
-    joined["delta"] = joined["time_match"] - joined["time_base"]
-    # New ops (no base time) get ratio=inf so the >20% mover filter —
-    # and the reader — can't miss a regression that only exists in match.
-    joined["ratio"] = np.where(
-        joined["time_base"] > 0,
-        joined["time_match"] / joined["time_base"].replace(0, np.nan),
-        # inf only for ops that actually exist in match: an op with zero
-        # time in BOTH runs is unchanged (ratio 1), not a >20% mover.
-        np.where(joined["time_match"] > 0, np.inf, 1.0))
-    table = joined.reindex(
-        joined["delta"].abs().sort_values(ascending=False).index
-    ).reset_index()
     out_path = os.path.join(cfg.logdir, "tpu_diff.csv")
-    os.makedirs(cfg.logdir, exist_ok=True)
-    table.to_csv(out_path, index=False)
+    table = _delta_table(per_op(base), per_op(match), "time", out_path)
 
-    tb, tm = float(joined["time_base"].sum()), float(joined["time_match"].sum())
+    tb, tm = float(table["time_base"].sum()), float(table["time_match"].sum())
     print_title("TPU op diff (base vs match)")
     print(table.head(15).to_string(index=False))
     moved = table[(table["ratio"] > 1.2) | (table["ratio"] < 1 / 1.2)]
@@ -104,6 +115,44 @@ def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
         f"diff: device time {tb:.4f}s -> {tm:.4f}s "
         f"({(tm / tb - 1) * 100 if tb else 0:+.1f}%); "
         f"{len(moved)} ops moved >20%; wrote {out_path}")
+    return table
+
+
+def sofa_mem_diff(cfg) -> Optional[pd.DataFrame]:
+    """Run-to-run HBM attribution diff — memory regressions by site.
+
+    Complements sofa_tpu_diff's time deltas: joins the two runs' peak
+    allocation-site tables (ingest/memprof.py) on (site, kind) and reports
+    held-byte deltas, so "this commit grew the optimizer state 2x" is one
+    table row instead of an OOM three days later.  No reference analogue —
+    its memory signal was one nvsmi total, undiffable by construction.
+    """
+    from sofa_tpu.ingest.memprof import load_memprof
+
+    base_df, _ = load_memprof(cfg.base_logdir)
+    match_df, _ = load_memprof(cfg.match_logdir)
+    if base_df is None or match_df is None or base_df.empty or match_df.empty:
+        print_warning("diff: no memprof.pb.gz in one of the runs — "
+                      "skipping memory diff")
+        return None
+
+    def per_site(df):
+        return df.groupby(["site", "kind"]).agg(
+            bytes=("bytes", "sum"), count=("count", "sum"))
+
+    out_path = os.path.join(cfg.logdir, "mem_diff.csv")
+    table = _delta_table(per_site(base_df), per_site(match_df), "bytes",
+                         out_path)
+
+    bb = float(table["bytes_base"].sum())
+    bm = float(table["bytes_match"].sum())
+    print_title("HBM attribution diff (base vs match)")
+    print(table.head(15).to_string(index=False))
+    grown = table[table["delta"] > 0.05 * max(bb, 1)]
+    print_progress(
+        f"diff: held bytes {bb / 1e9:.3f}GB -> {bm / 1e9:.3f}GB "
+        f"({(bm / bb - 1) * 100 if bb else 0:+.1f}%); "
+        f"{len(grown)} sites grew >5% of the base total; wrote {out_path}")
     return table
 
 

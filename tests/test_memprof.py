@@ -206,7 +206,8 @@ def test_snapshot_memprof_atomic_and_resilient(tmp_path):
     path = str(tmp_path / "memprof.pb.gz")
     assert snapshot_memprof(_StubJax, path, "final", 0)
     assert parse_memprof(path).shape[0] == 4
-    assert not os.path.exists(path + ".tmp")
+    import glob
+    assert not glob.glob(path + ".tmp*")  # writer-unique tmps all cleaned
 
     class _Broken:
         class profiler:  # noqa: N801
@@ -216,6 +217,74 @@ def test_snapshot_memprof_atomic_and_resilient(tmp_path):
 
     # Failure is reported, not raised — the profiled app must survive.
     assert not snapshot_memprof(_Broken, str(tmp_path / "x.pb.gz"), "final", 0)
+
+
+def make_profile(sites):
+    """One buffer sample per {site_name: bytes} behind a runtime frame."""
+    from sofa_tpu.ingest import memprof_pb2
+
+    p = memprof_pb2.Profile()
+    strings = [""]
+
+    def intern(s):
+        if s not in strings:
+            strings.append(s)
+        return strings.index(s)
+
+    for t, u in (("allocations", "count"), ("space", "bytes")):
+        vt = p.sample_type.add()
+        vt.type, vt.unit = intern(t), intern(u)
+    fn = p.function.add()
+    fn.id, fn.name = 1, intern("__call__")
+    loc = p.location.add()
+    loc.id = 1
+    loc.line.add().function_id = 1
+    for i, (site, nbytes) in enumerate(sites.items(), start=2):
+        fn = p.function.add()
+        fn.id, fn.name = i, intern(site)
+        loc = p.location.add()
+        loc.id = i
+        loc.line.add().function_id = i
+        s = p.sample.add()
+        s.location_id.extend([1, i])
+        s.value.extend([1, nbytes])
+        for key, val in (("kind", "buffer"), ("device", "TPU_0")):
+            lb = s.label.add()
+            lb.key, lb.str = intern(key), intern(val)
+    p.string_table.extend(strings)
+    return p
+
+
+def test_sofa_mem_diff_site_deltas(tmp_path):
+    from sofa_tpu.ml.diff import sofa_mem_diff
+
+    mb = 2**20
+    for name, sites in (
+        ("base", {"train_step": 100 * mb, "load_batch": 10 * mb}),
+        ("match", {"train_step": 250 * mb, "kv_cache": 50 * mb}),
+    ):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / "memprof.pb.gz", "wb") as f:
+            f.write(gzip.compress(make_profile(sites).SerializeToString()))
+    cfg = SofaConfig(logdir=str(tmp_path / "out") + "/")
+    cfg.base_logdir = str(tmp_path / "base")
+    cfg.match_logdir = str(tmp_path / "match")
+    table = sofa_mem_diff(cfg)
+    assert table is not None
+    assert os.path.isfile(cfg.path("mem_diff.csv"))
+    # Sorted by |delta|: train_step (+150MB) first, then kv_cache (+50MB,
+    # new in match -> ratio inf), then load_batch (-10MB, gone).
+    assert list(table["site"][:3]) == ["train_step", "kv_cache", "load_batch"]
+    t = table.set_index("site")
+    assert t.loc["train_step", "delta"] == 150 * mb
+    assert t.loc["train_step", "ratio"] == pytest.approx(2.5)
+    assert t.loc["kv_cache", "ratio"] == float("inf")
+    assert t.loc["load_batch", "delta"] == -10 * mb
+
+    # One side missing its snapshot: warn-and-skip, never raise.
+    cfg.match_logdir = str(tmp_path / "nowhere")
+    assert sofa_mem_diff(cfg) is None
 
 
 def test_api_profile_captures_memprof(logdir):
