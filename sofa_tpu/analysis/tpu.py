@@ -308,10 +308,14 @@ def op_tree_profile(frames, cfg, features: Features) -> None:
     sync = df[(df["category"] == 0) & (df["op_path"] != "")]
     if sync.empty:
         return
+    # Program paths repeat per op instance (a pod-scale trace is millions of
+    # rows over hundreds of distinct paths): aggregate per unique path
+    # vectorized first, then walk prefixes over the uniques only.
+    per_path = sync.groupby("op_path", sort=False).agg(
+        time=("duration", "sum"), count=("duration", "count"),
+        flops=("flops", "sum"), nbytes=("bytes_accessed", "sum"))
     agg: dict = {}
-    for path, dur, flops, nbytes in zip(
-            sync["op_path"], sync["duration"], sync["flops"],
-            sync["bytes_accessed"]):
+    for path, dur, cnt, flops, nbytes in per_path.itertuples(name=None):
         parts = path.split("/")
         for depth in range(1, len(parts) + 1):
             prefix = "/".join(parts[:depth])
@@ -319,7 +323,7 @@ def op_tree_profile(frames, cfg, features: Features) -> None:
             if a is None:
                 agg[prefix] = a = [depth, 0.0, 0, 0.0, 0.0]
             a[1] += dur
-            a[2] += 1
+            a[2] += cnt
             a[3] += flops
             a[4] += nbytes
     total = float(sync["duration"].sum())

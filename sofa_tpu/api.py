@@ -90,8 +90,10 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
         jax.profiler.stop_trace()
         if tpumon_stop is not None:
             tpumon_stop.set()
-            # The sampler shares the snapshot .tmp path; join before the
-            # exists-check below so the two writers never interleave.
+            # Join so the sampler's last tick can't publish a snapshot
+            # after the exists-check below decides a fallback is needed
+            # (tmp names are writer-unique, so corruption is impossible —
+            # this is about which snapshot wins).
             tpumon_thread.join(timeout=2.0)
         if memprof_path and not os.path.exists(memprof_path):
             # Sampler off or the growth gate never fired: final snapshot so

@@ -12,7 +12,8 @@ schema maps onto Perfetto's process/thread/track model:
             host threads by tid)
   X events = spans (ops, steps, host events) with args carrying the
             schema's analysis columns (flops, bytes, phase, op_path, ...)
-  C events = counter tracks from tpuutil (tc/mxu util %, HBM GB/s) and
+  C events = counter tracks from tpuutil (tc/mxu util %, HBM GB/s),
+    tpumon (live HBM used/occupancy per device) and
             host net/cpu series
 
 Timestamps are emitted in microseconds relative to the capture so traces
@@ -35,7 +36,8 @@ _HOST_PID = 1_000_000
 _CUSTOM_PID = 1_100_000
 
 PERFETTO_FRAMES = ["tputrace", "tpusteps", "tpumodules", "hosttrace",
-                   "customtrace", "tpuutil", "mpstat", "netbandwidth"]
+                   "customtrace", "tpuutil", "tpumon", "mpstat",
+                   "netbandwidth"]
 
 
 # Row iteration uses itertuples for the SMALL frames; the pod-scale op
@@ -233,6 +235,14 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
     util = get("tpuutil")
     if not util.empty:
         _counter_events(util, events)
+    # Live HBM occupancy rides the same per-device counter convention as
+    # the trace-derived rates; heartbeat rows (deviceId -1) are liveness
+    # bookkeeping, not a device counter.
+    mon = get("tpumon")
+    if not mon.empty:
+        mon = mon[(mon["name"] != "alive") & (mon["deviceId"] >= 0)]
+    if not mon.empty:
+        _counter_events(mon, events)
     _host_counter_events(get("mpstat"), ["usr", "sys", "iow"],
                          "cpu_", events)
     net = get("netbandwidth")
@@ -244,7 +254,7 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
         return None
 
     device_ids = set()
-    for df in (ops, steps, mods, util):
+    for df in (ops, steps, mods, util, mon):
         if not df.empty:
             device_ids.update(int(d) for d in df["deviceId"].unique())
     for pid in sorted(device_ids):

@@ -274,7 +274,15 @@ def _conform(df: pd.DataFrame) -> pd.DataFrame:
 
 
 def read_csv(path: str) -> pd.DataFrame:
-    return _conform(pd.read_csv(path))
+    # The multithreaded arrow parser reads a pod-scale tputrace ~2x faster
+    # than pandas' C engine AND parses floats correctly rounded (the C
+    # engine's default fast strtod is off by up to ~1e-10 relative).
+    # Fall back for anything arrow refuses (malformed lines, exotic quoting).
+    try:
+        df = pd.read_csv(path, engine="pyarrow")
+    except Exception:  # noqa: BLE001
+        df = pd.read_csv(path)
+    return _conform(df)
 
 
 def write_frame(df: pd.DataFrame, base_path: str, fmt: str = "csv") -> str:

@@ -77,6 +77,12 @@ def test_export_perfetto(tmp_path):
         {"timestamp": 0.01, "event": 55.0, "deviceId": 0,
          "name": "tc_util", "device_kind": "tpu"},
     ]), d + "tpuutil.csv")
+    write_csv(make_frame([
+        {"timestamp": 0.005, "event": 0.0, "deviceId": -1,
+         "name": "alive", "device_kind": "tpu"},      # heartbeat: excluded
+        {"timestamp": 0.005, "event": 2.5, "deviceId": 1,
+         "name": "hbm_used_gb", "device_kind": "tpu"},
+    ]), d + "tpumon.csv")
 
     from sofa_tpu.config import SofaConfig as _C
 
@@ -93,9 +99,13 @@ def test_export_perfetto(tmp_path):
     assert dma["tid"] == 1                       # async DMA lane
     counters = [e for e in evs if e["ph"] == "C"]
     assert counters and counters[0]["args"]["tc_util"] == 55.0
+    hbm = [e for e in counters if e["name"] == "hbm_used_gb"]
+    assert hbm and hbm[0]["pid"] == 1 and hbm[0]["args"]["hbm_used_gb"] == 2.5
+    assert not any(e["name"] == "alive" for e in counters)  # heartbeat out
     procs = [e for e in evs if e["ph"] == "M"
              and e["name"] == "process_name"]
-    assert {"tpu0", "host"} <= {e["args"]["name"] for e in procs}
+    # tpu1 exists only via the tpumon counter — device naming must cover it
+    assert {"tpu0", "tpu1", "host"} <= {e["args"]["name"] for e in procs}
 
     # CLI flag: no chartable host samplers here, but perfetto succeeds
     r = subprocess.run([sys.executable, "-m", "sofa_tpu", "export",

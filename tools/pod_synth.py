@@ -1,0 +1,85 @@
+"""Generate a synthetic 8-device x 200k-op pod-scale logdir.
+
+The perf harness behind the pod-scale numbers in README.md: flops/bytes are
+static per op name (XLA cost-model metadata is per-op, not per-occurrence),
+op names cycle over 700 symbols, timestamps/durations are exponential.
+
+    python tools/pod_synth.py /tmp/podlog/
+    sofa analyze --logdir /tmp/podlog/          # report-path timing
+    sofa export --logdir /tmp/podlog/ --perfetto
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from sofa_tpu.trace import make_frame, write_csv  # noqa: E402
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/podlog/"
+N_DEV, N_OPS = 8, 200_000
+rng = np.random.default_rng(0)
+
+os.makedirs(OUT, exist_ok=True)
+names = np.array([f"fusion.{i % 700}" for i in range(N_OPS)])
+cats = np.array(["fusion", "convolution", "all-reduce", "copy"])[
+    rng.integers(0, 4, N_OPS)]
+frames = []
+for dev in range(N_DEV):
+    ts = np.cumsum(rng.exponential(12e-6, N_OPS))
+    df = make_frame({
+        "timestamp": ts,
+        "duration": rng.exponential(8e-6, N_OPS),
+        "deviceId": dev,
+        "category": rng.integers(0, 3, N_OPS) % 2,  # some async
+        "name": names,
+        "hlo_category": cats,
+        # static per op name, like real XLA cost-model metadata
+        "flops": np.array([float(1e9 + (i % 700) * 1e6) for i in range(N_OPS)]),
+        "bytes_accessed": np.array([float(1e6 + (i % 700) * 1e3) for i in range(N_OPS)]),
+        "copyKind": np.where(cats == "all-reduce", 21, 0),
+        "payload": np.where(cats == "all-reduce", int(4e6), 0),
+        "device_kind": "tpu",
+        "phase": np.where(rng.random(N_OPS) < 0.5, "fw", "bw"),
+        "module": "jit_train_step",
+        "op_path": "jit(train_step)/transpose(jvp(main))/mul",
+        "tid": 0,
+        "pid": -1,
+        "event": 0.0,
+    })
+    frames.append(df)
+
+import pandas as pd  # noqa: E402
+
+tput = pd.concat(frames, ignore_index=True)
+write_csv(tput, OUT + "tputrace.csv")
+
+steps = []
+for dev in range(N_DEV):
+    t0 = 0.0
+    for s in range(50):
+        steps.append({"timestamp": t0, "duration": 0.048, "deviceId": dev,
+                      "name": f"step {s}", "device_kind": "tpu"})
+        t0 += 0.05
+write_csv(make_frame(steps), OUT + "tpusteps.csv")
+
+util = []
+for dev in range(N_DEV):
+    for t in np.arange(0, 2.5, 0.01):
+        util.append({"timestamp": t, "event": 60.0, "deviceId": dev,
+                     "name": "tc_util", "device_kind": "tpu"})
+write_csv(make_frame(util), OUT + "tpuutil.csv")
+
+mon = []
+for t in np.arange(0, 2.5, 1.0):
+    mon.append({"timestamp": t, "event": 0.0, "deviceId": -1, "name": "alive"})
+    for dev in range(N_DEV):
+        mon.append({"timestamp": t, "event": 2.5, "deviceId": dev,
+                    "name": "hbm_used_gb"})
+write_csv(make_frame(mon), OUT + "tpumon.csv")
+
+with open(OUT + "misc.txt", "w") as f:
+    f.write("elapsed_time 2.5\ncores 8\npid 1\nrc 0\n")
+with open(OUT + "sofa_time.txt", "w") as f:
+    f.write("1700000000.0\n")
+print("generated", OUT, len(tput), "op rows")
