@@ -60,18 +60,28 @@ def load_frames(cfg: SofaConfig,
                 only: "List[str] | None" = None) -> Dict[str, pd.DataFrame]:
     """Read trace frames from the logdir; ``only`` restricts to a subset so
     narrow consumers (sofa export) skip deserializing pod-scale traces they
-    never chart."""
+    never chart.  Reads overlap on a small thread pool — the arrow CSV and
+    parquet decoders release the GIL, so the 15 small frames hide behind
+    the one pod-scale tputrace."""
+    from concurrent.futures import ThreadPoolExecutor
+
     from sofa_tpu.trace import read_frame
 
-    frames: Dict[str, pd.DataFrame] = {}
-    for name in (only if only is not None else CSV_SOURCES):
+    names = list(only if only is not None else CSV_SOURCES)
+
+    def load_one(name: str) -> pd.DataFrame:
         try:
             df = read_frame(cfg.path(name))  # .parquet preferred, else .csv
         except Exception as e:  # noqa: BLE001
             print_warning(f"analyze: cannot read {cfg.path(name)}: {e}")
             df = empty_frame()
-        frames[name] = df if df is not None else empty_frame()
-    return frames
+        return df if df is not None else empty_frame()
+
+    if len(names) <= 1:
+        return {n: load_one(n) for n in names}
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        loaded = list(pool.map(load_one, names))
+    return dict(zip(names, loaded))
 
 
 # Frames whose deviceId column is a device/host ordinal that must rebase

@@ -287,6 +287,49 @@ def test_sofa_mem_diff_site_deltas(tmp_path):
     assert sofa_mem_diff(cfg) is None
 
 
+def test_export_folded_memory_flamegraph(cfg):
+    """--folded exports HBM bytes per allocation stack, root-first, width
+    = bytes (the pprof flame-view convention), executables excluded."""
+    from sofa_tpu.export_folded import export_folded
+
+    write_profile(cfg.path("memprof.pb.gz"))
+    paths = export_folded(cfg, frames={})
+    assert cfg.path("memprof.folded") in paths
+    lines = open(cfg.path("memprof.folded")).read().splitlines()
+    by_stack = dict(line.rsplit(" ", 1) for line in lines)
+    # build_profile: train_step holds 6MB+2MB on one stack, load_batch 1MB.
+    assert by_stack["train_step;_pjit_call_impl_python;__call__"] == str(8 * 2**20)
+    assert by_stack["load_batch;_pjit_call_impl_python;__call__"] == str(1 * 2**20)
+    assert not any("backend_compile" in s for s in by_stack)  # kind=executable, 0 bytes
+
+    # A truncated snapshot degrades with a warning, never a traceback —
+    # static/perfetto artifacts may already have succeeded in this export.
+    with open(cfg.path("memprof.pb.gz"), "wb") as f:
+        f.write(b"\x1f\x8b\x08\x00junk")
+    assert export_folded(cfg, frames={}) == []
+
+
+def test_export_folded_memprof_cluster_hosts(tmp_path):
+    """--cluster_hosts folds every host's snapshot, hostname as root frame."""
+    from sofa_tpu.export_folded import export_folded
+
+    top = str(tmp_path / "clog") + "/"
+    for host in ("h1", "h2"):
+        d = top.rstrip("/") + f"-{host}/"
+        os.makedirs(d)
+        with open(d + "memprof.pb.gz", "wb") as f:
+            f.write(gzip.compress(build_profile().SerializeToString()))
+    cfg = SofaConfig(logdir=top)
+    cfg.cluster_hosts = ["h1", "h2"]
+    paths = export_folded(cfg, frames={})
+    assert cfg.path("memprof.folded") in paths
+    lines = open(cfg.path("memprof.folded")).read().splitlines()
+    by_stack = dict(line.rsplit(" ", 1) for line in lines)
+    for host in ("h1", "h2"):
+        assert by_stack[f"{host};train_step;_pjit_call_impl_python;__call__"] \
+            == str(8 * 2**20)
+
+
 def test_api_profile_captures_memprof(logdir):
     """End-to-end on the CPU backend: sofa_tpu.api.profile leaves a
     parseable allocation-site snapshot beside the trace."""

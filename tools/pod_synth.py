@@ -16,7 +16,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from sofa_tpu.trace import make_frame, write_csv  # noqa: E402
 
-OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/podlog/"
+OUT = os.path.join(sys.argv[1] if len(sys.argv) > 1 else "/tmp/podlog", "")
 N_DEV, N_OPS = 8, 200_000
 rng = np.random.default_rng(0)
 
