@@ -21,6 +21,9 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file
 # would cost up to the full build timeout per ingested file.
 _FAILED: set = set()
 
+# Link flags per tool (appended after the source so ld resolves symbols).
+_EXTRA_FLAGS = {"perfetto_write": ["-lz"]}
+
 
 def ensure_built(tool: str) -> Optional[str]:
     """Return the path of a native helper, building it if needed.
@@ -45,7 +48,7 @@ def ensure_built(tool: str) -> Optional[str]:
     tmp = f"{binary}.build.{os.getpid()}"
     try:
         subprocess.run(
-            [gxx, "-O2", "-o", tmp, source],
+            [gxx, "-O2", "-o", tmp, source] + _EXTRA_FLAGS.get(tool, []),
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, binary)

@@ -62,46 +62,65 @@ def _op_args(row) -> Dict[str, object]:
     return args
 
 
-def _device_events(ops: pd.DataFrame, events: "List[dict | str]") -> None:
-    import numpy as np
+class _DeviceColumns:
+    """The pod-scale op frame, reduced to per-signature JSON prefixes plus
+    flat ts/dur/pid/lane/sig arrays — the exact input of the native writer
+    (native/perfetto_write.cc) and of the Python fallback loop."""
 
-    n = len(ops)
-    # .tolist() yields PYTHON scalars — np.float64's repr is not valid JSON
-    ts = (np.nan_to_num(ops["timestamp"].to_numpy(dtype=float)) * 1e6).tolist()
-    dur = (np.maximum(
-        np.nan_to_num(ops["duration"].to_numpy(dtype=float)), 0.0)
-        * 1e6).tolist()
-    pid = ops["deviceId"].to_numpy(dtype=int).tolist()
-    cat = ops["category"].to_numpy(dtype=int)
-    lane = np.where(cat == 0, 0, np.where(cat == 2, 1, 2)).tolist()
+    def __init__(self, ops: pd.DataFrame) -> None:
+        import numpy as np
 
-    # Args are metadata-derived, so the (name, args) pair takes only a few
-    # hundred distinct values in a pod-scale trace.  An EXACT vectorized
-    # signature (groupby.ngroup over the arg columns, C speed, no hash
-    # collisions) means only the FIRST row of each signature is ever
-    # converted to Python objects; the per-row loop is one list index plus
-    # one f-string.
-    sig_cols = [k for k in ("name", "hlo_category", "module", "phase",
-                            "op_path", "source", "flops", "bytes_accessed",
-                            "payload", "groups") if k in ops.columns]
-    sig_arr = ops.groupby(sig_cols, sort=False, dropna=False).ngroup() \
-        .to_numpy()
-    sig = sig_arr.tolist()
-    uniq, firsts = np.unique(sig_arr, return_index=True)
+        self.n = len(ops)
+        # Clamp AFTER the µs scale: nan->0 before *1e6 would let an inf (or
+        # ~1.8e302 s) re-overflow and both writers would emit the invalid
+        # JSON token `inf`.  ±1e15 µs (~31 years) is beyond any real trace,
+        # and %.3f of it stays well inside the native writer's buffer.
+        self.ts = np.nan_to_num(
+            ops["timestamp"].to_numpy(dtype=float) * 1e6,
+            posinf=1e15, neginf=-1e15)
+        self.dur = np.clip(np.nan_to_num(
+            ops["duration"].to_numpy(dtype=float) * 1e6,
+            posinf=1e15), 0.0, 1e15)
+        self.pid = ops["deviceId"].to_numpy(dtype=np.int32)
+        cat = ops["category"].to_numpy(dtype=int)
+        self.lane = np.where(
+            cat == 0, 0, np.where(cat == 2, 1, 2)).astype(np.uint8)
 
-    dumps = json.dumps
-    prefix: List[str] = [""] * len(uniq)
-    for s, row in zip(uniq.tolist(),
-                      ops.iloc[firsts].itertuples(index=False)):
-        prefix[s] = (
-            f'{{"name":{dumps(str(row.name))},"ph":"X","cat":"tpu_op",'
-            f'"args":{dumps(_op_args(row), separators=(",", ":"))},')
-    for i in range(n):
-        # pre-serialized Trace-Event line (floats via repr: valid JSON for
-        # the finite python floats .tolist()/nan_to_num guarantee)
-        events.append(
+        # Args are metadata-derived, so the (name, args) pair takes only a
+        # few hundred distinct values in a pod-scale trace.  An EXACT
+        # vectorized signature (groupby.ngroup over the arg columns, C
+        # speed, no hash collisions) means only the FIRST row of each
+        # signature is ever converted to Python objects.
+        sig_cols = [k for k in ("name", "hlo_category", "module", "phase",
+                                "op_path", "source", "flops",
+                                "bytes_accessed", "payload", "groups")
+                    if k in ops.columns]
+        sig_arr = ops.groupby(sig_cols, sort=False, dropna=False).ngroup() \
+            .to_numpy()
+        self.sig = sig_arr.astype(np.uint32)
+        uniq, firsts = np.unique(sig_arr, return_index=True)
+        dumps = json.dumps
+        self.prefixes: List[str] = [""] * len(uniq)
+        for s, row in zip(uniq.tolist(),
+                          ops.iloc[firsts].itertuples(index=False)):
+            self.prefixes[s] = (
+                f'{{"name":{dumps(str(row.name))},"ph":"X","cat":"tpu_op",'
+                f'"args":{dumps(_op_args(row), separators=(",", ":"))},')
+
+    def event_strings(self) -> "List[str]":
+        """Python fallback: pre-serialized Trace-Event lines (floats via
+        repr — valid JSON for the finite floats nan_to_num guarantees)."""
+        prefix = self.prefixes
+        sig = self.sig.tolist()  # .tolist() yields PYTHON scalars;
+        ts = self.ts.tolist()    # np.float64's repr is not valid JSON
+        dur = self.dur.tolist()
+        pid = self.pid.tolist()
+        lane = self.lane.tolist()
+        return [
             f'{prefix[sig[i]]}"ts":{ts[i]!r},"dur":{dur[i]!r},'
-            f'"pid":{pid[i]},"tid":{lane[i]}}}')
+            f'"pid":{pid[i]},"tid":{lane[i]}}}'
+            for i in range(self.n)
+        ]
 
 
 def _steps_events(steps: pd.DataFrame, events: List[dict]) -> None:
@@ -213,12 +232,12 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
         df = frames.get(name)
         return df if df is not None else pd.DataFrame()
 
-    # device events are PRE-SERIALIZED json strings (see _device_events);
-    # everything else stays a dict until the writer
-    events: "List[dict | str]" = []
+    # The pod-scale op frame stays COLUMNAR end to end (native writer gets
+    # arrays, Python fallback materializes strings late); everything else
+    # stays a dict until the writer.
+    events: "List[dict]" = []
     ops = get("tputrace")
-    if not ops.empty:
-        _device_events(ops, events)
+    dev = _DeviceColumns(ops) if not ops.empty else None
     steps = get("tpusteps")
     if not steps.empty:
         _steps_events(steps, events)
@@ -248,7 +267,7 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
     net = get("netbandwidth")
     if not net.empty:
         _host_counter_events(net, sorted(set(net["name"])), "", events)
-    if not events:
+    if dev is None and not events:
         print_warning("perfetto export: no trace frames — run "
                       "`sofa report` first")
         return None
@@ -271,19 +290,33 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
             name = "host" if host["deviceId"].nunique() == 1 \
                 else f"host{base // 256}"
             _meta(events, _HOST_PID + base, name, threads)
-    for (dev, label), pid in plane_pids.items():
+    for (_dev, label), pid in plane_pids.items():
         _meta(events, pid, str(label or "custom plane"))
 
     os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
     path = cfg.path(out_name)
-    # Streamed write, gzip level 5, compact separators: a pod-scale trace
-    # is millions of events and the default (level-9 gzip over one giant
-    # json.dump string) took most of the export's wall time.
     dumps = json.dumps
+    tail = ('],"displayTimeUnit":"ms","otherData":'
+            + dumps({"producer": "sofa_tpu", "logdir": cfg.logdir}) + "}")
+    n_total = (dev.n if dev is not None else 0) + len(events)
+
+    # Native single-pass writer (sprintf + zlib in C, ~4x on pod-scale
+    # traces); only worth a subprocess when the device frame is large.
+    # The non-device blob is joined only on this path — the fallback
+    # streams dicts in batches instead of materializing one giant string.
+    if dev is not None and dev.n >= 100_000 \
+            and os.environ.get("SOFA_NATIVE_PERFETTO", "1") != "0":
+        other_json = ",".join(
+            dumps(e, separators=(",", ":")) for e in events)
+        if _native_write(dev, other_json, tail, path):
+            print_progress(f"perfetto export: {n_total} events -> {path} "
+                           "(native writer; open in ui.perfetto.dev)")
+            return path
+
+    # Pure-Python fallback: streamed write, gzip level 5, batched ~64k
+    # strings per f.write (per-event writes were ~15% of the export).
     with gzip.open(path, "wt", encoding="utf-8", compresslevel=5) as f:
         f.write('{"traceEvents":[')
-        # device events arrive pre-serialized (see _device_events); batch
-        # ~64k per write — per-event f.write calls were ~15% of the export
         batch: List[str] = []
         wrote_any = False
 
@@ -297,15 +330,84 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
             wrote_any = True
             batch.clear()
 
+        for e in (dev.event_strings() if dev is not None else []):
+            batch.append(e)
+            if len(batch) >= 65536:
+                flush()
         for e in events:
-            batch.append(e if isinstance(e, str)
-                         else dumps(e, separators=(",", ":")))
+            batch.append(dumps(e, separators=(",", ":")))
             if len(batch) >= 65536:
                 flush()
         flush()
-        f.write('],"displayTimeUnit":"ms","otherData":')
-        f.write(dumps({"producer": "sofa_tpu", "logdir": cfg.logdir}))
-        f.write("}")
-    print_progress(f"perfetto export: {len(events)} events -> {path} "
+        f.write(tail)
+    print_progress(f"perfetto export: {n_total} events -> {path} "
                    "(open in ui.perfetto.dev)")
     return path
+
+
+def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
+                  path: str) -> bool:
+    """Hand the columnar device events to native/perfetto_write.cc.
+
+    Returns False on any failure (no compiler, bad exit, missing output) —
+    the caller keeps the pure-Python path, mirroring ingest/native_scan.py's
+    degradation contract.  Gzip level 4 ≈ the Python path's level 5 within
+    a few % of size at roughly twice the deflate speed.
+    """
+    import struct
+    import subprocess
+    import tempfile
+
+    from sofa_tpu.collectors.native_build import ensure_built
+
+    tool = ensure_built("perfetto_write")
+    if tool is None:
+        return False
+    tmp = None
+    try:
+        with tempfile.NamedTemporaryFile(
+                prefix="sofa_perfetto_", suffix=".bin", delete=False) as f:
+            tmp = f.name
+            f.write(struct.pack("<IIII", 0x31504653, 1, 4,
+                                len(dev.prefixes)))
+            for p in dev.prefixes:
+                b = p.encode("utf-8")
+                f.write(struct.pack("<I", len(b)))
+                f.write(b)
+            f.write(struct.pack("<Q", dev.n))
+            f.write(dev.ts.tobytes())
+            f.write(dev.dur.tobytes())
+            f.write(dev.sig.tobytes())
+            f.write(dev.pid.tobytes())
+            f.write(dev.lane.tobytes())
+            other = other_json.encode("utf-8")
+            f.write(struct.pack("<Q", len(other)))
+            f.write(other)
+            tail_b = tail.encode("utf-8")
+            f.write(struct.pack("<Q", len(tail_b)))
+            f.write(tail_b)
+        out_tmp = path + f".native.{os.getpid()}"
+        r = subprocess.run([tool, tmp, out_tmp],
+                           capture_output=True, timeout=600)
+        if r.returncode != 0 or not os.path.isfile(out_tmp):
+            print_warning("native perfetto_write failed "
+                          f"(rc={r.returncode}): "
+                          f"{r.stderr.decode(errors='replace')[:200]} — "
+                          "using the Python writer")
+            try:
+                os.unlink(out_tmp)
+            except OSError:
+                pass
+            return False
+        os.replace(out_tmp, path)
+        return True
+    except Exception as e:  # noqa: BLE001 — any failure degrades
+        print_warning(f"native perfetto_write failed ({e}) — "
+                      "using the Python writer")
+        return False
+    finally:
+        if tmp:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

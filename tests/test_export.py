@@ -116,6 +116,75 @@ def test_export_perfetto(tmp_path):
     assert "perfetto export" in r.stdout + r.stderr
 
 
+def test_export_perfetto_native_writer_equivalence(tmp_path, capsys):
+    """The native writer (native/perfetto_write.cc) and the Python path
+    emit the same events (ts/dur within the writer's ns resolution), and a
+    corrupt interchange file fails the tool without killing the export."""
+    import gzip
+    import json
+    import math
+    import subprocess
+
+    import numpy as np
+
+    from sofa_tpu.collectors.native_build import ensure_built
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.export_perfetto import export_perfetto
+    from sofa_tpu.trace import make_frame, write_csv
+
+    tool = ensure_built("perfetto_write")
+    if tool is None:
+        import pytest
+
+        pytest.skip("no C++ compiler for the native writer")
+
+    n = 120_000  # past the native-path threshold
+    rng = np.random.default_rng(7)
+    d = str(tmp_path / "nlog") + "/"
+    os.makedirs(d)
+    write_csv(make_frame({
+        "timestamp": np.cumsum(rng.exponential(1e-5, n)),
+        "duration": rng.exponential(5e-6, n),
+        "deviceId": rng.integers(0, 4, n),
+        "category": rng.integers(0, 3, n) % 2,
+        "name": np.array([f"fusion.{i % 37}" for i in range(n)]),
+        "hlo_category": "fusion",
+        "flops": np.array([float(1e9 + (i % 37)) for i in range(n)]),
+        "device_kind": "tpu",
+    }), d + "tputrace.csv")
+    cfg = SofaConfig(logdir=d)
+
+    os.environ.pop("SOFA_NATIVE_PERFETTO", None)
+    native = export_perfetto(cfg, out_name="native.json.gz")
+    # A silent fallback would make the comparison below vacuous (Python vs
+    # Python): require the native path to have actually run.
+    assert "(native writer" in capsys.readouterr().out
+    os.environ["SOFA_NATIVE_PERFETTO"] = "0"
+    try:
+        python = export_perfetto(cfg, out_name="python.json.gz")
+    finally:
+        del os.environ["SOFA_NATIVE_PERFETTO"]
+    assert "(native writer" not in capsys.readouterr().out
+    ea = json.load(gzip.open(native, "rt"))["traceEvents"]
+    eb = json.load(gzip.open(python, "rt"))["traceEvents"]
+    # + per-device meta: process_name + 4 thread_name rows x 4 devices
+    assert len(ea) == len(eb) == n + 20
+    for x, y in zip(ea, eb):
+        assert (x.get("name"), x.get("pid"), x.get("tid"), x.get("args")) \
+            == (y.get("name"), y.get("pid"), y.get("tid"), y.get("args"))
+        for k in ("ts", "dur"):
+            assert math.isclose(x.get(k, 0.0), y.get(k, 0.0),
+                                abs_tol=0.0005001)  # %.3f µs = ns grain
+
+    # Malformed interchange input: nonzero exit, no output published.
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"\x00" * 64)
+    r = subprocess.run([tool, bad, str(tmp_path / "bad.json.gz")],
+                       capture_output=True)
+    assert r.returncode != 0
+
+
 def test_export_perfetto_multihost_host_processes(tmp_path):
     """Per-host host timelines stay separate Perfetto processes: host rows
     carry their host's ordinal base in deviceId (host 1 -> 256), and thread
