@@ -75,9 +75,9 @@ class _DeviceColumns:
         # ~1.8e302 s) re-overflow and both writers would emit the invalid
         # JSON token `inf`.  ±1e15 µs (~31 years) is beyond any real trace,
         # and %.3f of it stays well inside the native writer's buffer.
-        self.ts = np.nan_to_num(
+        self.ts = np.clip(np.nan_to_num(
             ops["timestamp"].to_numpy(dtype=float) * 1e6,
-            posinf=1e15, neginf=-1e15)
+            posinf=1e15, neginf=-1e15), -1e15, 1e15)
         self.dur = np.clip(np.nan_to_num(
             ops["duration"].to_numpy(dtype=float) * 1e6,
             posinf=1e15), 0.0, 1e15)
@@ -364,6 +364,7 @@ def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
     if tool is None:
         return False
     tmp = None
+    out_tmp = path + f".native.{os.getpid()}"
     try:
         with tempfile.NamedTemporaryFile(
                 prefix="sofa_perfetto_", suffix=".bin", delete=False) as f:
@@ -386,7 +387,6 @@ def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
             tail_b = tail.encode("utf-8")
             f.write(struct.pack("<Q", len(tail_b)))
             f.write(tail_b)
-        out_tmp = path + f".native.{os.getpid()}"
         r = subprocess.run([tool, tmp, out_tmp],
                            capture_output=True, timeout=600)
         if r.returncode != 0 or not os.path.isfile(out_tmp):
@@ -394,10 +394,6 @@ def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
                           f"(rc={r.returncode}): "
                           f"{r.stderr.decode(errors='replace')[:200]} — "
                           "using the Python writer")
-            try:
-                os.unlink(out_tmp)
-            except OSError:
-                pass
             return False
         os.replace(out_tmp, path)
         return True
@@ -406,8 +402,12 @@ def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
                       "using the Python writer")
         return False
     finally:
-        if tmp:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        # out_tmp survives only via the os.replace above; a timeout or
+        # tool crash must not leave a multi-hundred-MB partial in the
+        # logdir.
+        for leftover in (tmp, out_tmp):
+            if leftover:
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass

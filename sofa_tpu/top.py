@@ -111,6 +111,42 @@ def _tpu_lines(logdir: str, now: float) -> List[str]:
     return out or ["TPU    tpumon.txt has no samples yet"]
 
 
+_MEM_CACHE: dict = {}   # path -> ((mtime_ns, size), rendered lines)
+
+
+def _mem_lines(logdir: str) -> List[str]:
+    """Top HBM allocation sites from the live peak snapshot, when the
+    sampler has captured one (collectors/tpumon.py overwrites
+    memprof.pb.gz at each new high-water mark, so this updates mid-run).
+    The decode+aggregate is cached on (mtime, size): the dashboard redraws
+    every --interval but the snapshot only changes at a new peak."""
+    path = os.path.join(logdir, "memprof.pb.gz")
+    try:
+        st = os.stat(path)
+    except OSError:
+        return []
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _MEM_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    try:
+        from sofa_tpu.ingest.memprof import aggregate_sites, load_memprof
+
+        df, meta = load_memprof(logdir)
+        sites = aggregate_sites(df, top_k=3)
+    except Exception:  # noqa: BLE001 — mid-overwrite reads must not kill top
+        return []      # (not cached: the finished overwrite will parse)
+    held = sites[sites["bytes"] > 0]
+    out = []
+    if not held.empty:
+        out = [f"hbm@{meta.get('trigger', 'peak')}  top sites:"]
+        for row in held.itertuples(index=False):
+            out.append(f"       {row.bytes / 1e9:6.2f} GB {row.share:4.0%}  "
+                       f"{row.site[:48]}")
+    _MEM_CACHE[path] = (key, out)
+    return out
+
+
 def _cpu_line(logdir: str) -> Optional[str]:
     df = _tail_load(os.path.join(logdir, "mpstat.txt"), procfs.parse_mpstat)
     rows = _latest(df)
@@ -156,6 +192,7 @@ def render_frame(logdir: str, now: Optional[float] = None,
     stamp = time.strftime("%H:%M:%S", time.localtime(now))
     lines = [f"sofa top — {title or logdir}   {stamp}"]
     lines += _tpu_lines(logdir, now)
+    lines += _mem_lines(logdir)
     for maker in (_cpu_line, _net_line, _disk_line):
         line = maker(logdir)
         if line:

@@ -116,7 +116,8 @@ def test_export_perfetto(tmp_path):
     assert "perfetto export" in r.stdout + r.stderr
 
 
-def test_export_perfetto_native_writer_equivalence(tmp_path, capsys):
+def test_export_perfetto_native_writer_equivalence(tmp_path, capsys,
+                                                   monkeypatch):
     """The native writer (native/perfetto_write.cc) and the Python path
     emit the same events (ts/dur within the writer's ns resolution), and a
     corrupt interchange file fails the tool without killing the export."""
@@ -154,16 +155,13 @@ def test_export_perfetto_native_writer_equivalence(tmp_path, capsys):
     }), d + "tputrace.csv")
     cfg = SofaConfig(logdir=d)
 
-    os.environ.pop("SOFA_NATIVE_PERFETTO", None)
+    monkeypatch.delenv("SOFA_NATIVE_PERFETTO", raising=False)
     native = export_perfetto(cfg, out_name="native.json.gz")
     # A silent fallback would make the comparison below vacuous (Python vs
     # Python): require the native path to have actually run.
     assert "(native writer" in capsys.readouterr().out
-    os.environ["SOFA_NATIVE_PERFETTO"] = "0"
-    try:
-        python = export_perfetto(cfg, out_name="python.json.gz")
-    finally:
-        del os.environ["SOFA_NATIVE_PERFETTO"]
+    monkeypatch.setenv("SOFA_NATIVE_PERFETTO", "0")
+    python = export_perfetto(cfg, out_name="python.json.gz")
     assert "(native writer" not in capsys.readouterr().out
     ea = json.load(gzip.open(native, "rt"))["traceEvents"]
     eb = json.load(gzip.open(python, "rt"))["traceEvents"]

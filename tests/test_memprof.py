@@ -142,6 +142,36 @@ def test_memprof_profile_pass(cfg):
     memprof_profile({}, empty, Features())
 
 
+def test_parse_memprof_fuzz_random_bytes(tmp_path):
+    """Arbitrary bytes either parse to a frame or raise promptly — the
+    parser must never hang or return malformed columns (same contract as
+    the pcap and native-scan fuzz tests)."""
+    import random
+
+    rng = random.Random(0)
+    path = str(tmp_path / "fuzz.bin")
+    base = build_profile().SerializeToString()
+    for trial in range(60):
+        if trial % 3 == 0:
+            blob = bytes(rng.randbytes(rng.randrange(0, 400)))
+        elif trial % 3 == 1:  # truncated real proto, sometimes gzipped
+            cut = base[:rng.randrange(0, len(base))]
+            blob = gzip.compress(cut) if trial % 2 else cut
+        else:  # real proto with flipped bytes
+            b = bytearray(base)
+            for _ in range(rng.randrange(1, 6)):
+                b[rng.randrange(len(b))] = rng.randrange(256)
+            blob = bytes(b)
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            df = parse_memprof(path)
+        except Exception:
+            continue  # rejecting malformed input is correct
+        assert list(df.columns) == ["device", "kind", "count", "bytes",
+                                    "site", "stack"]
+
+
 class _StubJax:
     """Stands in for the jax module inside snapshot_memprof."""
 

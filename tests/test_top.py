@@ -48,6 +48,32 @@ def test_top_render_frame(tmp_path):
     assert "net" in frame and "eth0" in frame
     # diskstat deltas: (6144-2048)*512 B read over ~1s -> ~2.0 MiB/s
     assert "disk" in frame and "read 2.0 MiB/s" in frame
+    assert "hbm@" not in frame  # no snapshot seeded -> no pane
+
+
+def test_top_memprof_pane(tmp_path):
+    """A live peak snapshot adds the top-allocation-sites pane."""
+    import gzip
+
+    from sofa_tpu.top import render_frame
+    from tests.test_memprof import build_profile
+
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    _seed_logdir(d)
+    with open(os.path.join(d, "memprof.pb.gz"), "wb") as f:
+        f.write(gzip.compress(build_profile().SerializeToString()))
+    import json
+    with open(os.path.join(d, "memprof.pb.gz.meta.json"), "w") as f:
+        json.dump({"trigger": "peak", "total_bytes": 9 << 20}, f)
+    frame = render_frame(d)
+    assert "hbm@peak  top sites:" in frame
+    assert "train_step" in frame and "load_batch" in frame
+    # A half-written snapshot (sampler mid-overwrite) drops the pane only.
+    with open(os.path.join(d, "memprof.pb.gz"), "wb") as f:
+        f.write(b"\x1f\x8b\x08\x00partial")
+    frame = render_frame(d)
+    assert "hbm@" not in frame and "tpu0" in frame
 
 
 def test_top_stale_heartbeat_flags(tmp_path):

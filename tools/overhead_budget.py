@@ -71,11 +71,13 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         col.start()
         return col.stop
 
-    def with_tpumon(rate: int):
+    def with_tpumon(rate: int, memprof: bool = False):
         from sofa_tpu.collectors.tpumon import start_sampler
 
         ev = threading.Event()
-        start_sampler(rate, scratch + "tpumon.txt", ev)
+        start_sampler(rate, scratch + "tpumon.txt", ev,
+                      memprof_path=(scratch + "memprof.pb.gz"
+                                    if memprof else None))
         return ev.set
 
     def with_xprof(python_tracer: bool = False):
@@ -103,6 +105,8 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         ("procmon @ 100 Hz", lambda: with_procmon(100)),
         ("tpumon @ 1 Hz (default)", lambda: with_tpumon(1)),
         ("tpumon @ 20 Hz", lambda: with_tpumon(20)),
+        ("tpumon @ 1 Hz + memprof snapshots",
+         lambda: with_tpumon(1, memprof=True)),
         ("xprof trace (host_tracer=2)", lambda: with_xprof()),
         ("xprof + python tracer", lambda: with_xprof(python_tracer=True)),
         ("full sofa.profile() stack", with_full_profile),
