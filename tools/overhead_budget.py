@@ -75,10 +75,18 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         from sofa_tpu.collectors.tpumon import start_sampler
 
         ev = threading.Event()
-        start_sampler(rate, scratch + "tpumon.txt", ev,
-                      memprof_path=(scratch + "memprof.pb.gz"
-                                    if memprof else None))
-        return ev.set
+        t = start_sampler(rate, scratch + "tpumon.txt", ev,
+                          memprof_path=(scratch + "memprof.pb.gz"
+                                        if memprof else None))
+
+        def teardown():
+            # Join so a final tick (up to 1/rate late, and a memprof
+            # snapshot is stop-the-world) can't bleed into the NEXT
+            # config's timed run.
+            ev.set()
+            t.join(timeout=3.0)
+
+        return teardown
 
     def with_xprof(python_tracer: bool = False):
         kwargs = {}
