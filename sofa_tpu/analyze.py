@@ -277,10 +277,9 @@ def _append_report_series(cfg: SofaConfig, series) -> None:
                 "data": s.to_points(cfg.viz_downsample_to),
             }
         )
-    with open(path, "w") as f:
-        f.write("sofa_traces = ")
-        json.dump(doc, f)
-        f.write(";\n")
+    from sofa_tpu.trace import write_report_js_doc
+
+    write_report_js_doc(doc, path)
 
 
 def stage_board(cfg: SofaConfig) -> None:

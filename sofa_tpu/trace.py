@@ -210,8 +210,20 @@ def classify_hlo_kind(name: str, category: str = "") -> CopyKind:
     return CopyKind.KERNEL
 
 
+_EMPTY_TEMPLATE: "pd.DataFrame | None" = None
+
+
 def empty_frame() -> pd.DataFrame:
-    return pd.DataFrame({c: pd.Series(dtype=type(_DEFAULTS[c]) if not isinstance(_DEFAULTS[c], str) else "object") for c in COLUMNS})
+    # Constructing 22 typed Series costs ~10ms; a pod-scale run calls this
+    # dozens of times (one per absent source), so hand out copies of one
+    # template instead.
+    global _EMPTY_TEMPLATE
+    if _EMPTY_TEMPLATE is None:
+        _EMPTY_TEMPLATE = pd.DataFrame(
+            {c: pd.Series(dtype=type(_DEFAULTS[c])
+                          if not isinstance(_DEFAULTS[c], str) else "object")
+             for c in COLUMNS})
+    return _EMPTY_TEMPLATE.copy()
 
 
 def make_frame(rows_or_cols) -> pd.DataFrame:
@@ -401,10 +413,18 @@ def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 1
         }
         for s in series
     ]
-    doc = {"series": payload, "meta": extra or {}}
+    write_report_js_doc({"series": payload, "meta": extra or {}}, path)
+
+
+def write_report_js_doc(doc: dict, path: str) -> None:
+    """THE report.js writer — analyze's series-merge path reparses this
+    exact shape (`sofa_traces = <json>;`), so every producer must go
+    through here.  dumps, not dump: the one-shot path runs json's C
+    encoder, while dump iterencodes 500k+ point dicts through Python
+    (~5x slower on a pod-scale report.js)."""
     with open(path, "w") as f:
         f.write("sofa_traces = ")
-        json.dump(doc, f)
+        f.write(json.dumps(doc))
         f.write(";\n")
 
 

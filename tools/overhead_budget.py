@@ -85,6 +85,11 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
             # config's timed run.
             ev.set()
             t.join(timeout=3.0)
+            if t.is_alive():
+                # Surface it: the invariant is broken, the next row is
+                # suspect (run_budget swallows teardown exceptions).
+                print("WARNING: tpumon sampler did not stop within 3s — "
+                      "the next config's timing may be contaminated")
 
         return teardown
 
