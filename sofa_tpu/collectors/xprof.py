@@ -52,6 +52,12 @@ def _chain_next_sitecustomize():
     # have their own (e.g. to register accelerator plugins); shadowing it
     # would change the profiled program's behavior, so find the next one and
     # execute it too.
+    #
+    # Bounded: accelerator-plugin hooks can block the MAIN thread forever
+    # when their device tunnel is down (observed: an axon claim loop
+    # spinning on a dead relay hung `sofa record` of a pure-host command).
+    # A SIGALRM guard turns that into a timeout the hook's own error
+    # handling (or ours) absorbs, so the profiled program still starts.
     import importlib.util
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -64,6 +70,36 @@ def _chain_next_sitecustomize():
             continue
         cand = os.path.join(ap, "sitecustomize.py")
         if os.path.isfile(cand):
+            timeout = 120.0
+            try:
+                timeout = float(
+                    os.environ.get("SOFA_TPU_CHAIN_TIMEOUT_S", "120") or 0)
+            except ValueError:
+                pass
+            old_handler = None
+            armed = False
+            signal = None
+            if timeout > 0:
+                try:
+                    import math
+                    import signal
+
+                    def _alarm(signum, frame):  # noqa: ARG001
+                        raise TimeoutError(
+                            "chained sitecustomize exceeded %gs (device "
+                            "tunnel down?) — continuing without it; set "
+                            "SOFA_TPU_CHAIN_TIMEOUT_S to adjust or 0 to "
+                            "disable this guard" % timeout)
+
+                    # old_handler may be None for a handler installed from
+                    # C — `armed` is the cleanup sentinel, never the
+                    # handler value.  ceil: alarm() truncates, and int(0.5)
+                    # == 0 would CANCEL the alarm instead of arming it.
+                    old_handler = signal.signal(signal.SIGALRM, _alarm)
+                    signal.alarm(max(1, math.ceil(timeout)))
+                    armed = True
+                except (AttributeError, ValueError, OSError):
+                    pass  # no SIGALRM on this platform / non-main thread
             try:
                 spec = importlib.util.spec_from_file_location("sitecustomize", cand)
                 mod = importlib.util.module_from_spec(spec)
@@ -72,6 +108,10 @@ def _chain_next_sitecustomize():
                 sys.stderr.write(
                     "sofa_tpu: chained sitecustomize %s failed: %r\\n" % (cand, e)
                 )
+            finally:
+                if armed:
+                    signal.alarm(0)
+                    signal.signal(signal.SIGALRM, old_handler or signal.SIG_DFL)
             return
 
 

@@ -533,3 +533,34 @@ def test_sofa_clean_keeps_raw(logdir):
     assert not os.path.exists(cfg.path("report.js"))
     assert os.path.isfile(cfg.path("misc.txt"))
     assert os.path.isfile(cfg.path("mpstat.txt"))
+
+
+def test_chained_sitecustomize_hang_is_bounded(tmp_path):
+    """A next-on-path site hook stuck on a dead device tunnel must not hang
+    the profiled program: the injection's SIGALRM guard times the chain out
+    and the command still runs (observed live: an axon claim loop spinning
+    forever on a dead relay stalled `sofa record` of a pure-host command)."""
+    import subprocess
+    import sys as _sys
+    import time
+
+    from sofa_tpu.collectors.xprof import _SITECUSTOMIZE
+
+    inject = tmp_path / "inject"
+    inject.mkdir()
+    (inject / "sitecustomize.py").write_text(_SITECUSTOMIZE)
+    hook = tmp_path / "hook"
+    hook.mkdir()
+    (hook / "sitecustomize.py").write_text("import time\ntime.sleep(300)\n")
+    env = dict(
+        os.environ,
+        PYTHONPATH=f"{inject}{os.pathsep}{hook}",
+        SOFA_TPU_CHAIN_TIMEOUT_S="2",
+        SOFA_TPU_XPROF_OPTS="{}",
+    )
+    t0 = time.time()
+    r = subprocess.run([_sys.executable, "-c", "print('program ran')"],
+                       capture_output=True, text=True, env=env, timeout=60)
+    assert time.time() - t0 < 30, "chain guard did not fire"
+    assert "program ran" in r.stdout
+    assert "chained sitecustomize" in r.stderr and "exceeded" in r.stderr

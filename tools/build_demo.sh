@@ -5,5 +5,10 @@
 set -e
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 OUT="${1:-demo}"
+# The demo workload is host-only (disk churn, no JAX) — pin the CPU backend
+# so an ambient accelerator platform (JAX_PLATFORMS=axon/tpu with its
+# tunnel down) can't stall the chained site hooks.  Override with
+# SOFA_DEMO_PLATFORM if you want the demo to ride the real backend.
+export JAX_PLATFORMS="${SOFA_DEMO_PLATFORM:-cpu}"
 "$ROOT/bin/sofa" stat "python $ROOT/examples/io_churn.py" --logdir "$OUT/sofalog/"
 echo "demo ready: open with  $ROOT/bin/sofa viz --logdir $OUT/sofalog/"
