@@ -76,6 +76,7 @@ def _chain_next_sitecustomize():
                     os.environ.get("SOFA_TPU_CHAIN_TIMEOUT_S", "120") or 0)
             except ValueError:
                 pass
+            timeout = min(timeout, 86400.0)  # inf/huge would overflow alarm()
             old_handler = None
             armed = False
             signal = None
@@ -98,20 +99,36 @@ def _chain_next_sitecustomize():
                     old_handler = signal.signal(signal.SIGALRM, _alarm)
                     signal.alarm(max(1, math.ceil(timeout)))
                     armed = True
-                except (AttributeError, ValueError, OSError):
+                except (AttributeError, ValueError, OSError, OverflowError):
                     pass  # no SIGALRM on this platform / non-main thread
             try:
-                spec = importlib.util.spec_from_file_location("sitecustomize", cand)
-                mod = importlib.util.module_from_spec(spec)
-                spec.loader.exec_module(mod)
-            except Exception as e:  # noqa: BLE001
+                try:
+                    spec = importlib.util.spec_from_file_location(
+                        "sitecustomize", cand)
+                    mod = importlib.util.module_from_spec(spec)
+                    spec.loader.exec_module(mod)
+                except Exception as e:  # noqa: BLE001
+                    sys.stderr.write(
+                        "sofa_tpu: chained sitecustomize %s failed: %r\\n"
+                        % (cand, e))
+                finally:
+                    if armed:
+                        signal.alarm(0)
+                        signal.signal(signal.SIGALRM,
+                                      old_handler or signal.SIG_DFL)
+            except TimeoutError as e:
+                # The alarm raced completion (fired between the hook
+                # returning and the cancel above): absorb it so the rest
+                # of the injection still arms, and finish the cleanup.
                 sys.stderr.write(
-                    "sofa_tpu: chained sitecustomize %s failed: %r\\n" % (cand, e)
-                )
-            finally:
+                    "sofa_tpu: chain timeout raced completion: %r\\n" % (e,))
                 if armed:
-                    signal.alarm(0)
-                    signal.signal(signal.SIGALRM, old_handler or signal.SIG_DFL)
+                    try:
+                        signal.alarm(0)
+                        signal.signal(signal.SIGALRM,
+                                      old_handler or signal.SIG_DFL)
+                    except Exception:  # noqa: BLE001
+                        pass
             return
 
 

@@ -285,15 +285,33 @@ def _conform(df: pd.DataFrame) -> pd.DataFrame:
     return df[COLUMNS]
 
 
+# Schema columns whose content is text: read them as str so value
+# inference can never mangle numeric-looking names ("5" would otherwise
+# come back as "5.0" whenever an empty cell makes the column float).
+_STR_COLS = {c: str for c, d in _DEFAULTS.items() if isinstance(d, str)}
+
+
 def read_csv(path: str) -> pd.DataFrame:
     # The multithreaded arrow parser reads a pod-scale tputrace ~2x faster
     # than pandas' C engine AND parses floats correctly rounded (the C
     # engine's default fast strtod is off by up to ~1e-10 relative).
-    # Fall back for anything arrow refuses (malformed lines, exotic quoting).
+    # pyarrow.csv directly (not pandas' engine="pyarrow" wrapper): its
+    # column_types apply AT PARSE TIME, so a numeric-looking name ("007")
+    # can never be inferred to int and mangled by a post-hoc str cast —
+    # the wrapper's dtype= does exactly that.  Anything arrow refuses
+    # (quoted newlines without newlines_in_values, malformed lines) falls
+    # back to the C engine, whose dtype= IS parse-time.
     try:
-        df = pd.read_csv(path, engine="pyarrow")
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        table = pacsv.read_csv(
+            path,
+            convert_options=pacsv.ConvertOptions(
+                column_types={c: pa.string() for c in _STR_COLS}))
+        df = table.to_pandas()
     except Exception:  # noqa: BLE001
-        df = pd.read_csv(path)
+        df = pd.read_csv(path, dtype=_STR_COLS)
     return _conform(df)
 
 

@@ -150,3 +150,58 @@ def test_packed_ip_round_trip():
 
 def test_empty_frame_columns():
     assert list(empty_frame().columns) == trace.COLUMNS
+
+
+def test_csv_round_trip_property(tmp_path):
+    """Hypothesis: any schema frame survives write_csv -> read_csv (the
+    arrow writer + the arrow-first reader added for pod-scale speed must
+    agree with the schema for arbitrary content, incl. quotes/commas/
+    newlines in names, extreme floats, and NaN-free defaults)."""
+    import pytest
+
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    names = st.text(
+        st.characters(codec="utf-8",
+                      exclude_characters="\x00\r",
+                      exclude_categories=("Cs",)),
+        min_size=0, max_size=24)
+    finite = st.floats(allow_nan=False, allow_infinity=False, width=64)
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(
+        st.fixed_dictionaries({
+            "timestamp": finite,
+            "duration": finite,
+            "name": names,
+            "module": names,
+            "deviceId": st.integers(-1, 2**31 - 1),
+            "payload": st.integers(0, 2**53),
+            "event": finite,
+        }),
+        min_size=1, max_size=12))
+    def run(rows):
+        df = make_frame(rows)
+        p = tmp_path / "prop.csv"
+        write_csv(df, str(p))
+        df2 = read_csv(str(p))
+        assert list(df2.columns) == COLUMNS
+        pd.testing.assert_frame_equal(
+            df.reset_index(drop=True), df2.reset_index(drop=True),
+            check_dtype=False)
+
+    run()
+
+
+def test_csv_round_trip_numeric_looking_names(tmp_path):
+    """Digit-only names beside empty ones must survive reload verbatim —
+    value inference once made the column float and '5' came back '5.0'."""
+    df = make_frame([{"timestamp": 0.1, "name": "5"},
+                     {"timestamp": 0.2, "name": ""},
+                     {"timestamp": 0.3, "name": "007"}])
+    p = tmp_path / "n.csv"
+    write_csv(df, str(p))
+    df2 = read_csv(str(p))
+    assert list(df2["name"]) == ["5", "", "007"]
