@@ -348,6 +348,53 @@ def _init_backend(budget_s: Optional[float] = None,
             _log_chip_holders()
 
 
+def _cpu_fallback_evidence() -> dict:
+    """Tunnel dead for the whole budget: measure the SAME paired-run
+    overhead on the CPU backend in a fresh subprocess and ride it on the
+    error line's extras.  The headline metric stays null — a CPU number is
+    not the TPU number — but the round still records that the harness
+    measures end to end (collector injection, trace capture, coverage
+    guard) rather than only that the relay was down.  Opt out with
+    SOFA_BENCH_CPU_FALLBACK=0.
+    """
+    import subprocess
+
+    if os.environ.get("SOFA_BENCH_CPU_FALLBACK", "1") != "1":
+        return {}
+    _state["phase"] = "cpu-backend evidence smoke"
+    _log("bench: tunnel never came up — measuring CPU-backend overhead "
+         "evidence (headline value stays null)")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SOFA_BENCH_RETRY_BUDGET_S="60",
+        SOFA_BENCH_CPU_FALLBACK="0",   # no recursion
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--batch", "8", "--image_size", "64", "--steps", "5",
+             "--repeats", "2"],
+            capture_output=True, text=True, timeout=240, env=env)
+        for line in reversed(r.stdout.splitlines()):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(doc, dict):
+                continue  # a bare JSON scalar on stdout is not the result
+            if doc.get("value") is None:
+                return {"cpu_smoke_error": str(doc.get("error"))[:160]}
+            return {
+                "cpu_smoke_overhead_pct": doc["value"],
+                "cpu_smoke_hlo_rows": doc.get("hlo_rows"),
+                "cpu_smoke_backend": doc.get("backend"),
+            }
+        return {"cpu_smoke_error": f"no JSON line (rc={r.returncode})"}
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        return {"cpu_smoke_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 def _time_steps(step, state_maker, n_steps: int, annotate: bool):
     import jax
 
@@ -402,8 +449,17 @@ def main() -> int:
         _init_backend()
     except Exception as e:
         msg = str(e).splitlines()[0] if str(e) else repr(e)
-        _emit(None, error=f"backend init failed after retries: "
-                          f"{type(e).__name__}: {msg}")
+        err = f"backend init failed after retries: {type(e).__name__}: {msg}"
+        # Error line FIRST — the smoke below can take minutes and a driver
+        # kill in that window must still find a parseable line (round 3
+        # regressed to parsed:null exactly by deferring the final emit).
+        _emit(None, error=err)
+        extra = _cpu_fallback_evidence()
+        if extra:
+            # The driver reads the LAST parseable line: re-emit the same
+            # error enriched with the CPU-backend evidence.
+            _state["done"] = False
+            _emit(None, error=err, extra=extra)
         return 1
 
     model, variables, x = create(args.batch, args.image_size)
@@ -437,6 +493,7 @@ def main() -> int:
         frames = ingest_xprof_dir(f"{logdir}r{args.repeats - 1}/xprof/",
                                   time.time())
         hlo_rows = len(frames.get("tputrace", []))
+        host_rows = len(frames.get("hosttrace", []))
     except Exception as e:
         _emit(None, error=f"benchmark run failed: {type(e).__name__}: "
                           f"{str(e).splitlines()[0] if str(e) else e!r}")
@@ -457,8 +514,12 @@ def main() -> int:
     t_bare = bare[len(bare) // 2]
     t_prof = prof[len(prof) // 2]
     overhead = max(0.0, (t_prof - t_bare) / t_bare * 100.0)
-    if hlo_rows == 0:
-        _log("bench: FAILED coverage guard — no HLO ops in captured trace")
+    # Coverage guard: an overhead number with an empty capture is a lie.
+    # On TPU the evidence is HLO device ops; a CPU(-smoke) backend has no
+    # device planes by construction, so its capture proof is the host
+    # runtime trace.
+    if hlo_rows == 0 and (jax.default_backend() == "tpu" or host_rows == 0):
+        _log("bench: FAILED coverage guard — empty captured trace")
         overhead = 100.0
     _log(f"bench: images/s bare {args.steps * args.batch / t_bare:.1f}, "
          f"profiled {args.steps * args.batch / t_prof:.1f}; "
@@ -467,6 +528,7 @@ def main() -> int:
         "images_per_sec_bare": round(args.steps * args.batch / t_bare, 1),
         "images_per_sec_profiled": round(args.steps * args.batch / t_prof, 1),
         "hlo_rows": int(hlo_rows),
+        "host_rows": int(host_rows),
         "backend": jax.default_backend(),
     })
     return 0

@@ -311,7 +311,11 @@ def read_csv(path: str) -> pd.DataFrame:
                 column_types={c: pa.string() for c in _STR_COLS}))
         df = table.to_pandas()
     except Exception:  # noqa: BLE001
-        df = pd.read_csv(path, dtype=_STR_COLS)
+        # keep_default_na off + empty-string-only NA: the C engine would
+        # otherwise read a name of "NA"/"null"/"nan" as NaN and _conform
+        # would rewrite it to "" — the arrow path above preserves them.
+        df = pd.read_csv(path, dtype=_STR_COLS,
+                         keep_default_na=False, na_values=[""])
     return _conform(df)
 
 

@@ -275,3 +275,36 @@ def test_validate_checklist_skips_cpu_smoke(tmp_path, monkeypatch):
     monkeypatch.setenv("SOFA_BENCH_VALIDATE", "0")
     monkeypatch.setattr(bench, "_probed_backend", "tpu")
     assert bench._run_validate_checklist(root=str(tmp_path)) is False
+
+
+def test_cpu_fallback_evidence_parses_child_json(monkeypatch):
+    """The dead-tunnel error line carries the CPU-smoke overhead extras —
+    the subprocess's LAST stdout line wins and failure shapes degrade to a
+    cpu_smoke_error key, never an exception."""
+    import subprocess
+    import types
+
+    import bench
+
+    def fake_run(cmd, **kw):
+        assert kw["env"]["JAX_PLATFORMS"] == "cpu"
+        assert kw["env"]["SOFA_BENCH_CPU_FALLBACK"] == "0"  # no recursion
+        return types.SimpleNamespace(
+            returncode=0,
+            stdout='noise\n{"value": 1.5, "hlo_rows": 0, "host_rows": 42, '
+                   '"backend": "cpu"}\n',
+            stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    out = bench._cpu_fallback_evidence()
+    assert out["cpu_smoke_overhead_pct"] == 1.5
+    assert out["cpu_smoke_backend"] == "cpu"
+
+    def fake_err(cmd, **kw):
+        return types.SimpleNamespace(returncode=3, stdout="no json", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_err)
+    assert "cpu_smoke_error" in bench._cpu_fallback_evidence()
+
+    monkeypatch.setenv("SOFA_BENCH_CPU_FALLBACK", "0")
+    assert bench._cpu_fallback_evidence() == {}
