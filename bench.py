@@ -97,6 +97,12 @@ def _install_signal_handlers() -> None:
     import signal
 
     def die(signum, frame):  # noqa: ARG001 — signal handler signature
+        child = _state.get("smoke_child")
+        if child is not None:   # don't orphan a running evidence smoke
+            try:
+                child.kill()
+            except Exception:  # noqa: BLE001
+                pass
         if not _state["done"]:
             name = signal.Signals(signum).name
             _emit(None, error=f"killed by {name} while {_state['phase']} "
@@ -371,11 +377,20 @@ def _cpu_fallback_evidence() -> dict:
         SOFA_BENCH_CPU_FALLBACK="0",   # no recursion
     )
     try:
-        r = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),
              "--batch", "8", "--image_size", "64", "--steps", "5",
              "--repeats", "2"],
-            capture_output=True, text=True, timeout=240, env=env)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        _state["smoke_child"] = proc   # the signal handler kills it with us
+        try:
+            stdout, _stderr = proc.communicate(timeout=240)
+        finally:
+            _state["smoke_child"] = None
+            if proc.poll() is None:
+                proc.kill()
+        r = type("R", (), {"stdout": stdout, "returncode": proc.returncode})
         for line in reversed(r.stdout.splitlines()):
             try:
                 doc = json.loads(line)
@@ -387,7 +402,9 @@ def _cpu_fallback_evidence() -> dict:
                 return {"cpu_smoke_error": str(doc.get("error"))[:160]}
             return {
                 "cpu_smoke_overhead_pct": doc["value"],
-                "cpu_smoke_hlo_rows": doc.get("hlo_rows"),
+                # host runtime rows ARE the capture proof on CPU (no
+                # device planes exist by construction)
+                "cpu_smoke_host_rows": doc.get("host_rows"),
                 "cpu_smoke_backend": doc.get("backend"),
             }
         return {"cpu_smoke_error": f"no JSON line (rc={r.returncode})"}
@@ -458,7 +475,6 @@ def main() -> int:
         if extra:
             # The driver reads the LAST parseable line: re-emit the same
             # error enriched with the CPU-backend evidence.
-            _state["done"] = False
             _emit(None, error=err, extra=extra)
         return 1
 

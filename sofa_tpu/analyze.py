@@ -287,6 +287,7 @@ def stage_board(cfg: SofaConfig) -> None:
     src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "board")
     if not os.path.isdir(src):
         return
+    os.makedirs(cfg.logdir, exist_ok=True)  # diff may stage before any CSV
     for name in os.listdir(src):
         shutil.copy2(os.path.join(src, name), cfg.path(name))
 

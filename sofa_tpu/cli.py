@@ -321,6 +321,8 @@ def main(argv=None) -> int:
             sofa_swarm_diff(cfg)
             sofa_tpu_diff(cfg)
             sofa_mem_diff(cfg)
+            from sofa_tpu.analyze import stage_board
+            stage_board(cfg)  # `sofa viz --logdir <diff dir>` -> Diff page
             return 0
         if cmd == "viz":
             from sofa_tpu.viz import sofa_viz

@@ -311,11 +311,15 @@ def read_csv(path: str) -> pd.DataFrame:
                 column_types={c: pa.string() for c in _STR_COLS}))
         df = table.to_pandas()
     except Exception:  # noqa: BLE001
-        # keep_default_na off + empty-string-only NA: the C engine would
-        # otherwise read a name of "NA"/"null"/"nan" as NaN and _conform
-        # would rewrite it to "" — the arrow path above preserves them.
+        # Per-column NA tokens: string columns treat only "" as missing
+        # (the C engine would otherwise read a name of "NA"/"nan" as NaN
+        # and _conform would rewrite it to "" — the arrow path preserves
+        # them), while numeric columns keep the usual NA vocabulary so a
+        # foreign CSV with "NA" in a float column still loads as NaN.
+        num_na = ["", "NA", "N/A", "NaN", "nan", "NULL", "null", "None"]
+        na = {c: ([""] if c in _STR_COLS else num_na) for c in COLUMNS}
         df = pd.read_csv(path, dtype=_STR_COLS,
-                         keep_default_na=False, na_values=[""])
+                         keep_default_na=False, na_values=na)
     return _conform(df)
 
 

@@ -282,28 +282,41 @@ def test_cpu_fallback_evidence_parses_child_json(monkeypatch):
     the subprocess's LAST stdout line wins and failure shapes degrade to a
     cpu_smoke_error key, never an exception."""
     import subprocess
-    import types
 
     import bench
 
-    def fake_run(cmd, **kw):
-        assert kw["env"]["JAX_PLATFORMS"] == "cpu"
-        assert kw["env"]["SOFA_BENCH_CPU_FALLBACK"] == "0"  # no recursion
-        return types.SimpleNamespace(
-            returncode=0,
-            stdout='noise\n{"value": 1.5, "hlo_rows": 0, "host_rows": 42, '
-                   '"backend": "cpu"}\n',
-            stderr="")
+    def fake_popen(stdout_text, rc=0):
+        class _P:
+            returncode = rc
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+            def __init__(self, cmd, **kw):
+                assert kw["env"]["JAX_PLATFORMS"] == "cpu"
+                assert kw["env"]["SOFA_BENCH_CPU_FALLBACK"] == "0"  # no recursion
+
+            def communicate(self, timeout=None):
+                return stdout_text, ""
+
+            def poll(self):
+                return rc
+
+            def kill(self):
+                pass
+
+        return _P
+
+    monkeypatch.setattr(
+        subprocess, "Popen",
+        fake_popen('noise\n123\n{"value": 1.5, "hlo_rows": 0, '
+                   '"host_rows": 42, "backend": "cpu"}\ntrue\n'))
     out = bench._cpu_fallback_evidence()
+    # the bare JSON scalars around the result line are skipped, and the
+    # host-row capture proof survives into the extras
     assert out["cpu_smoke_overhead_pct"] == 1.5
+    assert out["cpu_smoke_host_rows"] == 42
     assert out["cpu_smoke_backend"] == "cpu"
+    assert bench._state["smoke_child"] is None  # unregistered after use
 
-    def fake_err(cmd, **kw):
-        return types.SimpleNamespace(returncode=3, stdout="no json", stderr="")
-
-    monkeypatch.setattr(subprocess, "run", fake_err)
+    monkeypatch.setattr(subprocess, "Popen", fake_popen("no json", rc=3))
     assert "cpu_smoke_error" in bench._cpu_fallback_evidence()
 
     monkeypatch.setenv("SOFA_BENCH_CPU_FALLBACK", "0")

@@ -377,3 +377,30 @@ def test_api_profile_captures_memprof(logdir):
     assert df is not None and not df.empty
     assert meta.get("trigger") == "final"
     assert (df["kind"] == "buffer").any()
+
+
+def test_diff_cli_stages_board(tmp_path):
+    """`sofa diff` leaves a browsable logdir: the board (incl. the Diff
+    page reading tpu_diff/mem_diff/swarm_diff) is staged beside the CSVs."""
+    import subprocess
+    import sys as _sys
+
+    mb = 2**20
+    for name, sites in (("base", {"train_step": 100 * mb}),
+                        ("match", {"train_step": 150 * mb})):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / "memprof.pb.gz", "wb") as f:
+            f.write(gzip.compress(make_profile(sites).SerializeToString()))
+    out = str(tmp_path / "out") + "/"
+    r = subprocess.run(
+        [_sys.executable, "-m", "sofa_tpu", "diff",
+         "--base_logdir", str(tmp_path / "base"),
+         "--match_logdir", str(tmp_path / "match"),
+         "--logdir", out],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-400:]
+    assert os.path.isfile(out + "mem_diff.csv")
+    assert os.path.isfile(out + "diff-report.html")
+    assert os.path.isfile(out + "sofa_board.js")
