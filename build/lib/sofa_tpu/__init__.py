@@ -1,0 +1,54 @@
+"""sofa_tpu — a TPU-native, cross-layer performance profiler.
+
+A ground-up rebuild of the capabilities of cyliustack/sofa (see SURVEY.md) for
+the JAX/XLA/TPU stack: wrap any command, collect host CPU / network / disk
+activity plus TPU XPlane traces (HLO ops, collectives, infeed/outfeed), align
+every clock domain to one time base, normalize everything into one unified
+trace schema, analyze it into a performance feature vector with optimization
+hints, and serve an interactive browser timeline.
+
+Pipeline verbs (mirroring the reference CLI, /root/reference/bin/sofa:328-376):
+
+    sofa record "cmd"   -> sofalog/ raw collector outputs
+    sofa preprocess     -> sofalog/*.csv in the unified schema + report.js
+    sofa analyze        -> performance features, hints, reports
+    sofa viz            -> http server on sofalog/ (board GUI)
+    sofa stat  = record + preprocess + analyze
+    sofa report= [preprocess] + analyze [+ viz]
+    sofa diff  = preprocess x2 + swarm diff
+    sofa clean = remove derived files
+
+Public programmatic API:
+
+    from sofa_tpu import SofaConfig, record, preprocess, analyze, viz
+    from sofa_tpu.api import profile        # in-process context manager
+"""
+
+__version__ = "0.1.0"
+
+from sofa_tpu.config import SofaConfig, Filter  # noqa: F401
+
+
+def record(command, cfg):
+    """Run ``command`` under the collector swarm. Lazy import."""
+    from sofa_tpu.record import sofa_record
+
+    return sofa_record(command, cfg)
+
+
+def preprocess(cfg):
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    return sofa_preprocess(cfg)
+
+
+def analyze(cfg):
+    from sofa_tpu.analyze import sofa_analyze
+
+    return sofa_analyze(cfg)
+
+
+def viz(cfg):
+    from sofa_tpu.viz import sofa_viz
+
+    return sofa_viz(cfg)

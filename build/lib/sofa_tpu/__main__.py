@@ -1,0 +1,7 @@
+"""`python -m sofa_tpu` entry point."""
+import sys
+
+from sofa_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Analysis passes: unified-schema frames -> performance feature vector.
+
+Each pass is a pure function ``(frames, cfg, features) -> None`` appending
+(name, value) rows to the Features accumulator and optionally writing derived
+artifacts (comm.csv, netrank.csv, performance.csv, hint files).  The
+reference's equivalents live in sofa_analyze.py/sofa_common.py (SURVEY §2.5).
+"""

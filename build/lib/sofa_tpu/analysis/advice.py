@@ -1,0 +1,218 @@
+"""Optimization advice: device-mesh/ring recommendations + rule-based hints.
+
+Two reference features re-imagined for TPU:
+
+* xring (sofa_analyze.py:825-869 + tools/xring.py): NVLink-topology ring
+  search producing a CUDA_VISIBLE_DEVICES order.  TPU equivalent: order chips
+  along the ICI torus by their (x,y,z) coords and propose `jax.sharding.Mesh`
+  axis shapes that keep collectives on ICI; written to
+  sofa_hints/mesh_advice.txt.
+
+* POTATO hint service (sofa_analyze.py:49-73,1007-1048): remote gRPC advice
+  on the feature vector.  Local rules below give instant advice; the optional
+  gRPC client/server lives in analysis/hint_service.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.comm import load_topology
+from sofa_tpu.printing import print_hint
+
+
+def _factorizations(n: int) -> List[Tuple[int, ...]]:
+    """All 2D factor pairs of n, most-square first (good default meshes)."""
+    out = []
+    for a in range(1, int(n ** 0.5) + 1):
+        if n % a == 0:
+            out.append((a, n // a))
+    return sorted(out, key=lambda p: abs(p[0] - p[1]))
+
+
+def mesh_advice(frames, cfg, features: Features) -> None:
+    topo = load_topology(cfg)
+    if topo is None:
+        return
+    devices = topo.get("devices", [])
+    n = len(devices)
+    if n == 0:
+        return
+    lines = []
+    have_coords = all(d.get("coords") for d in devices)
+    ring = sorted(
+        devices,
+        key=lambda d: (_snake_key(d.get("coords") or [d["id"]]), d.get("core_on_chip", 0)),
+    )
+    ring_ids = [d["id"] for d in ring]
+    lines.append("# sofa_tpu mesh advice")
+    lines.append(f"device_count = {n}")
+    if have_coords:
+        lines.append(f"ici_ring_order = {ring_ids}  # snake order over torus coords")
+    else:
+        lines.append(f"ring_order = {ring_ids}  # by device id (no coords available)")
+    if n > 1:
+        shapes = _factorizations(n)[:3]
+        lines.append("suggested 2D meshes (data, model):")
+        for dp, tp in shapes:
+            lines.append(
+                f"  jax.make_mesh(({dp}, {tp}), ('data', 'model'))"
+            )
+        lines.append(
+            "put the model axis on the inner (fastest-varying, coord-adjacent)"
+            " chips so tensor-parallel collectives stay on shortest ICI paths"
+        )
+    hints_dir = cfg.path("sofa_hints")
+    os.makedirs(hints_dir, exist_ok=True)
+    with open(os.path.join(hints_dir, "mesh_advice.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    features.add_info("mesh_advice", f"{hints_dir}/mesh_advice.txt")
+
+
+def _snake_key(coords):
+    """Snake (boustrophedon) order over torus coords: traverse the innermost
+    dimension forward or backward depending on the parity of the outer
+    coordinates, so consecutive devices in the sort are nearest neighbors."""
+    key = []
+    parity = 0
+    for c in coords:
+        key.append(-c if parity % 2 else c)
+        parity += c
+    return tuple(key)
+
+
+# ---------------------------------------------------------------------------
+# Rule-based hints on the feature vector (local POTATO).
+
+def _pct(v: Optional[float]) -> float:
+    return float(v) if v is not None else 0.0
+
+
+def generate_hints(features: Features, cfg) -> List[str]:
+    hints: List[str] = []
+    get = features.get
+
+    comm_ratio = _pct(get("comm_ratio"))
+    if comm_ratio >= 0.15:
+        # The reference's compute- vs communication-bound verdict threshold
+        # (sofa_aisi.py:503-507).
+        hints.append(
+            f"communication-bound: collectives take {comm_ratio:.0%} of device"
+            " time — try larger per-chip batch, gradient-accumulation, or a"
+            " mesh shape that shortens the all-reduce ring (see mesh_advice)"
+        )
+    elif get("tpu_ops") is not None:
+        hints.append(f"compute-bound: collectives take {comm_ratio:.0%} of device time")
+
+    # Per-device rules scan tpu<N>_* (NOT hardcoded tpu0): multi-host device
+    # ids start at host_index*256, so there may be no device 0.  The worst
+    # device drives each hint.
+    effs = features.by_regex(r"tpu\d+_roofline_efficiency")
+    if effs:
+        name, eff = min(effs, key=lambda nv: nv[1])
+        dev = name.split("_", 1)[0]
+        if eff < 0.4:
+            mem_t = get(f"{dev}_memory_bound_time")
+            cmp_t = get(f"{dev}_compute_bound_time")
+            dominant = ("memory" if (mem_t or 0) >= (cmp_t or 0)
+                        else "compute")
+            fix = ("fuse elementwise chains into matmuls and raise arithmetic"
+                   " intensity (larger batch/tiles)" if dominant == "memory"
+                   else
+                   "check matmul shapes against the 128x128 MXU tile and"
+                   " prefer bf16 inputs")
+            hints.append(
+                f"ops on {dev} run at {eff:.0%} of their roofline bound and"
+                f" {dominant}-bound time dominates — {fix} (see roofline.csv)"
+            )
+
+    exposed = []
+    for name, hidden in features.by_regex(r"tpu\d+_async_hidden_pct"):
+        dev = name.split("_", 1)[0]
+        atime = get(f"{dev}_async_time")
+        optime = get(f"{dev}_op_time")
+        if (hidden < 50.0 and atime and optime
+                and atime > 0.05 * optime):
+            exposed.append((hidden, dev))
+    if exposed:
+        hidden, dev = min(exposed)
+        hints.append(
+            f"exposed DMA latency on {dev}: only {hidden:.0f}% of async copy"
+            " time overlaps TensorCore compute — enable/raise prefetching"
+            " (double-buffer inputs, jax.block_until_ready placement) or"
+            " fuse small transfers"
+        )
+
+    gaps = features.by_regex(r"tpu\d+_step_gap_pct")
+    if gaps:
+        name, gap = max(gaps, key=lambda nv: nv[1])
+        dev = name.split("_", 1)[0]
+        if gap > 15.0:
+            h2d = get(f"{dev}_step_h2d_pct") or 0.0
+            cause = (
+                f"host->device transfers cover {h2d:.0f}% of step time — the"
+                " input pipeline is the likely gate; prefetch batches to"
+                " device (double-buffer) or move preprocessing off the host"
+                if h2d > 0.2 * gap else
+                "little H2D activity fills the gaps — look at collective"
+                " waits, host callbacks, or synchronous eval between steps")
+            hints.append(
+                f"device idle inside steps on {dev}: TensorCore covers only"
+                f" {100.0 - gap:.0f}% of step time — {cause}"
+                " (see tpu_input_pipeline.csv)")
+
+    skew = get("step_skew_mean")
+    step_mean = get("step_time_mean") or get("aisi_step_time_mean")
+    if skew is not None and step_mean and skew > 0.05 * step_mean:
+        hints.append(
+            f"straggler skew: devices start the same step {skew * 1e3:.2f} ms"
+            " apart on average — check uneven sharding, host input pipelines,"
+            " or DCN interference (see tpu_step_skew.csv)"
+        )
+
+    mxu = get("mxu_util_mean")
+    if mxu is not None and mxu < 30.0:
+        hints.append(
+            f"MXU utilization is low ({mxu:.1f}% mean) — check for small"
+            " matmul shapes, fp32 where bf16 would do, or excessive"
+            " elementwise ops that cannot use the systolic array"
+        )
+    infeed = get("comm_h2d_time")
+    tpu_busy = get("tpu0_op_time")
+    if infeed and tpu_busy and infeed > 0.2 * tpu_busy:
+        hints.append(
+            "input-bound: host->device transfer is a large fraction of device"
+            " time — prefetch batches (double buffering) or move preprocessing"
+            " off the host"
+        )
+    iow = _pct(get("elapsed_iow_ratio"))
+    if iow > 0.2:
+        hints.append(
+            f"I/O-wait dominates {iow:.0%} of wall time — data loading is"
+            " likely the bottleneck (consider caching or faster storage)"
+        )
+    idl = _pct(get("elapsed_idl_ratio"))
+    if idl > 0.5:
+        hints.append(
+            f"{idl:.0%} of wall time is idle — the accelerator is starved or"
+            " the workload is tiny relative to the recording window"
+        )
+    cpu_util = get("cpu_util")
+    ncores = get("num_cores")
+    if cpu_util is not None and ncores and cpu_util > 0.85:
+        hints.append(
+            "host CPU is saturated — data pipeline or Python overhead may be"
+            " gating the TPU"
+        )
+    return hints
+
+
+def hint_report(features: Features, cfg) -> None:
+    hints = generate_hints(features, cfg)
+    for h in hints:
+        print_hint(h)
+    if hints:
+        with open(cfg.path("hints.txt"), "w") as f:
+            f.write("\n".join(hints) + "\n")

@@ -1,0 +1,401 @@
+"""Communication profile: data movement by kind + ICI traffic attribution.
+
+comm_profile retarget (reference sofa_common.py:23-177): the CUPTI copyKind
+taxonomy {H2D, D2H, D2D, P2P} extends to XLA collectives (CopyKind >= 20),
+and the src x dst GPU matrix becomes a chip x chip ICI traffic matrix derived
+from collective semantics + mesh topology — per-link hardware counters are
+not exposed in XPlane, so link traffic is estimated from the collective
+algorithm (ring) as the reference estimates nothing at all (it only counts
+NCCL kernel time, sofa_analyze.py:363-368).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_title
+from sofa_tpu.trace import CK_NAMES, CopyKind
+
+
+def load_topology(cfg) -> Optional[dict]:
+    path = cfg.path("tpu_topo.json")
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _wire_bytes(sel: pd.DataFrame, kind: int, n_devices: int) -> float:
+    """Estimated bytes a collective actually moves over ICI links, per
+    device row — the nccl-tests bus-bandwidth factors applied with each
+    op's own replica-group size g (workloads/collectives._bus_factor, the
+    same math tests/test_ici_groundtruth.py reconciles against real lowered
+    XLA collectives):
+
+      all-reduce            2 P (g-1)/g   (reduce-scatter + all-gather)
+      all-gather / r-s        P (g-1)/g
+      all-to-all              P (g-1)/g   (P/g to each of g-1 peers)
+      permute / broadcast     P
+
+    P here is the op's ``payload`` (bytes_accessed — memory traffic), so
+    the estimate inherits that calibration; ops with no recorded groups
+    fall back to the full device count (0 known devices -> factor for the
+    pairwise kinds only).
+    """
+    total = 0.0
+    for groups_json, payload in sel.groupby("groups")["payload"].sum().items():
+        payload = float(payload)
+        g = 0
+        if groups_json:
+            try:
+                parsed = json.loads(groups_json)
+                if parsed and parsed[0]:
+                    g = len(parsed[0])
+            except ValueError:
+                pass
+        if g < 2:
+            g = n_devices
+        if kind in (int(CopyKind.COLLECTIVE_PERMUTE),
+                    int(CopyKind.COLLECTIVE_BROADCAST), int(CopyKind.P2P)):
+            total += payload
+        elif g >= 2:
+            factor = (g - 1) / g
+            if kind == int(CopyKind.ALL_REDUCE):
+                factor *= 2.0
+            total += payload * factor
+    return total
+
+
+def comm_profile(frames, cfg, features: Features) -> None:
+    from sofa_tpu.trace import roi_clip
+
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    # Same ROI window as tpu_profile, so comm_ratio's numerator and
+    # denominator come from one clock interval.
+    df = roi_clip(df, cfg)
+    if df.empty:
+        return
+    # Collectives live on the sync "XLA Ops" line (category 0); H2D/D2H/D2D
+    # transfer spans live on the async DMA line (category 2), with stub
+    # copy-start/copy-done markers duplicated on the sync line.  Prefer the
+    # async spans for copies and fall back to the sync stubs when a backend
+    # emits no async line.
+    sync = df[df["category"] == 0]
+    async_ = df[df["category"] == 2]
+    coll_rows = sync[sync["copyKind"] >= 20]
+    copies = async_[(async_["copyKind"] > 0) & (async_["copyKind"] < 20)]
+    if copies.empty:
+        copies = sync[(sync["copyKind"] > 0) & (sync["copyKind"] < 20)]
+    moved = pd.concat([coll_rows, copies], ignore_index=True)
+    if moved.empty:
+        features.add("comm_time", 0.0)
+        return
+    topo = load_topology(cfg)
+    n_devices = len((topo or {}).get("devices", []))
+    rows = []
+    total_ici = 0.0
+    for kind, sel in moved.groupby("copyKind"):
+        kname = CK_NAMES.get(int(kind), str(kind))
+        dur = float(sel["duration"].sum())
+        payload = float(sel["payload"].sum())
+        row = {
+            "copyKind": int(kind),
+            "kind": kname,
+            "count": len(sel),
+            "total_time": dur,
+            "total_bytes": payload,
+            "mean_bandwidth": payload / dur if dur > 0 else 0.0,
+        }
+        features.add(f"comm_{kname.lower()}_time", dur)
+        features.add(f"comm_{kname.lower()}_bytes", payload)
+        if int(kind) >= 20 or int(kind) == int(CopyKind.P2P):
+            # total_bytes for collectives is MEMORY traffic (bytes_accessed:
+            # HBM reads+writes); ici_bytes is the estimated WIRE traffic —
+            # the nccl-tests bus math applied per op using its replica-group
+            # size (the same model the ici_matrix booking uses, reconciled
+            # in tests/test_ici_groundtruth.py).  P2P send/recv is ICI wire
+            # traffic too, payload == wire bytes.  Host copies (H2D/D2H/D2D)
+            # need no second column: they don't cross ICI.
+            wire = _wire_bytes(sel, int(kind), n_devices)
+            row["ici_bytes"] = wire
+            row["ici_bandwidth"] = wire / dur if dur > 0 else 0.0
+            features.add(f"comm_{kname.lower()}_ici_bytes", wire)
+            total_ici += wire
+        else:
+            row["ici_bytes"] = 0.0
+            row["ici_bandwidth"] = 0.0
+        rows.append(row)
+    if total_ici > 0:
+        features.add("comm_ici_bytes", total_ici)
+        ici_mask = (moved["copyKind"] >= 20) | \
+                   (moved["copyKind"] == int(CopyKind.P2P))
+        ici_dur = float(moved.loc[ici_mask, "duration"].sum())
+        if ici_dur > 0:
+            features.add("comm_ici_bandwidth", total_ici / ici_dur)
+    summary = pd.DataFrame(rows).sort_values("total_time", ascending=False)
+    summary.to_csv(cfg.path("comm.csv"), index=False)
+
+    coll = moved[moved["copyKind"] >= 20]
+    comm_time = float(coll["duration"].sum())
+    features.add("comm_time", comm_time)
+    total = float(df[df["category"] == 0]["duration"].sum())
+    features.add("comm_ratio", comm_time / total if total > 0 else 0.0)
+    if cfg.verbose and not summary.empty:
+        print_title("Data movement by kind")
+        print(summary.to_string(index=False))
+
+    matrix = ici_traffic_matrix(coll, topo)
+    if matrix is not None:
+        matrix.to_csv(cfg.path("ici_matrix.csv"))
+        features.add("ici_est_bytes", float(matrix.to_numpy().sum()))
+
+
+def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.DataFrame]:
+    """Estimate per-link ICI traffic from collective ops, participant-aware.
+
+    Each collective op row is recorded *per device*; that device sends bytes
+    only to its successor within its replica group (ring algorithm over the
+    group, ordered by the torus snake order so consecutive participants are
+    ICI neighbors).  Group membership comes from the op's replica_groups
+    (parsed at ingest into the ``groups`` column); ops with no recorded
+    groups are booked against all devices.
+
+    Per-device send volume by kind (P = op payload, g = group size):
+      all-reduce          2 P (g-1)/g   (reduce-scatter + all-gather phases)
+      all-gather / r-s      P (g-1)/g
+      all-to-all            P/g to EACH other participant (direct edges)
+      permute/broadcast     P to the ring successor (true pairs not in stats)
+
+    This replaces the reference's CUPTI P2P matrix (sofa_common.py:97-157);
+    single-chip hardware has no ICI traffic, so the model is validated by the
+    analytic unit tests in tests/test_analyze.py rather than by counters.
+    """
+    if topo is None:
+        return None
+    devices = topo.get("devices", [])
+    n = len(devices)
+    if n < 2 or coll is None or coll.empty:
+        return None
+    from sofa_tpu.analysis.advice import _snake_key
+
+    order = sorted(
+        devices,
+        key=lambda d: (_snake_key(d.get("coords") or [d["id"]]),
+                       d.get("core_on_chip", 0)),
+    )
+    ids = [d["id"] for d in order]
+    pos = {d: i for i, d in enumerate(ids)}
+    all_ids = ids
+
+    # Trace rows carry XPlane-local ordinals encoded as host*256+local
+    # (ingest/xplane.py device_id_base); topology and replica groups use
+    # GLOBAL jax device ids.  Translate via per-process id lists so
+    # multi-host traffic lands on the right chips.
+    by_proc: Dict[int, List[int]] = {}
+    for d in sorted(devices, key=lambda d: d["id"]):
+        by_proc.setdefault(int(d.get("process_index", 0)), []).append(d["id"])
+
+    def to_global(dev: int) -> int:
+        host, local = divmod(int(dev), 256)
+        proc_ids = by_proc.get(host)
+        if proc_ids and local < len(proc_ids):
+            return proc_ids[local]
+        return int(dev)
+
+    mat = np.zeros((n, n))
+    # Aggregate payloads per (device, kind, groups) before booking: one
+    # matrix update per distinct collective shape, not per op instance.
+    agg = coll.groupby(["deviceId", "copyKind", "groups"])["payload"].sum()
+    for (dev, kind, groups_json), payload in agg.items():
+        payload = float(payload)
+        dev = to_global(dev)
+        if payload <= 0 or dev not in pos:
+            continue
+        groups: List[List[int]] = []
+        if groups_json:
+            try:
+                groups = json.loads(groups_json)
+            except ValueError:
+                groups = []
+        group = next((g for g in groups if dev in g), None)
+        if group is None:
+            group = all_ids
+        members = [d for d in ids if d in set(group) and d in pos]
+        g = len(members)
+        if g < 2:
+            continue
+        i = pos[dev]
+        kind = int(kind)
+        if kind == int(CopyKind.ALL_TO_ALL):
+            share = payload / g
+            for m in members:
+                if m != dev:
+                    mat[i, pos[m]] += share
+            continue
+        if kind == int(CopyKind.ALL_REDUCE):
+            sent = 2.0 * payload * (g - 1) / g
+        elif kind in (int(CopyKind.ALL_GATHER), int(CopyKind.REDUCE_SCATTER)):
+            sent = payload * (g - 1) / g
+        else:  # permute / broadcast / p2p
+            sent = payload
+        succ = members[(members.index(dev) + 1) % g]
+        mat[i, pos[succ]] += sent
+    labels = [f"tpu{d}" for d in ids]
+    return pd.DataFrame(mat, index=labels, columns=labels)
+
+
+def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
+    """Pearson correlation between host-network (DCN) tx bandwidth and TPU
+    step activity — the cluster question BASELINE config #5 asks ("is DCN
+    traffic gating the steps?").  Returns None when either signal is absent.
+
+    The reference correlates GPU util against net tx/rx inside
+    concurrency_breakdown (sofa_analyze.py:75-243); here it is computed per
+    host over a common time grid so cluster_analyze can tabulate it.
+    """
+    net = frames.get("netbandwidth")
+    dev = frames.get("tputrace")
+    if net is None or net.empty or dev is None or dev.empty:
+        return None
+    tx = net[net["name"].str.endswith(".tx")]
+    ops = dev[dev["category"] == 0]
+    if tx.empty or ops.empty:
+        return None
+    t0 = float(min(tx["timestamp"].min(), ops["timestamp"].min()))
+    t1 = float(max(tx["timestamp"].max(),
+                   (ops["timestamp"] + ops["duration"]).max()))
+    if t1 <= t0:
+        return None
+    edges = np.linspace(t0, t1, n_bins + 1)
+    # per-bin mean tx bandwidth
+    tx_bins = np.zeros(n_bins)
+    idx = np.clip(np.searchsorted(edges, tx["timestamp"].to_numpy()) - 1,
+                  0, n_bins - 1)
+    counts = np.zeros(n_bins)
+    np.add.at(tx_bins, idx, tx["event"].to_numpy(dtype=float))
+    np.add.at(counts, idx, 1)
+    tx_bins = np.divide(tx_bins, np.maximum(counts, 1))
+    busy = _busy_bins(ops, edges)
+    if tx_bins.std() == 0 or busy.std() == 0:
+        return None
+    return float(np.corrcoef(tx_bins, busy)[0, 1])
+
+
+def _busy_bins(ops: pd.DataFrame, edges: np.ndarray) -> np.ndarray:
+    """Per-bin device busy time (op durations clipped into each bin) —
+    O(ops + bins): first/last bins get the partial overlaps, interior bins
+    get full width via a difference array, instead of clipping the whole op
+    array once per bin (64 x 1.6M elementwise at pod scale)."""
+    n_bins = len(edges) - 1
+    starts = ops["timestamp"].to_numpy(dtype=float)
+    ends = np.maximum(starts + ops["duration"].to_numpy(dtype=float), starts)
+    width = edges[1] - edges[0]
+    i0 = np.clip(np.searchsorted(edges, starts, "right") - 1, 0, n_bins - 1)
+    i1 = np.clip(np.searchsorted(edges, ends, "left") - 1, 0, n_bins - 1)
+    busy = np.zeros(n_bins)
+    same = i0 == i1
+    np.add.at(busy, i0[same], (ends - starts)[same])
+    sp = ~same
+    np.add.at(busy, i0[sp], (edges[i0[sp] + 1] - starts[sp]))
+    np.add.at(busy, i1[sp], (ends[sp] - edges[i1[sp]]))
+    # interior full bins i0+1 .. i1-1 via prefix-summed diff array
+    diff = np.zeros(n_bins + 1)
+    np.add.at(diff, i0[sp] + 1, width)
+    np.add.at(diff, i1[sp], -width)
+    busy += np.cumsum(diff[:-1])
+    return busy
+
+
+def net_profile(frames, cfg, features: Features) -> None:
+    """Host-network (DCN) packet profile (reference sofa_analyze.py:385-493)."""
+    df = frames.get("nettrace")
+    if df is None or df.empty:
+        return
+    from sofa_tpu.trace import read_net_addrs, unpack_ip
+
+    # id -> literal for interned (IPv6) addresses; empty when all-v4
+    addrs = read_net_addrs(cfg.path("net_addrs.csv"))
+
+    features.add("net_packets", len(df))
+    features.add("net_total_bytes", float(df["payload"].sum()))
+    features.add("net_total_time", float(df["duration"].sum()))
+    pairs = (
+        df.groupby(["pkt_src", "pkt_dst"])["payload"]
+        .agg(["sum", "count"])
+        .sort_values("sum", ascending=False)
+        .reset_index()
+    )
+    pairs["src"] = pairs["pkt_src"].map(lambda v: unpack_ip(v, addrs))
+    pairs["dst"] = pairs["pkt_dst"].map(lambda v: unpack_ip(v, addrs))
+    out_cols = ["src", "dst", "sum", "count"]
+    # Per-PEER step correlation (beyond the reference, which only ranks
+    # peers by bytes): which (src, dst) flow moves bytes in lockstep with
+    # device activity — i.e. WHICH peer is the one gating the steps that
+    # dcn_step_correlation flags in aggregate.
+    dev = frames.get("tputrace")
+    ops = dev[dev["category"] == 0] if dev is not None and not dev.empty \
+        else None
+    if ops is not None and not ops.empty and len(df) >= 8:
+        n_bins = 64
+        t0 = float(min(df["timestamp"].min(), ops["timestamp"].min()))
+        t1 = float(max(df["timestamp"].max(),
+                       (ops["timestamp"] + ops["duration"]).max()))
+        if t1 > t0:
+            edges = np.linspace(t0, t1, n_bins + 1)
+            busy = _busy_bins(ops, edges)
+            if busy.std() > 0:
+                corrs = []
+                top = pairs.head(8)
+                pkt_idx = np.clip(
+                    np.searchsorted(edges, df["timestamp"].to_numpy()) - 1,
+                    0, n_bins - 1)
+                payload = df["payload"].to_numpy(dtype=float)
+                # one row-partition pass for all peers, not a full-array
+                # scan per peer (pod captures are millions of packets)
+                pair_rows = df.groupby(["pkt_src", "pkt_dst"]).indices
+                for r in top.itertuples(index=False):
+                    sel = pair_rows.get((r.pkt_src, r.pkt_dst), [])
+                    bins = np.zeros(n_bins)
+                    np.add.at(bins, pkt_idx[sel], payload[sel])
+                    corrs.append(
+                        round(float(np.corrcoef(bins, busy)[0, 1]), 4)
+                        if bins.std() > 0 else None)
+                pairs["corr_step"] = pd.Series(
+                    corrs + [None] * (len(pairs) - len(corrs)))
+                out_cols.append("corr_step")
+                ranked = [c for c in corrs if c is not None]
+                if ranked:
+                    best = int(np.nanargmax(np.array(
+                        [c if c is not None else -2 for c in corrs])))
+                    features.add("dcn_top_peer_corr", corrs[best])
+                    features.add_info(
+                        "dcn_top_peer",
+                        f"{top.iloc[best]['src']}->{top.iloc[best]['dst']}")
+    pairs[out_cols].to_csv(cfg.path("netrank.csv"), index=False)
+
+
+def netbandwidth_profile(frames, cfg, features: Features) -> None:
+    """NIC byte-counter profile (reference sofa_analyze.py:531-594)."""
+    df = frames.get("netbandwidth")
+    if df is None or df.empty:
+        return
+    for direction in ("tx", "rx"):
+        rows = df[df["name"].str.endswith("." + direction)]
+        if rows.empty:
+            continue
+        q = rows["event"].quantile([0.25, 0.5, 0.75])
+        features.add(f"net_{direction}_q1", float(q.loc[0.25]))
+        features.add(f"net_{direction}_median", float(q.loc[0.5]))
+        features.add(f"net_{direction}_q3", float(q.loc[0.75]))
+        features.add(f"net_{direction}_total_bytes", float(rows["payload"].sum()))

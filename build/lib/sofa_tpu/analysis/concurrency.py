@@ -1,0 +1,121 @@
+"""Windowed concurrency breakdown — what dominates each slice of wall time.
+
+Reference: concurrency_breakdown (sofa_analyze.py:75-243) classifies each
+1/sys_mon_rate window into usr/sys/gpu/iow/idle by the dominant activity and
+correlates GPU activity with host metrics.  Retarget: `gpu` becomes `tpu`
+(TensorCore duty cycle) and the correlation set gains HBM bandwidth.
+Writes performance.csv (per-window class + metrics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_title
+
+
+def _window_series(df, name_filter, t0, t1, window, value_col="event"):
+    """Mean of a metric per window, aligned to edges [t0, t1)."""
+    edges = np.arange(t0, t1 + window, window)
+    out = np.zeros(len(edges) - 1)
+    rows = df[df["name"] == name_filter] if name_filter else df
+    # Drop samples outside [t0, t1): clamping them into the edge windows
+    # would pollute window 0 with all pre-ROI history.
+    rows = rows[(rows["timestamp"] >= t0) & (rows["timestamp"] < t1)]
+    if rows.empty:
+        return edges, out
+    idx = np.clip(((rows["timestamp"] - t0) / window).astype(int), 0, len(out) - 1)
+    sums = np.zeros(len(out))
+    counts = np.zeros(len(out))
+    np.add.at(sums, idx, rows[value_col].to_numpy(dtype=float))
+    np.add.at(counts, idx, 1)
+    mask = counts > 0
+    out[mask] = sums[mask] / counts[mask]
+    return edges, out
+
+
+def concurrency_breakdown(frames, cfg, features: Features) -> None:
+    mpstat = frames.get("mpstat")
+    if mpstat is None or mpstat.empty:
+        return
+    agg = mpstat[mpstat["deviceId"] == -1]
+    if agg.empty:
+        return
+    window = 1.0 / max(cfg.sys_mon_rate, 1)
+    t0 = float(agg["timestamp"].min())
+    t1 = float(agg["timestamp"].max())
+    if cfg.roi_end > cfg.roi_begin > 0:
+        t0, t1 = cfg.roi_begin, cfg.roi_end
+    if t1 <= t0:
+        return
+
+    edges, usr = _window_series(agg, "usr", t0, t1, window)
+    _, sys_ = _window_series(agg, "sys", t0, t1, window)
+    _, iow = _window_series(agg, "iow", t0, t1, window)
+    tpuutil = frames.get("tpuutil")
+    if tpuutil is not None and not tpuutil.empty:
+        _, tpu = _window_series(tpuutil, "tc_util", t0, t1, window)
+        _, hbm = _window_series(tpuutil, "hbm_gbps", t0, t1, window)
+    else:
+        tpu = np.zeros(len(edges) - 1)
+        hbm = np.zeros(len(edges) - 1)
+    net = frames.get("netbandwidth")
+    if net is not None and not net.empty:
+        tx_rows = net[net["name"].str.endswith(".tx")]
+        _, tx = _window_series(tx_rows, None, t0, t1, window)
+        rx_rows = net[net["name"].str.endswith(".rx")]
+        _, rx = _window_series(rx_rows, None, t0, t1, window)
+    else:
+        tx = np.zeros(len(edges) - 1)
+        rx = np.zeros(len(edges) - 1)
+
+    idle_floor = cfg.is_idle_threshold * 100.0
+    classes = []
+    for i in range(len(edges) - 1):
+        candidates = {
+            "tpu": tpu[i],
+            "usr": usr[i],
+            "sys": sys_[i],
+            "iow": iow[i],
+        }
+        dominant = max(candidates, key=candidates.get)
+        if candidates[dominant] < idle_floor:
+            dominant = "idl"
+        classes.append(dominant)
+
+    perf = pd.DataFrame(
+        {
+            "timestamp": edges[:-1],
+            "class": classes,
+            "usr": usr,
+            "sys": sys_,
+            "iow": iow,
+            "tpu_util": tpu,
+            "hbm_gbps": hbm,
+            "net_tx": tx,
+            "net_rx": rx,
+        }
+    )
+    perf.to_csv(cfg.path("performance.csv"), index=False)
+
+    elapsed = t1 - t0
+    counts = pd.Series(classes).value_counts()
+    for cls in ("tpu", "usr", "sys", "iow", "idl"):
+        ratio = counts.get(cls, 0) / len(classes) if classes else 0.0
+        features.add(f"elapsed_{cls}_ratio", ratio)
+    features.add("breakdown_windows", len(classes))
+    features.add("breakdown_elapsed", elapsed)
+
+    # Pearson correlation of TPU activity vs host metrics
+    # (reference correlates gpu vs usr/sys/iow/tx/rx, sofa_analyze.py:200-243).
+    if tpu.any():
+        for name, arr in (("usr", usr), ("sys", sys_), ("iow", iow),
+                          ("net_tx", tx), ("net_rx", rx), ("hbm", hbm)):
+            if arr.any() and np.std(arr) > 0 and np.std(tpu) > 0:
+                corr = float(np.corrcoef(tpu, arr)[0, 1])
+                features.add(f"corr_tpu_{name}", corr)
+    if cfg.verbose:
+        print_title("Concurrency breakdown (dominant class per window)")
+        print(counts.to_string())

@@ -1,0 +1,94 @@
+"""gRPC advice service — remote hints on a performance feature vector.
+
+The reference queries a remote POTATO server
+(/root/reference/bin/sofa_analyze.py:49-73: gRPC Hint(HintRequest{hostname,
+pfv}) -> HintResponse) and autodiscovers it from the environment
+(bin/sofa:269-271).  This module provides both sides with no grpc_tools
+dependency: handlers are registered generically and messages come from the
+protoc-generated hint_pb2 (sofa_tpu/native/hint.proto).
+
+Server:  python -m sofa_tpu.analysis.hint_service [port]
+Client:  sofa report --hint_server host:port   (also honors
+         $SOFA_HINT_SERVER, the POTATO_SERVER_SERVICE_HOST analogue)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from sofa_tpu.ingest import hint_pb2
+
+SERVICE = "sofa_tpu.hint.HintService"
+METHOD = f"/{SERVICE}/Hint"
+
+
+def discover_server(cfg) -> str | None:
+    if cfg.hint_server:
+        return cfg.hint_server
+    host = os.environ.get("SOFA_HINT_SERVER")
+    return host
+
+
+def request_hints(server: str, features, hostname: str = "", timeout: float = 5.0) -> List[str]:
+    import grpc
+
+    if ":" not in server:
+        server += ":50051"
+    req = hint_pb2.HintRequest(hostname=hostname or os.uname().nodename)
+    for name, value in features.to_frame().itertuples(index=False):
+        req.features[name] = float(value)
+    with grpc.insecure_channel(server) as channel:
+        call = channel.unary_unary(
+            METHOD,
+            request_serializer=hint_pb2.HintRequest.SerializeToString,
+            response_deserializer=hint_pb2.HintResponse.FromString,
+        )
+        resp = call(req, timeout=timeout)
+    return list(resp.hints)
+
+
+def serve(port: int = 50051, block: bool = True):
+    """Run the advice server: applies the local rule engine to whatever
+    feature vector a client sends."""
+    import grpc
+
+    from sofa_tpu.analysis.advice import generate_hints
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.config import SofaConfig
+
+    def hint_handler(request: hint_pb2.HintRequest, context) -> hint_pb2.HintResponse:
+        features = Features()
+        for name, value in request.features.items():
+            features.add(name, value)
+        hints = generate_hints(features, SofaConfig())
+        if not hints:
+            hints = ["no obvious bottleneck in the submitted feature vector"]
+        return hint_pb2.HintResponse(hints=hints)
+
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "Hint": grpc.unary_unary_rpc_method_handler(
+                hint_handler,
+                request_deserializer=hint_pb2.HintRequest.FromString,
+                response_serializer=hint_pb2.HintResponse.SerializeToString,
+            )
+        },
+    )
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    print(f"sofa_tpu hint service listening on :{bound}")
+    if block:
+        server.wait_for_termination()
+    return server, bound
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(int(sys.argv[1]) if len(sys.argv) > 1 else 50051)

@@ -1,0 +1,640 @@
+"""TPU-side analysis: HLO-op profile, module profile, utilization, ROI.
+
+The gpu_profile/nvsmi_profile/spotlight retarget (reference
+sofa_analyze.py:343-377,259-341,875-894): kernel/NCCL attribution becomes
+HLO-category and XLA-collective attribution; SM-utilization ROI detection
+becomes TensorCore-duty-cycle ROI detection.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_hint, print_title, print_warning
+from sofa_tpu.trace import CopyKind, roi_bounds as _roi_bounds, roi_clip
+
+
+def tpu_profile(frames, cfg, features: Features) -> None:
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    # Spotlight/manual ROI clips warmup+teardown like the reference's
+    # profile_region did for its GPU profile (bin/sofa:302-309).
+    df = roi_clip(df, cfg)
+    if df.empty:
+        return
+    sync = df[df["category"] == 0]
+    features.add("tpu_devices", df["deviceId"].nunique())
+    features.add("tpu_ops", len(sync))
+
+    for device_id, rows in sync.groupby("deviceId"):
+        total = float(rows["duration"].sum())
+        features.add(f"tpu{device_id}_op_time", total)
+        kern = rows[rows["copyKind"] == int(CopyKind.KERNEL)]
+        features.add(f"tpu{device_id}_kernel_time", float(kern["duration"].sum()))
+        coll = rows[rows["copyKind"] >= 20]
+        features.add(f"tpu{device_id}_collective_time", float(coll["duration"].sum()))
+
+    features.add("tpu_total_flops", float(sync["flops"].sum()))
+    features.add("tpu_total_bytes_accessed", float(sync["bytes_accessed"].sum()))
+
+    # Training-phase split (reference bin/sofa:284-285 fw/bw kernel filters).
+    fw = float(sync.loc[sync["phase"] == "fw", "duration"].sum())
+    bw = float(sync.loc[sync["phase"] == "bw", "duration"].sum())
+    if fw > 0 or bw > 0:
+        features.add("tpu_fw_time", fw)
+        features.add("tpu_bw_time", bw)
+        if fw > 0:
+            features.add("tpu_bw_fw_ratio", bw / fw)
+
+    # Top ops by total time (the reference's top-k GPU kernel table).
+    top = (
+        sync.groupby("name")
+        .agg(
+            total_time=("duration", "sum"),
+            count=("duration", "count"),
+            mean_time=("duration", "mean"),
+            flops=("flops", "sum"),
+            bytes_accessed=("bytes_accessed", "sum"),
+            source=("source", "first"),
+        )
+        .sort_values("total_time", ascending=False)
+    )
+    top.head(50).to_csv(cfg.path("tpu_top_ops.csv"))
+    if cfg.verbose and not top.empty:
+        print_title("Top-10 HLO ops by total time")
+        print(top.head(10).to_string())
+
+    # Per-category breakdown (convolution / fusion / all-reduce / ...).
+    cat = sync.assign(
+        cat=sync["hlo_category"].where(sync["hlo_category"] != "", "uncategorized")
+    ).groupby("cat")["duration"].sum().sort_values(ascending=False)
+    for name, value in cat.items():
+        features.add(f"hlo_time_{_slug(name)}", float(value))
+    cat.to_csv(cfg.path("tpu_categories.csv"))
+
+    # Per-module (jit function) totals.
+    mods = frames.get("tpumodules")
+    if mods is not None and not mods.empty:
+        mods = roi_clip(mods, cfg)
+    if mods is not None and not mods.empty:
+        per_mod = mods.groupby("name")["duration"].agg(["sum", "count"])
+        per_mod.to_csv(cfg.path("tpu_modules_summary.csv"))
+        features.add("tpu_module_launches", int(per_mod["count"].sum()))
+
+
+def overlap_profile(frames, cfg, features: Features) -> None:
+    """How much async data movement hides under compute, per device.
+
+    TPU DMA (Async XLA Ops, category 2) is supposed to overlap TensorCore
+    work; time where a DMA runs with no concurrent sync op is exposed
+    latency.  Emits per device:
+
+      tpu<N>_async_time         total async-op span time
+      tpu<N>_async_hidden_pct   % of that time covered by sync compute
+
+    The reference's concurrency_breakdown classifies wall-clock windows
+    (sofa_analyze.py:75-243); this is the op-level complement XPlane's
+    exact spans make possible.
+    """
+    import numpy as np
+
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    df = roi_clip(df, cfg)
+    for device_id, rows in df.groupby("deviceId"):
+        sync = rows[rows["category"] == 0]
+        asyn = rows[rows["category"] == 2]
+        if sync.empty or asyn.empty:
+            continue
+        from sofa_tpu.trace import merged_intervals
+
+        marr = merged_intervals(
+            sync["timestamp"].to_numpy(float),
+            (sync["timestamp"] + sync["duration"]).to_numpy(float))
+        a0 = asyn["timestamp"].to_numpy(float)
+        a1 = (asyn["timestamp"] + asyn["duration"]).to_numpy(float)
+        total = float((a1 - a0).sum())
+        if total <= 0:
+            continue
+        hidden = float(np.maximum(_union_coverage(marr, a0, a1), 0.0).sum())
+        features.add(f"tpu{device_id}_async_time", total)
+        features.add(f"tpu{device_id}_async_hidden_pct",
+                     100.0 * min(hidden / total, 1.0))
+
+
+def step_skew_profile(frames, cfg, features: Features) -> None:
+    """Straggler detection across devices from the per-device step spans.
+
+    With >1 device, step k should begin everywhere at once; the spread
+    (max-min begin over devices, per step index) is collective wait /
+    straggler skew.  Emits step_skew_mean/max features and
+    tpu_step_skew.csv.  Single-device traces are a no-op.
+    """
+    steps = frames.get("tpusteps")
+    if steps is None or steps.empty:
+        return
+    # Baseline for "how bad is the skew": mean device step duration.  Own
+    # feature (not aisi's) so the hint works in default runs where the
+    # optional aisi pass is off.
+    features.add("step_time_mean", float(steps["duration"].mean()))
+    if steps["deviceId"].nunique() < 2:
+        return
+    per = steps.groupby("event")["timestamp"].agg(["min", "max", "count"])
+    per = per[per["count"] >= 2]
+    if per.empty:
+        return
+    skew = per["max"] - per["min"]
+    out = per.reset_index().rename(columns={"event": "step"})
+    out["skew"] = skew.values
+    out[["step", "skew", "count"]].to_csv(
+        cfg.path("tpu_step_skew.csv"), index=False)
+    features.add("step_skew_mean", float(skew.mean()))
+    features.add("step_skew_max", float(skew.max()))
+
+
+def _union_coverage(arr, t0s, t1s):
+    """Covered length of each query window [t0, t1) under a DISJOINT sorted
+    interval union ``arr`` — O((M+Q) log M) via prefix sums, not a per-query
+    clip over every interval (same technique as overlap_profile)."""
+    import numpy as np
+
+    if not len(arr):
+        return np.zeros(len(t0s))
+    starts, ends = arr[:, 0], arr[:, 1]
+    cum = np.concatenate([[0.0], np.cumsum(ends - starts)])
+
+    def measure_below(ts):
+        # total covered length in (-inf, t) per t
+        j = np.searchsorted(starts, ts, side="right")
+        below = cum[j]
+        prev = np.maximum(j - 1, 0)
+        # subtract the part of interval j-1 that lies beyond t
+        over = np.maximum(ends[prev] - np.maximum(ts, starts[prev]), 0.0)
+        return below - np.where(j > 0, over, 0.0)
+
+    return measure_below(np.asarray(t1s)) - measure_below(np.asarray(t0s))
+
+
+def _intersect_intervals(a, b):
+    """Intersection of two DISJOINT sorted interval unions (Mx2 arrays)."""
+    import numpy as np
+
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i, 0], b[j, 0])
+        hi = min(a[i, 1], b[j, 1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i, 1] < b[j, 1]:
+            i += 1
+        else:
+            j += 1
+    return np.asarray(out, dtype=float).reshape(-1, 2)
+
+
+def input_pipeline_profile(frames, cfg, features: Features) -> None:
+    """Input-pipeline boundedness: device idle gaps INSIDE steps.
+
+    The classic TPU failure mode: the TensorCore finishes a step's compute
+    and waits for the next batch (host preprocessing / infeed / H2D).  Per
+    device and step span this measures
+
+      busy_pct  — % of the step covered by sync compute (interval union)
+      gap_ms    — step time with NO sync op running
+      h2d_ms    — EXPOSED host->device transfer time inside the step
+                  (H2D/infeed spans minus their part hidden under sync
+                  compute): well-prefetched copies overlap compute and
+                  must not implicate the input pipeline
+
+    and emits tpu<N>_step_gap_pct / tpu<N>_step_h2d_pct features plus
+    tpu_input_pipeline.csv.  TensorBoard's input-pipeline analyzer is the
+    tpu-world precedent; the reference has no analogue (GPU idle showed up
+    only in its wall-clock concurrency_breakdown, sofa_analyze.py:75-243).
+    """
+    import numpy as np
+
+    from sofa_tpu.trace import merged_intervals
+
+    steps = frames.get("tpusteps")
+    ops = frames.get("tputrace")
+    if steps is None or steps.empty or ops is None or ops.empty:
+        return
+    ops = roi_clip(ops, cfg)
+    # Steps get the same ROI as the ops they are measured against, or
+    # every step outside the window scores as 100% gap.
+    steps = roi_clip(steps, cfg)
+    if ops.empty or steps.empty:
+        return
+    rows = []
+    for device_id, dev_steps in steps.groupby("deviceId"):
+        dev_ops = ops[ops["deviceId"] == device_id]
+        # "Busy" means the core computes: sync H2D/D2H waits (a sync infeed
+        # IS the input stall this pass exists to expose) must not count.
+        if dev_ops.empty:
+            continue  # no op capture for this device: gap would be artifact
+        sync = dev_ops[(dev_ops["category"] == 0)
+                       & ~dev_ops["copyKind"].isin(
+                           (int(CopyKind.H2D), int(CopyKind.D2H)))]
+        # A device whose only ops are copies is FULLY input-bound — the
+        # worst case must be scored (100% gap), not skipped.
+        marr = (merged_intervals(
+            sync["timestamp"].to_numpy(float),
+            (sync["timestamp"] + sync["duration"]).to_numpy(float))
+            if not sync.empty else np.empty((0, 2)))
+        # infeed ops classify as CopyKind.H2D at ingest (classify_hlo_kind)
+        # whichever line they appear on, so copyKind == 1 covers them.
+        h2d = dev_ops[dev_ops["copyKind"] == 1]
+        harr = (merged_intervals(
+            h2d["timestamp"].to_numpy(float),
+            (h2d["timestamp"] + h2d["duration"]).to_numpy(float))
+            if not h2d.empty else np.empty((0, 2)))
+        hidden_h2d = _intersect_intervals(harr, marr)
+
+        t0s = dev_steps["timestamp"].to_numpy(float)
+        t1s = t0s + dev_steps["duration"].to_numpy(float)
+        bounds = _roi_bounds(cfg)
+        if bounds is not None:
+            # ROI-straddling steps keep only their in-window portion, or
+            # the clipped-away ops would read as phantom gap.
+            t0s = np.maximum(t0s, bounds[0])
+            t1s = np.minimum(t1s, bounds[1])
+        busy = _union_coverage(marr, t0s, t1s)
+        h2d_s = (_union_coverage(harr, t0s, t1s)
+                 - _union_coverage(hidden_h2d, t0s, t1s))
+        for i, srow in enumerate(dev_steps.itertuples(index=False)):
+            if t1s[i] <= t0s[i]:
+                continue
+            dur = t1s[i] - t0s[i]
+            rows.append({
+                "deviceId": int(device_id), "step": float(srow.event),
+                "t0": t0s[i], "dur": dur,
+                "busy_pct": 100.0 * busy[i] / dur,
+                "gap_ms": max(0.0, dur - busy[i]) * 1e3,
+                "h2d_ms": h2d_s[i] * 1e3,
+            })
+    if not rows:
+        return
+    table = pd.DataFrame(rows)
+    table.to_csv(cfg.path("tpu_input_pipeline.csv"), index=False)
+    for device_id, sel in table.groupby("deviceId"):
+        dur_s = sel["dur"].sum()
+        if dur_s <= 0:
+            continue
+        gap_pct = 100.0 * (sel["gap_ms"].sum() / 1e3) / dur_s
+        h2d_pct = 100.0 * (sel["h2d_ms"].sum() / 1e3) / dur_s
+        features.add(f"tpu{device_id}_step_gap_pct", float(gap_pct))
+        features.add(f"tpu{device_id}_step_h2d_pct", float(h2d_pct))
+
+
+def op_tree_profile(frames, cfg, features: Features) -> None:
+    """Hierarchical time attribution over the JAX program structure.
+
+    Every op carries its provenance path (op_path column, from XPlane's
+    tf_op stat: "jit(train_step)/jvp(main)/dot_general"); each op's time
+    is credited to every prefix of its path, yielding a tree like
+    TensorBoard's op_profile — but over the unified schema, so it composes
+    with phase/device filters.  The reference has no analogue (its closest
+    is the flat top-k kernel table, sofa_analyze.py:343-377).  Writes
+    tpu_op_tree.csv (path, depth, time, count, flops, bytes).
+    """
+    df = frames.get("tputrace")
+    if df is None or df.empty or "op_path" not in df.columns:
+        return
+    df = roi_clip(df, cfg)
+    sync = df[(df["category"] == 0) & (df["op_path"] != "")]
+    if sync.empty:
+        return
+    # Program paths repeat per op instance (a pod-scale trace is millions of
+    # rows over hundreds of distinct paths): aggregate per unique path
+    # vectorized first, then walk prefixes over the uniques only.
+    per_path = sync.groupby("op_path", sort=False).agg(
+        time=("duration", "sum"), count=("duration", "count"),
+        flops=("flops", "sum"), nbytes=("bytes_accessed", "sum"))
+    agg: dict = {}
+    for path, dur, cnt, flops, nbytes in per_path.itertuples(name=None):
+        parts = path.split("/")
+        for depth in range(1, len(parts) + 1):
+            prefix = "/".join(parts[:depth])
+            a = agg.get(prefix)
+            if a is None:
+                agg[prefix] = a = [depth, 0.0, 0, 0.0, 0.0]
+            a[1] += dur
+            a[2] += cnt
+            a[3] += flops
+            a[4] += nbytes
+    total = float(sync["duration"].sum())
+    table = pd.DataFrame(
+        [(p, d, t, c, f, b) for p, (d, t, c, f, b) in agg.items()],
+        columns=["path", "depth", "time", "count", "flops", "bytes_accessed"],
+    ).sort_values(["depth", "time"], ascending=[True, False])
+    table["time_pct"] = 100.0 * table["time"] / total if total > 0 else 0.0
+    table.to_csv(cfg.path("tpu_op_tree.csv"), index=False)
+    features.add("op_tree_paths", len(table))
+    if cfg.verbose and not table.empty:
+        print_title("Op tree (time by program path, depth <= 2)")
+        shallow = table[table["depth"] <= 2].head(12)
+        print(shallow[["path", "time", "time_pct", "count"]]
+              .to_string(index=False))
+
+
+def roofline_profile(frames, cfg, features: Features) -> None:
+    """Per-op speed-of-light analysis against the chip's peak rates.
+
+    For every HLO kernel op with flops/bytes metadata, the attainable
+    ("speed of light") time is max(flops/peak_flops, bytes/peak_hbm_bw) —
+    the roofline bound under perfect overlap — and efficiency is
+    sol_time/actual_time.  Ops are classed compute- vs memory-bound by
+    which term dominates.  The reference has no equivalent (its closest is
+    nvsmi SM%, sofa_analyze.py:259-341); on TPU the XPlane op trace carries
+    exact per-op flops/bytes, so the bound is computable per op.  Writes
+    roofline.csv and duration-weighted per-device features.
+    """
+    import json
+    import os
+
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    meta_path = cfg.path("tpu_meta.json")
+    if not os.path.isfile(meta_path):
+        return
+    with open(meta_path) as f:
+        meta = json.load(f)
+
+    df = roi_clip(df, cfg)
+    rows = df[(df["category"] == 0)
+              & (df["copyKind"] == int(CopyKind.KERNEL))
+              & (df["duration"] > 0)
+              & ((df["flops"] > 0) | (df["bytes_accessed"] > 0))]
+    if rows.empty:
+        return
+
+    out = []
+    for device_id, dev in rows.groupby("deviceId"):
+        peaks = meta.get(str(device_id), {})
+        peak_flops = float(peaks.get("peak_teraflops_per_second", 0)) * 1e12
+        peak_bw = float(
+            peaks.get("peak_hbm_bw_gigabytes_per_second", 0)) * 1e9
+        if peak_flops <= 0 or peak_bw <= 0:
+            continue
+        agg = dev.groupby("name").agg(
+            time=("duration", "sum"),
+            count=("duration", "count"),
+            flops=("flops", "sum"),
+            bytes_accessed=("bytes_accessed", "sum"),
+        )
+        t_compute = agg["flops"] / peak_flops
+        t_memory = agg["bytes_accessed"] / peak_bw
+        agg["sol_time"] = pd.concat([t_compute, t_memory], axis=1).max(axis=1)
+        agg["efficiency"] = (agg["sol_time"] / agg["time"]).clip(upper=1.0)
+        agg["bound"] = "memory"
+        agg.loc[t_compute >= t_memory, "bound"] = "compute"
+        agg["deviceId"] = device_id
+        out.append(agg)
+
+        total = float(agg["time"].sum())
+        # Aggregate from the *clipped* per-op efficiencies: an op whose
+        # flops/bytes metadata is overcounted (sol_time > time) must not
+        # push the device aggregate past 1.0 or mask everyone else.
+        sol = float((agg["time"] * agg["efficiency"]).sum())
+        features.add(f"tpu{device_id}_roofline_efficiency",
+                     sol / total if total else 0.0)
+        for bound in ("compute", "memory"):
+            features.add(
+                f"tpu{device_id}_{bound}_bound_time",
+                float(agg.loc[agg["bound"] == bound, "time"].sum()))
+        tf, tb = float(agg["flops"].sum()), float(agg["bytes_accessed"].sum())
+        if tb > 0:
+            features.add(f"tpu{device_id}_arithmetic_intensity", tf / tb)
+
+    if not out:
+        return
+    table = (pd.concat(out)
+             .sort_values("time", ascending=False)
+             .reset_index())
+    table.to_csv(cfg.path("roofline.csv"), index=False)
+    if cfg.verbose:
+        heavy = table.head(20).sort_values("efficiency").head(5)
+        print_title("Furthest-from-roofline heavy ops")
+        print(heavy[["name", "time", "efficiency", "bound"]].to_string(
+            index=False))
+
+
+def tpuutil_profile(frames, cfg, features: Features) -> None:
+    df = frames.get("tpuutil")
+    if df is None or df.empty:
+        return
+    for metric in ("tc_util", "mxu_util", "hbm_gbps"):
+        rows = df[df["name"] == metric]
+        if rows.empty:
+            continue
+        features.add(f"{metric}_mean", float(rows["event"].mean()))
+        features.add(f"{metric}_max", float(rows["event"].max()))
+        q = rows["event"].quantile([0.25, 0.5, 0.75])
+        features.add(f"{metric}_median", float(q.loc[0.5]))
+
+
+def tpumon_profile(frames, cfg, features: Features) -> None:
+    """Live HBM occupancy/liveness features (the nvsmi_profile analogue,
+    reference sofa_analyze.py:259-341) from the in-process sampler — present
+    even when XPlane tracing was off."""
+    df = frames.get("tpumon")
+    if df is None or df.empty:
+        return
+    alive = df[df["name"] == "alive"]
+    if not alive.empty:
+        features.add("tpumon_samples", len(alive))
+        span = float(alive["timestamp"].max() - alive["timestamp"].min())
+        features.add("tpumon_span", span)
+    used = df[df["name"] == "hbm_used_gb"]
+    for device_id, rows in used.groupby("deviceId"):
+        features.add(f"tpu{device_id}_hbm_used_mean_gb",
+                     float(rows["event"].mean()))
+        features.add(f"tpu{device_id}_hbm_used_max_gb",
+                     float(rows["event"].max()))
+        # peak_bytes_in_use is carried in payload of the occupancy rows
+    occ = df[df["name"] == "hbm_occupancy"]
+    for device_id, rows in occ.groupby("deviceId"):
+        features.add(f"tpu{device_id}_hbm_occupancy_mean", float(rows["event"].mean()))
+        features.add(f"tpu{device_id}_hbm_occupancy_max", float(rows["event"].max()))
+        peak = float(rows["payload"].max())
+        if peak > 0:
+            features.add(f"tpu{device_id}_hbm_peak_gb", peak / 1e9)
+
+
+def memprof_profile(frames, cfg, features: Features) -> None:
+    """HBM attribution: which allocation sites held the occupancy peak.
+
+    Consumes the pprof snapshot collectors/tpumon.py captured when the
+    summed bytes-in-use set its high-water mark (ingest/memprof.py), writes
+    the top-site table to tpu_memprof.csv for the board, and promotes the
+    totals to features.  The reference's memory story ends at one used-MB
+    number per GPU from nvsmi (sofa_record.py:300-310); an allocation-site
+    breakdown is the TPU-native answer to "what do I evict to stop OOMing".
+    """
+    from sofa_tpu.ingest.memprof import aggregate_sites, load_memprof
+
+    df, meta = load_memprof(cfg.logdir)
+    if df is None or df.empty:
+        return
+    buffers = df[df["kind"] == "buffer"]
+    features.add("memprof_held_gb", float(buffers["bytes"].sum()) / 1e9)
+    features.add("memprof_buffers", float(buffers["count"].sum()))
+    features.add("memprof_sites", float(buffers["site"].nunique()))
+    n_dev = buffers.loc[buffers["device"] != "", "device"].nunique()
+    if n_dev:
+        features.add("memprof_devices", float(n_dev))
+    sites = aggregate_sites(df)
+    sites.to_csv(cfg.path("tpu_memprof.csv"), index=False)
+    if meta.get("trigger"):
+        features.add_info("memprof_trigger", meta["trigger"])
+    if not sites.empty:
+        top = sites.iloc[0]
+        features.add_info(
+            "memprof_top_site",
+            f"{top['site']} ({top['bytes'] / 1e9:.2f} GB, "
+            f"{top['share']:.0%})")
+    if cfg.verbose:
+        print_title("Top HBM allocation sites")
+        print(sites.head(10).to_string(index=False))
+
+
+def spotlight_roi(frames, cfg, features: Features) -> None:
+    """Set cfg.roi_begin/roi_end from TensorCore utilization.
+
+    Hysteresis detector ported from the reference's nvsmi SM-util state
+    machine (sofa_analyze.py:875-894): utilization >= high for `up` windows
+    begins the ROI; < low back to 0 ends it.  Manual --profile_region wins.
+    """
+    if cfg.profile_region:
+        try:
+            begin_s, _, end_s = cfg.profile_region.partition(":")
+            cfg.roi_begin = float(begin_s or 0)
+            cfg.roi_end = float(end_s or 0)
+            features.add("roi_begin", cfg.roi_begin)
+            features.add("roi_end", cfg.roi_end)
+            return
+        except ValueError:
+            print_warning(f"bad --profile_region {cfg.profile_region!r}; ignoring")
+    if not cfg.spotlight:
+        return
+    df = frames.get("tpuutil")
+    if df is None or df.empty:
+        return
+    util = df[df["name"] == "tc_util"].sort_values("timestamp")
+    if util.empty:
+        return
+    high, low, up_count = 50.0, 10.0, 3
+    count = 0
+    begin = end = None
+    t_first = float(util["timestamp"].min() - util["duration"].iloc[0])
+    for _, row in util.iterrows():
+        if row["event"] >= high:
+            count += 1
+            if count >= up_count and begin is None:
+                begin = max(row["timestamp"] - row["duration"] * up_count, t_first)
+        elif row["event"] < low:
+            if begin is not None:  # first drop after the ROI began ends it
+                end = row["timestamp"] - row["duration"]
+                break
+            count = 0
+    if begin is not None:
+        if end is None or end <= begin:
+            end = float(util["timestamp"].max())
+        cfg.roi_begin, cfg.roi_end = begin, end
+        features.add("roi_begin", begin)
+        features.add("roi_end", end)
+        print_hint(f"spotlight ROI: {begin:.3f}s .. {end:.3f}s")
+
+
+def serving_profile(frames, cfg, features: Features) -> None:
+    """Prefill/decode phase split for serving (inference) captures.
+
+    No reference analogue — the reference profiles training only.  On TPU
+    the two serving regimes are architecturally different (prefill is
+    MXU/compute-bound, decode re-reads the whole KV cache per token and is
+    HBM-bound), and BASELINE config #4 asks exactly for "inference HLO-op +
+    HBM-bandwidth attribution".  Phases are recognized from XLA module
+    names (jit_run_prefill / jit_run_decode / *generate* — whatever the
+    program jitted, matched case-insensitively), so any serving stack that
+    jits its prefill and decode separately gets the split for free:
+
+      serving_prefill_time / serving_decode_time     device time per phase
+      serving_prefill_intensity / ..._decode_...     flops per HBM byte
+      serving_ttft                                   first prefill span wall
+      serving_decode_calls                           decode dispatches
+
+    plus a memory-bound hint when decode's arithmetic intensity collapses
+    relative to prefill's (the KV-cache-bound signature).
+    """
+    df = frames.get("tputrace")
+    if df is None or df.empty or "module" not in df.columns:
+        return
+    df = roi_clip(df, cfg)  # spotlight ROI excludes warmup/compile ops
+    sync = df[df["category"] == 0]
+    if sync.empty:
+        return
+    mods = sync["module"].astype(str)
+    uniq = [m for m in mods.unique() if m]
+    pre_names = [m for m in uniq if "prefill" in m.lower()]
+    dec_names = [m for m in uniq
+                 if "decode" in m.lower() or "generate" in m.lower()]
+    if not pre_names or not dec_names:
+        return
+
+    def phase(names):
+        sel = sync[mods.isin(names)]
+        dur = float(sel["duration"].sum())
+        flops = float(sel["flops"].sum())
+        nbytes = float(sel["bytes_accessed"].sum())
+        return sel, dur, flops, nbytes
+
+    pre, pre_t, pre_f, pre_b = phase(pre_names)
+    dec, dec_t, dec_f, dec_b = phase(dec_names)
+    if pre_t <= 0 or dec_t <= 0:
+        return
+    features.add("serving_prefill_time", pre_t)
+    features.add("serving_decode_time", dec_t)
+    pre_i = pre_f / pre_b if pre_b > 0 else 0.0
+    dec_i = dec_f / dec_b if dec_b > 0 else 0.0
+    features.add("serving_prefill_intensity", pre_i)
+    features.add("serving_decode_intensity", dec_i)
+    if dec_b > 0:
+        features.add("serving_decode_hbm_gbps", dec_b / dec_t / 1e9)
+    # TTFT proxy: wall span of the FIRST prefill dispatch only — a steady
+    # serving capture has prefills recurring throughout, so spanning all of
+    # them would approximate the whole capture.  The module-launch line
+    # delimits dispatches exactly; without it, fall back to the prefill ops
+    # that precede the first decode op.
+    launches = frames.get("tpumodules")
+    ttft = None
+    if launches is not None and not launches.empty:
+        launches = roi_clip(launches, cfg)
+        lnames = launches["name"].astype(str)
+        pre_launch = launches[lnames.isin(pre_names)] \
+            .sort_values("timestamp")
+        if not pre_launch.empty:
+            ttft = float(pre_launch.iloc[0]["duration"])
+        features.add("serving_decode_calls", int(lnames.isin(
+            dec_names).sum()))
+    if ttft is None:
+        first_dec = float(dec["timestamp"].min())
+        head = pre[pre["timestamp"] < first_dec]
+        if not head.empty:
+            ttft = float((head["timestamp"] + head["duration"]).max()
+                         - head["timestamp"].min())
+    if ttft is not None:
+        features.add("serving_ttft", ttft)
+    if dec_i > 0 and pre_i / max(dec_i, 1e-12) >= 4.0:
+        print_hint(
+            f"serving: decode is HBM-bound ({dec_i:.1f} flops/byte vs "
+            f"prefill {pre_i:.1f}) — KV-cache reads dominate; consider "
+            "larger decode batches, GQA/MQA, or a quantized cache")
+
+
+def _slug(name: str) -> str:
+    return name.strip().lower().replace(" ", "_").replace("-", "_")

@@ -1,0 +1,377 @@
+"""`sofa analyze` — unified CSVs -> features, hints, reports.
+
+Reads the CSVs preprocess wrote (files-on-disk contract, so analyze re-runs
+standalone), executes every analysis pass with per-pass degradation (the
+reference wraps each in try/except IOError, sofa_analyze.py:873-977), prints
+the feature table, emits hints, stages the board GUI, and prints the
+``Complete!!`` sentinel the reference's test matrix greps for
+(test/test.py:68-75, sofa_analyze.py:1055).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List
+
+import pandas as pd
+
+from sofa_tpu.analysis import advice, comm, concurrency, host, tpu
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import read_misc
+from sofa_tpu.printing import print_progress, print_warning
+from sofa_tpu.trace import empty_frame, read_csv
+
+CSV_SOURCES = [
+    "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
+    "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
+    "tpumon", "tpusteps", "customtrace", "blktrace",
+]
+
+_PASSES = [
+    ("spotlight", tpu.spotlight_roi),
+    ("cpu_profile", host.cpu_profile),
+    ("mpstat_profile", host.mpstat_profile),
+    ("vmstat_profile", host.vmstat_profile),
+    ("diskstat_profile", host.diskstat_profile),
+    ("blktrace_latency_profile", host.blktrace_latency_profile),
+    ("strace_profile", host.strace_profile),
+    ("pystacks_profile", host.pystacks_profile),
+    ("netbandwidth_profile", comm.netbandwidth_profile),
+    ("net_profile", comm.net_profile),
+    ("tpu_profile", tpu.tpu_profile),
+    ("op_tree_profile", tpu.op_tree_profile),
+    ("overlap_profile", tpu.overlap_profile),
+    ("step_skew_profile", tpu.step_skew_profile),
+    ("input_pipeline_profile", tpu.input_pipeline_profile),
+    ("roofline_profile", tpu.roofline_profile),
+    ("serving_profile", tpu.serving_profile),
+    ("tpuutil_profile", tpu.tpuutil_profile),
+    ("tpumon_profile", tpu.tpumon_profile),
+    ("memprof_profile", tpu.memprof_profile),
+    ("comm_profile", comm.comm_profile),
+    ("concurrency_breakdown", concurrency.concurrency_breakdown),
+    ("mesh_advice", advice.mesh_advice),
+]
+
+
+def load_frames(cfg: SofaConfig,
+                only: "List[str] | None" = None) -> Dict[str, pd.DataFrame]:
+    """Read trace frames from the logdir; ``only`` restricts to a subset so
+    narrow consumers (sofa export) skip deserializing pod-scale traces they
+    never chart.  Reads overlap on a small thread pool — the arrow CSV and
+    parquet decoders release the GIL, so the 15 small frames hide behind
+    the one pod-scale tputrace."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from sofa_tpu.trace import read_frame
+
+    names = list(only if only is not None else CSV_SOURCES)
+
+    def load_one(name: str) -> pd.DataFrame:
+        try:
+            df = read_frame(cfg.path(name))  # .parquet preferred, else .csv
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"analyze: cannot read {cfg.path(name)}: {e}")
+            df = empty_frame()
+        return df if df is not None else empty_frame()
+
+    if len(names) <= 1:
+        return {n: load_one(n) for n in names}
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        loaded = list(pool.map(load_one, names))
+    return dict(zip(names, loaded))
+
+
+# Frames whose deviceId column is a device/host ordinal that must rebase
+# per host on a cluster merge.  Every other frame's deviceId means a core /
+# lane index; its host identity is the `host` column stamped on every merged
+# frame, plus — for _HOST_SAMPLER_FRAMES only — the repurposed pid column.
+_DEVICE_ID_FRAMES = frozenset(
+    {"tputrace", "tpusteps", "tpumodules", "tpuutil", "hosttrace",
+     "customtrace", "tpumon"})
+
+# Host-sampler frames whose pid column is unused (-1): a cluster merge may
+# repurpose it for the host ordinal.  cputrace/strace/pystacks/blktrace carry
+# the REAL sampled process pid there (perf_script.py:121) and must not be
+# overwritten — their host identity rides the `host` column stamped on every
+# merged frame instead.
+_HOST_SAMPLER_FRAMES = frozenset(
+    {"mpstat", "vmstat", "diskstat", "netbandwidth", "nettrace"})
+
+
+def cluster_host_cfgs(cfg: SofaConfig):
+    """(ordinal, hostname, host_cfg) per configured host — THE one place
+    that knows the per-host logdir naming and ordinal assignment.  The
+    ordinal follows the configured host list (like ingest's
+    device_id_base=host_index*256), so a missing logdir never renumbers
+    the hosts after it."""
+    import copy as _copy
+
+    for i, hostname in enumerate(cfg.cluster_hosts):
+        host_cfg = _copy.deepcopy(cfg)
+        host_cfg.logdir = cfg.logdir.rstrip("/") + f"-{hostname}/"
+        host_cfg.__post_init__()
+        yield i, hostname, host_cfg
+
+
+def cluster_clock_shifts(time_bases: Dict[str, float]):
+    """(cluster zero, per-host shift) from per-host sofa_time bases; a
+    host with no readable time base gets shift 0 and a warning."""
+    known = [tb for tb in time_bases.values() if tb > 0]
+    tb0 = min(known) if known else 0.0
+    shifts = {}
+    for hostname, tb in time_bases.items():
+        if tb > 0:
+            shifts[hostname] = tb - tb0
+        else:
+            print_warning(
+                f"cluster: {hostname} has no sofa_time.txt — its series "
+                "are not clock-aligned on the merged timeline")
+            shifts[hostname] = 0.0
+    return tb0, shifts
+
+
+def load_cluster_frames(cfg: SofaConfig,
+                        only: "List[str] | None" = None
+                        ) -> Dict[str, pd.DataFrame]:
+    """Per-host frames merged onto the cluster clock, for the exporters.
+
+    Same alignment rule as cluster_analyze's merged report.js (earliest
+    host's time base is zero; each host shifts by its clock offset), plus
+    host-ordinal deviceId keying: device rows rebase by +i*256 (each
+    host's logdir was ingested alone with base 0) and host-sampler rows
+    (deviceId -1: mpstat/netbandwidth/...) are stamped with the host's
+    ordinal base so per-host identity survives the merge.
+    """
+    import numpy as np
+
+    from sofa_tpu.preprocess import read_time_base
+
+    merged: Dict[str, List[pd.DataFrame]] = {}
+    time_bases: Dict[str, float] = {}
+    host_frames = []
+    for i, hostname, host_cfg in cluster_host_cfgs(cfg):
+        if not os.path.isdir(host_cfg.logdir):
+            print_warning(f"cluster: missing logdir {host_cfg.logdir}")
+            continue
+        host_frames.append((i, hostname, load_frames(host_cfg, only=only)))
+        time_bases[hostname] = read_time_base(host_cfg)
+    _, shifts = cluster_clock_shifts(time_bases)
+    for i, hostname, frames in host_frames:
+        shift = shifts[hostname]
+        for key, df in frames.items():
+            if df.empty:
+                continue
+            df = df.copy()
+            df["timestamp"] = df["timestamp"] + shift
+            if key in _DEVICE_ID_FRAMES:
+                if i and "deviceId" in df.columns:
+                    dev = df["deviceId"].to_numpy()
+                    # heartbeat/aggregate rows (-1) stay; real ordinals
+                    # rebase to the host's base
+                    df["deviceId"] = np.where(dev >= 0, dev + i * 256, dev)
+            elif key in _HOST_SAMPLER_FRAMES and "pid" in df.columns:
+                # Host-sampler frames use deviceId for the CORE/lane index;
+                # host identity rides the otherwise-unused pid column.
+                # Frames with real sampled pids (cputrace/strace/...) are
+                # left intact — consumers use `host` for identity there.
+                df["pid"] = i
+            df["host"] = i
+            merged.setdefault(key, []).append(df)
+    return {k: pd.concat(v, ignore_index=True) for k, v in merged.items()}
+
+
+def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None) -> Features:
+    if frames is None:
+        frames = load_frames(cfg)
+    features = Features()
+    misc = read_misc(cfg)
+    features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))
+
+    for name, fn in _PASSES:
+        try:
+            fn(frames, cfg, features)
+        except Exception as e:  # noqa: BLE001 — per-pass degradation
+            print_warning(f"analyze pass {name}: {e}")
+
+    if not features.get("num_cores") and misc.get("cores"):
+        features.add("num_cores", int(misc["cores"]))
+
+    extra_series = []
+    if cfg.enable_aisi:
+        try:
+            from sofa_tpu.ml.aisi import iteration_series, sofa_aisi
+
+            iters = sofa_aisi(frames, cfg, features)
+            marker = iteration_series(iters)
+            if marker is not None:
+                extra_series.append(marker)
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"aisi: {e}")
+    if cfg.enable_hsg or cfg.enable_swarms:
+        try:
+            from sofa_tpu.ml.hsg import sofa_hsg, swarm_series
+
+            clustered = sofa_hsg(frames, cfg, features)
+            extra_series.extend(swarm_series(clustered, cfg.num_swarms))
+        except Exception as e:  # noqa: BLE001
+            print_warning(f"hsg: {e}")
+    if extra_series:
+        try:
+            _append_report_series(cfg, extra_series)
+        except Exception as e:  # noqa: BLE001 — report.js is not worth aborting for
+            print_warning(f"cannot merge analysis series into report.js: {e}")
+
+    print(features.render())
+    features.save(cfg.path("features.csv"))
+
+    # Remote advice service, when configured or discoverable from the
+    # environment ($SOFA_HINT_SERVER — the POTATO autodiscovery analogue).
+    try:
+        from sofa_tpu.analysis.hint_service import discover_server, request_hints
+
+        server = discover_server(cfg)
+        if server:
+            from sofa_tpu.printing import print_hint
+
+            for hint in request_hints(server, features):
+                print_hint(f"[remote] {hint}")
+    except Exception as e:  # noqa: BLE001
+        print_warning(f"hint server: {e}")
+    advice.hint_report(features, cfg)
+
+    stage_board(cfg)
+    print("Complete!!")
+    return features
+
+
+def _append_report_series(cfg: SofaConfig, series) -> None:
+    """Merge analysis-derived series (iteration markers, swarms) into the
+    report.js preprocess wrote (reference injects these in traces_to_json,
+    sofa_aisi.py:318-345 and sofa_ml.py:289-309)."""
+    import json
+
+    path = cfg.path("report.js")
+    doc = {"series": [], "meta": {}}
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                text = f.read()
+            doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+        except (ValueError, OSError) as e:
+            # Never rewrite a file we could not parse — that would replace
+            # every preprocess-written series with just ours.
+            print_warning(f"cannot merge into report.js (leaving it untouched): {e}")
+            return
+    replace = {s.name for s in series}
+    doc["series"] = [s for s in doc["series"] if s["name"] not in replace]
+    for s in series:
+        doc["series"].append(
+            {
+                "name": s.name,
+                "title": s.title,
+                "color": s.color,
+                "kind": s.kind,
+                "data": s.to_points(cfg.viz_downsample_to),
+            }
+        )
+    from sofa_tpu.trace import write_report_js_doc
+
+    write_report_js_doc(doc, path)
+
+
+def stage_board(cfg: SofaConfig) -> None:
+    """Copy the board GUI beside the data (reference sofa_analyze.py:1050-1052)."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "board")
+    if not os.path.isdir(src):
+        return
+    os.makedirs(cfg.logdir, exist_ok=True)  # diff may stage before any CSV
+    for name in os.listdir(src):
+        shutil.copy2(os.path.join(src, name), cfg.path(name))
+
+
+def cluster_analyze(
+    cfg: SofaConfig,
+    preloaded: "Dict[str, Dict[str, pd.DataFrame]] | None" = None,
+) -> Dict[str, Features]:
+    """Multi-host report: per-host analysis + ONE merged cross-host timeline.
+
+    Reference cluster_analyze (sofa_analyze.py:1057-1137) only aggregated
+    per-host feature tables; here each host's series are additionally shifted
+    onto a common clock (offset = that host's sofa_time.txt time base minus
+    the earliest host's) and written as a single merged report.js in the top
+    logdir, plus the DCN-traffic-vs-step correlation per host (BASELINE
+    config #5's question).
+
+    ``preloaded`` maps hostname -> frames dict for hosts whose preprocess
+    just ran in this process (the report path hands them through so the
+    pod-scale CSVs written a moment ago aren't re-deserialized).
+    """
+    from sofa_tpu.analysis.comm import dcn_step_correlation
+    from sofa_tpu.preprocess import build_series, read_time_base
+    from sofa_tpu.trace import series_to_report_js
+
+    results: Dict[str, Features] = {}
+    rows = []
+    merged_series = []
+    host_frames: Dict[str, Dict[str, pd.DataFrame]] = {}
+    time_bases: Dict[str, float] = {}
+    host_cfgs: Dict[str, SofaConfig] = {}
+    for _i, hostname, host_cfg in cluster_host_cfgs(cfg):
+        if not os.path.isdir(host_cfg.logdir):
+            print_warning(f"cluster: missing logdir {host_cfg.logdir}")
+            continue
+        print_progress(f"cluster: analyzing {hostname}")
+        host_cfgs[hostname] = host_cfg
+        host_frames[hostname] = (
+            preloaded[hostname] if preloaded and hostname in preloaded
+            else load_frames(host_cfg))
+        results[hostname] = sofa_analyze(host_cfg, host_frames[hostname])
+        time_bases[hostname] = read_time_base(host_cfg)
+        row = {"host": hostname}
+        for key in ("elapsed_time", "cpu_util", "tpu0_op_time", "comm_ratio",
+                    "net_tx_total_bytes", "net_rx_total_bytes", "tc_util_mean"):
+            value = results[hostname].get(key)
+            if value is not None:
+                row[key] = value
+        corr = dcn_step_correlation(host_frames[hostname])
+        if corr is not None:
+            row["dcn_step_corr"] = round(corr, 4)
+        rows.append(row)
+
+    if host_frames:
+        # Merged timeline: earliest host's time base is the cluster zero;
+        # every other host's series shift right by its clock offset.  A host
+        # whose sofa_time.txt is missing reads 0.0 — excluding it from the
+        # zero keeps one broken fetch from shifting every healthy host by
+        # an epoch.
+        tb0, shifts = cluster_clock_shifts(time_bases)
+        for hostname, frames in host_frames.items():
+            shift = shifts[hostname]
+            host_cfg = host_cfgs[hostname]
+            for s in build_series(host_cfg, frames):
+                data = s.data.copy()
+                data["timestamp"] = data["timestamp"] + shift
+                s.data = data
+                s.name = f"{hostname}_{s.name}"
+                s.title = f"[{hostname}] {s.title}"
+                merged_series.append(s)
+        os.makedirs(cfg.logdir, exist_ok=True)
+        series_to_report_js(
+            merged_series, cfg.path("report.js"), cfg.viz_downsample_to,
+            {"cluster_hosts": list(host_frames), "time_base": tb0},
+        )
+        stage_board(cfg)
+        print_progress(
+            f"cluster: merged timeline of {len(host_frames)} hosts "
+            f"({len(merged_series)} series) -> {cfg.path('report.js')}")
+
+    if rows:
+        summary = pd.DataFrame(rows)
+        os.makedirs(cfg.logdir, exist_ok=True)
+        summary.to_csv(cfg.path("cluster_summary.csv"), index=False)
+        print_progress("cluster summary:")
+        print(summary.to_string(index=False))
+    return results

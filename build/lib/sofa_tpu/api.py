@@ -1,0 +1,135 @@
+"""Programmatic, in-process profiling API.
+
+For users who own the Python process (the common JAX case) and do not want
+the wrap-a-command CLI:
+
+    import sofa_tpu.api as sofa
+
+    with sofa.profile("sofalog/"):
+        train_step(...)  # any JAX work
+
+    # then: sofa report --logdir sofalog/
+
+This records the same artifact set as `sofa record` minus the process-level
+wrappers (perf/strace prefixes do not apply in-process).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+from sofa_tpu.config import SofaConfig
+
+
+@contextlib.contextmanager
+def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
+    import jax
+
+    if cfg is None:
+        cfg = SofaConfig(logdir=logdir)
+    else:
+        cfg.logdir = logdir
+        cfg.__post_init__()
+    os.makedirs(cfg.logdir, exist_ok=True)
+
+    from sofa_tpu.collectors.procmon import ProcMonCollector
+    from sofa_tpu.collectors.timebase import TimebaseCollector
+    from sofa_tpu.collectors.tpumon import start_sampler
+
+    timebase = TimebaseCollector(cfg)
+    procmon = ProcMonCollector(cfg)
+    timebase.start()
+    if procmon.probe() is None:
+        procmon.start()
+    memprof_path = cfg.path("memprof.pb.gz") if cfg.enable_mem_prof else None
+    # Drop the previous run's snapshot: the finally-block fallback keys on
+    # file existence, and a stale profile would masquerade as this run's.
+    for stale in (cfg.path("memprof.pb.gz"),
+                  cfg.path("memprof.pb.gz.meta.json")):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    tpumon_stop = None
+    tpumon_thread = None
+    if cfg.enable_tpu_mon:
+        import threading
+
+        try:  # the sampler appends; drop any previous run's samples
+            os.unlink(cfg.path("tpumon.txt"))
+        except OSError:
+            pass
+        tpumon_stop = threading.Event()
+        tpumon_thread = start_sampler(
+            cfg.tpu_mon_rate, cfg.path("tpumon.txt"), tpumon_stop,
+            memprof_path=memprof_path)
+
+    kwargs = {}
+    try:
+        po = jax.profiler.ProfileOptions()
+        po.host_tracer_level = int(cfg.xprof_host_tracer_level)
+        po.python_tracer_level = 1 if cfg.xprof_python_tracer else 0
+        kwargs["profiler_options"] = po
+    except Exception:
+        pass
+    jax.profiler.start_trace(cfg.xprof_dir, **kwargs)
+    t0 = time.time_ns()
+    with jax.profiler.TraceAnnotation(f"sofa_timebase_marker:{t0}"):
+        t1 = time.time_ns()
+    with open(cfg.path("xprof_marker.txt"), "w") as f:
+        f.write(f"{t0} {t1}\n")
+    _snapshot_topology(jax, cfg.logdir)
+
+    start = time.time()
+    try:
+        yield cfg
+    finally:
+        jax.profiler.stop_trace()
+        if tpumon_stop is not None:
+            tpumon_stop.set()
+            # Join so the sampler's last tick can't publish a snapshot
+            # after the exists-check below decides a fallback is needed
+            # (tmp names are writer-unique, so corruption is impossible —
+            # this is about which snapshot wins).
+            tpumon_thread.join(timeout=2.0)
+        if memprof_path and not os.path.exists(memprof_path):
+            # Sampler off or the growth gate never fired: final snapshot so
+            # the allocation-site table exists for every profiled run.
+            from sofa_tpu.collectors.tpumon import snapshot_memprof
+
+            snapshot_memprof(jax, memprof_path, "final", 0)
+        procmon.stop()
+        timebase.stop()  # end-of-run anchor enables the drift fit at ingest
+        elapsed = time.time() - start
+        with open(cfg.path("misc.txt"), "w") as f:
+            f.write(f"elapsed_time {elapsed:.6f}\n")
+            f.write(f"cores {os.cpu_count() or 1}\n")
+            f.write(f"pid {os.getpid()}\n")
+            f.write("rc 0\n")
+
+
+def _snapshot_topology(jax, logdir: str) -> None:
+    devs = [
+        {
+            "id": d.id,
+            "process_index": d.process_index,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", ""),
+            "coords": list(getattr(d, "coords", []) or []),
+            "core_on_chip": getattr(d, "core_on_chip", -1),
+        }
+        for d in jax.devices()
+    ]
+    info = {
+        "platform": jax.default_backend(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "devices": devs,
+    }
+    with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+        json.dump(info, f, indent=1)

@@ -1,0 +1,348 @@
+"""The ``sofa`` command line.
+
+Eight subcommands with the same verbs and composition rules as the reference
+CLI (/root/reference/bin/sofa:328-376):
+
+  record "cmd"      collect raw traces into logdir
+  preprocess        raw files -> unified-schema CSVs + report.js
+  analyze           CSVs -> features, hints, reports
+  viz               serve the board GUI over logdir
+  report            [preprocess] + analyze [+ --with-gui viz]
+  stat "cmd"        record + preprocess + analyze
+  diff              preprocess base/match logdirs + swarm diff
+  export            static sofa_report.pdf/overview.png for headless sharing
+  top               live terminal dashboard over a running recording
+  clean             remove derived files, keep raw collector output
+  setup             host-enablement doctor (sysctls, tool caps) — replaces
+                    the reference's empower.py / enable_strace_perf_pcm.py
+
+Flags are declared once and materialized onto a SofaConfig dataclass
+(sofa_tpu/config.py) rather than the reference's field-by-field copy
+(bin/sofa:159-326).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from sofa_tpu import __version__
+from sofa_tpu.config import Filter, SofaConfig
+from sofa_tpu.plugins import load_plugins
+from sofa_tpu import printing
+from sofa_tpu.printing import print_error, print_main_progress
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Every optional flag defaults to argparse.SUPPRESS: an attribute exists on
+    # the parsed namespace ONLY if the user actually typed the flag.  Config
+    # resolution is then a clean two-layer overlay — SofaConfig defaults (or
+    # the TOML file) below, explicitly-typed CLI flags on top — with no
+    # "flag set to its default value" ambiguity.
+    S = argparse.SUPPRESS
+    p = argparse.ArgumentParser(
+        prog="sofa",
+        argument_default=S,
+        description="sofa_tpu: TPU-native cross-layer profiler "
+        "(record / preprocess / analyze / viz).",
+    )
+    p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
+    p.add_argument("command", choices=[
+        "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
+        "export", "top", "clean", "setup",
+    ])
+    p.add_argument("usr_command", nargs="?", default="", help="command to profile (record/stat)")
+
+    g = p.add_argument_group("pipeline")
+    g.add_argument("--logdir")
+    g.add_argument("--config", default=None, help="TOML config file; explicit CLI flags override it")
+    g.add_argument("--verbose", action="store_true")
+    g.add_argument("--skip_preprocess", action="store_true")
+    g.add_argument("--with-gui", dest="with_gui", action="store_true", default=False,
+                   help="serve the board after `report`")
+    g.add_argument("--perfetto", action="store_true", default=False,
+                   help="`export` also writes trace.json.gz "
+                        "(Trace Event Format, opens in ui.perfetto.dev)")
+    g.add_argument("--folded", action="store_true", default=False,
+                   help="`export` also writes *.folded collapsed stacks "
+                        "(speedscope.app / flamegraph.pl)")
+    g.add_argument("--interval", type=float, default=2.0,
+                   help="`top` refresh period in seconds")
+    g.add_argument("--once", action="store_true", default=False,
+                   help="`top` renders one frame and exits")
+
+    g = p.add_argument_group("record: host")
+    g.add_argument("--perf_events")
+    g.add_argument("--no-perf-events", dest="no_perf_events", action="store_true")
+    g.add_argument("--cpu_sample_rate", type=int)
+    g.add_argument("--perf_call_graph", choices=["off", "fp", "dwarf"])
+    g.add_argument("--sys_mon_rate", type=int)
+    g.add_argument("--enable_strace", action="store_true")
+    g.add_argument("--strace_min_time", type=float)
+    g.add_argument("--enable_py_stacks", action="store_true")
+    g.add_argument("--enable_tcpdump", action="store_true")
+    g.add_argument("--netstat_interface")
+    g.add_argument("--blkdev")
+    g.add_argument("--pid", type=int, help="attach to a running pid instead of launching")
+
+    g = p.add_argument_group("record: tpu")
+    g.add_argument("--disable_xprof", action="store_true")
+    g.add_argument("--xprof_host_tracer_level", type=int)
+    g.add_argument("--xprof_python_tracer", action="store_true")
+    g.add_argument("--xprof_delay_s", type=float)
+    g.add_argument("--xprof_duration_s", type=float)
+    g.add_argument("--tpu_mon_rate", type=int)
+    g.add_argument("--disable_tpu_mon", action="store_true")
+    g.add_argument("--disable_memprof", action="store_true",
+                   help="skip the peak-HBM allocation-site snapshot")
+
+    g = p.add_argument_group("preprocess")
+    g.add_argument("--cpu_time_offset_ms", type=int)
+    g.add_argument("--tpu_time_offset_ms", type=float,
+                   help="shift device/XPlane timestamps by this many ms when "
+                        "automatic marker/timebase alignment is wrong")
+    g.add_argument("--viz_downsample_to", type=int)
+    g.add_argument("--trace_format", choices=["csv", "parquet"],
+                   help="columnar parquet keeps pod-scale op traces small")
+    g.add_argument("--network_filters", help="comma-joined ip filters")
+    g.add_argument("--cpu_filters", help="comma-joined keyword:color specs")
+    g.add_argument("--tpu_filters", help="comma-joined keyword:color specs")
+
+    g = p.add_argument_group("analyze")
+    g.add_argument("--num_iterations", type=int)
+    g.add_argument("--num_swarms", type=int)
+    g.add_argument("--enable_aisi", action="store_true")
+    g.add_argument("--enable_hsg", action="store_true")
+    g.add_argument("--enable_swarms", action="store_true")
+    g.add_argument("--is_idle_threshold", type=float)
+    g.add_argument("--profile_region", help='manual ROI "begin:end" seconds')
+    g.add_argument("--spotlight", action="store_true", help="auto-ROI from TPU utilization")
+    g.add_argument("--hint_server", help="gRPC advice service host:port")
+    g.add_argument("--iterations_from",
+                   choices=["auto", "steps", "marker", "module", "op"])
+
+    g = p.add_argument_group("diff")
+    g.add_argument("--base_logdir")
+    g.add_argument("--match_logdir")
+
+    g = p.add_argument_group("viz")
+    g.add_argument("--viz_port", type=int)
+    g.add_argument("--viz_bind", help='bind address (default 127.0.0.1; '
+                                      'use 0.0.0.0 to serve remotely)')
+
+    g = p.add_argument_group("cluster")
+    g.add_argument("--cluster_hosts", help="comma-joined host list for multi-host runs")
+
+    g = p.add_argument_group("setup")
+    g.add_argument("--apply", action="store_true", default=False,
+                   help="setup: run the fix commands instead of printing them")
+    g.add_argument("--empower", action="append", dest="empower", default=None,
+                   help="setup: utility to grant profiling capabilities "
+                        "(e.g. --empower tcpdump); repeatable")
+
+    p.add_argument("--plugin", action="append", dest="plugins",
+                   help="module[:func] called with the config at startup")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> SofaConfig:
+    cfg = SofaConfig.from_toml(args.config) if args.config else SofaConfig()
+    passed = vars(args)
+
+    def was_set(name: str) -> bool:
+        return name in passed
+
+    # Flags that map 1:1 onto SofaConfig fields.
+    for name in (
+        "logdir", "verbose", "skip_preprocess",
+        "perf_events", "no_perf_events", "cpu_sample_rate", "perf_call_graph",
+        "sys_mon_rate",
+        "enable_strace", "strace_min_time", "enable_py_stacks", "enable_tcpdump",
+        "netstat_interface", "blkdev", "pid",
+        "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
+        "xprof_duration_s", "tpu_mon_rate",
+        "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
+        "trace_format",
+        "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",
+        "enable_swarms", "is_idle_threshold", "profile_region", "spotlight",
+        "hint_server", "iterations_from",
+        "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
+    ):
+        if was_set(name):
+            setattr(cfg, name, passed[name])
+    if was_set("disable_xprof"):
+        cfg.enable_xprof = not passed["disable_xprof"]
+    if was_set("disable_tpu_mon"):
+        cfg.enable_tpu_mon = not passed["disable_tpu_mon"]
+    if was_set("disable_memprof"):
+        cfg.enable_mem_prof = not passed["disable_memprof"]
+    if was_set("network_filters"):
+        cfg.network_filters = [s for s in passed["network_filters"].split(",") if s]
+    if was_set("cpu_filters"):
+        cfg.cpu_filters = [Filter.parse(s) for s in passed["cpu_filters"].split(",") if s]
+    if was_set("tpu_filters"):
+        cfg.tpu_filters = [Filter.parse(s) for s in passed["tpu_filters"].split(",") if s]
+    if was_set("cluster_hosts"):
+        cfg.cluster_hosts = [s for s in passed["cluster_hosts"].split(",") if s]
+    if args.usr_command:
+        cfg.command = args.usr_command
+    cfg.__post_init__()
+    return cfg
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = config_from_args(args)
+    except (ValueError, OSError) as e:
+        print_error(f"bad configuration: {e}")
+        return 2
+    printing.verbose = cfg.verbose
+    load_plugins(cfg)
+
+    cmd = args.command
+    try:
+        if cmd == "record":
+            if not cfg.command and cfg.pid is None:
+                print_error('record needs a command: sofa record "python train.py"')
+                return 2
+            from sofa_tpu.record import cluster_record, sofa_record
+            print_main_progress("SOFA record")
+            if cfg.cluster_hosts:
+                return cluster_record(cfg.command, cfg)
+            return sofa_record(cfg.command, cfg)
+        if cmd == "preprocess":
+            from sofa_tpu.preprocess import sofa_preprocess
+            print_main_progress("SOFA preprocess")
+            sofa_preprocess(cfg)
+            return 0
+        if cmd == "analyze":
+            from sofa_tpu.analyze import sofa_analyze
+            print_main_progress("SOFA analyze")
+            sofa_analyze(cfg)
+            return 0
+        if cmd == "report":
+            from sofa_tpu.analyze import sofa_analyze, cluster_analyze
+            from sofa_tpu.preprocess import sofa_preprocess
+            print_main_progress("SOFA report")
+            if cfg.cluster_hosts:
+                from sofa_tpu.analyze import cluster_host_cfgs
+                preloaded = {}
+                for _i, host, host_cfg in cluster_host_cfgs(cfg):
+                    if not cfg.skip_preprocess and \
+                            os.path.isdir(host_cfg.logdir):
+                        preloaded[host] = sofa_preprocess(host_cfg)
+                cluster_analyze(cfg, preloaded=preloaded or None)
+            else:
+                # hand the preprocessed frames straight to analyze — at pod
+                # scale re-reading the CSVs written one line earlier costs
+                # ~25% of the whole report wall-time
+                frames = (sofa_preprocess(cfg)
+                          if not cfg.skip_preprocess else None)
+                sofa_analyze(cfg, frames=frames)
+                frames = None  # don't pin pod-scale frames under the GUI
+            if args.with_gui:
+                from sofa_tpu.viz import sofa_viz
+                sofa_viz(cfg)
+            return 0
+        if cmd == "export":
+            from sofa_tpu.export_static import STATIC_FRAMES, export_static
+            print_main_progress("SOFA export")
+            wanted = set(STATIC_FRAMES)
+            if args.perfetto:
+                from sofa_tpu.export_perfetto import (
+                    PERFETTO_FRAMES, export_perfetto)
+                wanted |= set(PERFETTO_FRAMES)
+            if args.folded:
+                from sofa_tpu.export_folded import (
+                    FOLDED_FRAMES, export_folded)
+                wanted |= set(FOLDED_FRAMES)
+            if args.perfetto or args.folded or cfg.cluster_hosts:
+                # One deserialization pass for every exporter — tputrace is
+                # the pod-scale frame; reading it twice is real money.
+                # --cluster_hosts merges every host's frames onto the
+                # cluster clock first, so one trace/PDF spans the pod.
+                from sofa_tpu.analyze import load_cluster_frames, load_frames
+                frames = (load_cluster_frames(cfg, only=sorted(wanted))
+                          if cfg.cluster_hosts
+                          else load_frames(cfg, only=sorted(wanted)))
+                # Exit contract: an EXPLICITLY flagged artifact failing is
+                # an error; the implicit static charts contribute success
+                # but (e.g. matplotlib not installed) must not fail a run
+                # whose requested artifacts all landed.  Folded stacks stay
+                # soft — legitimately absent when no stack sampler ran.
+                wrote_any = bool(export_static(cfg, frames))
+                failed_explicit = False
+                if args.perfetto:
+                    p_ok = bool(export_perfetto(cfg, frames))
+                    wrote_any |= p_ok
+                    failed_explicit |= not p_ok
+                if args.folded:
+                    wrote_any |= bool(export_folded(cfg, frames))
+                return 0 if wrote_any and not failed_explicit else 1
+            return 0 if export_static(cfg) else 1
+        if cmd == "top":
+            from sofa_tpu.top import sofa_top
+            return sofa_top(cfg, interval=args.interval, once=args.once)
+        if cmd == "stat":
+            if not cfg.command:
+                print_error('stat needs a command: sofa stat "python train.py"')
+                return 2
+            from sofa_tpu.analyze import sofa_analyze
+            from sofa_tpu.preprocess import sofa_preprocess
+            from sofa_tpu.record import sofa_record
+            print_main_progress("SOFA stat = record + preprocess + analyze")
+            rc = sofa_record(cfg.command, cfg)
+            # A failed workload still leaves traces worth analyzing; report
+            # anyway but surface the child's rc as our exit status.
+            sofa_analyze(cfg, frames=sofa_preprocess(cfg))
+            return rc
+        if cmd == "diff":
+            if not (cfg.base_logdir and cfg.match_logdir):
+                print_error("diff needs --base_logdir and --match_logdir")
+                return 2
+            import copy
+            from sofa_tpu.analysis.features import Features
+            from sofa_tpu.ml.diff import (
+                sofa_mem_diff,
+                sofa_swarm_diff,
+                sofa_tpu_diff,
+            )
+            from sofa_tpu.ml.hsg import sofa_hsg
+            from sofa_tpu.preprocess import sofa_preprocess
+            print_main_progress("SOFA diff")
+            for d in (cfg.base_logdir, cfg.match_logdir):
+                c = copy.deepcopy(cfg)
+                c.logdir = d
+                c.__post_init__()
+                frames = sofa_preprocess(c)
+                sofa_hsg(frames, c, Features())  # writes auto_caption.csv
+            sofa_swarm_diff(cfg)
+            sofa_tpu_diff(cfg)
+            sofa_mem_diff(cfg)
+            from sofa_tpu.analyze import stage_board
+            stage_board(cfg)  # `sofa viz --logdir <diff dir>` -> Diff page
+            return 0
+        if cmd == "viz":
+            from sofa_tpu.viz import sofa_viz
+            print_main_progress("SOFA viz")
+            sofa_viz(cfg)
+            return 0
+        if cmd == "clean":
+            from sofa_tpu.record import sofa_clean
+            sofa_clean(cfg)
+            return 0
+        if cmd == "setup":
+            from sofa_tpu.setup_env import sofa_setup
+            print_main_progress("SOFA setup")
+            return sofa_setup(utilities=args.empower, apply=args.apply)
+    except KeyboardInterrupt:
+        print_error("interrupted")
+        return 130
+    print_error(f"unknown command {cmd!r}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

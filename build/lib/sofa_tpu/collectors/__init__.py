@@ -1,0 +1,12 @@
+"""Collector classes for `sofa record`.
+
+The reference implements collection as one 370-line function full of Popen
+handles and daemon threads (/root/reference/bin/sofa_record.py:150-524).
+Here every source is a Collector with a uniform probe/start/stop/harvest
+lifecycle plus two composition hooks — a command prefix (strace-style) and
+child-environment injection (the JAX profiler hook) — so record.py is a thin
+orchestrator and each collector degrades independently when its tool or
+hardware is absent (SURVEY §1 "graceful degradation everywhere").
+"""
+
+from sofa_tpu.collectors.base import Collector, CollectorState  # noqa: F401

@@ -1,0 +1,108 @@
+"""Collector lifecycle.
+
+A collector moves through: probe() -> start() -> [child runs] -> stop() ->
+harvest().  All steps are best-effort: a probe failure downgrades the
+collector to a no-op with a console warning, never an error — profiling must
+work on machines missing any subset of tools (the reference probes with
+`command -v` for the same reason, sofa_record.py:217-223,249,264,300).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import shutil
+import signal
+import subprocess
+from typing import Dict, List, Optional
+
+from sofa_tpu.printing import print_info, print_warning
+
+
+class CollectorState(enum.Enum):
+    IDLE = "idle"
+    RUNNING = "running"
+    STOPPED = "stopped"
+    UNAVAILABLE = "unavailable"
+
+
+class Collector:
+    """Base collector; subclasses override the hooks they need."""
+
+    name = "collector"
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.state = CollectorState.IDLE
+
+    # -- lifecycle ---------------------------------------------------------
+    def probe(self) -> Optional[str]:
+        """Return None if usable, else a human-readable reason it is not."""
+        return None
+
+    def start(self) -> None:
+        """Begin collection (background process / thread / file setup)."""
+
+    def stop(self) -> None:
+        """End collection and flush output files."""
+
+    def harvest(self) -> None:
+        """Post-run transformation of raw output (e.g. blkparse)."""
+
+    # -- composition hooks -------------------------------------------------
+    def command_prefix(self) -> List[str]:
+        """Tokens to prepend to the profiled command (e.g. strace ...)."""
+        return []
+
+    def child_env(self) -> Dict[str, str]:
+        """Environment variables to inject into the profiled command."""
+        return {}
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def which(tool: str) -> Optional[str]:
+        return shutil.which(tool)
+
+    def unavailable(self, reason: str) -> None:
+        self.state = CollectorState.UNAVAILABLE
+        print_warning(f"{self.name}: {reason} — skipping this collector")
+
+
+class ProcessCollector(Collector):
+    """A collector backed by one background process."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.proc: Optional[subprocess.Popen] = None
+
+    def launch(self, argv, **popen_kwargs) -> None:
+        print_info(f"{self.name}: {' '.join(argv)}")
+        self.proc = subprocess.Popen(argv, **popen_kwargs)
+        self.state = CollectorState.RUNNING
+
+    def stop(self, sig=signal.SIGTERM, timeout: float = 5.0) -> None:
+        if self.proc is None:
+            return
+        try:
+            if self.proc.poll() is None:
+                self.proc.send_signal(sig)
+                try:
+                    self.proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    print_warning(f"{self.name}: did not exit on signal; killing")
+                    self.proc.kill()
+                    self.proc.wait(timeout=timeout)
+        except ProcessLookupError:
+            pass
+        self.state = CollectorState.STOPPED
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+
+
+def ensure_logdir(path: str) -> None:
+    os.makedirs(path, exist_ok=True)

@@ -1,0 +1,100 @@
+"""Small host-process collectors: vmstat, tcpdump, blktrace, strace.
+
+Each is the direct analogue of a reference collector
+(/root/reference/bin/sofa_record.py:249-255,291-298,336-337,440-446) with
+probe-based degradation."""
+
+from __future__ import annotations
+
+import subprocess
+from typing import List, Optional
+
+from sofa_tpu.collectors.base import Collector, ProcessCollector
+from sofa_tpu.printing import print_warning
+
+
+class VmstatCollector(ProcessCollector):
+    name = "vmstat"
+
+    def probe(self) -> Optional[str]:
+        if not self.cfg.enable_vmstat:
+            return "disabled"
+        if self.which("vmstat") is None:
+            return "vmstat not installed"
+        return None
+
+    def start(self) -> None:
+        self._out = open(self.cfg.path("vmstat.txt"), "w")
+        self.launch(["vmstat", "-w", "-t", "1"], stdout=self._out,
+                    stderr=subprocess.DEVNULL)
+
+    def stop(self, **kwargs) -> None:
+        super().stop(**kwargs)
+        if getattr(self, "_out", None):
+            self._out.close()
+
+
+class TcpdumpCollector(ProcessCollector):
+    name = "tcpdump"
+
+    def probe(self) -> Optional[str]:
+        if not self.cfg.enable_tcpdump:
+            return "disabled (enable with --enable_tcpdump)"
+        if self.which("tcpdump") is None:
+            return "tcpdump not installed"
+        return None
+
+    def start(self) -> None:
+        self.launch(
+            ["tcpdump", "-i", "any", "-w", self.cfg.path("sofa.pcap"),
+             "-s", "96"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+
+class BlktraceCollector(ProcessCollector):
+    name = "blktrace"
+
+    def probe(self) -> Optional[str]:
+        if not self.cfg.blkdev:
+            return "disabled (enable with --blkdev <dev>)"
+        if self.which("blktrace") is None:
+            return "blktrace not installed"
+        return None
+
+    def start(self) -> None:
+        self.launch(
+            ["blktrace", f"--dev={self.cfg.blkdev}",
+             "-D", self.cfg.logdir, "-o", "blktrace"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def harvest(self) -> None:
+        if self.which("blkparse") is None:
+            print_warning("blktrace: blkparse missing; leaving raw trace")
+            return
+        try:
+            with open(self.cfg.path("blktrace.txt"), "w") as out:
+                subprocess.run(
+                    ["blkparse", "-i", self.cfg.path("blktrace")],
+                    stdout=out, stderr=subprocess.DEVNULL, timeout=120,
+                )
+        except (subprocess.SubprocessError, OSError) as e:
+            print_warning(f"blktrace: blkparse failed: {e}")
+
+
+class StraceCollector(Collector):
+    name = "strace"
+
+    def probe(self) -> Optional[str]:
+        if not self.cfg.enable_strace:
+            return "disabled (enable with --enable_strace)"
+        if self.which("strace") is None:
+            return "strace not installed"
+        return None
+
+    def command_prefix(self) -> List[str]:
+        return [
+            "strace", "-q", "-T", "-tt", "-f",
+            "-o", self.cfg.path("strace.txt"),
+        ]

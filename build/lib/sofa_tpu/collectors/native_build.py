@@ -1,0 +1,65 @@
+"""Lazy build of the native helpers.
+
+The reference compiles its C++ timebase helper with g++ at record time
+(/root/reference/bin/sofa_record.py:179); we do the same for timebase and
+sysmon, caching the binaries beside their sources, with a pure-Python
+fallback path when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from sofa_tpu.printing import print_info, print_warning
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+# Tools whose build already failed in this process: retrying g++ per call
+# would cost up to the full build timeout per ingested file.
+_FAILED: set = set()
+
+# Link flags per tool (appended after the source so ld resolves symbols).
+_EXTRA_FLAGS = {"perfetto_write": ["-lz"]}
+
+
+def ensure_built(tool: str) -> Optional[str]:
+    """Return the path of a native helper, building it if needed.
+
+    The compile goes to a per-process temp name and lands via atomic
+    os.replace, so concurrent builders (pool workers after a parent build
+    timeout) can never hand each other a half-written binary.
+    """
+    binary = os.path.join(NATIVE_DIR, tool)
+    source = binary + ".cc"
+    if os.path.isfile(binary) and os.access(binary, os.X_OK):
+        src_mtime = os.path.getmtime(source) if os.path.isfile(source) else 0
+        if os.path.getmtime(binary) >= src_mtime:
+            return binary
+    if tool in _FAILED or not os.path.isfile(source):
+        return None
+    gxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if gxx is None:
+        _FAILED.add(tool)
+        print_warning(f"native {tool}: no C++ compiler; using Python fallback")
+        return None
+    tmp = f"{binary}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            [gxx, "-O2", "-o", tmp, source] + _EXTRA_FLAGS.get(tool, []),
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, binary)
+        print_info(f"native {tool}: built with {gxx}")
+        return binary
+    except (subprocess.SubprocessError, OSError) as e:
+        _FAILED.add(tool)
+        print_warning(f"native {tool}: build failed ({e}); using Python fallback")
+        return None
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

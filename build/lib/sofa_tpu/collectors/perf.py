@@ -1,0 +1,131 @@
+"""CPU sampling via `perf record`.
+
+Wraps the profiled command as `perf record -o logdir/perf.data -F rate
+[-e events] -- <cmd>` (reference: sofa_record.py:339-354).  When perf is
+missing or gated by kernel sysctls the collector degrades to a
+/usr/bin/time -v wrapper (reference fallback, sofa_record.py:401-405) and the
+CPU timeline is reconstructed from procmon's per-core counters instead.
+
+The reference hard-exits when kptr_restrict/perf_event_paranoid are too
+strict (sofa_record.py:188-199); we degrade with the exact sysctl command in
+the warning instead — profiling should never refuse to run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from sofa_tpu.collectors.base import Collector
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _count_events(spec: str) -> int:
+    """TOP-LEVEL events in a perf -e list: commas inside raw PMU
+    descriptors (cpu/event=0x3c,umask=0x1/) or {group} syntax separate
+    parameters, not events."""
+    n, depth, in_pmu = 1, 0, False
+    for ch in spec:
+        if ch == "/":
+            in_pmu = not in_pmu
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(depth - 1, 0)
+        elif ch == "," and depth == 0 and not in_pmu:
+            n += 1
+    return n
+
+
+class PerfCollector(Collector):
+    name = "perf"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.mode = "off"  # off | perf | time
+
+    def probe(self) -> Optional[str]:
+        # A degraded perf is still a usable collector (time -v fallback), so
+        # fallback paths warn here and return None; only "no fallback either"
+        # reports unavailable.
+        from sofa_tpu.printing import print_warning
+
+        self.mode = "perf"
+        if self.cfg.no_perf_events:
+            self.mode = "time"
+        elif self.which("perf") is None:
+            self.mode = "time"
+            print_warning("perf: not installed — falling back to /usr/bin/time -v")
+        else:
+            paranoid = _read_int("/proc/sys/kernel/perf_event_paranoid")
+            if paranoid is not None and paranoid > 1 and os.geteuid() != 0:
+                self.mode = "time"
+                print_warning(
+                    f"perf: perf_event_paranoid={paranoid}; run "
+                    "`sudo sysctl -w kernel.perf_event_paranoid=-1` to enable "
+                    "perf sampling — falling back to /usr/bin/time -v"
+                )
+        if self.mode == "time" and not os.path.isfile("/usr/bin/time"):
+            return "neither perf nor /usr/bin/time available"
+        return None
+
+    def _record_argv(self) -> List[str]:
+        cfg = self.cfg
+        argv = [
+            "perf", "record",
+            "-o", cfg.path("perf.data"),
+            "-F", str(cfg.cpu_sample_rate),
+        ]
+        if cfg.perf_call_graph == "fp":
+            argv += ["--call-graph", "fp"]
+        elif cfg.perf_call_graph == "dwarf":
+            argv += ["--call-graph", "dwarf,16384"]
+        if cfg.perf_events:
+            argv += ["-e", cfg.perf_events]
+        return argv
+
+    def command_prefix(self) -> List[str]:
+        cfg = self.cfg
+        if self.mode == "perf":
+            return self._record_argv() + ["--"]
+        if self.mode == "time" and os.path.isfile("/usr/bin/time"):
+            return ["/usr/bin/time", "-v", "-o", cfg.path("time.txt")]
+        return []
+
+    def attach_argv(self, pid: int) -> List[str]:
+        """`perf record -p <pid>` for attach mode; [] when perf unavailable."""
+        if self.mode != "perf":
+            return []
+        return self._record_argv() + ["-p", str(pid)]
+
+    def scoped_argv(self, cgroup: str) -> List[str]:
+        """Container-scoped sampling: system-wide filtered to the
+        container's cgroup (`-a -G`, like the reference's
+        --cgroup=docker/<cid>, sofa_record.py:380-399).  Pid-attach
+        fallback is attach_argv."""
+        if self.mode != "perf":
+            return []
+        # perf pairs cgroups with events positionally: one -G entry per
+        # -e event, or only the first event gets scoped.
+        n_events = (_count_events(self.cfg.perf_events)
+                    if self.cfg.perf_events else 1)
+        return self._record_argv() + [
+            "-a", "-G", ",".join([cgroup] * n_events)]
+
+    def harvest(self) -> None:
+        # Copy kernel symbols for offline `perf script` runs, like the
+        # reference snapshots /proc/kallsyms (sofa_record.py:231-233).
+        if self.mode != "perf":
+            return
+        try:
+            with open("/proc/kallsyms") as src, open(self.cfg.path("kallsyms"), "w") as dst:
+                dst.write(src.read())
+        except OSError:
+            pass

@@ -1,0 +1,76 @@
+"""In-process Python stack sampler — the pyflame analogue.
+
+The reference shells out to pyflame (/root/reference/bin/sofa_record.py:326-333),
+a tool that is long dead upstream.  Instead we sample ``sys._current_frames()``
+from a daemon thread inside the profiled interpreter (delivered by the same
+sitecustomize injection as the XPlane collector), which needs no ptrace
+capability and works in containers.
+
+Output format (pystacks.txt), one line per thread per tick:
+
+    <unix_ts> <tid> <outermost;...;innermost>
+
+where each frame is ``module.qualname``.  Parsed by
+sofa_tpu/ingest/pystacks_parse.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Self-contained module text written into the injection directory; it must
+# not import sofa_tpu (see xprof.py for why).
+_SAMPLER = '''
+"""sofa_tpu in-process Python stack sampler (auto-generated)."""
+import sys
+import threading
+import time
+
+
+def _format_stack(frame):
+    parts = []
+    depth = 0
+    while frame is not None and depth < 128:
+        code = frame.f_code
+        mod = frame.f_globals.get("__name__", "?")
+        parts.append("%s.%s" % (mod, code.co_name))
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()
+    return ";".join(parts)
+
+
+def _loop(rate_hz, out_path, self_tid):
+    interval = 1.0 / max(rate_hz, 1e-3)
+    with open(out_path, "a", buffering=1) as out:
+        while True:
+            ts = time.time()
+            try:
+                frames = sys._current_frames()
+            except Exception:
+                return
+            for tid, frame in frames.items():
+                if tid == self_tid:
+                    continue
+                try:
+                    out.write("%.6f %d %s\\n" % (ts, tid, _format_stack(frame)))
+                except Exception:
+                    return
+            time.sleep(interval)
+
+
+def start_sampler(rate_hz, out_path):
+    # The sampler must skip its own thread; its ident is only known once the
+    # thread runs, so capture it inside the target.
+    def _run():
+        _loop(rate_hz, out_path, threading.get_ident())
+
+    t = threading.Thread(target=_run, daemon=True, name="sofa_tpu_pystacks")
+    t.start()
+    return t
+'''
+
+
+def write_sampler_module(inject_dir: str) -> None:
+    with open(os.path.join(inject_dir, "sofa_tpu_pystacks.py"), "w") as f:
+        f.write(_SAMPLER)

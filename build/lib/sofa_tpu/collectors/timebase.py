@@ -1,0 +1,72 @@
+"""Clock-domain anchoring.
+
+Writes two files at record start:
+
+  sofa_time.txt  — the run's unix zero point (every trace timestamp becomes
+                   t - time_base, like the reference's sofa_time.txt,
+                   sofa_record.py:244-247)
+  timebase.txt   — simultaneous (realtime, monotonic, boottime,
+                   monotonic_raw) ns samples from the native tool (or a
+                   Python clock_gettime fallback), the bridge for any
+                   collector that stamps a non-realtime clock (the
+                   reference's perf_timebase.txt analogue,
+                   sofa_record.py:236-237)
+
+The XPlane session clock is anchored separately by an in-trace marker (see
+collectors/xprof.py)."""
+
+from __future__ import annotations
+
+import time
+
+from sofa_tpu.collectors.base import Collector
+from sofa_tpu.collectors.native_build import ensure_built
+import subprocess
+
+
+def python_timebase_samples(n: int = 3):
+    rows = []
+    for _ in range(n):
+        rt0 = time.clock_gettime_ns(time.CLOCK_REALTIME)
+        mono = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        boot = time.clock_gettime_ns(time.CLOCK_BOOTTIME)
+        raw = time.clock_gettime_ns(time.CLOCK_MONOTONIC_RAW)
+        rt1 = time.clock_gettime_ns(time.CLOCK_REALTIME)
+        rows.append(((rt0 + rt1) // 2, mono, boot, raw))
+    return rows
+
+
+class TimebaseCollector(Collector):
+    name = "timebase"
+
+    def _sample_lines(self):
+        tool = ensure_built("timebase")
+        if tool:
+            try:
+                out = subprocess.run(
+                    [tool, "3"], capture_output=True, text=True, timeout=10, check=True
+                ).stdout
+                lines = [ln for ln in out.splitlines() if ln.strip()]
+                if lines:
+                    return lines
+            except (subprocess.SubprocessError, OSError):
+                pass
+        return [" ".join(str(v) for v in row) for row in python_timebase_samples()]
+
+    def start(self) -> None:
+        cfg = self.cfg
+        cfg.time_base = time.time()
+        with open(cfg.path("sofa_time.txt"), "w") as f:
+            f.write(f"{cfg.time_base:.9f}\n")
+        with open(cfg.path("timebase.txt"), "w") as f:
+            f.write("\n".join(self._sample_lines()) + "\n")
+
+    def stop(self) -> None:
+        # Second anchor at record end: with samples at both ends of the run,
+        # realtime-vs-monotonic drift becomes observable and ingest can fit a
+        # slope instead of a bare offset (long runs, NTP slew).
+        try:
+            with open(self.cfg.path("timebase.txt"), "a") as f:
+                f.write("\n".join(self._sample_lines()) + "\n")
+        except OSError:
+            pass

@@ -1,0 +1,233 @@
+"""Live TPU runtime-metrics sampler — the `nvidia-smi dmon` analogue.
+
+The reference samples GPU utilization/memory with nvidia-smi daemons
+(/root/reference/bin/sofa_record.py:300-310).  libtpu has no external query
+tool and the chip is held by the profiled process, so the sampler lives
+*inside* that process (delivered by the same sitecustomize injection as the
+XPlane collector, or started directly by sofa_tpu.api.profile) and reads
+``device.memory_stats()`` — HBM bytes in use / limit / peak — at
+``tpu_mon_rate`` Hz.
+
+This is the low-rate, always-on complement to the trace-derived tc_util
+series (ingest/xplane.py:tpu_utilization): it keeps working when XPlane
+tracing is off (--disable_xprof), windowed (xprof_duration_s), or lost, and
+it reports *occupancy* (bytes held) which the op trace cannot.
+
+Output format (tpumon.txt), one line per device per tick plus a liveness
+heartbeat (deviceId -1):
+
+    <unix_ns> <device_id> <bytes_in_use> <bytes_limit> <peak_bytes_in_use>
+
+Parsed by sofa_tpu/ingest/tpumon_parse.py.
+
+The sampler doubles as the trigger for HBM *attribution* snapshots: when the
+summed bytes-in-use sets a new high-water mark, it dumps
+``jax.profiler.device_memory_profile()`` (a gzipped pprof Profile keyed by
+allocation call stack) to ``memprof.pb.gz``.  One total from nvsmi is all the
+reference ever had (sofa_record.py:300-310); the snapshot says *which
+allocation sites* hold the peak — the question OOM debugging actually asks.
+A snapshot is a stop-the-world serialize of every live buffer's stack, so it
+is growth-gated (>2% over the previous mark) and rate-limited, not per-tick.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Self-contained module text written into the injection directory; it must
+# not import sofa_tpu (see xprof.py for why).  The same text is exec'd below
+# so the in-process API (sofa_tpu.api.profile) shares one implementation.
+_SAMPLER = '''
+"""sofa_tpu in-process TPU runtime-metrics sampler (auto-generated)."""
+import sys
+import threading
+import time
+
+
+def _backend_ready():
+    """jax imported AND a backend actually initialized.
+
+    Touching jax.local_devices() ourselves would *trigger* backend init and
+    could reorder the profiled program's startup; instead poll the bridge's
+    backend table (internal but guarded — on rename we fall back to a grace
+    period after import).
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is not None and hasattr(xb, "_backends"):
+            return jax if xb._backends else None
+    except Exception:
+        pass
+    # Internals moved: wait a grace period after the import instead.
+    if getattr(_backend_ready, "_seen", None) is None:
+        _backend_ready._seen = time.time()
+    return jax if time.time() - _backend_ready._seen > 5.0 else None
+
+
+_MEMPROF = {"snap": 0, "last": 0.0}   # bytes at / time of last snapshot
+
+
+def snapshot_memprof(jax, path, trigger, total_bytes):
+    """Dump the device memory profile (gzipped pprof) + a meta sidecar.
+
+    Best-effort by contract: the profiled program must never die because an
+    observability snapshot failed (chip mid-teardown, read-only logdir, ...).
+    """
+    import json
+    import os as _os
+    try:
+        blob = jax.profiler.device_memory_profile()
+        # Writer-unique tmp name: the sampler thread and the at-exit
+        # fallback may snapshot concurrently (injection atexit order is not
+        # ours to pick); each writes its own tmp and the atomic replace
+        # means the published file is always ONE complete snapshot.
+        tmp = "%s.tmp.%d.%d" % (path, _os.getpid(), threading.get_ident())
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        _os.replace(tmp, path)   # readers never see a half-written profile
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"unix_ns": time.time_ns(), "trigger": trigger,
+                       "total_bytes": int(total_bytes)}, f)
+        return True
+    except Exception as e:
+        sys.stderr.write("sofa_tpu: memprof snapshot failed: %r\\n" % (e,))
+        return False
+
+
+def _maybe_memprof(jax, path, total_bytes):
+    """Growth-gated, rate-limited peak snapshot (see module docstring).
+
+    The gate baseline is the bytes at the last *successful snapshot* — never
+    the per-tick observation — so gradual growth (1% per tick, compounding)
+    still re-triggers once it sums past 2% since the snapshot, and a
+    rate-limited tick re-arms instead of silently raising the bar.
+    """
+    if not path or total_bytes <= 0:
+        return
+    if total_bytes <= _MEMPROF["snap"] * 1.02:
+        return
+    now = time.time()
+    if now - _MEMPROF["last"] < 2.0:
+        return
+    if snapshot_memprof(jax, path, "peak", total_bytes):
+        _MEMPROF["snap"] = total_bytes
+        _MEMPROF["last"] = now
+
+
+def _loop(rate_hz, out_path, stop, memprof_path=None):
+    jax = None
+    while jax is None:
+        if stop is not None and stop.is_set():
+            return
+        jax = _backend_ready()
+        if jax is None:
+            time.sleep(0.1)
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return
+    interval = 1.0 / max(rate_hz, 1e-3)
+    try:
+        out = open(out_path, "a", buffering=1)
+    except OSError:
+        return
+    with out:
+        while stop is None or not stop.is_set():
+            ts = time.time_ns()
+            try:
+                out.write("%d -1 0 0 0\\n" % ts)   # liveness heartbeat
+                wrote = False
+                total_used = 0
+                for d in devs:
+                    try:
+                        ms = d.memory_stats()
+                    except Exception:
+                        ms = None
+                    if not ms:
+                        continue
+                    wrote = True
+                    total_used += int(ms.get("bytes_in_use", 0))
+                    out.write("%d %d %d %d %d\\n" % (
+                        ts, d.id,
+                        ms.get("bytes_in_use", 0),
+                        ms.get("bytes_limit", 0),
+                        ms.get("peak_bytes_in_use", 0),
+                    ))
+                if not wrote:
+                    # PJRT clients without memory_stats (e.g. tunneled
+                    # backends): approximate HBM in use with the bytes of
+                    # live arrays this process holds per device.  limit=0
+                    # marks the estimate; ingest emits used-only rows.
+                    per = {}
+                    try:
+                        for a in jax.live_arrays():
+                            try:
+                                for sh in a.addressable_shards:
+                                    did = sh.device.id
+                                    per[did] = per.get(did, 0) + int(
+                                        sh.data.nbytes)
+                            except Exception:
+                                pass
+                    except Exception:
+                        per = {}
+                    for did, used in sorted(per.items()):
+                        total_used += used
+                        out.write("%d %d %d 0 0\\n" % (ts, did, used))
+                _maybe_memprof(jax, memprof_path, total_used)
+            except Exception:
+                return
+            time.sleep(interval)
+
+
+def start_sampler(rate_hz, out_path, stop=None, memprof_path=None):
+    """Start the sampler thread; returns it.  Waits for jax by itself, so it
+    is safe to call before the profiled program imports jax.  Pass a
+    threading.Event as `stop` to end the loop (in-process API use); pass
+    `memprof_path` to arm peak-triggered HBM attribution snapshots."""
+    own_stop = stop is None
+    if own_stop:
+        stop = threading.Event()
+    if memprof_path:
+        # Re-arm the growth gate: a previous profile() in this process left
+        # its peak as the baseline, which would suppress this run's
+        # snapshots unless it out-allocated the last one by 2%.
+        _MEMPROF.update(snap=0, last=0.0)
+    t = threading.Thread(
+        target=_loop, args=(rate_hz, out_path, stop, memprof_path),
+        daemon=True, name="sofa_tpu_tpumon",
+    )
+    t.start()
+    if own_stop:
+        # A daemon thread mid-PJRT-call during interpreter teardown can
+        # abort the whole process (SIGABRT from the C++ layer); stop and
+        # join the sampler BEFORE shutdown instead.
+        import atexit
+        import os
+
+        def _shutdown():
+            stop.set()
+            t.join(timeout=2.0)
+            # No peak ever cleared the gate (or xprof's own exit fallback is
+            # absent because tracing was off): leave a final snapshot.
+            jax = sys.modules.get("jax")
+            if memprof_path and jax is not None \\
+                    and not os.path.exists(memprof_path):
+                snapshot_memprof(jax, memprof_path, "final", 0)
+
+        atexit.register(_shutdown)
+    return t
+'''
+
+# One implementation: exec the injected text for in-process callers.
+_ns: dict = {}
+exec(compile(_SAMPLER, "<sofa_tpu_tpumon>", "exec"), _ns)
+start_sampler = _ns["start_sampler"]
+snapshot_memprof = _ns["snapshot_memprof"]
+
+
+def write_sampler_module(inject_dir: str) -> None:
+    with open(os.path.join(inject_dir, "sofa_tpu_tpumon.py"), "w") as f:
+        f.write(_SAMPLER)

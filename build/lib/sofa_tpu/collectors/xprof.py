@@ -1,0 +1,360 @@
+"""TPU trace collection by zero-code-change injection.
+
+The reference attaches to GPU work from outside the process with
+`nvprof --profile-all-processes` (/root/reference/bin/sofa_record.py:217-221).
+There is no external attach for libtpu, so we get inside instead: record
+writes a self-contained ``sitecustomize.py`` into logdir/_inject/ and prepends
+that directory to the child's PYTHONPATH.  Python imports sitecustomize
+automatically at startup; ours arms a watcher that waits for the profiled
+program to import JAX, then:
+
+  1. calls jax.profiler.start_trace(logdir/xprof) — XPlane capture;
+  2. stamps the clock marker: records CLOCK_REALTIME and immediately opens a
+     TraceAnnotation named ``sofa_timebase_marker:<unix_ns>`` so the XPlane
+     session clock can be pinned to unix time at preprocess (this replaces
+     the reference's cuhello known-kernel trick, sofa_preprocess.py:1557-1616);
+  3. snapshots TPU topology (device coords, kinds, process indices) to
+     tpu_topo.json — the nvlink_topo.txt analogue (sofa_record.py:311-312);
+  4. optionally runs the in-process Python stack sampler (the pyflame
+     analogue, sofa_record.py:326-333) — see collectors/pystacks.py docs;
+  5. stops the trace at process exit (atexit) or after a fixed duration.
+
+Non-Python or non-JAX commands simply never trigger the watcher; the
+injection is inert.  Programmatic users can instead use sofa_tpu.api.profile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+from sofa_tpu.collectors.base import Collector
+
+# The injected file is deliberately dependency-free: it must work in any
+# Python the user's command runs, including ones that cannot import sofa_tpu.
+_SITECUSTOMIZE = '''
+"""sofa_tpu record-time injection (auto-generated; removed by `sofa clean`)."""
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+
+_OPTS = json.loads(os.environ.get("SOFA_TPU_XPROF_OPTS", "{}"))
+_DONE = {"started": False, "stopped": False}
+
+
+def _chain_next_sitecustomize():
+    # Python imports exactly one sitecustomize — the first on sys.path, which
+    # is ours because record prepends the injection dir. Environments often
+    # have their own (e.g. to register accelerator plugins); shadowing it
+    # would change the profiled program's behavior, so find the next one and
+    # execute it too.
+    #
+    # Bounded: accelerator-plugin hooks can block the MAIN thread forever
+    # when their device tunnel is down (observed: an axon claim loop
+    # spinning on a dead relay hung `sofa record` of a pure-host command).
+    # A SIGALRM guard turns that into a timeout the hook's own error
+    # handling (or ours) absorbs, so the profiled program still starts.
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in sys.path:
+        try:
+            ap = os.path.abspath(p or os.getcwd())
+        except OSError:
+            continue
+        if ap == here:
+            continue
+        cand = os.path.join(ap, "sitecustomize.py")
+        if os.path.isfile(cand):
+            timeout = 120.0
+            try:
+                timeout = float(
+                    os.environ.get("SOFA_TPU_CHAIN_TIMEOUT_S", "120") or 0)
+            except ValueError:
+                pass
+            timeout = min(timeout, 86400.0)  # inf/huge would overflow alarm()
+            old_handler = None
+            armed = False
+            signal = None
+            if timeout > 0:
+                try:
+                    import math
+                    import signal
+
+                    def _alarm(signum, frame):  # noqa: ARG001
+                        raise TimeoutError(
+                            "chained sitecustomize exceeded %gs (device "
+                            "tunnel down?) — continuing without it; set "
+                            "SOFA_TPU_CHAIN_TIMEOUT_S to adjust or 0 to "
+                            "disable this guard" % timeout)
+
+                    # old_handler may be None for a handler installed from
+                    # C — `armed` is the cleanup sentinel, never the
+                    # handler value.  ceil: alarm() truncates, and int(0.5)
+                    # == 0 would CANCEL the alarm instead of arming it.
+                    old_handler = signal.signal(signal.SIGALRM, _alarm)
+                    signal.alarm(max(1, math.ceil(timeout)))
+                    armed = True
+                except (AttributeError, ValueError, OSError, OverflowError):
+                    pass  # no SIGALRM on this platform / non-main thread
+            try:
+                try:
+                    spec = importlib.util.spec_from_file_location(
+                        "sitecustomize", cand)
+                    mod = importlib.util.module_from_spec(spec)
+                    spec.loader.exec_module(mod)
+                except Exception as e:  # noqa: BLE001
+                    sys.stderr.write(
+                        "sofa_tpu: chained sitecustomize %s failed: %r\\n"
+                        % (cand, e))
+                finally:
+                    if armed:
+                        signal.alarm(0)
+                        signal.signal(signal.SIGALRM,
+                                      old_handler or signal.SIG_DFL)
+            except TimeoutError as e:
+                # The alarm raced completion (fired between the hook
+                # returning and the cancel above): absorb it so the rest
+                # of the injection still arms, and finish the cleanup.
+                sys.stderr.write(
+                    "sofa_tpu: chain timeout raced completion: %r\\n" % (e,))
+                if armed:
+                    try:
+                        signal.alarm(0)
+                        signal.signal(signal.SIGALRM,
+                                      old_handler or signal.SIG_DFL)
+                    except Exception:  # noqa: BLE001
+                        pass
+            return
+
+
+_chain_next_sitecustomize()
+
+
+def _snapshot_topology(jax, logdir):
+    try:
+        devs = []
+        for d in jax.devices():
+            devs.append({
+                "id": d.id,
+                "process_index": d.process_index,
+                "platform": d.platform,
+                "device_kind": getattr(d, "device_kind", ""),
+                "coords": list(getattr(d, "coords", []) or []),
+                "core_on_chip": getattr(d, "core_on_chip", -1),
+            })
+        info = {
+            "platform": jax.default_backend(),
+            "process_count": jax.process_count(),
+            "process_index": jax.process_index(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "devices": devs,
+        }
+        with open(os.path.join(logdir, "tpu_topo.json"), "w") as f:
+            json.dump(info, f, indent=1)
+    except Exception as e:  # noqa: BLE001 - never break the profiled app
+        sys.stderr.write("sofa_tpu: topology snapshot failed: %r\\n" % (e,))
+
+
+def _stop(jax):
+    if _DONE["stopped"] or not _DONE["started"]:
+        return
+    _DONE["stopped"] = True
+    # HBM attribution fallback: if the tpumon sampler never caught a peak
+    # (sampler off, or memory never grew past the gate), take one final
+    # snapshot so the report always has *some* allocation-site table.
+    mp = os.environ.get("SOFA_TPU_MEMPROF_OUT")
+    if mp and not os.path.exists(mp):
+        try:
+            from sofa_tpu_tpumon import snapshot_memprof
+            snapshot_memprof(jax, mp, "final", 0)
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write("sofa_tpu: final memprof failed: %r\\n" % (e,))
+    try:
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write("sofa_tpu: stop_trace failed: %r\\n" % (e,))
+
+
+def _start(jax):
+    logdir = _OPTS["logdir"]
+    delay = float(_OPTS.get("delay_s", 0) or 0)
+    if delay > 0:
+        time.sleep(delay)
+    kwargs = {"create_perfetto_link": False, "create_perfetto_trace": False}
+    try:
+        # host_tracer_level / python_tracer flags ride ProfileOptions where
+        # this jax has it (>=0.4.32); older jax just gets the defaults.
+        po = jax.profiler.ProfileOptions()
+        po.host_tracer_level = int(_OPTS.get("host_tracer_level", 2))
+        po.python_tracer_level = 1 if _OPTS.get("python_tracer") else 0
+        kwargs["profiler_options"] = po
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        jax.profiler.start_trace(os.path.join(logdir, "xprof"), **kwargs)
+        _DONE["started"] = True
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write("sofa_tpu: start_trace failed: %r\\n" % (e,))
+        return
+    # Clock marker: unix time <-> XPlane session time. Two bracketing reads
+    # bound the annotation-entry cost.
+    t0 = time.time_ns()
+    with jax.profiler.TraceAnnotation("sofa_timebase_marker:%d" % t0):
+        t1 = time.time_ns()
+    with open(os.path.join(logdir, "xprof_marker.txt"), "w") as f:
+        f.write("%d %d\\n" % (t0, t1))
+    atexit.register(lambda: _stop(jax))
+    _snapshot_topology(jax, logdir)
+    dur = float(_OPTS.get("duration_s", 0) or 0)
+    if dur > 0:
+        timer = threading.Timer(dur, lambda: _stop(jax))
+        timer.daemon = True
+        timer.start()
+
+
+def _watch():
+    # Poll for the jax module becoming importable-and-initialized, THEN for
+    # the program to initialize a backend itself.  Calling start_trace
+    # before that would make the *profiler* trigger default-backend init —
+    # overriding any platform the program pins in main() (e.g.
+    # jax_platforms=cpu) and hanging outright when a TPU tunnel is dead.
+    # A meta-path hook cannot easily run *after* a package finishes
+    # importing; a 20 ms poll is robust and costs nothing once armed.
+    deadline = time.time() + float(_OPTS.get("arm_timeout_s", 86400))
+    jax = None
+    while time.time() < deadline:
+        jax = sys.modules.get("jax")
+        if jax is not None and getattr(jax, "profiler", None) is not None \\
+                and getattr(jax, "version", None) is not None:
+            break
+        jax = None
+        time.sleep(0.02)
+    if jax is None:
+        return             # never saw a usable jax: give up, don't start
+    while True:
+        try:
+            xb = sys.modules.get("jax._src.xla_bridge")
+            if xb is None or not hasattr(xb, "_backends"):
+                break      # internals moved: start immediately (old behavior)
+            if xb._backends:
+                break      # program initialized a backend; safe to attach
+        except Exception:
+            break
+        if time.time() >= deadline:
+            return         # timed out waiting: starting now would trigger
+                           # backend init ourselves — give up instead
+        time.sleep(0.02)
+    _start(jax)
+
+
+def _platform_guard():
+    # Env-over-config: an image-level site hook may force-prepend its own
+    # platform, overriding an explicit JAX_PLATFORMS (and hanging backend
+    # init when that platform's tunnel is dead).  jax itself honors the
+    # env var, so a mismatch right after import means a hook defeated the
+    # user's choice — restore it before the program initializes a backend.
+    # Best-effort by design: a program whose own config.update races our
+    # first poll can be re-overridden (hence the stderr breadcrumb), and
+    # later program updates always win because we write exactly once.
+    p = os.environ.get("JAX_PLATFORMS", "")
+    if not p:
+        return
+    deadline = time.time() + float(_OPTS.get("arm_timeout_s", 86400))
+    while time.time() < deadline:
+        jax = sys.modules.get("jax")
+        if jax is not None and getattr(jax, "config", None) is not None \\
+                and getattr(jax, "version", None) is not None:
+            try:
+                if jax.config.jax_platforms != p:
+                    jax.config.update("jax_platforms", p)
+                    print("sofa_tpu: restored JAX_PLATFORMS=%s over a "
+                          "site-hook platform override" % p,
+                          file=sys.stderr)
+            except Exception as e:
+                print("sofa_tpu: platform restore failed: %r" % (e,),
+                      file=sys.stderr)
+            return
+        time.sleep(0.005)
+
+
+# The guard runs whenever the injection is present (tpumon/pystacks-only
+# runs included), not just when XPlane tracing is enabled.
+_g = threading.Thread(target=_platform_guard, daemon=True,
+                      name="sofa_tpu_platform_guard")
+_g.start()
+
+if _OPTS.get("enable", False):
+    _t = threading.Thread(target=_watch, daemon=True, name="sofa_tpu_xprof_watch")
+    _t.start()
+
+if os.environ.get("SOFA_TPU_PYSTACKS_HZ"):
+    from sofa_tpu_pystacks import start_sampler  # lives beside this file
+    start_sampler(
+        float(os.environ["SOFA_TPU_PYSTACKS_HZ"]),
+        os.environ["SOFA_TPU_PYSTACKS_OUT"],
+    )
+
+if os.environ.get("SOFA_TPU_TPUMON_HZ"):
+    from sofa_tpu_tpumon import start_sampler as _tpumon_start
+    _tpumon_start(
+        float(os.environ["SOFA_TPU_TPUMON_HZ"]),
+        os.environ["SOFA_TPU_TPUMON_OUT"],
+        memprof_path=os.environ.get("SOFA_TPU_MEMPROF_OUT"),
+    )
+'''
+
+
+class XProfCollector(Collector):
+    name = "xprof"
+
+    def probe(self) -> Optional[str]:
+        # The injection carries the XPlane trace AND the tpumon/pystacks
+        # samplers; it is only pointless when every in-process collector is
+        # off (--disable_xprof alone must NOT kill the live HBM monitor).
+        if not (self.cfg.enable_xprof or self.cfg.enable_tpu_mon
+                or self.cfg.enable_py_stacks):
+            return "disabled (--disable_xprof and --disable_tpu_mon)"
+        return None
+
+    def start(self) -> None:
+        cfg = self.cfg
+        os.makedirs(cfg.inject_dir, exist_ok=True)
+        if cfg.enable_xprof:
+            os.makedirs(cfg.xprof_dir, exist_ok=True)
+        with open(os.path.join(cfg.inject_dir, "sitecustomize.py"), "w") as f:
+            f.write(_SITECUSTOMIZE)
+        from sofa_tpu.collectors import tpumon
+        from sofa_tpu.collectors.pystacks import write_sampler_module
+
+        write_sampler_module(cfg.inject_dir)
+        tpumon.write_sampler_module(cfg.inject_dir)
+
+    def child_env(self) -> Dict[str, str]:
+        cfg = self.cfg
+        opts = {
+            "enable": bool(cfg.enable_xprof),
+            "logdir": os.path.abspath(cfg.logdir),
+            "delay_s": cfg.xprof_delay_s,
+            "duration_s": cfg.xprof_duration_s,
+            "host_tracer_level": cfg.xprof_host_tracer_level,
+            "python_tracer": cfg.xprof_python_tracer,
+        }
+        env = {"SOFA_TPU_XPROF_OPTS": json.dumps(opts)}
+        if cfg.enable_mem_prof and (cfg.enable_xprof or cfg.enable_tpu_mon):
+            env["SOFA_TPU_MEMPROF_OUT"] = os.path.abspath(
+                cfg.path("memprof.pb.gz"))
+        existing = os.environ.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = cfg.inject_dir + (os.pathsep + existing if existing else "")
+        if cfg.enable_py_stacks:
+            env["SOFA_TPU_PYSTACKS_HZ"] = str(cfg.py_stack_rate)
+            env["SOFA_TPU_PYSTACKS_OUT"] = os.path.abspath(cfg.path("pystacks.txt"))
+        if cfg.enable_tpu_mon:
+            env["SOFA_TPU_TPUMON_HZ"] = str(cfg.tpu_mon_rate)
+            env["SOFA_TPU_TPUMON_OUT"] = os.path.abspath(cfg.path("tpumon.txt"))
+        return env

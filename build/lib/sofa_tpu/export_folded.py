@@ -1,0 +1,127 @@
+"""Folded-stack export (`sofa export --folded`) for flame tooling.
+
+Writes Brendan-Gregg-format collapsed stacks — ``frame;frame;leaf count``
+per line — the lingua franca of speedscope.app, flamegraph.pl, and
+inferno, so sampled stacks from a sofa capture drop straight into the
+ecosystem's flame-graph viewers:
+
+  pystacks.folded — the in-process Python sampler's FULL stacks
+                    (collectors/pystacks.py stores them in `module`)
+  cputrace.folded — perf samples; the parser keeps the leaf plus up to 3
+                    callers ("leaf<-c1<-c2"), exported caller-first as a
+                    partial stack
+  memprof.folded  — HBM bytes held per allocation stack from the peak
+                    memory snapshot (ingest/memprof.py) — a MEMORY flame
+                    graph: width is bytes, not time
+
+The reference has no flame-graph path at all; its closest artifact is the
+hsg swarm clustering over the same samples.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from sofa_tpu.printing import print_progress, print_warning
+
+FOLDED_FRAMES = ["pystacks", "cputrace"]
+
+
+def _fold_pystacks(df: pd.DataFrame) -> Counter:
+    # module carries the full semicolon stack, root-first
+    return Counter(s for s in df["module"] if s)
+
+
+def _fold_cputrace(df: pd.DataFrame) -> Counter:
+    counts: Counter = Counter()
+    for name in df["name"]:
+        if not name:
+            continue
+        # perf_script names are "leaf<-caller1<-caller2 @ dso" where the
+        # dso annotates the LEAF; split it off first or it sticks to the
+        # outermost caller and fragments identical stacks.
+        name, _, dso = str(name).partition(" @ ")
+        frames = name.split("<-")
+        if dso:
+            frames[0] = f"{frames[0]} [{dso}]"
+        counts[";".join(reversed(frames))] += 1
+    return counts
+
+
+def _fold_memprof(cfg) -> Counter:
+    """HBM bytes per allocation stack — pprof stacks are leaf-first, folded
+    format wants root-first.  Count = bytes held, so flame width reads as
+    memory, the same convention pprof's own flame view uses.  A cluster
+    export folds every host's snapshot with the hostname as the root frame
+    (per-host logdirs each hold their own memprof.pb.gz)."""
+    from sofa_tpu.ingest.memprof import load_memprof
+
+    sources = [(cfg.logdir, "")]
+    if getattr(cfg, "cluster_hosts", None):
+        from sofa_tpu.analyze import cluster_host_cfgs
+
+        sources = [(host_cfg.logdir, hostname + ";")
+                   for _i, hostname, host_cfg in cluster_host_cfgs(cfg)]
+    counts: Counter = Counter()
+    for logdir, prefix in sources:
+        try:
+            df, _meta = load_memprof(logdir)
+        except Exception as e:  # noqa: BLE001 — corrupt snapshot degrades
+            print_warning(f"folded export: unreadable memprof snapshot in "
+                          f"{logdir}: {e}")
+            continue
+        if df is None:
+            continue
+        held = df[(df["kind"] == "buffer") & (df["bytes"] > 0)]
+        for stack, nbytes in zip(held["stack"], held["bytes"]):
+            frames = [f for f in str(stack).split(";") if f]
+            if frames:
+                counts[prefix + ";".join(reversed(frames))] += int(nbytes)
+    return counts
+
+
+def _write(counts: Counter, path: str) -> bool:
+    if not counts:
+        return False
+    with open(path, "w") as f:
+        for stack, n in counts.most_common():
+            f.write(f"{stack} {n}\n")
+    return True
+
+
+def export_folded(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None
+                  ) -> List[str]:
+    """Write *.folded files into the logdir; returns the paths written."""
+    if frames is None:
+        from sofa_tpu.analyze import load_frames
+
+        frames = load_frames(cfg, only=FOLDED_FRAMES)
+    os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
+    written: List[str] = []
+    jobs = (
+        ("pystacks", _fold_pystacks),
+        ("cputrace", _fold_cputrace),
+    )
+    for name, fold in jobs:
+        df = frames.get(name)
+        if df is None or df.empty:
+            continue
+        path = cfg.path(f"{name}.folded")
+        if _write(fold(df), path):
+            written.append(path)
+    # Memory flame graph rides the snapshot file, not a trace frame.
+    mem_path = cfg.path("memprof.folded")
+    if _write(_fold_memprof(cfg), mem_path):
+        written.append(mem_path)
+    if written:
+        print_progress(
+            "folded stacks -> " + ", ".join(written)
+            + "  (open in speedscope.app / flamegraph.pl)")
+    else:
+        print_warning("folded export: no sampled stacks in this capture "
+                      "(--enable_py_stacks / perf)")
+    return written

@@ -1,0 +1,413 @@
+"""Perfetto / Chrome-trace export of the unified timeline.
+
+``sofa export --perfetto`` writes ``trace.json.gz`` in the Trace Event
+Format, openable in ui.perfetto.dev or chrome://tracing — so a sofa
+capture can ride the ecosystem's standard trace viewer in addition to the
+built-in board.  The reference has no equivalent (its only interchange
+formats are CSVs); this is TPU-first interop: every frame of the unified
+schema maps onto Perfetto's process/thread/track model:
+
+  process = device (tpu<N> / host / custom plane), named via metadata
+  thread  = lane within the device (sync ops, async DMA, Steps, modules,
+            host threads by tid)
+  X events = spans (ops, steps, host events) with args carrying the
+            schema's analysis columns (flops, bytes, phase, op_path, ...)
+  C events = counter tracks from tpuutil (tc/mxu util %, HBM GB/s),
+    tpumon (live HBM used/occupancy per device) and
+            host net/cpu series
+
+Timestamps are emitted in microseconds relative to the capture so traces
+stay compact.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from sofa_tpu.printing import print_progress, print_warning
+
+# Stable synthetic pids per source "process" — Perfetto groups tracks by pid.
+_HOST_PID = 1_000_000
+_CUSTOM_PID = 1_100_000
+
+PERFETTO_FRAMES = ["tputrace", "tpusteps", "tpumodules", "hosttrace",
+                   "customtrace", "tpuutil", "tpumon", "mpstat",
+                   "netbandwidth"]
+
+
+# Row iteration uses itertuples for the SMALL frames; the pod-scale op
+# frame gets a columnar path below (itertuples walks arrow-backed string
+# cells one by one — ~12M __iter__ calls on a 1.6M-row trace — and
+# per-event json.dumps dominated the export; column-wise bulk conversion +
+# cached per-unique-args serialization cut the 1.6M-event export ~4x).
+
+def _op_args(row) -> Dict[str, object]:
+    args: Dict[str, object] = {}
+    for key in ("hlo_category", "module", "phase", "op_path", "source"):
+        v = getattr(row, key, "")
+        if v:
+            args[key] = str(v)
+    for key in ("flops", "bytes_accessed", "payload"):
+        v = getattr(row, key, 0)
+        if v:
+            args[key] = float(v)
+    g = getattr(row, "groups", "")
+    if g:
+        args["replica_groups"] = str(g)
+    return args
+
+
+class _DeviceColumns:
+    """The pod-scale op frame, reduced to per-signature JSON prefixes plus
+    flat ts/dur/pid/lane/sig arrays — the exact input of the native writer
+    (native/perfetto_write.cc) and of the Python fallback loop."""
+
+    def __init__(self, ops: pd.DataFrame) -> None:
+        import numpy as np
+
+        self.n = len(ops)
+        # Clamp AFTER the µs scale: nan->0 before *1e6 would let an inf (or
+        # ~1.8e302 s) re-overflow and both writers would emit the invalid
+        # JSON token `inf`.  ±1e15 µs (~31 years) is beyond any real trace,
+        # and %.3f of it stays well inside the native writer's buffer.
+        self.ts = np.clip(np.nan_to_num(
+            ops["timestamp"].to_numpy(dtype=float) * 1e6,
+            posinf=1e15, neginf=-1e15), -1e15, 1e15)
+        self.dur = np.clip(np.nan_to_num(
+            ops["duration"].to_numpy(dtype=float) * 1e6,
+            posinf=1e15), 0.0, 1e15)
+        self.pid = ops["deviceId"].to_numpy(dtype=np.int32)
+        cat = ops["category"].to_numpy(dtype=int)
+        self.lane = np.where(
+            cat == 0, 0, np.where(cat == 2, 1, 2)).astype(np.uint8)
+
+        # Args are metadata-derived, so the (name, args) pair takes only a
+        # few hundred distinct values in a pod-scale trace.  An EXACT
+        # vectorized signature (groupby.ngroup over the arg columns, C
+        # speed, no hash collisions) means only the FIRST row of each
+        # signature is ever converted to Python objects.
+        sig_cols = [k for k in ("name", "hlo_category", "module", "phase",
+                                "op_path", "source", "flops",
+                                "bytes_accessed", "payload", "groups")
+                    if k in ops.columns]
+        sig_arr = ops.groupby(sig_cols, sort=False, dropna=False).ngroup() \
+            .to_numpy()
+        self.sig = sig_arr.astype(np.uint32)
+        uniq, firsts = np.unique(sig_arr, return_index=True)
+        dumps = json.dumps
+        self.prefixes: List[str] = [""] * len(uniq)
+        for s, row in zip(uniq.tolist(),
+                          ops.iloc[firsts].itertuples(index=False)):
+            self.prefixes[s] = (
+                f'{{"name":{dumps(str(row.name))},"ph":"X","cat":"tpu_op",'
+                f'"args":{dumps(_op_args(row), separators=(",", ":"))},')
+
+    def event_strings(self) -> "List[str]":
+        """Python fallback: pre-serialized Trace-Event lines (floats via
+        repr — valid JSON for the finite floats nan_to_num guarantees)."""
+        prefix = self.prefixes
+        sig = self.sig.tolist()  # .tolist() yields PYTHON scalars;
+        ts = self.ts.tolist()    # np.float64's repr is not valid JSON
+        dur = self.dur.tolist()
+        pid = self.pid.tolist()
+        lane = self.lane.tolist()
+        return [
+            f'{prefix[sig[i]]}"ts":{ts[i]!r},"dur":{dur[i]!r},'
+            f'"pid":{pid[i]},"tid":{lane[i]}}}'
+            for i in range(self.n)
+        ]
+
+
+def _steps_events(steps: pd.DataFrame, events: List[dict]) -> None:
+    for row in steps.itertuples(index=False):
+        events.append({
+            "name": row.name, "ph": "X", "cat": "step",
+            "ts": row.timestamp * 1e6,
+            "dur": max(row.duration, 0.0) * 1e6,
+            "pid": int(row.deviceId), "tid": 3,
+        })
+
+
+def _module_events(mods: pd.DataFrame, events: List[dict]) -> None:
+    for row in mods.itertuples(index=False):
+        events.append({
+            "name": row.name, "ph": "X", "cat": "xla_module",
+            "ts": row.timestamp * 1e6,
+            "dur": max(row.duration, 0.0) * 1e6,
+            "pid": int(row.deviceId), "tid": 4,
+        })
+
+
+def _host_events(host: pd.DataFrame, events: List[dict]) -> None:
+    # deviceId on host rows is the host's ordinal base (host_index*256), so
+    # each host of a pod gets its own Perfetto process — thread ids from
+    # different machines must never interleave on one track.
+    for row in host.itertuples(index=False):
+        events.append({
+            "name": row.name, "ph": "X", "cat": "host",
+            "ts": row.timestamp * 1e6,
+            "dur": max(row.duration, 0.0) * 1e6,
+            "pid": _HOST_PID + max(int(row.deviceId), 0),
+            "tid": int(row.tid) & 0x7FFFFFFF,
+            "args": ({"thread": row.module}
+                     if getattr(row, "module", "") else {}),
+        })
+
+
+def _custom_events(custom: pd.DataFrame, events: List[dict],
+                   plane_pids: Dict[tuple, int]) -> None:
+    # One pid per (host, plane label): a runtime can emit several CUSTOM
+    # planes per host and they share deviceId (the host's ordinal base).
+    for row in custom.itertuples(index=False):
+        key = (int(row.deviceId), getattr(row, "module", ""))
+        pid = plane_pids.setdefault(key, _CUSTOM_PID + len(plane_pids))
+        events.append({
+            "name": row.name, "ph": "X", "cat": "custom_plane",
+            "ts": row.timestamp * 1e6,
+            "dur": max(row.duration, 0.0) * 1e6,
+            "pid": pid,
+            "tid": int(row.tid) & 0x7FFFFFFF,
+            "args": {"plane": key[1]},
+        })
+
+
+def _counter_events(util: pd.DataFrame, events: List[dict]) -> None:
+    for row in util.itertuples(index=False):
+        events.append({
+            "name": row.name, "ph": "C", "cat": "util",
+            "ts": row.timestamp * 1e6,
+            "pid": int(row.deviceId),
+            "args": {row.name: float(row.event)},
+        })
+
+
+def _host_counter_events(df: pd.DataFrame, names: List[str],
+                         label: str, events: List[dict]) -> None:
+    """Per-timestamp mean of a host sampler series as a Perfetto counter —
+    per HOST, so a cluster export never averages one saturated machine
+    against its idle neighbors.  Host identity is the `pid` column
+    (stamped by load_cluster_frames; -1 = single-host capture); deviceId
+    in sampler frames is the CPU-core/lane index and is deliberately
+    averaged over."""
+    if df.empty:
+        return
+    for hpid, host_rows in df.groupby("pid"):
+        pid = _HOST_PID + max(int(hpid), 0) * 256
+        for name in names:
+            rows = host_rows[host_rows["name"] == name]
+            if rows.empty:
+                continue
+            agg = rows.groupby("timestamp")["event"].mean()
+            for ts, v in agg.items():
+                events.append({
+                    "name": f"{label}{name}", "ph": "C", "cat": "host_util",
+                    "ts": ts * 1e6, "pid": pid,
+                    "args": {f"{label}{name}": float(v)},
+                })
+
+
+def _meta(events: List[dict], pid: int, name: str,
+          threads: Optional[Dict[int, str]] = None) -> None:
+    events.append({"name": "process_name", "ph": "M", "pid": pid,
+                   "args": {"name": name}})
+    for tid, tname in (threads or {}).items():
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": tname}})
+
+
+def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
+                    out_name: str = "trace.json.gz") -> Optional[str]:
+    """Write the Trace-Event-Format export; returns the path or None."""
+    if frames is None:
+        from sofa_tpu.analyze import load_frames
+
+        frames = load_frames(cfg, only=PERFETTO_FRAMES)
+
+    def get(name: str) -> pd.DataFrame:
+        df = frames.get(name)
+        return df if df is not None else pd.DataFrame()
+
+    # The pod-scale op frame stays COLUMNAR end to end (native writer gets
+    # arrays, Python fallback materializes strings late); everything else
+    # stays a dict until the writer.
+    events: "List[dict]" = []
+    ops = get("tputrace")
+    dev = _DeviceColumns(ops) if not ops.empty else None
+    steps = get("tpusteps")
+    if not steps.empty:
+        _steps_events(steps, events)
+    mods = get("tpumodules")
+    if not mods.empty:
+        _module_events(mods, events)
+    host = get("hosttrace")
+    if not host.empty:
+        _host_events(host, events)
+    custom = get("customtrace")
+    plane_pids: Dict[tuple, int] = {}
+    if not custom.empty:
+        _custom_events(custom, events, plane_pids)
+    util = get("tpuutil")
+    if not util.empty:
+        _counter_events(util, events)
+    # Live HBM occupancy rides the same per-device counter convention as
+    # the trace-derived rates; heartbeat rows (deviceId -1) are liveness
+    # bookkeeping, not a device counter.
+    mon = get("tpumon")
+    if not mon.empty:
+        mon = mon[(mon["name"] != "alive") & (mon["deviceId"] >= 0)]
+    if not mon.empty:
+        _counter_events(mon, events)
+    _host_counter_events(get("mpstat"), ["usr", "sys", "iow"],
+                         "cpu_", events)
+    net = get("netbandwidth")
+    if not net.empty:
+        _host_counter_events(net, sorted(set(net["name"])), "", events)
+    if dev is None and not events:
+        print_warning("perfetto export: no trace frames — run "
+                      "`sofa report` first")
+        return None
+
+    device_ids = set()
+    for df in (ops, steps, mods, util, mon):
+        if not df.empty:
+            device_ids.update(int(d) for d in df["deviceId"].unique())
+    for pid in sorted(device_ids):
+        _meta(events, pid, f"tpu{pid}",
+              {0: "XLA Ops (sync)", 1: "Async DMA", 3: "Steps",
+               4: "XLA Modules"})
+    if not host.empty:
+        for base, sel in host.groupby("deviceId"):
+            threads = {}
+            for _, row in sel.drop_duplicates("tid").iterrows():
+                threads[int(row["tid"]) & 0x7FFFFFFF] = (
+                    str(row.get("module")) or f"tid {row['tid']}")
+            base = max(int(base), 0)
+            name = "host" if host["deviceId"].nunique() == 1 \
+                else f"host{base // 256}"
+            _meta(events, _HOST_PID + base, name, threads)
+    for (_dev, label), pid in plane_pids.items():
+        _meta(events, pid, str(label or "custom plane"))
+
+    os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
+    path = cfg.path(out_name)
+    dumps = json.dumps
+    tail = ('],"displayTimeUnit":"ms","otherData":'
+            + dumps({"producer": "sofa_tpu", "logdir": cfg.logdir}) + "}")
+    n_total = (dev.n if dev is not None else 0) + len(events)
+
+    # Native single-pass writer (sprintf + zlib in C, ~4x on pod-scale
+    # traces); only worth a subprocess when the device frame is large.
+    # The non-device blob is joined only on this path — the fallback
+    # streams dicts in batches instead of materializing one giant string.
+    if dev is not None and dev.n >= 100_000 \
+            and os.environ.get("SOFA_NATIVE_PERFETTO", "1") != "0":
+        other_json = ",".join(
+            dumps(e, separators=(",", ":")) for e in events)
+        if _native_write(dev, other_json, tail, path):
+            print_progress(f"perfetto export: {n_total} events -> {path} "
+                           "(native writer; open in ui.perfetto.dev)")
+            return path
+
+    # Pure-Python fallback: streamed write, gzip level 5, batched ~64k
+    # strings per f.write (per-event writes were ~15% of the export).
+    with gzip.open(path, "wt", encoding="utf-8", compresslevel=5) as f:
+        f.write('{"traceEvents":[')
+        batch: List[str] = []
+        wrote_any = False
+
+        def flush():
+            nonlocal wrote_any
+            if not batch:
+                return
+            if wrote_any:
+                f.write(",")
+            f.write(",".join(batch))
+            wrote_any = True
+            batch.clear()
+
+        for e in (dev.event_strings() if dev is not None else []):
+            batch.append(e)
+            if len(batch) >= 65536:
+                flush()
+        for e in events:
+            batch.append(dumps(e, separators=(",", ":")))
+            if len(batch) >= 65536:
+                flush()
+        flush()
+        f.write(tail)
+    print_progress(f"perfetto export: {n_total} events -> {path} "
+                   "(open in ui.perfetto.dev)")
+    return path
+
+
+def _native_write(dev: _DeviceColumns, other_json: str, tail: str,
+                  path: str) -> bool:
+    """Hand the columnar device events to native/perfetto_write.cc.
+
+    Returns False on any failure (no compiler, bad exit, missing output) —
+    the caller keeps the pure-Python path, mirroring ingest/native_scan.py's
+    degradation contract.  Gzip level 4 ≈ the Python path's level 5 within
+    a few % of size at roughly twice the deflate speed.
+    """
+    import struct
+    import subprocess
+    import tempfile
+
+    from sofa_tpu.collectors.native_build import ensure_built
+
+    tool = ensure_built("perfetto_write")
+    if tool is None:
+        return False
+    tmp = None
+    out_tmp = path + f".native.{os.getpid()}"
+    try:
+        with tempfile.NamedTemporaryFile(
+                prefix="sofa_perfetto_", suffix=".bin", delete=False) as f:
+            tmp = f.name
+            f.write(struct.pack("<IIII", 0x31504653, 1, 4,
+                                len(dev.prefixes)))
+            for p in dev.prefixes:
+                b = p.encode("utf-8")
+                f.write(struct.pack("<I", len(b)))
+                f.write(b)
+            f.write(struct.pack("<Q", dev.n))
+            f.write(dev.ts.tobytes())
+            f.write(dev.dur.tobytes())
+            f.write(dev.sig.tobytes())
+            f.write(dev.pid.tobytes())
+            f.write(dev.lane.tobytes())
+            other = other_json.encode("utf-8")
+            f.write(struct.pack("<Q", len(other)))
+            f.write(other)
+            tail_b = tail.encode("utf-8")
+            f.write(struct.pack("<Q", len(tail_b)))
+            f.write(tail_b)
+        r = subprocess.run([tool, tmp, out_tmp],
+                           capture_output=True, timeout=600)
+        if r.returncode != 0 or not os.path.isfile(out_tmp):
+            print_warning("native perfetto_write failed "
+                          f"(rc={r.returncode}): "
+                          f"{r.stderr.decode(errors='replace')[:200]} — "
+                          "using the Python writer")
+            return False
+        os.replace(out_tmp, path)
+        return True
+    except Exception as e:  # noqa: BLE001 — any failure degrades
+        print_warning(f"native perfetto_write failed ({e}) — "
+                      "using the Python writer")
+        return False
+    finally:
+        # out_tmp survives only via the os.replace above; a timeout or
+        # tool crash must not leave a multi-hundred-MB partial in the
+        # logdir.
+        for leftover in (tmp, out_tmp):
+            if leftover:
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass

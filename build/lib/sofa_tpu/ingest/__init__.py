@@ -1,0 +1,7 @@
+"""Parsers turning raw collector output into unified-schema DataFrames.
+
+One module per source (the reference concentrates all of this in the 2106-line
+sofa_preprocess.py; see SURVEY §2.4 for the per-parser map).  Every parser is
+a pure function ``text/path -> DataFrame`` so fixtures can test it without
+running collectors.
+"""

@@ -1,0 +1,133 @@
+"""HBM attribution snapshot (pprof) -> allocation-site table.
+
+Decodes the gzipped pprof ``Profile`` that ``jax.profiler
+.device_memory_profile()`` emits (captured at the observed occupancy peak by
+collectors/tpumon.py, or at exit as a fallback) into a flat DataFrame:
+
+    device | kind | count | bytes | site | stack
+
+One row per pprof sample.  ``site`` is the innermost *user-attributable*
+frame (the profiler's leaf frames are jax-internal dispatch like
+``_pjit_call_impl_python``; OOM debugging wants the caller's line), and
+``stack`` is the full leaf-first ``;``-joined frame path for flame-style
+drill-down.
+
+No reference analogue: nvsmi gave the reference one used-MB total per GPU
+(sofa_record.py:300-310).  Attribution by allocation site is only possible
+because the TPU runtime is in-process with the allocator's Python callers.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import Optional, Tuple
+
+import pandas as pd
+
+# Frames below this module prefix set are runtime plumbing, not user code;
+# `site` skips past them to the first frame that is neither.
+_RUNTIME_FRAME_HINTS = (
+    "_pjit", "pjit", "cache_miss", "reraise_with_filtered_traceback",
+    "backend_compile", "wrapper", "__call__", "_python_pjit_helper",
+    "call_impl", "apply_primitive", "lower", "compile", "_cpp_pjit",
+)
+
+
+def _site_of(frames: list) -> str:
+    for name in frames:
+        if not any(h in name for h in _RUNTIME_FRAME_HINTS):
+            return name
+    return frames[0] if frames else "(unknown)"
+
+
+def parse_memprof(path: str) -> pd.DataFrame:
+    """Decode one ``memprof.pb.gz`` into the allocation-site DataFrame."""
+    from sofa_tpu.ingest import memprof_pb2
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    try:
+        blob = gzip.decompress(blob)
+    except OSError:
+        pass  # already raw proto (synthetic fixtures)
+    prof = memprof_pb2.Profile()
+    prof.ParseFromString(blob)
+
+    st = list(prof.string_table)
+
+    def s(i: int) -> str:
+        return st[i] if 0 <= i < len(st) else ""
+
+    # Column order of the sample values: find (allocations,count) and
+    # (space,bytes); fall back positionally for foreign producers.
+    count_i, bytes_i = 0, min(1, max(0, len(prof.sample_type) - 1))
+    for i, vt in enumerate(prof.sample_type):
+        unit = s(vt.unit)
+        if unit == "count":
+            count_i = i
+        elif unit == "bytes":
+            bytes_i = i
+
+    fn_name = {f.id: s(f.name) for f in prof.function}
+    loc_frames = {}
+    for loc in prof.location:
+        loc_frames[loc.id] = [fn_name.get(ln.function_id, "")
+                              for ln in loc.line] or [f"0x{loc.address:x}"]
+
+    rows = []
+    for sample in prof.sample:
+        frames = []
+        for lid in sample.location_id:  # leaf first, per pprof convention
+            frames.extend(loc_frames.get(lid, []))
+        labels = {}
+        for lb in sample.label:
+            labels[s(lb.key)] = s(lb.str) if lb.str else lb.num
+        values = list(sample.value)
+
+        def v(i: int) -> int:
+            return int(values[i]) if i < len(values) else 0
+
+        rows.append({
+            "device": str(labels.get("device", "")),
+            "kind": str(labels.get("kind", "buffer")),
+            "count": v(count_i),
+            "bytes": v(bytes_i),
+            "site": _site_of(frames),
+            "stack": ";".join(frames),
+        })
+    return pd.DataFrame(
+        rows, columns=["device", "kind", "count", "bytes", "site", "stack"])
+
+
+def load_memprof(logdir: str) -> Tuple[Optional[pd.DataFrame], dict]:
+    """(samples, meta) for a logdir, or (None, {}) when never captured.
+
+    meta is the sidecar collectors/tpumon.py writes: unix_ns, trigger
+    ("peak" | "final"), total_bytes at trigger time.
+    """
+    path = os.path.join(logdir, "memprof.pb.gz")
+    if not os.path.isfile(path):
+        return None, {}
+    df = parse_memprof(path)
+    meta = {}
+    try:
+        with open(path + ".meta.json") as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        pass
+    return df, meta
+
+
+def aggregate_sites(df: pd.DataFrame, top_k: int = 30) -> pd.DataFrame:
+    """Top allocation sites by held bytes, with per-site share of total."""
+    if df is None or df.empty:
+        return pd.DataFrame(
+            columns=["site", "kind", "bytes", "count", "share"])
+    g = (df.groupby(["site", "kind"], as_index=False)
+           .agg(bytes=("bytes", "sum"), count=("count", "sum"))
+           .sort_values("bytes", ascending=False))
+    total = float(g["bytes"].sum()) or 1.0
+    g["share"] = g["bytes"] / total
+    return g.head(top_k).reset_index(drop=True)

@@ -1,0 +1,153 @@
+"""Reader for the native columnar XPlane scan (native/xplane_scan.cc).
+
+Pod-scale ingest is bounded by the per-event Python loop over proto
+objects; the native scanner walks the wire format once and hands back flat
+numpy arrays per line, so `ingest/xplane.py` can assemble the op frame
+vectorized (metadata-derived fields are computed once per metadata id and
+gathered with a searchsorted index).
+
+Everything degrades: no compiler / failed scan / mismatched layout all
+return None and the caller keeps the pure-Python path.  Set
+``SOFA_NATIVE_SCAN=0`` to force the Python path (the equivalence tests use
+this to produce the reference frames).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import subprocess
+import tempfile
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from sofa_tpu.printing import print_info, print_warning
+
+_MAGIC = 0x31584653  # "SFX1" little-endian
+_VERSION = 1
+
+
+@dataclass
+class ScanLine:
+    line_id: int
+    timestamp_ns: int
+    name: str
+    metadata_ids: np.ndarray   # i64[n]
+    offsets_ps: np.ndarray     # i64[n]
+    durations_ps: np.ndarray   # i64[n]
+    flags: np.ndarray          # u8[n]; bit0 = derived per-event stats
+
+
+@dataclass
+class ScanPlane:
+    name: str
+    lines: List[ScanLine]
+
+
+def enabled() -> bool:
+    return os.environ.get("SOFA_NATIVE_SCAN", "1") != "0"
+
+
+def ensure_scanner() -> Optional[str]:
+    """Build (lazily) and return the scanner binary path, or None."""
+    if not enabled():
+        return None
+    from sofa_tpu.collectors.native_build import ensure_built
+
+    return ensure_built("xplane_scan")
+
+
+def _parse(buf: bytes) -> Optional[List[ScanPlane]]:
+    try:
+        return _parse_inner(buf)
+    except (struct.error, IndexError, ValueError):
+        # Truncated scanner output (e.g. disk-full short write) must land
+        # on the Python fallback, never abort the ingest.
+        return None
+
+
+def _parse_inner(buf: bytes) -> Optional[List[ScanPlane]]:
+    if len(buf) < 8:
+        return None
+    magic, version = struct.unpack_from("<II", buf, 0)
+    if magic != _MAGIC or version != _VERSION:
+        return None
+    planes: List[ScanPlane] = []
+    off = 8
+    n_buf = len(buf)
+    while off < n_buf:
+        tag = buf[off]
+        off += 1
+        if tag == 1:
+            (nlen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            name = buf[off:off + nlen].decode(errors="replace")
+            off += nlen
+            planes.append(ScanPlane(name, []))
+        elif tag == 2:
+            line_id, ts_ns = struct.unpack_from("<qq", buf, off)
+            off += 16
+            (nlen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            name = buf[off:off + nlen].decode(errors="replace")
+            off += nlen
+            if not planes:
+                return None
+            planes[-1].lines.append(
+                ScanLine(line_id, ts_ns, name,
+                         np.empty(0, np.int64), np.empty(0, np.int64),
+                         np.empty(0, np.int64), np.empty(0, np.uint8)))
+        elif tag == 3:
+            (n,) = struct.unpack_from("<Q", buf, off)
+            off += 8
+            need = n * 8 * 3 + n
+            if off + need > n_buf or not planes or not planes[-1].lines:
+                return None
+            line = planes[-1].lines[-1]
+            line.metadata_ids = np.frombuffer(buf, np.int64, n, off)
+            off += n * 8
+            line.offsets_ps = np.frombuffer(buf, np.int64, n, off)
+            off += n * 8
+            line.durations_ps = np.frombuffer(buf, np.int64, n, off)
+            off += n * 8
+            line.flags = np.frombuffer(buf, np.uint8, n, off)
+            off += n
+        else:
+            return None
+    return planes
+
+
+def scan_file(path: str, derived_stat_names) -> Optional[List[ScanPlane]]:
+    """Run the native scanner over one .xplane.pb; None on any failure."""
+    exe = ensure_scanner()
+    if exe is None:
+        return None
+    fd, out_path = tempfile.mkstemp(prefix="sofa_xscan_", suffix=".bin")
+    os.close(fd)
+    try:
+        r = subprocess.run(
+            [exe, path, out_path, ",".join(sorted(derived_stat_names))],
+            capture_output=True, text=True, timeout=300)
+        if r.returncode != 0:
+            print_warning(f"native scan failed ({r.stderr.strip()[:120]}); "
+                          "using Python ingest")
+            return None
+        with open(out_path, "rb") as f:
+            planes = _parse(f.read())
+        if planes is None:
+            print_warning("native scan produced an unreadable layout; "
+                          "using Python ingest")
+        else:
+            print_info(f"native scan: {os.path.basename(path)} "
+                       f"({sum(len(p.lines) for p in planes)} lines)")
+        return planes
+    except (OSError, subprocess.SubprocessError) as e:
+        print_warning(f"native scan unavailable ({e}); using Python ingest")
+        return None
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass

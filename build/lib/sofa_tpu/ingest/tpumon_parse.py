@@ -1,0 +1,79 @@
+"""tpumon.txt -> unified-schema frame.
+
+Input: one line per device per tick (collectors/tpumon.py),
+
+    <unix_ns> <device_id> <bytes_in_use> <bytes_limit> <peak_bytes_in_use>
+
+deviceId -1 is the liveness heartbeat.  Output rows mirror the trace-derived
+tpuutil conventions (name=metric, event=value):
+
+    hbm_used_gb    — HBM bytes in use, GB (payload carries raw bytes)
+    hbm_occupancy  — % of bytes_limit in use
+    alive          — heartbeat, event=1.0
+
+The reference's nvsmi_trace.csv is the GPU analogue
+(/root/reference/bin/sofa_preprocess.py:1013-1183).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pandas as pd
+
+from sofa_tpu.trace import empty_frame, make_frame
+
+
+def parse_tpumon_line(line: str):
+    """One sampler line -> (ts_ns, dev, used, limit, peak) or None.
+
+    The single place that knows the 5-field format — parse_tpumon and the
+    `sofa top` dashboard both go through it."""
+    parts = line.split()
+    if len(parts) != 5:
+        return None
+    try:
+        return tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+
+
+def parse_tpumon(text: str, time_base: float = 0.0) -> pd.DataFrame:
+    rows = []
+    for line in text.splitlines():
+        parsed = parse_tpumon_line(line)
+        if parsed is None:
+            continue
+        ts_ns, dev, used, limit, peak = parsed
+        t = ts_ns / 1e9 - time_base
+        if dev == -1:
+            rows.append(
+                {
+                    "timestamp": t, "event": 1.0, "deviceId": -1,
+                    "name": "alive", "device_kind": "tpu",
+                }
+            )
+            continue
+        rows.append(
+            {
+                "timestamp": t, "event": used / 1e9, "deviceId": dev,
+                "payload": used, "name": "hbm_used_gb", "device_kind": "tpu",
+            }
+        )
+        if limit > 0:
+            rows.append(
+                {
+                    "timestamp": t, "event": 100.0 * used / limit,
+                    "deviceId": dev, "payload": peak,
+                    "name": "hbm_occupancy", "device_kind": "tpu",
+                }
+            )
+    return make_frame(rows)
+
+
+def ingest_tpumon(logdir: str, time_base: float = 0.0) -> pd.DataFrame:
+    path = os.path.join(logdir, "tpumon.txt")
+    if not os.path.isfile(path):
+        return empty_frame()
+    with open(path) as f:
+        return parse_tpumon(f.read(), time_base)

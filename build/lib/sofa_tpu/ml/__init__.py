@@ -1,0 +1,9 @@
+"""Pattern-mining layer: iteration detection (AISI), swarm clustering (HSG),
+and run-to-run swarm diff.
+
+The reference builds these on a McCreight suffix tree + fuzzywuzzy + KMeans
+(SURVEY §2.6).  This implementation uses a suffix automaton for repeated-
+pattern mining (same asymptotics, far less code), difflib for fuzzy matching
+(no external dependency), and exact occurrence positions instead of KMeans
+boundary clustering.
+"""

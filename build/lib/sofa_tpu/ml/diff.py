@@ -1,0 +1,204 @@
+"""`sofa diff` — run-to-run swarm comparison.
+
+Reference sofa_swarm_diff (sofa_ml.py:311-415,417-539): load two
+auto_caption.csv files, concatenate each cluster's function names, fuzzy-
+match clusters across runs, and report per-cluster duration deltas plus the
+match intersection rate.  Same shape here with difflib as the fuzzy matcher.
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+from typing import Dict, Optional
+
+import pandas as pd
+
+from sofa_tpu.printing import print_progress, print_title, print_warning
+
+
+def _cluster_signatures(df: pd.DataFrame) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for cid, rows in df.groupby("cluster_ID"):
+        names = rows["name"].astype(str)
+        out[int(cid)] = {
+            "names": " ".join(sorted(names.unique())[:80]),
+            "name_set": set(names.unique()),
+            "duration": float(rows["duration"].sum()),
+            "samples": len(rows),
+        }
+    return out
+
+
+def match_swarms(base: Dict[int, dict], match: Dict[int, dict]) -> Dict[int, Optional[int]]:
+    """Greedy best-ratio matching of base clusters onto match clusters
+    (reference matching_two_dicts_of_swarm, sofa_ml.py:311-341)."""
+    pairs = []
+    for b, bs in base.items():
+        for m, ms in match.items():
+            ratio = difflib.SequenceMatcher(None, bs["names"], ms["names"]).ratio()
+            pairs.append((ratio, b, m))
+    pairs.sort(reverse=True)
+    used_b, used_m = set(), set()
+    out: Dict[int, Optional[int]] = {b: None for b in base}
+    for ratio, b, m in pairs:
+        if ratio < 0.3:
+            break
+        if b in used_b or m in used_m:
+            continue
+        out[b] = m
+        used_b.add(b)
+        used_m.add(m)
+    return out
+
+
+def _delta_table(base: pd.DataFrame, match: pd.DataFrame, value_col: str,
+                 out_path: str) -> pd.DataFrame:
+    """Outer-join two per-key aggregates into the shared diff shape.
+
+    delta = match - base; ratio uses the one inf convention both diffs rely
+    on: keys new in match get ratio=inf so the mover filter — and the
+    reader — can't miss a regression that only exists in match, while a key
+    with zero value in BOTH runs is unchanged (ratio 1), not a mover.
+    Sorted by |delta| and written to out_path.
+    """
+    import numpy as np
+
+    joined = base.join(match, how="outer",
+                       lsuffix="_base", rsuffix="_match").fillna(0.0)
+    b, m = f"{value_col}_base", f"{value_col}_match"
+    joined["delta"] = joined[m] - joined[b]
+    joined["ratio"] = np.where(
+        joined[b] > 0,
+        joined[m] / joined[b].replace(0, np.nan),
+        np.where(joined[m] > 0, np.inf, 1.0))
+    table = joined.reindex(
+        joined["delta"].abs().sort_values(ascending=False).index
+    ).reset_index()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    table.to_csv(out_path, index=False)
+    return table
+
+
+def sofa_tpu_diff(cfg) -> Optional[pd.DataFrame]:
+    """Run-to-run HLO-op diff — the TPU-side complement to the swarm diff.
+
+    The reference could only diff CPU swarms (its GPU table had no
+    cross-run matching); HLO op names are stable across runs of the same
+    program, so an exact name join gives per-op time deltas directly.
+    Reads both runs' tputrace frames, writes tpu_diff.csv sorted by
+    |delta|, and flags ops whose time moved more than 20 %.
+    """
+    from sofa_tpu.trace import read_frame, roi_clip
+
+    base = read_frame(os.path.join(cfg.base_logdir, "tputrace"))
+    match = read_frame(os.path.join(cfg.match_logdir, "tputrace"))
+    if base is None or match is None or base.empty or match.empty:
+        print_warning("diff: no tputrace in one of the runs — skipping "
+                      "TPU op diff")
+        return None
+
+    def per_op(df):
+        sync = roi_clip(df, cfg)        # same window as every other pass
+        sync = sync[sync["category"] == 0]
+        return sync.groupby("name").agg(
+            time=("duration", "sum"), count=("duration", "count"))
+
+    out_path = os.path.join(cfg.logdir, "tpu_diff.csv")
+    table = _delta_table(per_op(base), per_op(match), "time", out_path)
+
+    tb, tm = float(table["time_base"].sum()), float(table["time_match"].sum())
+    print_title("TPU op diff (base vs match)")
+    print(table.head(15).to_string(index=False))
+    moved = table[(table["ratio"] > 1.2) | (table["ratio"] < 1 / 1.2)]
+    print_progress(
+        f"diff: device time {tb:.4f}s -> {tm:.4f}s "
+        f"({(tm / tb - 1) * 100 if tb else 0:+.1f}%); "
+        f"{len(moved)} ops moved >20%; wrote {out_path}")
+    return table
+
+
+def sofa_mem_diff(cfg) -> Optional[pd.DataFrame]:
+    """Run-to-run HBM attribution diff — memory regressions by site.
+
+    Complements sofa_tpu_diff's time deltas: joins the two runs' peak
+    allocation-site tables (ingest/memprof.py) on (site, kind) and reports
+    held-byte deltas, so "this commit grew the optimizer state 2x" is one
+    table row instead of an OOM three days later.  No reference analogue —
+    its memory signal was one nvsmi total, undiffable by construction.
+    """
+    from sofa_tpu.ingest.memprof import load_memprof
+
+    base_df, _ = load_memprof(cfg.base_logdir)
+    match_df, _ = load_memprof(cfg.match_logdir)
+    if base_df is None or match_df is None or base_df.empty or match_df.empty:
+        print_warning("diff: no memprof.pb.gz in one of the runs — "
+                      "skipping memory diff")
+        return None
+
+    def per_site(df):
+        return df.groupby(["site", "kind"]).agg(
+            bytes=("bytes", "sum"), count=("count", "sum"))
+
+    out_path = os.path.join(cfg.logdir, "mem_diff.csv")
+    table = _delta_table(per_site(base_df), per_site(match_df), "bytes",
+                         out_path)
+
+    bb = float(table["bytes_base"].sum())
+    bm = float(table["bytes_match"].sum())
+    print_title("HBM attribution diff (base vs match)")
+    print(table.head(15).to_string(index=False))
+    grown = table[table["delta"] > 0.05 * max(bb, 1)]
+    print_progress(
+        f"diff: held bytes {bb / 1e9:.3f}GB -> {bm / 1e9:.3f}GB "
+        f"({(bm / bb - 1) * 100 if bb else 0:+.1f}%); "
+        f"{len(grown)} sites grew >5% of the base total; wrote {out_path}")
+    return table
+
+
+def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
+    base_path = os.path.join(cfg.base_logdir, "auto_caption.csv")
+    match_path = os.path.join(cfg.match_logdir, "auto_caption.csv")
+    for p in (base_path, match_path):
+        if not os.path.isfile(p):
+            print_warning(f"diff: {p} missing — run with --enable_hsg or `sofa diff`")
+            return None
+    base = _cluster_signatures(pd.read_csv(base_path))
+    match = _cluster_signatures(pd.read_csv(match_path))
+    mapping = match_swarms(base, match)
+
+    rows = []
+    for b, m in mapping.items():
+        bs = base[b]
+        row = {
+            "base_cluster": b,
+            "match_cluster": m if m is not None else -1,
+            "base_duration": bs["duration"],
+            "base_samples": bs["samples"],
+        }
+        if m is not None:
+            ms = match[m]
+            inter = bs["name_set"] & ms["name_set"]
+            union = bs["name_set"] | ms["name_set"]
+            row.update(
+                {
+                    "match_duration": ms["duration"],
+                    "duration_delta": ms["duration"] - bs["duration"],
+                    "duration_ratio": (
+                        ms["duration"] / bs["duration"] if bs["duration"] > 0 else 0.0
+                    ),
+                    "intersection_rate": len(inter) / len(union) if union else 0.0,
+                }
+            )
+        rows.append(row)
+    table = pd.DataFrame(rows).sort_values("base_duration", ascending=False)
+    out_path = os.path.join(cfg.logdir, "swarm_diff.csv")
+    os.makedirs(cfg.logdir, exist_ok=True)
+    table.to_csv(out_path, index=False)
+    print_title("Swarm diff (base vs match)")
+    print(table.to_string(index=False))
+    matched = table[table["match_cluster"] >= 0]
+    print_progress(
+        f"diff: matched {len(matched)}/{len(table)} swarms; wrote {out_path}"
+    )
+    return table

@@ -1,0 +1,126 @@
+"""HSG — hierarchical swarm grouping of timeline samples.
+
+Reference hsg_v2 (sofa_ml.py:243-287): AgglomerativeClustering of CPU
+samples on event=log10(IP) with average linkage into num_swarms clusters;
+each swarm is captioned by the most common demangled function name, reported
+as a "Function Swarm Report" and written to auto_caption.csv (the input to
+`sofa diff`).
+
+Same algorithm here, running over cputrace (perf samples) when present and
+falling back to the XPlane host-runtime trace otherwise — TPU hosts often
+lack perf but always have the host tracer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_progress, print_title, print_warning
+
+
+def pick_samples(frames) -> Tuple[Optional[pd.DataFrame], str]:
+    cputrace = frames.get("cputrace")
+    if cputrace is not None and not cputrace.empty:
+        return cputrace, "cputrace"
+    hosttrace = frames.get("hosttrace")
+    if hosttrace is not None and not hosttrace.empty:
+        return hosttrace, "hosttrace"
+    return None, ""
+
+
+def hsg_cluster(
+    df: pd.DataFrame, num_swarms: int, max_samples: int = 200_000
+) -> pd.DataFrame:
+    """Return df with an added cluster_ID column.
+
+    The clustering feature is one scalar (event = log10 IP / lane value), so
+    full AgglomerativeClustering — O(n^2) memory in sklearn — is overkill;
+    splitting the sorted values at the k-1 largest gaps produces the same
+    partition single-linkage would, in O(n log n), and survives million-row
+    perf captures.
+    """
+    if len(df) > max_samples:
+        df = df.iloc[:: int(np.ceil(len(df) / max_samples))]
+    df = df.reset_index(drop=True)
+    k = min(num_swarms, len(df))
+    if k < 2:
+        return df.assign(cluster_ID=0)
+    values = df["event"].to_numpy(dtype=float)
+    order = np.argsort(values)
+    sorted_vals = values[order]
+    gaps = np.diff(sorted_vals)
+    if len(gaps) == 0 or not np.any(gaps > 0):
+        return df.assign(cluster_ID=0)
+    k = min(k, int(np.count_nonzero(gaps > 0)) + 1)
+    cut_positions = np.sort(np.argsort(gaps)[-(k - 1):])  # indices into sorted_vals
+    boundaries = sorted_vals[cut_positions]  # last value of each lower cluster
+    # side="left": a value equal to a boundary belongs to the lower cluster.
+    labels = np.searchsorted(boundaries, values, side="left")
+    return df.assign(cluster_ID=labels)
+
+
+def sofa_hsg(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
+    df, source = pick_samples(frames)
+    if df is None:
+        print_warning("hsg: no cputrace or hosttrace samples to cluster")
+        return None
+    clustered = hsg_cluster(df, cfg.num_swarms)
+    report_rows = []
+    for cid, rows in clustered.groupby("cluster_ID"):
+        names = rows["name"].astype(str)
+        caption = names.mode().iloc[0] if not names.empty else ""
+        report_rows.append(
+            {
+                "cluster_ID": int(cid),
+                "caption": caption,
+                "samples": len(rows),
+                "total_duration": float(rows["duration"].sum()),
+                "function_names": "|".join(names.unique()[:50]),
+            }
+        )
+    report = pd.DataFrame(report_rows).sort_values(
+        "total_duration", ascending=False
+    ).reset_index(drop=True)
+    # auto_caption.csv is the diff input (reference sofa_ml.py:289-309).
+    clustered.to_csv(cfg.path("auto_caption.csv"), index=False)
+    report.to_csv(cfg.path("swarms_report.csv"), index=False)
+    with open(cfg.path("swarms_report.txt"), "w") as f:
+        f.write(report.drop(columns=["function_names"]).to_string(index=False) + "\n")
+    features.add("hsg_swarms", len(report))
+    print_progress(f"hsg: {len(report)} swarms over {len(clustered)} {source} samples")
+    if cfg.verbose:
+        print_title("Function Swarm Report")
+        print(report.drop(columns=["function_names"]).head(20).to_string(index=False))
+    return clustered
+
+
+def swarm_series(clustered: Optional[pd.DataFrame], max_swarms: int = 10):
+    """Per-swarm timeline series for the board."""
+    if clustered is None or clustered.empty or "cluster_ID" not in clustered:
+        return []
+    from sofa_tpu.trace import SofaSeries
+
+    palette = [
+        "tomato", "gold", "mediumseagreen", "deepskyblue", "orchid",
+        "darkkhaki", "salmon", "turquoise", "plum", "lightslategray",
+    ]
+    out = []
+    top = (
+        clustered.groupby("cluster_ID")["duration"].sum()
+        .sort_values(ascending=False).head(max_swarms)
+    )
+    for i, cid in enumerate(top.index):
+        rows = clustered[clustered["cluster_ID"] == cid]
+        caption = rows["name"].astype(str).mode()
+        title = f"swarm {cid}: {caption.iloc[0][:40] if not caption.empty else ''}"
+        out.append(
+            SofaSeries(
+                f"swarm_{cid}", title, palette[i % len(palette)],
+                rows.drop(columns=["cluster_ID"]),
+            )
+        )
+    return out

@@ -1,0 +1,49 @@
+// timebase — host clock-domain anchor for sofa_tpu.
+//
+// The reference pins unix epoch time against the kernel profiler's uptime
+// clock by running `perf record` at a known gettimeofday() instant
+// (/root/reference/bin/sofa_perf_timebase.cc:8-19).  The TPU build needs the
+// same bridge but across more domains: perf/ftrace stamp CLOCK_MONOTONIC (or
+// BOOTTIME), the XPlane trace stamps its own session clock, and /proc
+// samplers stamp CLOCK_REALTIME.  This tool emits N simultaneous
+// (realtime, monotonic, boottime, monotonic_raw) samples so preprocess can
+// convert any of those domains into unix time by linear fit; the XPlane
+// session clock is anchored separately by the in-trace marker annotation
+// (sofa_tpu/collectors/xprof.py).
+//
+// Output: one line per sample to stdout:
+//   <realtime_ns> <monotonic_ns> <boottime_ns> <monotonic_raw_ns>
+//
+// Usage: timebase [samples=3] [interval_ms=0]
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+
+static long long now_ns(clockid_t id) {
+  struct timespec ts;
+  if (clock_gettime(id, &ts) != 0) return -1;
+  return static_cast<long long>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char** argv) {
+  int samples = argc > 1 ? atoi(argv[1]) : 3;
+  int interval_ms = argc > 2 ? atoi(argv[2]) : 0;
+  if (samples < 1) samples = 1;
+  for (int i = 0; i < samples; ++i) {
+    // Read the fast pair twice around the slower ones to bound skew; emit
+    // the midpoint of realtime so the tuple is as simultaneous as possible.
+    long long rt0 = now_ns(CLOCK_REALTIME);
+    long long mono = now_ns(CLOCK_MONOTONIC);
+    long long boot = now_ns(CLOCK_BOOTTIME);
+    long long raw = now_ns(CLOCK_MONOTONIC_RAW);
+    long long rt1 = now_ns(CLOCK_REALTIME);
+    long long rt = (rt0 + rt1) / 2;
+    printf("%lld %lld %lld %lld\n", rt, mono, boot, raw);
+    if (interval_ms > 0 && i + 1 < samples) {
+      struct timespec req = {interval_ms / 1000, (interval_ms % 1000) * 1000000L};
+      nanosleep(&req, nullptr);
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,304 @@
+// xplane_scan — columnar XPlane event extractor.
+//
+// Ingesting pod-scale .xplane.pb captures is bounded by the per-event
+// Python loop, not by protobuf decoding (the proto runtime is already
+// native).  This helper walks the protobuf wire format directly and emits
+// every line's events as flat columnar arrays that numpy can frombuffer,
+// so the Python side (sofa_tpu/ingest/native_scan.py) derives per-metadata
+// fields once per metadata id and assembles frames vectorized.
+//
+// Wire schema: sofa_tpu/native/xplane.proto (field numbers mirror
+// tensorflow's xplane.proto; unknown fields are skipped, so richer real
+// captures parse fine).
+//
+// Usage: xplane_scan <in.xplane.pb> <out.bin> [derived_stat_names_csv]
+//
+// Output (little-endian):
+//   u32 magic 0x53465831 ("SFX1" LE), u32 version=1
+//   records:
+//     u8 1 (plane): u32 name_len, name bytes
+//     u8 2 (line):  i64 line_id, i64 timestamp_ns, u32 name_len, name
+//     u8 3 (events): u64 n, n*i64 metadata_id, n*i64 offset_ps,
+//                    n*i64 duration_ps, n*u8 flags
+//                    flag bit0: event carries a stat whose metadata name is
+//                    in the derived set (Python re-derives those rows from
+//                    the proto); bit1: num_occurrences form (aggregated).
+//
+// Like timebase/sysmon this is built lazily (collectors/native_build.py
+// pattern) and everything degrades to the pure-Python path when the
+// binary or toolchain is unavailable.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Slice {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool done() const { return p >= end; }
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  Slice sub() {  // length-delimited payload
+    uint64_t n = varint();
+    // Compare against the remaining length, never `p + n > end`: n is a
+    // corruption-controlled varint and p + n can overflow (pointer UB),
+    // wrap below `end`, and pass the check with wild subsequent reads.
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return {end, end};
+    }
+    Slice s{p, p + n};
+    p += n;
+    return s;
+  }
+
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      // Clamp fixed-width skips to `end`: advancing p past end would make
+      // sub()'s `end - p` remaining-length math go negative (huge as
+      // uint64) if a caller raced ahead of the ok flag.
+      case 0: varint(); break;
+      case 1: if (end - p >= 8) { p += 8; } else { p = end; ok = false; } break;
+      case 2: sub(); break;
+      case 5: if (end - p >= 4) { p += 4; } else { p = end; ok = false; } break;
+      default: ok = false;
+    }
+  }
+};
+
+struct Out {
+  FILE* f;
+  void raw(const void* d, size_t n) { fwrite(d, 1, n, f); }
+  void u8(uint8_t v) { raw(&v, 1); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void str(const Slice& s) {
+    u32(static_cast<uint32_t>(s.end - s.p));
+    raw(s.p, s.end - s.p);
+  }
+};
+
+// One pass over an XEvent: scalar fields + whether any stat's metadata id
+// is in the derived set.
+void scan_event(Slice ev, const std::set<uint64_t>& derived, int64_t* mid,
+                int64_t* off_ps, int64_t* dur_ps, uint8_t* flags) {
+  *mid = 0;
+  *off_ps = 0;
+  *dur_ps = 0;
+  *flags = 0;
+  while (!ev.done() && ev.ok) {
+    uint64_t key = ev.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 0) {
+      *mid = static_cast<int64_t>(ev.varint());
+    } else if (field == 2 && wt == 0) {
+      *off_ps = static_cast<int64_t>(ev.varint());
+    } else if (field == 3 && wt == 0) {
+      *dur_ps = static_cast<int64_t>(ev.varint());
+    } else if (field == 5 && wt == 0) {
+      ev.varint();
+      *flags |= 2;  // aggregated num_occurrences form
+    } else if (field == 4 && wt == 2) {
+      Slice st = ev.sub();
+      while (!st.done() && st.ok) {
+        uint64_t skey = st.varint();
+        if ((skey >> 3) == 1 && (skey & 7) == 0) {
+          if (derived.count(st.varint())) *flags |= 1;
+        } else {
+          st.skip(skey & 7);
+        }
+      }
+    } else {
+      ev.skip(wt);
+    }
+  }
+}
+
+// stat_metadata map entry -> (id, name)
+void scan_stat_metadata_entry(Slice entry, const std::set<std::string>& names,
+                              std::set<uint64_t>* derived) {
+  uint64_t key_id = 0;
+  std::string name;
+  while (!entry.done() && entry.ok) {
+    uint64_t key = entry.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 0) {
+      key_id = entry.varint();
+    } else if (field == 2 && wt == 2) {
+      Slice v = entry.sub();  // XStatMetadata
+      while (!v.done() && v.ok) {
+        uint64_t vkey = v.varint();
+        uint32_t vf = vkey >> 3, vwt = vkey & 7;
+        if (vf == 2 && vwt == 2) {
+          Slice n = v.sub();
+          name.assign(reinterpret_cast<const char*>(n.p), n.end - n.p);
+        } else {
+          v.skip(vwt);
+        }
+      }
+    } else {
+      entry.skip(wt);
+    }
+  }
+  if (key_id && names.count(name)) derived->insert(key_id);
+}
+
+void scan_line(Slice line, const std::set<uint64_t>& derived, Out* out) {
+  int64_t line_id = 0, ts_ns = 0;
+  Slice name{nullptr, nullptr};
+  std::vector<Slice> events;
+  while (!line.done() && line.ok) {
+    uint64_t key = line.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 0) {
+      line_id = static_cast<int64_t>(line.varint());
+    } else if (field == 2 && wt == 2) {
+      name = line.sub();
+    } else if (field == 3 && wt == 0) {
+      ts_ns = static_cast<int64_t>(line.varint());
+    } else if (field == 4 && wt == 2) {
+      events.push_back(line.sub());
+    } else {
+      line.skip(wt);
+    }
+  }
+  out->u8(2);
+  out->i64(line_id);
+  out->i64(ts_ns);
+  out->str(name);
+
+  size_t n = events.size();
+  std::vector<int64_t> mids(n), offs(n), durs(n);
+  std::vector<uint8_t> flags(n);
+  for (size_t i = 0; i < n; i++) {
+    scan_event(events[i], derived, &mids[i], &offs[i], &durs[i], &flags[i]);
+  }
+  out->u8(3);
+  out->u64(n);
+  out->raw(mids.data(), n * 8);
+  out->raw(offs.data(), n * 8);
+  out->raw(durs.data(), n * 8);
+  out->raw(flags.data(), n);
+}
+
+void scan_plane(Slice plane, const std::set<std::string>& derived_names,
+                Out* out) {
+  // Pass 1: stat_metadata (serialized order is unspecified; the derived
+  // set must exist before events are flagged).
+  std::set<uint64_t> derived;
+  Slice p1 = plane;
+  Slice name{nullptr, nullptr};
+  while (!p1.done() && p1.ok) {
+    uint64_t key = p1.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 5 && wt == 2) {
+      scan_stat_metadata_entry(p1.sub(), derived_names, &derived);
+    } else if (field == 2 && wt == 2) {
+      name = p1.sub();
+    } else {
+      p1.skip(wt);
+    }
+  }
+  out->u8(1);
+  out->str(name);
+  // Pass 2: lines.
+  while (!plane.done() && plane.ok) {
+    uint64_t key = plane.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 3 && wt == 2) {
+      scan_line(plane.sub(), derived, out);
+    } else {
+      plane.skip(wt);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: xplane_scan <in.xplane.pb> <out.bin> [derived_csv]\n");
+    return 2;
+  }
+  FILE* in = fopen(argv[1], "rb");
+  if (!in) {
+    perror("open input");
+    return 1;
+  }
+  fseek(in, 0, SEEK_END);
+  long size = ftell(in);
+  fseek(in, 0, SEEK_SET);
+  std::vector<uint8_t> buf(size > 0 ? size : 0);
+  if (size > 0 && fread(buf.data(), 1, size, in) != static_cast<size_t>(size)) {
+    fclose(in);
+    fprintf(stderr, "short read\n");
+    return 1;
+  }
+  fclose(in);
+
+  std::set<std::string> derived_names;
+  if (argc > 3) {
+    std::string csv(argv[3]);
+    size_t start = 0;
+    while (start <= csv.size()) {
+      size_t comma = csv.find(',', start);
+      if (comma == std::string::npos) comma = csv.size();
+      if (comma > start) derived_names.insert(csv.substr(start, comma - start));
+      start = comma + 1;
+    }
+  }
+
+  FILE* fo = fopen(argv[2], "wb");
+  if (!fo) {
+    perror("open output");
+    return 1;
+  }
+  Out out{fo};
+  out.u32(0x31584653u);  // "SFX1" little-endian
+  out.u32(1);
+
+  Slice top{buf.data(), buf.data() + buf.size()};
+  while (!top.done() && top.ok) {
+    uint64_t key = top.varint();
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 2) {
+      scan_plane(top.sub(), derived_names, &out);
+    } else {
+      top.skip(wt);
+    }
+  }
+  // A short write (disk full) must exit nonzero, or the Python side would
+  // parse a silently truncated layout.
+  bool write_error = ferror(fo) != 0;
+  if (fclose(fo) != 0) write_error = true;
+  if (write_error) {
+    fprintf(stderr, "output write failed\n");
+    return 1;
+  }
+  if (!top.ok) {
+    fprintf(stderr, "malformed protobuf input\n");
+    return 1;
+  }
+  return 0;
+}

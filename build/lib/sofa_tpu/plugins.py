@@ -1,0 +1,30 @@
+"""Plugin loading.
+
+The reference imports any module named on PYTHONPATH and calls
+``<name>(cfg)`` at CLI start (/root/reference/bin/sofa:21,322 with
+plugins/dummy_plugin.py).  We generalize: ``--plugin mod`` or ``--plugin
+mod:func`` — the callable receives the SofaConfig before the pipeline runs and
+may mutate it (register filters, tweak collector knobs, ...).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from sofa_tpu.printing import print_error, print_info
+
+
+def load_plugins(cfg) -> None:
+    for spec in cfg.plugins:
+        mod_name, _, func_name = spec.partition(":")
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            print_error(f"plugin {spec!r}: cannot import {mod_name!r}: {e}")
+            continue
+        func = getattr(mod, func_name or mod_name.rsplit(".", 1)[-1], None)
+        if not callable(func):
+            print_error(f"plugin {spec!r}: no callable entry point")
+            continue
+        print_info(f"plugin {spec!r} loaded")
+        func(cfg)

@@ -1,0 +1,260 @@
+"""`sofa preprocess` — raw collector files -> unified CSVs + report.js.
+
+The files-on-disk contract (SURVEY §1): every parser reads logdir raw files
+and writes `<source>.csv` in the unified schema, then all timeline series are
+serialized to report.js for the board.  Each source is optional and failures
+degrade per-source (the reference wraps every pass in try/except,
+sofa_analyze.py:873-977; we do the same here at ingest).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import pandas as pd
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.ingest import procfs
+from sofa_tpu.ingest.pcap import ingest_pcap
+from sofa_tpu.ingest.perf_script import ingest_perf
+from sofa_tpu.ingest.strace_parse import parse_pystacks, parse_strace
+from sofa_tpu.ingest.timebase_align import converter
+from sofa_tpu.ingest.xplane import ingest_xprof_dir
+from sofa_tpu.printing import print_progress, print_warning
+from sofa_tpu.trace import (SofaSeries, downsample, empty_frame, write_csv,
+                            write_frame)
+
+# Distinct default colors for the master timeline (CSS color names the board
+# understands; reference picks similar fixed palette per series).
+_SERIES_STYLE = {
+    "cputrace": ("CPU samples", "dodgerblue"),
+    "hosttrace": ("Host runtime", "slategray"),
+    "pystacks": ("Python stacks", "goldenrod"),
+    "strace": ("Syscalls", "brown"),
+    "mpstat": ("CPU util %", "steelblue"),
+    "vmstat": ("vmstat", "darkkhaki"),
+    "diskstat": ("Disk", "sienna"),
+    "netbandwidth": ("NIC B/s", "seagreen"),
+    "nettrace": ("Packets", "olive"),
+    "tputrace": ("TPU HLO ops", "darkorchid"),
+    "tpumodules": ("TPU modules", "mediumvioletred"),
+    "tpuutil": ("TPU util", "crimson"),
+    "tpumon": ("TPU HBM", "firebrick"),
+    "tpusteps": ("TPU steps", "black"),
+    "customtrace": ("Runtime (megascale/DCN)", "teal"),
+    "blktrace": ("Block IO latency (ms)", "peru"),
+}
+
+
+def read_time_base(cfg: SofaConfig) -> float:
+    try:
+        with open(cfg.path("sofa_time.txt")) as f:
+            return float(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        print_warning("sofa_time.txt missing; timestamps stay absolute")
+        return 0.0
+
+
+def read_misc(cfg: SofaConfig) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    try:
+        with open(cfg.path("misc.txt")) as f:
+            for line in f:
+                p = line.split()
+                if len(p) == 2:
+                    out[p[0]] = p[1]
+    except OSError:
+        pass
+    return out
+
+
+def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
+    if not os.path.isdir(cfg.logdir):
+        raise FileNotFoundError(
+            f"logdir {cfg.logdir} does not exist — run `sofa record` first"
+        )
+    time_base = read_time_base(cfg)
+    cfg.time_base = time_base
+    offset = cfg.cpu_time_offset_ms / 1e3
+    frames: Dict[str, pd.DataFrame] = {}
+
+    def ingest(name: str, fn, *args, **kwargs):
+        try:
+            df = fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — per-source degradation
+            print_warning(f"preprocess {name}: {e}")
+            df = empty_frame()
+        frames[name] = df
+        if not df.empty and offset:
+            df["timestamp"] = df["timestamp"] + offset
+
+    # --- host samplers ----------------------------------------------------
+    ingest("mpstat", procfs.load, cfg.path("mpstat.txt"), procfs.parse_mpstat, time_base)
+    ingest("diskstat", procfs.load, cfg.path("diskstat.txt"), procfs.parse_diskstat, time_base)
+    ingest("netbandwidth", procfs.load, cfg.path("netstat.txt"), procfs.parse_netstat, time_base)
+    ingest("cpuinfo", procfs.load, cfg.path("cpuinfo.txt"), procfs.parse_cpuinfo, time_base)
+    ingest("vmstat", procfs.load, cfg.path("vmstat.txt"), procfs.parse_vmstat, time_base,
+           record_start=time_base)
+
+    # --- perf CPU samples (needs the MHz interpolator + clock bridge) -----
+    mono_to_unix = converter(cfg.path("timebase.txt"), "monotonic")
+    mhz_at = procfs.cpu_mhz_interpolator(frames.get("cpuinfo", empty_frame()))
+    ingest("cputrace", ingest_perf, cfg.logdir, time_base, mono_to_unix, mhz_at)
+
+    # --- syscalls / python stacks / packets -------------------------------
+    def _load_text(path, parser, **kw):
+        if not os.path.isfile(path):
+            return empty_frame()
+        with open(path) as f:
+            return parser(f.read(), time_base=time_base, **kw)
+
+    ingest("strace", _load_text, cfg.path("strace.txt"), parse_strace,
+           min_time=cfg.strace_min_time)
+    ingest("pystacks", _load_text, cfg.path("pystacks.txt"), parse_pystacks)
+    ingest("nettrace", ingest_pcap, cfg.path("sofa.pcap"), time_base)
+
+    # --- live TPU runtime metrics (works even with --disable_xprof) -------
+    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
+
+    ingest("tpumon", ingest_tpumon, cfg.logdir, time_base)
+
+    # --- block IO latency (blkparse times are already trace-relative) -----
+    from sofa_tpu.ingest.blktrace_parse import ingest_blktrace
+
+    ingest("blktrace", ingest_blktrace, cfg.logdir, 0.0)
+
+    # --- TPU XPlane -------------------------------------------------------
+    tpu_meta: Dict[str, Dict[str, float]] = {}
+    try:
+        xframes = ingest_xprof_dir(cfg.xprof_dir, time_base)
+        tpu_meta = xframes.pop("_meta", {})  # type: ignore[assignment]
+        # Manual escape hatch mirroring cpu_time_offset_ms for the device
+        # side: when the marker/timebase alignment is wrong (bad marker, NTP
+        # step mid-run), the trace can be salvaged without re-recording.
+        tpu_off = cfg.tpu_time_offset_ms / 1e3
+        if tpu_off:
+            for df in xframes.values():
+                if not df.empty:
+                    df["timestamp"] = df["timestamp"] + tpu_off
+        frames.update(xframes)
+    except Exception as e:  # noqa: BLE001
+        print_warning(f"preprocess xplane: {e}")
+    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
+                "tpusteps", "customtrace"):
+        frames.setdefault(key, empty_frame())
+
+    # --- write frames -----------------------------------------------------
+    trace_format = cfg.trace_format
+    if trace_format == "parquet":
+        try:
+            import pyarrow  # noqa: F401 — pandas' default parquet engine
+        except ImportError:
+            print_warning("trace_format=parquet needs pyarrow (pip install "
+                          "'sofa-tpu[parquet]'); falling back to csv")
+            trace_format = "csv"
+    def _write_one(item):
+        name, df = item
+        write_frame(df, cfg.path(name), trace_format)
+        if trace_format == "parquet":
+            # The board's detail pages fetch <name>.csv; keep a downsampled
+            # viz copy beside the full-fidelity parquet (analyze prefers
+            # the parquet — trace.read_frame).  write_csv directly: the
+            # csv mode of write_frame would unlink the parquet just written.
+            write_csv(downsample(df, cfg.viz_downsample_to),
+                      cfg.path(f"{name}.csv"))
+
+    to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
+    n_csv = len(to_write)
+    # Frames are independent files and the pyarrow CSV/parquet writers
+    # release the GIL, so a small thread pool overlaps the pod-scale
+    # tputrace write with the fifteen small ones.
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        list(pool.map(_write_one, to_write))
+
+    # --- assemble the timeline series -> report.js ------------------------
+    series = build_series(cfg, frames)
+    misc = read_misc(cfg)
+    meta = {
+        "elapsed_time": float(misc.get("elapsed_time", 0) or 0),
+        "time_base": time_base,
+        "tpu_meta": tpu_meta,
+        "logdir": cfg.logdir,
+    }
+    from sofa_tpu.trace import series_to_report_js
+
+    series_to_report_js(series, cfg.path("report.js"), cfg.viz_downsample_to, meta)
+    if tpu_meta:
+        # Device peak rates for the analyze-side roofline pass (analysis
+        # reads CSVs, not report.js, so the peaks get their own file).
+        import json
+
+        with open(cfg.path("tpu_meta.json"), "w") as f:
+            json.dump(tpu_meta, f, indent=1)
+    print_progress(
+        f"preprocess wrote {n_csv} csv files and report.js ({len(series)} series)"
+    )
+    return frames
+
+
+def build_series(cfg: SofaConfig, frames: Dict[str, pd.DataFrame]) -> List[SofaSeries]:
+    series: List[SofaSeries] = []
+    for key, (title, color) in _SERIES_STYLE.items():
+        df = frames.get(key)
+        if df is None or df.empty:
+            continue
+        y_axis = "event"
+        kind = "scatter"
+        if key in ("mpstat", "vmstat", "diskstat", "netbandwidth", "tpuutil",
+                   "tpumon"):
+            kind = "line"
+        base = df
+        if key == "mpstat":
+            # Timeline shows aggregate non-idle % (per-metric detail lives in
+            # the CSV for cpu-report).
+            base = df[(df["deviceId"] == -1) & (df["name"].isin(["usr", "sys"]))]
+        series.append(SofaSeries(key, title, color, base, y_axis=y_axis, kind=kind))
+
+    # Keyword filter groups pulled into their own colored series
+    # (reference behavior for cpu/gpu filters, bin/sofa:258-291).
+    def _contains(col, keyword):
+        # case-insensitive substring match via the column's UNIQUE values:
+        # HLO-op/symbol names repeat heavily (~400 uniques in a 1.6M-row pod
+        # trace), so matching uniques + isin beats str.contains row-by-row
+        # by orders of magnitude
+        kw = keyword.lower()
+        hits = [u for u in col.unique() if kw in str(u).lower()]
+        return col.isin(hits)
+
+    cputrace = frames.get("cputrace", empty_frame())
+    for filt in cfg.cpu_filters:
+        if cputrace.empty:
+            break
+        sel = cputrace[_contains(cputrace["name"], filt.keyword)]
+        if not sel.empty:
+            series.append(
+                SofaSeries(f"cpu_{filt.keyword}", f"CPU: {filt.keyword}", filt.color, sel)
+            )
+    # fw/bw phase series — the board filter for training-phase attribution
+    # (reference default GPU filters _fw_/_bw_, bin/sofa:284-285).
+    tputrace = frames.get("tputrace", empty_frame())
+    if not tputrace.empty and "phase" in tputrace.columns:
+        for phase, title, color in (("fw", "TPU forward", "mediumseagreen"),
+                                    ("bw", "TPU backward", "crimson")):
+            sel = tputrace[tputrace["phase"] == phase]
+            if not sel.empty:
+                series.append(
+                    SofaSeries(f"tpu_phase_{phase}", title, color, sel))
+    for filt in cfg.tpu_filters:
+        if tputrace.empty:
+            break
+        mask = _contains(tputrace["name"], filt.keyword) | \
+            _contains(tputrace["hlo_category"], filt.keyword)
+        sel = tputrace[mask]
+        if not sel.empty:
+            series.append(
+                SofaSeries(f"tpu_{filt.keyword}", f"TPU: {filt.keyword}", filt.color, sel)
+            )
+    return series

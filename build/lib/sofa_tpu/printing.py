@@ -1,0 +1,83 @@
+"""Colored console logging for sofa_tpu.
+
+Equivalent surface to the reference's sofa_print helpers
+(/root/reference/bin/sofa_print.py:18-49) — title / error / warning / info /
+hint / progress banners with ANSI colors, gated on a module-level verbosity —
+but implemented as a tiny logger object so library users can silence it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_COLORS = {
+    "red": "\033[1;31m",
+    "green": "\033[1;32m",
+    "yellow": "\033[1;33m",
+    "blue": "\033[1;34m",
+    "magenta": "\033[1;35m",
+    "cyan": "\033[1;36m",
+    "white": "\033[1;37m",
+    "end": "\033[0m",
+}
+
+# Module state: whether to emit at all, and whether stdout is a tty (no color
+# when piped, so test harnesses can grep plain strings).
+enabled = True
+verbose = False
+
+
+def _use_color(stream) -> bool:
+    if os.environ.get("NO_COLOR"):
+        return False
+    return stream.isatty()
+
+
+def _emit(tag: str, color: str, msg: str, stream=None) -> None:
+    if not enabled:
+        return
+    stream = stream or sys.stdout
+    if _use_color(stream):
+        print(f"{_COLORS[color]}{tag}{_COLORS['end']} {msg}", file=stream)
+    else:
+        print(f"{tag} {msg}", file=stream)
+    stream.flush()
+
+
+def print_title(msg: str) -> None:
+    if not enabled:
+        return
+    bar = "=" * max(8, len(msg))
+    if _use_color(sys.stdout):
+        print(f"\n{_COLORS['cyan']}{bar}\n{msg}\n{bar}{_COLORS['end']}")
+    else:
+        print(f"\n{bar}\n{msg}\n{bar}")
+    sys.stdout.flush()
+
+
+def print_error(msg: str) -> None:
+    # Errors and warnings go to stderr: stdout may be piped data
+    # (features tables, report output) and must stay parseable.
+    _emit("[ERROR]", "red", msg, stream=sys.stderr)
+
+
+def print_warning(msg: str) -> None:
+    _emit("[WARNING]", "yellow", msg, stream=sys.stderr)
+
+
+def print_info(msg: str) -> None:
+    if verbose:
+        _emit("[INFO]", "white", msg)
+
+
+def print_hint(msg: str) -> None:
+    _emit("[HINT]", "green", msg)
+
+
+def print_progress(msg: str) -> None:
+    _emit("[PROGRESS]", "blue", msg)
+
+
+def print_main_progress(msg: str) -> None:
+    _emit("[STAGE]", "magenta", msg)

@@ -48,11 +48,10 @@ RAW_FILES = [
 ]
 
 # Derived files (removed by `sofa clean`).
-DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".css", ".json.gz",
-                    ".pdf", ".png", ".folded")
-DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
-                 "hints.txt", "tpu_meta.json"]
-DERIVED_DIRS = ["board", "sofa_hints"]
+DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".json.gz", ".pdf",
+                    ".png", ".folded")
+DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt"]
+DERIVED_DIRS = ["board"]
 
 
 def build_collectors(cfg):

@@ -1,0 +1,150 @@
+"""Environment doctor & enabler: ``sofa setup``.
+
+The reference splits host enablement across three root-needing helpers —
+sysctl tweaks (/root/reference/tools/enable_strace_perf_pcm.py), capability
+grants for tcpdump-style utilities via a "sofa" group
+(/root/reference/tools/empower.py:46-60), and a distro-probing dependency
+installer (/root/reference/tools/prepare.sh).  Here all of it is one
+subcommand with a safe default: ``sofa setup`` *reports* what each collector
+needs and prints the exact commands; ``sofa setup --apply`` runs them
+(through sudo when available).  Nothing is installed — the TPU image is
+expected to ship its own toolchain, so missing binaries only degrade the
+matching collector.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Callable, List, Optional, Tuple
+
+from sofa_tpu.printing import print_hint, print_info, print_progress, print_warning
+
+# (sysctl key, value wanted for full-fidelity perf/strace recording)
+SYSCTLS = [
+    ("kernel.perf_event_paranoid", "-1"),
+    ("kernel.kptr_restrict", "0"),
+]
+
+# Collector binaries and the subsystem each one unlocks.
+TOOLS = [
+    ("perf", "CPU sampling (collectors/perf.py)"),
+    ("tcpdump", "DCN packet capture (collectors/hostproc.py)"),
+    ("blktrace", "block-IO tracing (collectors/hostproc.py)"),
+    ("blkparse", "block-IO trace decoding"),
+    ("strace", "syscall tracing (collectors/hostproc.py)"),
+    ("vmstat", "memory/context-switch sampling"),
+]
+
+# Capabilities a non-root profiling user needs per utility (empower.py's
+# setcap line, generalized).
+CAPS = {
+    "tcpdump": "cap_net_raw,cap_net_admin=eip",
+    "blktrace": "cap_sys_admin=eip",
+    "perf": "cap_perfmon,cap_sys_ptrace=eip",
+}
+
+
+def _read_sysctl(key: str) -> Optional[str]:
+    path = "/proc/sys/" + key.replace(".", "/")
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _sudo_prefix() -> str:
+    return "sudo " if shutil.which("sudo") and os.geteuid() != 0 else ""
+
+
+def check(utilities: Optional[List[str]] = None) -> Tuple[List[str], int]:
+    """Returns (fix commands, number of problems found) and prints a report."""
+    fixes: List[str] = []
+    problems = 0
+    sudo = _sudo_prefix()
+
+    for key, want in SYSCTLS:
+        have = _read_sysctl(key)
+        if have is None:
+            print_warning(f"setup: {key} unreadable (sandboxed /proc?)")
+            problems += 1
+        elif have != want:
+            print_warning(f"setup: {key} = {have}, want {want}")
+            fixes.append(f"{sudo}sysctl -w {key}={want}")
+            problems += 1
+        else:
+            print_info(f"setup: {key} = {have} ok")
+
+    for tool, why in TOOLS:
+        path = shutil.which(tool)
+        if path:
+            print_info(f"setup: {tool} found at {path}")
+        else:
+            print_warning(f"setup: {tool} missing — degrades {why}")
+            problems += 1
+
+    for util in utilities or []:
+        path = shutil.which(util) or util
+        cap = CAPS.get(os.path.basename(path))
+        if cap is None:
+            print_warning(
+                f"setup: no capability profile for {util!r} (known: "
+                f"{', '.join(sorted(CAPS))}) — refusing to guess a grant")
+            problems += 1
+            continue
+        if not os.path.isfile(path):
+            print_warning(f"setup: {util}: not a file, cannot grant caps")
+            problems += 1
+            continue
+        got = ""
+        if shutil.which("getcap"):
+            out = subprocess.run(["getcap", path], capture_output=True,
+                                 text=True)
+            got = out.stdout.strip()
+        # getcap prints caps sorted by capability number, so compare the
+        # individual names, not the whole comma-joined string.
+        if all(c in got for c in cap.split("=")[0].split(",")):
+            print_info(f"setup: {path} already has {cap}")
+        else:
+            print_warning(f"setup: {path} lacks {cap}")
+            fixes.append(f"{sudo}setcap {cap} {path}")
+            problems += 1
+
+    # TPU side: purely file-level checks; never touch the JAX backend here
+    # (its init can hang when the chip is busy, and `setup` must always work).
+    accel = [d for d in ("/dev/accel0", "/dev/vfio/0") if os.path.exists(d)]
+    if accel:
+        print_info(f"setup: TPU device node present: {', '.join(accel)}")
+    else:
+        print_info("setup: no local TPU device node (remote/tunneled chips "
+                   "are still usable via JAX)")
+    return fixes, problems
+
+
+def sofa_setup(utilities: Optional[List[str]] = None, apply: bool = False,
+               runner: Callable[[str], int] = None) -> int:
+    """Report (and with apply=True, fix) host prerequisites.
+
+    runner is injectable for tests; defaults to shell execution.
+    """
+    fixes, problems = check(utilities)
+    if not fixes:
+        if problems:
+            print_hint(f"setup: {problems} issue(s), none auto-fixable "
+                       "(install missing tools via your image/package manager)")
+        else:
+            print_progress("setup: environment fully enabled")
+        return 0 if not problems else 1
+    if not apply:
+        print_hint("setup: run these (or re-run with --apply):")
+        for cmd in fixes:
+            print(f"  {cmd}")
+        return 1
+    run = runner or (lambda c: subprocess.run(c, shell=True).returncode)
+    rc = 0
+    for cmd in fixes:
+        print_progress(f"setup: {cmd}")
+        rc = max(rc, run(cmd))
+    return rc

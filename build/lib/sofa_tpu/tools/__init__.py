@@ -1,0 +1,6 @@
+"""Operational helpers: event-driven recording, cluster scripts.
+
+The reference keeps these in tools/ (sofa-edr.py, slurmsofa.sh, killsofa.sh,
+/root/reference/tools/); the Python ones live in-package here so they ship
+with `pip install`, the shell ones in the repo-root tools/ directory.
+"""

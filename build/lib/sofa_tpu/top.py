@@ -1,0 +1,246 @@
+"""`sofa top` — live terminal dashboard over a recording logdir.
+
+The nvidia-smi / `nvidia-smi dmon` habit, TPU-side: while `sofa record`
+(or any sofa.profile-instrumented process) runs, its samplers append
+tpumon.txt (per-device HBM + liveness heartbeat) and the procmon text
+files (mpstat/netstat/diskstat); `sofa top` tails those files and redraws
+a compact ANSI dashboard every --interval seconds.  `--once` renders a
+single frame and exits (what the tests drive).
+
+The reference had no equivalent — nvidia-smi itself played this role and
+sofa only recorded it; on TPU hosts there is no vendor tool to lean on,
+so the dashboard ships with the profiler.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+import pandas as pd
+
+from sofa_tpu.ingest import procfs
+from sofa_tpu.printing import print_error
+
+_BAR_W = 24
+
+
+def _bar(pct: float) -> str:
+    pct = min(max(pct, 0.0), 100.0)
+    fill = int(round(pct / 100.0 * _BAR_W))
+    return "[" + "#" * fill + "-" * (_BAR_W - fill) + "]"
+
+
+def _fmt_bytes_rate(bps: float) -> str:
+    for unit, div in (("GiB/s", 2 ** 30), ("MiB/s", 2 ** 20),
+                      ("KiB/s", 2 ** 10)):
+        if bps >= div:
+            return f"{bps / div:.1f} {unit}"
+    return f"{bps:.0f} B/s"
+
+
+def _latest(df: pd.DataFrame) -> pd.DataFrame:
+    """Rows of the newest sample timestamp (procfs parsers emit absolute
+    timestamps when time_base=0)."""
+    if df.empty:
+        return df
+    return df[df["timestamp"] == df["timestamp"].max()]
+
+
+def _tail_text(path: str, max_bytes: int = 65536) -> Optional[str]:
+    """The file's tail window, first (possibly partial) line dropped:
+    sampler files grow for the lifetime of a multi-hour recording and a
+    dashboard tick needs just the last samples."""
+    if not os.path.isfile(path):
+        return None
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        text = f.read().decode(errors="replace")
+    if size > max_bytes:
+        text = text.split("\n", 1)[-1]
+    return text
+
+
+def _tail_load(path: str, parser, max_bytes: int = 65536) -> pd.DataFrame:
+    text = _tail_text(path, max_bytes)
+    if text is None:
+        from sofa_tpu.trace import empty_frame
+
+        return empty_frame()
+    return parser(text, time_base=0.0)
+
+
+def _tpu_lines(logdir: str, now: float) -> List[str]:
+    from sofa_tpu.ingest.tpumon_parse import parse_tpumon_line
+
+    text = _tail_text(os.path.join(logdir, "tpumon.txt"))
+    if text is None:
+        return ["TPU    no tpumon.txt (enable_tpu_mon off, or nothing "
+                "recording yet)"]
+    latest = {}
+    beat_ns = None
+    for line in text.splitlines():
+        parsed = parse_tpumon_line(line)
+        if parsed is None:
+            continue
+        ts_ns, dev, used, limit, peak = parsed
+        if dev == -1:
+            beat_ns = ts_ns
+        else:
+            latest[dev] = (ts_ns, used, limit, peak)
+    out = []
+    for dev in sorted(latest):
+        ts_ns, used, limit, peak = latest[dev]
+        if limit:
+            occ = 100.0 * used / limit
+            out.append(
+                f"tpu{dev}   hbm {used / 1e9:6.2f}/{limit / 1e9:.2f} GB "
+                f"{_bar(occ)} {occ:5.1f}%  peak {peak / 1e9:.2f} GB")
+        else:  # CPU backend / runtimes that report no bytes_limit
+            out.append(
+                f"tpu{dev}   hbm {used / 1e9:6.2f} GB (no limit reported)"
+                f"  peak {peak / 1e9:.2f} GB")
+    if beat_ns is not None:
+        age = max(0.0, now - beat_ns / 1e9)
+        health = "live" if age < 5.0 else f"STALE ({age:.0f}s)"
+        out.append(f"tpu    heartbeat {age:4.1f}s ago — {health}")
+    return out or ["TPU    tpumon.txt has no samples yet"]
+
+
+_MEM_CACHE: dict = {}   # path -> ((mtime_ns, size), rendered lines)
+
+
+def _mem_lines(logdir: str) -> List[str]:
+    """Top HBM allocation sites from the live peak snapshot, when the
+    sampler has captured one (collectors/tpumon.py overwrites
+    memprof.pb.gz at each new high-water mark, so this updates mid-run).
+    The decode+aggregate is cached on (mtime, size): the dashboard redraws
+    every --interval but the snapshot only changes at a new peak."""
+    path = os.path.join(logdir, "memprof.pb.gz")
+    try:
+        st = os.stat(path)
+    except OSError:
+        return []
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _MEM_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    try:
+        from sofa_tpu.ingest.memprof import aggregate_sites, load_memprof
+
+        df, meta = load_memprof(logdir)
+        sites = aggregate_sites(df, top_k=3)
+    except Exception:  # noqa: BLE001 — mid-overwrite reads must not kill top
+        return []      # (not cached: the finished overwrite will parse)
+    held = sites[sites["bytes"] > 0]
+    out = []
+    if not held.empty:
+        out = [f"hbm@{meta.get('trigger', 'peak')}  top sites:"]
+        for row in held.itertuples(index=False):
+            out.append(f"       {row.bytes / 1e9:6.2f} GB {row.share:4.0%}  "
+                       f"{row.site[:48]}")
+    _MEM_CACHE[path] = (key, out)
+    return out
+
+
+def _cpu_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "mpstat.txt"), procfs.parse_mpstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    vals = {n: float(rows[rows["name"] == n]["event"].mean())
+            for n in ("usr", "sys", "iow", "idl")
+            if not rows[rows["name"] == n].empty}
+    busy = 100.0 - vals.get("idl", 100.0)
+    return (f"cpu    {_bar(busy)} {busy:5.1f}%  "
+            + "  ".join(f"{n} {vals[n]:4.1f}%" for n in ("usr", "sys", "iow")
+                        if n in vals))
+
+
+def _net_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "netstat.txt"),
+                    procfs.parse_netstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    parts = []
+    for name, sel in rows.groupby("name"):
+        parts.append(f"{name} {_fmt_bytes_rate(float(sel['event'].sum()))}")
+    return "net    " + "  ".join(sorted(parts)[:6])
+
+
+def _disk_line(logdir: str) -> Optional[str]:
+    df = _tail_load(os.path.join(logdir, "diskstat.txt"),
+                    procfs.parse_diskstat)
+    rows = _latest(df)
+    if rows.empty:
+        return None
+    # parse_diskstat emits <dev>.r_bw / <dev>.w_bw (bytes/s)
+    rd = float(rows[rows["name"].str.endswith(".r_bw")]["event"].sum())
+    wr = float(rows[rows["name"].str.endswith(".w_bw")]["event"].sum())
+    return (f"disk   read {_fmt_bytes_rate(rd)}  "
+            f"write {_fmt_bytes_rate(wr)}")
+
+
+def render_frame(logdir: str, now: Optional[float] = None,
+                 title: Optional[str] = None) -> str:
+    now = time.time() if now is None else now
+    stamp = time.strftime("%H:%M:%S", time.localtime(now))
+    lines = [f"sofa top — {title or logdir}   {stamp}"]
+    lines += _tpu_lines(logdir, now)
+    lines += _mem_lines(logdir)
+    for maker in (_cpu_line, _net_line, _disk_line):
+        line = maker(logdir)
+        if line:
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def render_cluster_frame(cfg, now: Optional[float] = None) -> str:
+    """One stacked frame over every host's logdir of a cluster recording
+    (the `sofa record --cluster_hosts` layout)."""
+    from sofa_tpu.analyze import cluster_host_cfgs
+
+    now = time.time() if now is None else now  # one clock for every block
+    blocks = []
+    seen_any = False
+    for _i, hostname, host_cfg in cluster_host_cfgs(cfg):
+        if not os.path.isdir(host_cfg.logdir):
+            blocks.append(f"sofa top — {hostname}   (no logdir yet)")
+            continue
+        seen_any = True
+        blocks.append(render_frame(host_cfg.logdir, now, title=hostname))
+    if not seen_any:
+        raise FileNotFoundError(
+            f"no host logdirs under {cfg.logdir.rstrip('/')}-<host>/ — "
+            "start a `sofa record --cluster_hosts ...` first")
+    return "\n\n".join(blocks)
+
+
+def sofa_top(cfg, interval: float = 2.0, once: bool = False) -> int:
+    interval = max(float(interval), 0.1)  # 0/negative would spin or raise
+    if cfg.cluster_hosts:
+        render = lambda: render_cluster_frame(cfg)  # noqa: E731
+    elif os.path.isdir(cfg.logdir):
+        render = lambda: render_frame(cfg.logdir)   # noqa: E731
+    else:
+        print_error(f"logdir {cfg.logdir} does not exist — start a "
+                    "`sofa record` first")
+        return 1
+    try:
+        if once:
+            print(render())
+            return 0
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H" + render() + "\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+    except FileNotFoundError as e:
+        print_error(str(e))
+        return 1
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,519 @@
+"""The unified trace schema and timeline-series model.
+
+Everything every collector produces — perf samples, HLO ops, ICI collectives,
+packets, disk I/O, syscalls, Python stacks, utilization samples — is coerced
+into ONE flat schema before analysis.  This mirrors the single most
+load-bearing design decision of the reference (13-column schema,
+/root/reference/bin/sofa_config.py:49-62), with TPU-era extension columns
+(device_kind, hlo_category, module, flops, bytes_accessed) that default to
+empty and never break base-schema consumers.
+
+Column semantics (base 13, reference-compatible):
+
+  timestamp  float  seconds since the run's time base (sofa_time.txt)
+  event      float  numeric y-value for the scatter timeline (source-specific:
+                    log10(IP) for CPU samples, op index for HLO ops, metric id
+                    for samplers)
+  duration   float  seconds
+  deviceId   int    host = -1; TPU core/chip ordinal otherwise; cpu core for
+                    per-core samplers
+  copyKind   int    data-movement taxonomy, see CopyKind
+  payload    int    bytes moved (copies/packets) or event-specific magnitude.
+                    NOTE dual semantics: for copies/packets (copyKind < 20)
+                    this is wire bytes; for collectives (copyKind >= 20) it
+                    is bytes_accessed — HBM reads+writes, NOT bytes over
+                    ICI.  comm.csv's ici_bytes column / comm_*_ici_bytes
+                    features carry the wire-byte estimate for collectives
+                    (analysis/comm._wire_bytes).
+  bandwidth  float  bytes/second for transfers — payload/duration, so it
+                    inherits payload's dual semantics (memory-byte rate for
+                    collectives, wire rate for copies)
+  pkt_src    int    sender address id (packets only): packed IPv4 below
+                    V6_ID_BASE, interned IPv6 id at/above it (the literal
+                    lives in the capture's net_addrs.csv side table)
+  pkt_dst    int    receiver address id, same encoding as pkt_src
+  pid        int
+  tid        int
+  name       str    human-readable event name (demangled symbol, HLO op, ...)
+  category   int    reserved series tag (reference kept it, we keep it)
+
+Extension columns (TPU build):
+
+  device_kind   str   "cpu" | "tpu" | "net" | "disk" | ...
+  hlo_category  str   XLA-reported op category ("convolution", "all-reduce"...)
+  module        str   enclosing XLA module (jit function) name
+  flops         float XLA-reported flop count for the op
+  bytes_accessed float XLA-reported memory traffic for the op
+  groups        str   JSON replica groups "[[0,1],[2,3]]" for collective ops
+                      (participants of the collective; "" when unknown)
+  phase         str   training-phase attribution: "fw" | "bw" | "" (unknown),
+                      derived from the op's JAX provenance path (transpose(jvp)
+                      marks the backward pass)
+  source        str   user-code provenance "file.py:line" XLA recorded for the
+                      op (real libtpu captures carry it per event metadata)
+  op_path       str   JAX program-structure path for the op (the tf_op stat,
+                      e.g. "jit(train_step)/jvp(main)/dot_general") — feeds
+                      the hierarchical op-tree profile
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+BASE_COLUMNS = [
+    "timestamp",
+    "event",
+    "duration",
+    "deviceId",
+    "copyKind",
+    "payload",
+    "bandwidth",
+    "pkt_src",
+    "pkt_dst",
+    "pid",
+    "tid",
+    "name",
+    "category",
+]
+
+EXTRA_COLUMNS = ["device_kind", "hlo_category", "module", "flops",
+                 "bytes_accessed", "groups", "phase", "source", "op_path"]
+
+COLUMNS = BASE_COLUMNS + EXTRA_COLUMNS
+
+_DEFAULTS = {
+    "timestamp": 0.0,
+    "event": 0.0,
+    "duration": 0.0,
+    "deviceId": -1,
+    "copyKind": -1,
+    "payload": 0,
+    "bandwidth": 0.0,
+    "pkt_src": -1,
+    "pkt_dst": -1,
+    "pid": -1,
+    "tid": -1,
+    "name": "",
+    "category": 0,
+    "device_kind": "",
+    "hlo_category": "",
+    "module": "",
+    "flops": 0.0,
+    "bytes_accessed": 0.0,
+    "groups": "",
+    "phase": "",
+    "source": "",
+    "op_path": "",
+}
+
+
+def roi_bounds(cfg) -> "Optional[tuple]":
+    """(begin, end) when a region of interest is active, else None."""
+    begin, end = cfg.roi_begin, cfg.roi_end
+    if end > begin > 0 or (begin == 0 and end > 0):
+        return begin, end
+    return None
+
+
+def roi_clip(df: pd.DataFrame, cfg) -> pd.DataFrame:
+    """Clip a frame to the region of interest when one is set.
+
+    Selection is by *overlap*, not start time: a long op straddling the
+    ROI boundary still contributes (un-prorated) — dropping it would
+    undercount kernel time and misreport DMA overlap inside the window.
+    """
+    bounds = roi_bounds(cfg)
+    if bounds is not None:
+        begin, end = bounds
+        starts = df["timestamp"]
+        ends = starts + df["duration"]
+        return df[(starts <= end) & (ends >= begin)]
+    return df
+
+
+def merged_intervals(starts, ends) -> np.ndarray:
+    """Union of possibly-overlapping [start, end) intervals, as an (n, 2)
+    array sorted by start.  Vectorized: running-max of ends, split where a
+    start exceeds every prior end."""
+    starts = np.asarray(starts, dtype=float)
+    ends = np.asarray(ends, dtype=float)
+    if starts.size == 0:
+        return np.empty((0, 2))
+    order = np.argsort(starts, kind="stable")
+    s, e = starts[order], ends[order]
+    emax = np.maximum.accumulate(e)
+    new = np.concatenate([[True], s[1:] > emax[:-1]])
+    idx = np.flatnonzero(new)
+    ms = s[idx]
+    me = np.concatenate([emax[idx[1:] - 1], emax[-1:]])
+    return np.stack([ms, me], axis=1)
+
+
+class CopyKind(IntEnum):
+    """Data-movement taxonomy.
+
+    Values 0/1/2/8/10 keep the reference's CUPTI-derived numbering
+    (/root/reference/bin/sofa_common.py:20) so cross-tool comparisons hold;
+    the >=20 range adds first-class XLA/ICI collective kinds, which the
+    reference could only approximate by NCCL kernel-name matching
+    (sofa_analyze.py:363-368).
+    """
+
+    NA = -1
+    KERNEL = 0          # pure compute (HLO op with no transfer semantics)
+    H2D = 1             # host->device (infeed / transfer-to-device)
+    D2H = 2             # device->host (outfeed / transfer-from-device)
+    D2D = 8             # on-chip copy
+    P2P = 10            # inter-chip point-to-point (ICI send/recv)
+    ALL_REDUCE = 20
+    ALL_GATHER = 21
+    REDUCE_SCATTER = 22
+    ALL_TO_ALL = 23
+    COLLECTIVE_PERMUTE = 24
+    COLLECTIVE_BROADCAST = 25
+
+
+CK_NAMES = {int(k): k.name for k in CopyKind}
+
+# Map an HLO op/category name onto the taxonomy.
+_COLLECTIVE_KINDS = [
+    ("all-reduce", CopyKind.ALL_REDUCE),
+    ("all-gather", CopyKind.ALL_GATHER),
+    ("reduce-scatter", CopyKind.REDUCE_SCATTER),
+    ("all-to-all", CopyKind.ALL_TO_ALL),
+    ("collective-permute", CopyKind.COLLECTIVE_PERMUTE),
+    ("collective-broadcast", CopyKind.COLLECTIVE_BROADCAST),
+]
+
+
+def classify_hlo_kind(name: str, category: str = "") -> CopyKind:
+    """Classify an HLO op into the CopyKind taxonomy by name/category."""
+    text = f"{name} {category}".lower()
+    for key, kind in _COLLECTIVE_KINDS:
+        if key in text or key.replace("-", "_") in text:
+            return kind
+    if "infeed" in text or "transfer-to-device" in text or "host-to-device" in text:
+        return CopyKind.H2D
+    if "outfeed" in text or "transfer-from-device" in text or "device-to-host" in text:
+        return CopyKind.D2H
+    if "send" in text.split() or text.startswith("send") or "recv" in text.split() or text.startswith("recv"):
+        return CopyKind.P2P
+    if text.startswith(("copy", "async-copy")) or " copy " in text:
+        return CopyKind.D2D
+    return CopyKind.KERNEL
+
+
+_EMPTY_TEMPLATE: "pd.DataFrame | None" = None
+
+
+def empty_frame() -> pd.DataFrame:
+    # Constructing 22 typed Series costs ~10ms; a pod-scale run calls this
+    # dozens of times (one per absent source), so hand out copies of one
+    # template instead.
+    global _EMPTY_TEMPLATE
+    if _EMPTY_TEMPLATE is None:
+        _EMPTY_TEMPLATE = pd.DataFrame(
+            {c: pd.Series(dtype=type(_DEFAULTS[c])
+                          if not isinstance(_DEFAULTS[c], str) else "object")
+             for c in COLUMNS})
+    return _EMPTY_TEMPLATE.copy()
+
+
+def make_frame(rows_or_cols) -> pd.DataFrame:
+    """Build a schema DataFrame from a list of dicts or a dict of columns.
+
+    Missing columns are filled with schema defaults; unknown keys rejected.
+    """
+    if isinstance(rows_or_cols, dict):
+        df = pd.DataFrame(rows_or_cols)
+    else:
+        df = pd.DataFrame(list(rows_or_cols))
+    if df.empty:
+        return empty_frame()
+    unknown = set(df.columns) - set(COLUMNS)
+    if unknown:
+        raise ValueError(f"columns outside the unified schema: {sorted(unknown)}")
+    for col in COLUMNS:
+        if col not in df.columns:
+            df[col] = _DEFAULTS[col]
+        elif df[col].isna().any():
+            # rows that omit a key another row provides must still get the
+            # schema default, not NaN — NaN silently falls out of every
+            # `category == 0`-style filter downstream
+            df[col] = df[col].fillna(_DEFAULTS[col])
+    return df[COLUMNS]
+
+
+def write_csv(df: pd.DataFrame, path: str) -> None:
+    # pyarrow's CSV writer is several times faster than pandas' for the
+    # pod-scale op frame, with the same quoting contract (quote only when
+    # needed — the board's splitCSVLine handles either).  Any conversion
+    # surprise falls back to pandas.
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        pacsv.write_csv(table, path,
+                        pacsv.WriteOptions(quoting_style="needed"))
+        return
+    except Exception:  # noqa: BLE001 — formatting fallback, never fatal
+        pass
+    df.to_csv(path, index=False)
+
+
+def _conform(df: pd.DataFrame) -> pd.DataFrame:
+    for col in COLUMNS:
+        if col not in df.columns:
+            df[col] = _DEFAULTS[col]
+    for col, default in _DEFAULTS.items():
+        if col not in df.columns:
+            continue
+        if isinstance(default, str):
+            df[col] = df[col].fillna("").astype(str)
+        elif isinstance(default, float) and df[col].dtype.kind != "f":
+            # Whole-valued float columns round-trip as ints through CSV
+            # inference; schema dtype wins so save/load never flips dtypes.
+            df[col] = df[col].astype("float64")
+    return df[COLUMNS]
+
+
+# Schema columns whose content is text: read them as str so value
+# inference can never mangle numeric-looking names ("5" would otherwise
+# come back as "5.0" whenever an empty cell makes the column float).
+_STR_COLS = {c: str for c, d in _DEFAULTS.items() if isinstance(d, str)}
+
+
+def read_csv(path: str) -> pd.DataFrame:
+    # The multithreaded arrow parser reads a pod-scale tputrace ~2x faster
+    # than pandas' C engine AND parses floats correctly rounded (the C
+    # engine's default fast strtod is off by up to ~1e-10 relative).
+    # pyarrow.csv directly (not pandas' engine="pyarrow" wrapper): its
+    # column_types apply AT PARSE TIME, so a numeric-looking name ("007")
+    # can never be inferred to int and mangled by a post-hoc str cast —
+    # the wrapper's dtype= does exactly that.  Anything arrow refuses
+    # (quoted newlines without newlines_in_values, malformed lines) falls
+    # back to the C engine, whose dtype= IS parse-time.
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        table = pacsv.read_csv(
+            path,
+            convert_options=pacsv.ConvertOptions(
+                column_types={c: pa.string() for c in _STR_COLS}))
+        df = table.to_pandas()
+    except Exception:  # noqa: BLE001
+        # Per-column NA tokens: string columns treat only "" as missing
+        # (the C engine would otherwise read a name of "NA"/"nan" as NaN
+        # and _conform would rewrite it to "" — the arrow path preserves
+        # them), while numeric columns keep the usual NA vocabulary so a
+        # foreign CSV with "NA" in a float column still loads as NaN.
+        num_na = ["", "NA", "N/A", "NaN", "nan", "NULL", "null", "None"]
+        na = {c: ([""] if c in _STR_COLS else num_na) for c in COLUMNS}
+        df = pd.read_csv(path, dtype=_STR_COLS,
+                         keep_default_na=False, na_values=na)
+    return _conform(df)
+
+
+def write_frame(df: pd.DataFrame, base_path: str, fmt: str = "csv") -> str:
+    """Write a unified-schema frame as <base_path>.<fmt>; returns the path.
+
+    Parquet keeps big HLO-op traces columnar and ~5-10x smaller than CSV
+    (the reference's CSV-everywhere contract does not survive pod-scale
+    traces — SURVEY §7 "trace volume").
+    """
+    import os
+
+    if fmt == "parquet":
+        path = base_path + ".parquet"
+        df.to_parquet(path, index=False)
+    else:
+        path = base_path + ".csv"
+        write_csv(df, path)
+        # read_frame prefers .parquet; a stale one from an earlier
+        # parquet-mode run must not shadow this fresh csv.
+        try:
+            os.unlink(base_path + ".parquet")
+        except OSError:
+            pass
+    return path
+
+
+def read_frame(base_path: str) -> Optional[pd.DataFrame]:
+    """Read <base_path>.parquet if present, else <base_path>.csv, else None."""
+    import os
+
+    if os.path.isfile(base_path + ".parquet"):
+        return _conform(pd.read_parquet(base_path + ".parquet"))
+    if os.path.isfile(base_path + ".csv"):
+        return read_csv(base_path + ".csv")
+    return None
+
+
+def downsample(df: pd.DataFrame, max_points: int) -> pd.DataFrame:
+    """Downsample a frame to ~``max_points`` rows, never dropping stragglers.
+
+    The reference downsampled with a fixed iteration stride
+    (sofa_preprocess.py:51-57); a target row count adapts to trace volume,
+    which matters far more for HLO-op traces (SURVEY §7 "Trace volume").
+    A pure stride keeps every k-th row, so a rare 100 ms straggler op
+    between strides would vanish from exactly the timeline region the user
+    zooms first — the kept set is therefore the UNION of the stride sample
+    and the top-K rows by duration (K = max_points/10), in original order.
+    """
+    if max_points <= 0 or len(df) <= max_points:
+        return df
+    k = max(1, max_points // 10) if "duration" in df.columns else 0
+    stride = int(np.ceil(len(df) / max(1, max_points - k)))
+    keep = np.zeros(len(df), dtype=bool)
+    keep[::stride] = True
+    if k:
+        dur = pd.to_numeric(df["duration"], errors="coerce").fillna(0.0)
+        keep[np.argsort(dur.to_numpy())[-k:]] = True
+    return df.iloc[np.flatnonzero(keep)]
+
+
+@dataclass
+class SofaSeries:
+    """One named, colored series on the master timeline.
+
+    The reference models this as SOFATrace (bin/sofa_models.py:1-7) and
+    serializes every series into ``report.js`` (sofa_preprocess.py:343-374);
+    our board consumes the same contract as pure JSON.
+    """
+
+    name: str           # JS-identifier-ish unique key
+    title: str          # legend text
+    color: str
+    data: pd.DataFrame = field(default_factory=empty_frame)
+    y_axis: str = "event"    # which column supplies y values
+    kind: str = "scatter"    # scatter | line | band
+
+    def to_points(self, max_points: int = 10000) -> List[dict]:
+        df = downsample(self.data, max_points)
+        if df.empty:
+            return []
+        ys = df[self.y_axis] if self.y_axis in df.columns else df["event"]
+
+        def _num(v: float, digits: int) -> float:
+            # NaN/Inf would serialize as bare `NaN` tokens — invalid JSON for
+            # the board's JSON.parse — so coerce to 0.
+            v = float(v)
+            return round(v, digits) if math.isfinite(v) else 0.0
+
+        pts = [
+            {
+                "x": _num(x, 6),
+                "y": _num(y, 6),
+                "name": str(n),
+                "d": _num(d, 9),
+            }
+            for x, y, n, d in zip(df["timestamp"], ys, df["name"], df["duration"])
+        ]
+        return pts
+
+
+def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 10000,
+                        extra: Optional[dict] = None) -> None:
+    """Serialize all series to ``report.js`` — the board's data contract.
+
+    Written as ``sofa_traces = [...]`` (one JSON blob), the modern analogue of
+    the reference's per-series JS vars + sofa_traces array
+    (sofa_preprocess.py:343-374,2104).
+    """
+    payload = [
+        {
+            "name": s.name,
+            "title": s.title,
+            "color": s.color,
+            "kind": s.kind,
+            "data": s.to_points(max_points),
+        }
+        for s in series
+    ]
+    write_report_js_doc({"series": payload, "meta": extra or {}}, path)
+
+
+def write_report_js_doc(doc: dict, path: str) -> None:
+    """THE report.js writer — analyze's series-merge path reparses this
+    exact shape (`sofa_traces = <json>;`), so every producer must go
+    through here.  dumps, not dump: the one-shot path runs json's C
+    encoder, while dump iterencodes 500k+ point dicts through Python
+    (~5x slower on a pod-scale report.js)."""
+    with open(path, "w") as f:
+        f.write("sofa_traces = ")
+        f.write(json.dumps(doc))
+        f.write(";\n")
+
+
+def packed_ip(ip: str) -> int:
+    """Pack dotted IPv4 into the reference's integer encoding.
+
+    pkt_src/dst = sum(octet * 1000^(3-i)) — kept bit-compatible with
+    sofa_preprocess.py:182-186 so diffing against reference traces works.
+    """
+    try:
+        octets = [int(o) for o in ip.split(".")]
+    except ValueError:
+        return -1
+    if len(octets) != 4:
+        return -1
+    value = 0
+    for i, o in enumerate(octets):
+        value += o * 1000 ** (3 - i)
+    return value
+
+
+# IPv6 addresses can't ride the 1000-base IPv4 packing (128 bits vs the
+# float64-exact 2^53 ceiling); they are interned instead — ids counted up
+# from V6_ID_BASE, literal addresses in the capture's net_addrs.csv side
+# table.  The base sits above any packed IPv4 (max 255255255255 ≈ 2.6e11)
+# and well below 2^53, so ids stay exact through the float frame columns.
+V6_ID_BASE = 10 ** 12
+
+
+def unpack_ip(value: int, addrs: "dict | None" = None) -> str:
+    """Integer address id -> literal. ``addrs`` is the interned id->literal
+    table (net_addrs.csv) for IPv6 ids; without it a v6 id degrades to a
+    stable placeholder rather than a wrong dotted quad."""
+    if value < 0:  # -1 is the schema's "not a packet" sentinel
+        return "n/a"
+    v = int(value)
+    if v >= V6_ID_BASE:
+        if addrs:
+            hit = addrs.get(v)
+            if hit:
+                return hit
+        return f"ipv6#{v - V6_ID_BASE}"
+    octets = []
+    for i in range(4):
+        octets.append(v // 1000 ** (3 - i))
+        v %= 1000 ** (3 - i)
+    return ".".join(str(o) for o in octets)
+
+
+def read_net_addrs(path: str) -> dict:
+    """Load a capture's interned id->literal address table (net_addrs.csv,
+    written by ingest_pcap when non-IPv4 packets appear). Missing file ->
+    empty dict: every consumer degrades to unpack_ip placeholders."""
+    import csv
+    import os
+
+    table: dict = {}
+    if not os.path.isfile(path):
+        return table
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            try:
+                table[int(row["id"])] = row["address"]
+            except (KeyError, ValueError):
+                continue
+    return table

@@ -1,0 +1,68 @@
+"""`sofa viz` — serve the board GUI over the logdir.
+
+Like the reference (sofa_viz.py:18) this is just an HTTP file server rooted
+at logdir (analyze stages the board HTML/JS there), but embedded so we can
+bind/port-retry and print the URL.
+"""
+
+from __future__ import annotations
+
+import errno
+import functools
+import http.server
+import os
+import socket
+import socketserver
+
+from sofa_tpu.printing import print_error, print_progress
+
+
+class _QuietHandler(http.server.SimpleHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+
+def sofa_viz(cfg, serve_forever: bool = True):
+    if not os.path.isdir(cfg.logdir):
+        print_error(f"logdir {cfg.logdir} does not exist")
+        return None
+    handler = functools.partial(_QuietHandler, directory=cfg.logdir)
+    socketserver.TCPServer.allow_reuse_address = True
+    httpd = None
+    last_err = None
+    for port_try in range(cfg.viz_port, cfg.viz_port + 20):
+        try:
+            httpd = socketserver.TCPServer((cfg.viz_bind, port_try), handler)
+            break
+        except OSError as e:
+            last_err = e
+            if getattr(e, "errno", None) != errno.EADDRINUSE:
+                # A bad bind address fails identically on every port —
+                # retrying the range would only bury the real error.
+                break
+    if httpd is None:
+        print_error(
+            f"cannot bind a port in {cfg.viz_port}..{cfg.viz_port + 19}: {last_err}"
+        )
+        return None
+    port = httpd.server_address[1]
+    if cfg.viz_bind == "127.0.0.1":
+        host = "localhost"
+    elif cfg.viz_bind in ("", "0.0.0.0", "::"):
+        # Wildcard bind: print an address a *remote* user can reach.
+        host = socket.gethostname()
+    else:
+        host = cfg.viz_bind
+    print_progress(
+        f"serving {cfg.logdir} at http://{host}:{port}/ (Ctrl-C stops; "
+        f"bound to {cfg.viz_bind or 'all interfaces'})"
+    )
+    if serve_forever:
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return None
+    return httpd

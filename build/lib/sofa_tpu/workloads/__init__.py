@@ -1,0 +1,29 @@
+"""Built-in JAX workloads: the profiling targets for the benchmark configs.
+
+The reference validated itself against external workloads (tf_cnn_benchmarks
+resnet50/vgg16 and the PyTorch ImageNet examples,
+/root/reference/validation/framework_eval.py:50-99).  The TPU build ships its
+own, so every BASELINE.json config is runnable out of the box with
+``sofa record "python -m sofa_tpu.workloads.<name>"``:
+
+  resnet        JAX/Flax ResNet-50 train/infer steps        (config #2)
+  collectives   all-reduce/all-gather/ppermute ICI microbench (config #3,
+                the xring.py equivalent: /root/reference/tools/xring.py:34-72)
+  transformer   Llama-style decoder, dp/fsdp/tp/sp sharded over a Mesh with
+                ring/flash/zig-zag attention                 (configs #4, #5)
+  inference     KV-cache prefill + greedy decode             (config #4)
+  moe           Switch-MoE with expert-parallel all-to-all dispatch
+  pipeline      GPipe-style pipeline parallelism over ppermute
+
+Supporting modules: flash_pallas (the streaming Pallas kernel),
+ring_attention / ring_flash (sequence parallelism, plain and fused).
+
+Each module is TPU-first: bfloat16 matmuls, static shapes, `lax.scan` loops,
+shardings declared as `PartitionSpec`s over a `jax.sharding.Mesh` so XLA
+inserts the ICI collectives.  They all run identically on the CPU backend with
+virtual devices (tests) and on real chips (bench).
+"""
+
+from sofa_tpu.workloads.common import make_mesh, steps_per_sec
+
+__all__ = ["make_mesh", "steps_per_sec"]

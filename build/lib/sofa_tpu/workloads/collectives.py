@@ -1,0 +1,152 @@
+"""ICI collective microbench — the xring.py equivalent (BASELINE config #3).
+
+The reference swept ring-allreduce configurations over 2..N GPUs with
+tf_cnn_benchmarks and tabulated the observed traffic
+(/root/reference/tools/xring.py:34-72).  The TPU-native version drives the
+collectives directly: for each mesh axis and each payload size it times
+psum (all-reduce), all_gather, psum_scatter (reduce-scatter), and ppermute
+(neighbor exchange) under `jax.shard_map`, reporting algorithm and bus
+bandwidth per chip the way nccl-tests does, so the profiler's ICI-attribution
+path (sofa_tpu/analysis/comm.py) always has a canonical traffic generator —
+and the printed table is itself mesh-shape advice.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _bus_factor(kind: str, n: int) -> float:
+    """Bytes actually crossing links per byte of input, per nccl-tests math."""
+    if n <= 1:
+        return 0.0
+    return {
+        "all_reduce": 2.0 * (n - 1) / n,
+        "all_gather": (n - 1) / n,
+        "reduce_scatter": (n - 1) / n,
+        "ppermute": 1.0,
+    }[kind]
+
+
+def _make_op(kind: str, axis: str, mesh: Mesh):
+    """Jitted collective over ``axis``.
+
+    Every op takes a 2-D input [n, count] sharded P(axis, None) — each chip
+    genuinely holds distinct data, so XLA cannot constant-fold the collective
+    away — and the shard_map is full-manual (the unused mesh axes are simply
+    absent from the specs, i.e. replicated).
+    """
+    n = mesh.shape[axis]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(x):                                 # local shape [1, count]
+        if kind == "all_reduce":
+            return lax.psum(x, axis)             # unvarying -> out P()
+        if kind == "all_gather":
+            return lax.all_gather(x, axis, axis=0, tiled=True)
+        if kind == "reduce_scatter":
+            # Local [1, count] -> flatten so the scatter dim is count; each
+            # chip contributes count elements and keeps count // n.
+            return lax.psum_scatter(x[0], axis, tiled=True)
+        if kind == "ppermute":
+            return lax.ppermute(x, axis, perm)
+        raise ValueError(kind)
+
+    out_spec = {
+        "all_reduce": P(None, None),     # psum result is axis-invariant
+        "all_gather": P(None, None),     # gathered result likewise
+        "reduce_scatter": P(axis),       # each chip keeps its shard
+        "ppermute": P(axis, None),
+    }[kind]
+    # all_gather's output is value-replicated over `axis` but the varying-
+    # manual-axes inference can't prove it; the replication is real, so the
+    # static check is safely disabled for that op only.
+    kwargs = {"check_vma": False} if kind == "all_gather" else {}
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axis, None),),
+                       out_specs=out_spec, **kwargs)
+    return jax.jit(fn)
+
+
+def bench_axis(mesh: Mesh, axis: str, sizes_mb: List[float], reps: int = 10,
+               dtype=jnp.bfloat16) -> List[Dict]:
+    rows = []
+    n = mesh.shape[axis]
+    item = jnp.dtype(dtype).itemsize
+    key = jax.random.PRNGKey(0)
+    for mb in sizes_mb:
+        nbytes = int(mb * 2 ** 20)               # per-chip buffer target
+        count = max(nbytes // item, n)
+        count = (count // n) * n
+        x = jax.device_put(
+            jax.random.normal(key, (n, count), jnp.float32).astype(dtype),
+            NamedSharding(mesh, P(axis, None)))
+        for kind in ("all_reduce", "all_gather", "reduce_scatter", "ppermute"):
+            op = _make_op(kind, axis, mesh)
+            op(x).block_until_ready()            # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = op(x)
+            y.block_until_ready()
+            dt = (time.perf_counter() - t0) / reps
+            # nccl-tests size convention: per-rank buffer for all_reduce /
+            # reduce_scatter / ppermute, total gathered output for all_gather
+            # (each chip really receives (n-1)/n of it over links).
+            size_b = count * item * (n if kind == "all_gather" else 1)
+            alg = size_b / dt / 1e9
+            rows.append({
+                "collective": kind, "axis": axis, "axis_size": n,
+                "size_mb": round(size_b / 2 ** 20, 3),
+                "time_us": round(dt * 1e6, 1),
+                "algbw_gbps": round(alg, 3),
+                "busbw_gbps": round(alg * _bus_factor(kind, n), 3),
+            })
+    return rows
+
+
+def run(mesh: Mesh, sizes_mb=None, reps: int = 10) -> List[Dict]:
+    sizes_mb = sizes_mb or [1, 4, 16, 64]
+    rows = []
+    for axis in mesh.axis_names:
+        if mesh.shape[axis] > 1:
+            rows.extend(bench_axis(mesh, axis, sizes_mb, reps))
+    return rows
+
+
+def print_table(rows: List[Dict]) -> None:
+    hdr = ["collective", "axis", "axis_size", "size_mb", "time_us",
+           "algbw_gbps", "busbw_gbps"]
+    print("  ".join(f"{h:>14}" for h in hdr))
+    for r in rows:
+        print("  ".join(f"{r[h]:>14}" for h in hdr))
+    if rows:
+        best = max(rows, key=lambda r: r["busbw_gbps"])
+        print(f"best bus bandwidth: {best['busbw_gbps']} GB/s "
+              f"({best['collective']} over axis {best['axis']!r}, "
+              f"{best['size_mb']} MB)")
+
+
+def main(argv=None):
+    from sofa_tpu.workloads.common import make_mesh, parse_workload_args
+
+    args = parse_workload_args(argv, {
+        "sizes_mb": "1,4,16,64", "reps": 10, "axes": "data,model",
+    })
+    names = tuple(args.axes.split(","))
+    n = len(jax.devices())
+    if n == 1:
+        print("collectives: single device, nothing to do")
+        return
+    mesh = make_mesh(names)
+    rows = run(mesh, [float(s) for s in args.sizes_mb.split(",")], args.reps)
+    print_table(rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Shared plumbing for the built-in workloads.
+
+Mesh construction, timing loops, and the ``sofa``-aware step-marker
+annotation.  Marker names follow the ``sofa_step`` convention the AISI
+iteration detector keys on, mirroring how the reference located iterations
+from repeated kernel-name subsequences (/root/reference/bin/sofa_aisi.py:110-136)
+— with explicit markers the detection is exact instead of fuzzy, and the
+suffix-tree path remains as the fallback for unannotated programs.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import nullcontext
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def balanced_factorization(n: int, num_axes: int) -> Tuple[int, ...]:
+    """Factor ``n`` into ``num_axes`` factors, largest first, as balanced as
+    a greedy prime split allows (8, 3 axes -> (2, 2, 2); 12, 2 -> (4, 3))."""
+    factors = [1] * num_axes
+    # Prime-factorize n, then pack primes (largest first) onto the smallest bin.
+    primes = []
+    m, p = n, 2
+    while p * p <= m:
+        while m % p == 0:
+            primes.append(p)
+            m //= p
+        p += 1
+    if m > 1:
+        primes.append(m)
+    for prime in sorted(primes, reverse=True):
+        i = int(np.argmin(factors))
+        factors[i] *= prime
+    return tuple(sorted(factors, reverse=True))
+
+
+def make_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices=None,
+    platform: Optional[str] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    With ``axis_sizes=None`` the device count is balanced across the axes;
+    an explicit size of -1 means "whatever is left".  ``platform="cpu"``
+    selects the (virtual-device) CPU backend even when a TPU backend is the
+    default — how tests and multi-chip dry runs get an 8-device mesh on a
+    single-chip host.
+    """
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if axis_sizes is None:
+        sizes = balanced_factorization(n, len(axis_names))
+    else:
+        sizes = list(axis_sizes)
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            if n % known:
+                raise ValueError(f"{n} devices not divisible by {known}")
+            sizes[sizes.index(-1)] = n // known
+        if int(np.prod(sizes)) != n:
+            raise ValueError(f"mesh {dict(zip(axis_names, sizes))} != {n} devices")
+    dev_array = np.asarray(devices).reshape(tuple(sizes))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def step_annotation(step: int):
+    """TraceAnnotation wrapping one training/inference step.
+
+    This is the TPU-era replacement for deriving iteration boundaries from
+    kernel-name repetition: the annotation lands in the XPlane host plane and
+    preprocess turns it into explicit iteration markers.
+    """
+    try:
+        return jax.profiler.TraceAnnotation(f"sofa_step_{step}")
+    except Exception:
+        return nullcontext()
+
+
+def steps_per_sec(step_fn, state, n_steps: int, warmup: int = 2,
+                  annotate: bool = True) -> Tuple[float, object]:
+    """Run ``state = step_fn(state)`` n_steps times and report steady-state
+    steps/second (after ``warmup`` compile/autotune steps)."""
+    for _ in range(warmup):
+        state = step_fn(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        with (step_annotation(i) if annotate else nullcontext()):
+            state = step_fn(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return n_steps / dt, state
+
+
+def parse_workload_args(argv, defaults: Dict[str, object]):
+    """Tiny ``--key value`` parser so workloads stay dependency-free.
+
+    Also applies the env-over-config platform rule before any backend
+    init: the image's sitecustomize may force-register a TPU platform
+    whose init *hangs* when the device tunnel is down, and a user who set
+    JAX_PLATFORMS=cpu (e.g. `sofa record` smoke runs) must win over it.
+    """
+    import argparse
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+
+    p = argparse.ArgumentParser()
+    for k, v in defaults.items():
+        if isinstance(v, bool):
+            p.add_argument(f"--{k}", action=argparse.BooleanOptionalAction,
+                           default=v)
+        else:
+            p.add_argument(f"--{k}", type=type(v), default=v)
+    return p.parse_args(argv)

@@ -1,0 +1,273 @@
+"""Pallas TPU flash attention — the fused local-attention kernel.
+
+The transformer workload's per-chip attention (plain_causal_attention and
+each ring-attention hop) materializes the [B,H,Tq,Tk] score matrix in HBM;
+this kernel keeps the online-softmax recurrence in VMEM so scores never
+leave the chip.  Grid = (batch*head, q-block, k-block) with the k dimension
+innermost ("arbitrary" semantics): K/V stream through VMEM one block at a
+time while the running (acc, m, l) state lives in VMEM scratch, so per-chip
+sequence length is bounded by HBM, not the ~16 MB VMEM — f32 accumulation,
+MXU matmuls via jnp.dot(preferred_element_type=f32).
+
+Layout notes (see /opt/skills/guides/pallas_guide.md): last dim = head_dim
+rides the 128-lane axis; q/k blocks default to 128 rows (MXU tile); the m/l
+softmax state is kept lane-broadcast at [block_q, 128] so every scratch
+buffer respects the (8, 128) f32 tile.
+
+Falls back to the interpreter off-TPU so numerics are testable anywhere
+(tests/test_workloads.py compares against the reference lax implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sofa_tpu.workloads.ring_attention import NEG_INF
+
+
+def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                  m_ref, l_ref, *, block_q: int, block_k: int, num_k: int,
+                  scale: float):
+    # shift_ref: [1] int32 in SMEM — the causal offset: key j is visible to
+    #   query i iff j <= i + shift.  shift=0 is aligned causal attention,
+    #   shift>=T sees everything (non-causal), shift<=-block sees nothing
+    #   (the kernel still runs and emits out=0, lse~NEG_INF).  A *dynamic*
+    #   shift lets one compiled kernel serve every hop of ring attention,
+    #   where the visiting K/V block's global offset is a traced value.
+    # q_ref: [1, block_q, D]; k_ref, v_ref: [1, block_k, D] (streamed per ik)
+    # o_ref: [1, block_q, D]; lse_ref: [1, 8, block_q] (sublane-broadcast so
+    # the block satisfies TPU (8, 128) tiling)
+    # scratch: acc [block_q, D] f32; m, l [block_q, 128] f32 lane-broadcast
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    shift = shift_ref[0]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Blocks past the frontier (every key strictly after the last visible
+    # position for this q-block) contribute nothing — skip their compute.
+    contributes = ik * block_k <= iq * block_q + block_q - 1 + shift
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale         # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                 # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(k_pos > q_pos + shift, NEG_INF, s)
+        m_prev = m_ref[:, :1]                            # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        # Clamp the softmax reference: a row with every key masked so far
+        # keeps m ~ NEG_INF, and exp(s - m) would be exp(0)=1 garbage
+        # instead of 0.  Clamped, exp(NEG_INF - (-1e29)) underflows to 0, so
+        # fully-masked rows accumulate nothing and emit lse ~ -1e29.
+        m_new = jnp.maximum(jnp.maximum(m_prev, m_blk), -1e29)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                           # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == num_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse = (m_ref[:, 0] + jnp.log(l[:, 0]))           # [bq]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
+
+
+def _flash_forward(
+    q, k, v,
+    shift,
+    block_q: int,
+    block_k: int,
+    interpret: Optional[bool],
+    static_causal: bool = False,
+):
+    """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T]).
+
+    ``shift`` is the (possibly traced) causal offset: key j visible to query
+    i iff j <= i + shift.  0 = aligned causal, >= T = full attention,
+    <= -T = fully masked (out 0, lse ~ NEG_INF).
+
+    ``static_causal`` promises shift <= 0 at trace time.  Then no k-block
+    past the q-block's diagonal can ever contribute, so the K/V index maps
+    clamp to the diagonal: skipped iterations re-request the previous
+    block, and the Pallas pipeline elides the copy — the upper-triangle
+    half of K/V HBM traffic disappears.  Must stay False for ring hops,
+    whose traced shift can be positive.
+    """
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq lens ({t}, {tk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = d ** -0.5
+    num_k = tk // block_k
+    shift = jnp.asarray(shift, jnp.int32).reshape(1)
+
+    # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
+    def to_planes(x):
+        tt = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
+
+    qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
+        scale=scale)
+    if static_causal:
+        def kv_index(bh, iq, ik):
+            last = (iq * block_q + block_q - 1) // block_k
+            return (bh, jnp.minimum(ik, last), 0)
+    else:
+        def kv_index(bh, iq, ik):
+            return (bh, ik, 0)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(shift, qp, kp, vp)
+    return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
+            lse[:, 0, :].reshape(b, h, t))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
+    before calling, as the transformer workload already does)."""
+    shift = 0 if causal else k.shape[1]
+    return _flash_forward(q, k, v, shift, block_q, block_k, interpret,
+                          static_causal=causal)[0]
+
+
+def supports(t: int, block: int = 128) -> bool:
+    """True when a [.., T, ..] attention can run through the fused kernel.
+
+    Besides divisibility, the q-block (second-to-minor tile dim) must be a
+    sublane multiple — 16 covers bf16 and f32 on current TPUs.
+    """
+    bq = min(block, t)
+    return t % bq == 0 and bq % 16 == 0
+
+
+@jax.custom_vjp
+def flash_causal_attention(q, k, v):
+    """Differentiable fused causal attention, [B, T, H, D] in and out.
+
+    Forward runs the Pallas kernel and keeps only O(B·H·T) residuals (the
+    output and per-row logsumexp) — the FlashAttention recipe.  Backward is
+    an explicit blockwise gradient (one scan over k-blocks, probabilities
+    recomputed per block from the saved lse) in stock lax ops, so the
+    [T, T] score matrix never materializes in either direction and XLA
+    still fuses everything onto the MXU.
+    """
+    out, _ = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
+    return out
+
+
+def _fwd(q, k, v):
+    out, lse = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
+    return out, (q, k, v, out, lse)
+
+
+def _grad_block(q, k, v, g, delta, lse, shift, block: int = 128):
+    """Blockwise attention gradients against one visiting K/V block.
+
+    All stock lax ops (one scan over k-chunks, probabilities recomputed from
+    the saved per-row lse) — the [Tq, Tk] matrix never fully materializes.
+    ``shift`` is the same causal offset the forward kernel uses; q rows are
+    local positions, k positions are offset by it.  Returns (dq, dk, dv) in
+    f32 — dq for the local q shard, dk/dv for the *visiting* block.
+    """
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    bk = min(block, tk)
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    q_pos = jnp.arange(t)[:, None]                     # [T, 1]
+    kb = k.astype(jnp.float32).reshape(b, tk // bk, bk, h, d)
+    vb = v.astype(jnp.float32).reshape(b, tk // bk, bk, h, d)
+
+    def body(dq, blk):
+        kj, vj, j = blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj) * scale
+        k_pos = j * bk + jnp.arange(bk)[None, :]
+        s = jnp.where((k_pos > q_pos + shift)[None, None], NEG_INF, s)
+        p = jnp.exp(s - lse[..., None])                # [B,H,T,bk]
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vj)
+        ds = p * (dp - delta[..., None])               # [B,H,T,bk]
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kj) * scale
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dq, (dk, dv) = jax.lax.scan(
+        body, dq0,
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(tk // bk)))
+    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
+    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
+    return dq, dk, dv
+
+
+def _bwd(res, g):
+    q, k, v, out, lse = res
+    # delta_i = sum_d(dout_i * out_i) — the softmax-jacobian diagonal term.
+    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    dq, dk, dv = _grad_block(q, k, v, g, delta, lse, 0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_causal_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,245 @@
+"""Autoregressive inference for the Llama-style transformer (KV cache).
+
+BASELINE config #4 ("Llama-3-8B inference: HLO-op + HBM-bandwidth
+attribution") needs a decode workload, not just training steps: decode is
+memory-bound — every step re-reads the whole KV cache from HBM to produce
+one token — which is exactly the regime the roofline pass and HBM series
+exist to expose.
+
+TPU-first shape discipline: the cache is a static [L, B, max_seq, KVH, Dh]
+buffer, decode positions are masked (`j > cur_len` -> NEG_INF) instead of
+sliced, prefill is one full forward pass that also emits per-layer K/V,
+and the decode loop is a single `lax.scan` (one compiled step, N
+iterations).  Sampling is greedy argmax so runs are deterministic and the
+step-vs-full-forward equivalence is testable.
+
+Tensor parallelism composes: with a mesh, the cache shards over "model"
+(the KV heads) and batch over "data", matching transformer.param_specs;
+sequence parallelism does not apply at decode (T=1 per step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sofa_tpu.workloads.ring_attention import NEG_INF
+from sofa_tpu.workloads.transformer import (
+    TransformerConfig,
+    _rmsnorm,
+    layer_body,
+)
+
+
+def _cache_spec(mesh: Optional[Mesh]):
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(None, "data", None, "model", None))
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               mesh: Optional[Mesh] = None):
+    """Zeroed K/V buffers: a (k, v) pair of [L, B, max_seq, KVH, Dh]."""
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_kv_heads, cfg.d_head)
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    if mesh is not None:
+        k = jax.device_put(k, _cache_spec(mesh))
+        v = jax.device_put(v, _cache_spec(mesh))
+    return k, v
+
+
+def _attend_cache(q, k_cache, v_cache, cur_len):
+    """q: [B, T, H, Dh] attends the first cur_len+T cache positions.
+
+    k/v_cache: [B, max_seq, KVH, Dh] (already containing this step's
+    entries).  Valid keys are j <= cur_len + (query's offset), expressed
+    with a mask so shapes stay static.  GQA runs as a grouped einsum — the
+    cache is read once at its stored width, never materialized
+    head-repeated (decode is the memory-bound regime this workload
+    exists to expose; the f32 converts fuse into the dots).
+    """
+    b, t, h, dh = q.shape
+    kvh = k_cache.shape[2]
+    rep = h // kvh
+    qg = q.reshape(b, t, kvh, rep, dh).astype(jnp.float32)
+    scale = dh ** -0.5
+    s = jnp.einsum("btkrd,bskd->bkrts", qg,
+                   k_cache.astype(jnp.float32)) * scale
+    j = jnp.arange(k_cache.shape[1])[None, None, None, None, :]
+    q_pos = cur_len + jnp.arange(t)[None, None, None, :, None]
+    s = jnp.where(j > q_pos, NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkrts,bskd->btkrd", p, v_cache.astype(jnp.float32))
+    return o.reshape(b, t, h, dh).astype(q.dtype)
+
+
+def _block(params, x, tokens_positions, cache, cur_len,
+           cfg: TransformerConfig):
+    """Transformer stack over x [B, T, D], reading+writing the KV cache at
+    offset cur_len.  Returns (logits [B, T, vocab], cache).
+
+    The layer math is transformer.layer_body — one shared copy — with the
+    attention swapped for a cache read/write."""
+    k_cache, v_cache = cache
+
+    def layer(x, lp_kv):
+        lp, kc, vc = lp_kv
+
+        def attn(q, kk, v):
+            kc2 = lax.dynamic_update_slice(kc, kk.astype(kc.dtype),
+                                           (0, cur_len, 0, 0))
+            vc2 = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                           (0, cur_len, 0, 0))
+            return _attend_cache(q, kc2, vc2, cur_len), (kc2, vc2)
+
+        return layer_body(x, lp, cfg, tokens_positions, attn)
+
+    x, (k_cache, v_cache) = lax.scan(layer, x,
+                                     (params["layers"], k_cache, v_cache))
+    x = _rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, (k_cache, v_cache)
+
+
+def prefill(params, tokens, cache, cfg: TransformerConfig):
+    """Full-sequence forward that populates the cache.
+
+    tokens: [B, T_prompt].  Returns (logits [B, T, vocab], cache).
+    """
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    return _block(params, x, positions, cache, 0, cfg)
+
+
+def decode_step(params, token, cache, cur_len, cfg: TransformerConfig):
+    """One token in, one token's logits out.  token: [B] int32."""
+    b = token.shape[0]
+    positions = jnp.broadcast_to(cur_len, (b, 1))
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]
+    logits, cache = _block(params, x, positions, cache, cur_len, cfg)
+    return logits[:, 0], cache
+
+
+def decode_loop(params, first_tok, cache, t_prompt: int, max_new: int,
+                cfg: TransformerConfig) -> jax.Array:
+    """Greedy scan from the first generated token: returns [B, max_new].
+
+    Runs max_new - 1 decode steps (the first new token came from prefill;
+    the token produced by the final step would be position max_new + 1 and
+    is never computed)."""
+
+    def step(carry, i):
+        tok, cache = carry
+        logits, cache = decode_step(params, tok, cache, t_prompt + i, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (nxt, cache), nxt
+
+    (_, _), toks = lax.scan(step, (first_tok, cache),
+                            jnp.arange(max_new - 1))
+    return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
+
+
+def generate(params, prompt, max_new: int, cfg: TransformerConfig,
+             mesh: Optional[Mesh] = None) -> jax.Array:
+    """Greedy decode: [B, T_prompt] -> [B, T_prompt + max_new].
+
+    jit-able end to end; the decode loop is one lax.scan.
+    """
+    b, t_prompt = prompt.shape
+    if t_prompt + max_new > cfg.max_seq:
+        raise ValueError(f"{t_prompt} + {max_new} exceeds max_seq "
+                         f"{cfg.max_seq}")
+    cache = init_cache(cfg, b, mesh)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    next_tok = jnp.argmax(logits[:, t_prompt - 1], axis=-1).astype(
+        prompt.dtype)
+    new = decode_loop(params, next_tok, cache, t_prompt, max_new, cfg)
+    return jnp.concatenate([prompt, new], axis=1)
+
+
+def make_serving_fns(cfg: TransformerConfig, prompt_len: int, max_new: int,
+                     mesh: Optional[Mesh] = None):
+    """The two jitted serving entry points, split so the profiler sees the
+    two regimes as separate XLA modules (jit_run_prefill / jit_run_decode —
+    the names analysis/tpu.serving_profile anchors on):
+
+      run_prefill(params, prompt)      -> (first_token, cache)
+      run_decode(params, tok, cache)   -> [B, max_new] generated tokens
+    """
+
+    @jax.jit
+    def run_prefill(p, x):
+        cache = init_cache(cfg, x.shape[0], mesh)
+        logits, cache = prefill(p, x, cache, cfg)
+        tok = jnp.argmax(logits[:, x.shape[1] - 1], -1).astype(x.dtype)
+        return tok, cache
+
+    @jax.jit
+    def run_decode(p, tok, cache):
+        return decode_loop(p, tok, cache, prompt_len, max_new, cfg)
+
+    return run_prefill, run_decode
+
+
+def main(argv=None):
+    import time
+
+    from sofa_tpu.workloads.common import make_mesh, parse_workload_args
+    from sofa_tpu.workloads.transformer import init_params, shard_params
+
+    args = parse_workload_args(argv, {
+        "batch": 4, "prompt": 128, "new_tokens": 128, "d_model": 512,
+        "n_layers": 4, "n_heads": 8, "n_kv_heads": 4, "d_ff": 1408,
+        "vocab": 32000, "data": 0, "model": 0,
+    })
+    cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,
+                            n_layers=args.n_layers, n_heads=args.n_heads,
+                            n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
+                            max_seq=args.prompt + args.new_tokens)
+    mesh = None
+    n = len(jax.devices())
+    if n > 1:
+        sizes = None
+        if args.data or args.model:
+            # A single flag set leaves the other axis to absorb the rest.
+            sizes = (args.data or -1, args.model or -1)
+        mesh = make_mesh(("data", "model"), sizes)
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        # Reuse the training param specs; the decode mesh has no seq axis.
+        params = shard_params(params, cfg, mesh)
+    prompt = jax.random.randint(key, (args.batch, args.prompt), 0, cfg.vocab)
+
+    # Prefill and decode are different regimes (compute- vs memory-bound);
+    # time them separately so the reported numbers mean something.
+    run_prefill, run_decode = make_serving_fns(
+        cfg, args.prompt, args.new_tokens, mesh)
+
+    tok, cache = run_prefill(params, prompt)
+    jax.block_until_ready(run_decode(params, tok, cache))   # compile both
+    t0 = time.perf_counter()
+    tok, cache = run_prefill(params, prompt)
+    jax.block_until_ready((tok, cache))
+    t1 = time.perf_counter()
+    out = run_decode(params, tok, cache)
+    out.block_until_ready()
+    t2 = time.perf_counter()
+    pre_tps = args.batch * args.prompt / (t1 - t0)
+    # The decode window runs new_tokens - 1 steps (the first new token is
+    # the prefill window's argmax).
+    dec_tps = args.batch * max(1, args.new_tokens - 1) / (t2 - t1)
+    print(f"inference: prefill {pre_tps:,.1f} tokens/s, "
+          f"decode {dec_tps:,.1f} tokens/s "
+          f"(batch {args.batch}, prompt {args.prompt}, "
+          f"new {args.new_tokens}, mesh={dict(mesh.shape) if mesh else None})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,347 @@
+"""Switch-style Mixture-of-Experts decoder with expert parallelism.
+
+The all-to-all traffic generator: the reference profiler classified NCCL
+collectives by kernel-name grep (/root/reference/bin/sofa_analyze.py:363-368)
+and never saw expert-parallel dispatch at all; this workload generates the
+real thing — two `lax.all_to_all` exchanges per MoE layer over the "expert"
+mesh axis (CopyKind.ALL_TO_ALL in the trace taxonomy, sofa_tpu/trace.py) —
+so the comm profile, ICI matrix, and per-iteration attribution all have a
+first-class EP workload to observe.
+
+TPU-first shape discipline: top-1 (Switch) routing with a *static* capacity
+per expert — dispatch/combine are dense one-hot einsums, so XLA sees fixed
+shapes and keeps everything on the MXU; tokens over capacity are dropped
+(standard Switch behavior, the aux loss pushes the router toward balance).
+Experts shard one-or-more-per-chip over the ``expert`` axis; tokens ride
+(data × expert) as a flat data dimension outside the MoE block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sofa_tpu.workloads.ring_attention import plain_causal_attention
+from sofa_tpu.workloads.transformer import _rmsnorm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 8192
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    max_seq: int = 512
+    dtype: Any = jnp.bfloat16
+    router_aux_weight: float = 0.01
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(n_experts: int = 4) -> "MoEConfig":
+        return MoEConfig(vocab=256, d_model=32, n_layers=2, n_heads=2,
+                         d_ff=64, n_experts=n_experts, max_seq=64)
+
+
+def init_params(cfg: MoEConfig, key) -> Dict[str, Any]:
+    k = iter(jax.random.split(key, 12))
+    d, f, e, l = cfg.d_model, cfg.d_ff, cfg.n_experts, cfg.n_layers
+
+    def norm(key, *shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "embed": norm(next(k), cfg.vocab, d),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), jnp.float32),
+            "wqkv": norm(next(k), l, d, 3 * d),
+            "wo": norm(next(k), l, d, d),
+            "moe_norm": jnp.ones((l, d), jnp.float32),
+            # Router stays float32: tiny, and logit noise moves tokens.
+            "router": jax.random.normal(next(k), (l, d, e),
+                                        jnp.float32) * (d ** -0.5),
+            "w_up": norm(next(k), l, e, d, f),
+            "w_down": norm(next(k), l, e, f, d),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm(next(k), d, cfg.vocab),
+    }
+
+
+def param_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    """Experts shard over "expert"; everything else is replicated."""
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wqkv": P(None, None, None),
+            "wo": P(None, None, None),
+            "moe_norm": P(None, None),
+            "router": P(None, None, None),
+            "w_up": P(None, "expert", None, None),
+            "w_down": P(None, "expert", None, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+
+
+def _dispatch_tensors(logits, n_experts: int, capacity: int):
+    """Top-1 routing -> (dispatch [N,E,C] one-hot, combine [N,E,C], aux).
+
+    Position of each token inside its expert's buffer is its rank among
+    same-expert tokens (cumsum); ranks >= capacity are dropped.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [N, E]
+    expert = jnp.argmax(probs, axis=-1)                           # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot              # [N, E]
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                            dtype=jnp.float32)                     # [N, C]
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]               # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * sum_e(fraction_dispatched_e * mean_prob_e).
+    frac = onehot.mean(axis=0)
+    aux = n_experts * jnp.sum(frac * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
+def _expert_ffn(xs, w_up, w_down, dtype, upcast: bool = False):
+    """Per-expert gelu MLP over dispatched slots.
+
+    xs: [..., E, C, D] in ``dtype`` (bf16 on TPU — the MXU path); matmuls
+    accumulate in f32, activations return to ``dtype``.  With
+    ``upcast=True`` (execution platform is not TPU — the caller checks the
+    *mesh's* devices, not the process default backend) the dots run in
+    f32: XLA:CPU's dot thunk rejects bf16 batched contractions (numerics
+    are covered by the f32 equivalence tests either way).
+    """
+    if upcast and dtype == jnp.bfloat16:
+        dtype = jnp.float32
+        xs = xs.astype(dtype)
+    h = jnp.einsum("...ecd,edf->...ecf", xs, w_up.astype(dtype),
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h).astype(dtype)
+    return jnp.einsum("...ecf,efd->...ecd", h, w_down.astype(dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig,
+                  upcast: bool = False):
+    """Single-device reference: every expert runs on every token's slot.
+
+    x: [N, D].  Ground truth for the expert-parallel path in tests; also
+    the fallback when no mesh is given.
+    """
+    n = x.shape[0]
+    capacity = _capacity(n, cfg)
+    logits = x.astype(jnp.float32) @ router_w                      # [N, E]
+    dispatch, combine, aux = _dispatch_tensors(logits, cfg.n_experts,
+                                               capacity)
+    xs = jnp.einsum("nec,nd->ecd", dispatch,
+                    x.astype(jnp.float32)).astype(cfg.dtype)       # [E, C, D]
+    # Round-trip through cfg.dtype exactly like the expert-parallel path
+    # does at its return all-to-all, so the two paths stay bit-identical.
+    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype,
+                     upcast=upcast).astype(cfg.dtype)
+    out = jnp.einsum("nec,ecd->nd", combine, ys.astype(jnp.float32))
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn_expert_parallel(x, router_w, w_up, w_down, cfg: MoEConfig,
+                            axis_name: str, upcast: bool = False):
+    """Expert-parallel MoE block; runs inside shard_map over ``axis_name``.
+
+    x: [N_local, D] — this shard's tokens.  w_up/w_down: [E_local, D, F] —
+    this shard's experts.  Two all-to-alls: tokens out to their experts,
+    results back.  Expert id e lives on shard e // E_local.
+    """
+    shards = lax.psum(1, axis_name)
+    e_local = w_up.shape[0]
+    n_local, d = x.shape
+    capacity = _capacity(n_local, cfg)
+    logits = x.astype(jnp.float32) @ router_w
+    dispatch, combine, aux = _dispatch_tensors(logits, cfg.n_experts,
+                                               capacity)
+    # Dispatch math stays f32 (one-hot sums), but the dispatched slots ride
+    # the wire and the MXU in cfg.dtype — the ICI byte counts a profiled
+    # run observes are the real bf16 deployment numbers.
+    xs = jnp.einsum("nec,nd->ecd", dispatch,
+                    x.astype(jnp.float32)).astype(cfg.dtype)
+    # [E, C, D] -> [S, E_local, C, D]; all_to_all swaps the shard dim for
+    # the token-source dim, landing every token on its expert's chip.
+    xs = xs.reshape(shards, e_local, capacity, d)
+    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False)                   # [S(src), E_local, C, D]
+    ys = _expert_ffn(xs, w_up, w_down, cfg.dtype,
+                     upcast=upcast).astype(cfg.dtype)
+    ys = lax.all_to_all(ys, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False)                   # [S, E_local, C, D]
+    ys = ys.reshape(cfg.n_experts, capacity, d).astype(jnp.float32)
+    out = jnp.einsum("nec,ecd->nd", combine, ys)
+    # Per-device aux averaged across shards — the actual Switch/GShard
+    # formulation (each device balances its own batch).  This is a
+    # different statistic from the dense path's global-batch aux, so the
+    # two paths agree on logits but not (exactly) on aux.
+    aux = lax.pmean(aux, axis_name)
+    return out.astype(x.dtype), aux
+
+
+def _capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    return max(1, int(np.ceil(n_tokens / cfg.n_experts
+                              * cfg.capacity_factor)))
+
+
+def forward(params, tokens, cfg: MoEConfig,
+            mesh: Optional[Mesh] = None):
+    """Logits [B, T, vocab] + router aux loss.  With a mesh, the MoE block
+    runs expert-parallel over its "expert" axis; attention and the dense
+    parts treat (data, expert) as one flat batch dimension."""
+    b, t = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    use_ep = mesh is not None and mesh.shape.get("expert", 1) > 1
+    if use_ep and cfg.n_experts % mesh.shape["expert"]:
+        raise ValueError(f"n_experts {cfg.n_experts} must divide over the "
+                         f"expert axis ({mesh.shape['expert']})")
+    # bf16 fallback keys on the platform the computation actually runs on:
+    # the mesh's devices when given (tests build CPU meshes even on TPU
+    # hosts), else the process default backend.
+    if mesh is not None:
+        platform = next(iter(mesh.devices.flat)).platform
+    else:
+        platform = jax.default_backend()
+    upcast = platform != "tpu"
+
+    def moe_block(h2, router_w, w_up, w_down):
+        flat = h2.reshape(b * t, cfg.d_model)
+        if use_ep:
+            spec_x = P(("data", "expert"), None)
+            spec_w = P("expert", None, None)
+
+            def fn(xs, up, down):
+                out, aux = moe_ffn_expert_parallel(xs, router_w, up, down,
+                                                   cfg, "expert",
+                                                   upcast=upcast)
+                # moe_ffn_* pmeans aux over the expert axis; tokens also
+                # shard over "data", so finish the mean there for a fully
+                # replicated scalar.
+                return out, lax.pmean(aux, "data")
+
+            out, aux = jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(spec_x, spec_w, spec_w),
+                out_specs=(spec_x, P()))(flat, w_up, w_down)
+        else:
+            out, aux = moe_ffn_dense(flat, router_w, w_up, w_down, cfg,
+                                     upcast=upcast)
+        return out.reshape(b, t, cfg.d_model), aux
+
+    def layer(carry, lp):
+        x, aux_sum = carry
+        h = _rmsnorm(x, lp["attn_norm"])
+        qkv = (h @ lp["wqkv"]).reshape(b, t, 3, cfg.n_heads, cfg.d_head)
+        o = plain_causal_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        x = x + o.reshape(b, t, -1) @ lp["wo"]
+        h2 = _rmsnorm(x, lp["moe_norm"])
+        y, aux = moe_block(h2, lp["router"], lp["w_up"], lp["w_down"])
+        return (x + y, aux_sum + aux), None
+
+    (x, aux_sum), _ = lax.scan(layer, (x, jnp.float32(0.0)),
+                               params["layers"])
+    x = _rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux_sum / cfg.n_layers
+
+
+def loss_fn(params, tokens, cfg: MoEConfig, mesh: Optional[Mesh] = None):
+    logits, aux = forward(params, tokens, cfg, mesh)
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + cfg.router_aux_weight * aux
+
+
+def build(cfg: MoEConfig, mesh: Optional[Mesh], batch: int, seq: int,
+          seed: int = 0):
+    """Params + optimizer + jitted step + a data batch, placed on the mesh."""
+    import optax
+
+    key = jax.random.PRNGKey(seed)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        specs = param_specs(cfg)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab)
+    if mesh is not None:
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P(("data", "expert"), None)))
+    return params, opt_state, step, tokens
+
+
+def main(argv=None):
+    from sofa_tpu.workloads.common import (make_mesh, parse_workload_args,
+                                           steps_per_sec)
+
+    args = parse_workload_args(argv, {
+        "batch": 8, "seq": 256, "steps": 10, "d_model": 256, "n_layers": 2,
+        "n_heads": 4, "d_ff": 512, "n_experts": 8, "vocab": 8192,
+        "data": 0, "expert": 0,
+    })
+    cfg = MoEConfig(vocab=args.vocab, d_model=args.d_model,
+                    n_layers=args.n_layers, n_heads=args.n_heads,
+                    d_ff=args.d_ff, n_experts=args.n_experts,
+                    max_seq=args.seq)
+    n = len(jax.devices())
+    mesh = None
+    if n > 1:
+        sizes = None
+        if args.data or args.expert:
+            sizes = (args.data or -1, args.expert or -1)
+        mesh = make_mesh(("data", "expert"), sizes)
+        ep = mesh.shape["expert"]
+        if cfg.n_experts % ep:
+            bumped = ep * -(-cfg.n_experts // ep)
+            print(f"moe: rounding n_experts {cfg.n_experts} -> {bumped} "
+                  f"(multiple of expert axis {ep})")
+            cfg = dataclasses.replace(cfg, n_experts=bumped)
+    params, opt_state, step, tokens = build(cfg, mesh, args.batch, args.seq)
+
+    def one(state):
+        p, o, _ = state
+        return step(p, o, tokens)
+
+    sps, state = steps_per_sec(one, (params, opt_state, 0.0), args.steps)
+    mesh_desc = dict(mesh.shape) if mesh else {"single": 1}
+    print(f"moe: {sps:.3f} steps/s  {sps * args.batch * args.seq:,.0f} "
+          f"tokens/s  loss={float(state[2]):.3f}  mesh={mesh_desc}")
+
+
+if __name__ == "__main__":
+    main()

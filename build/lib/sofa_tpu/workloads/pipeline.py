@@ -1,0 +1,256 @@
+"""Pipeline-parallel transformer training over a "stage" mesh axis.
+
+GPipe-style microbatch pipelining, written the shard_map way: layer
+parameters shard over ``stage`` (each chip owns layers_per_stage layers),
+microbatched activations flow stage-to-stage over `lax.ppermute` — ICI
+neighbor traffic, the same link class ring attention rides — and the
+schedule is one `lax.scan` over M + S - 1 ticks (static trip count, no
+data-dependent control flow).  The backward pass is plain autodiff through
+the scan: JAX reverses ppermute into the opposite rotation, which *is* the
+backward pipeline.
+
+The reference profiler could only watch pipeline traffic as P2P copies
+(/root/reference/bin/sofa_common.py:97-157, copyKind 10); this workload
+generates it natively so COLLECTIVE_PERMUTE attribution and the ICI matrix
+have a pipeline-parallel source.  Completes the parallelism matrix next to
+dp/fsdp (transformer), sp (ring attention), tp (model axis), and ep (moe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sofa_tpu.workloads.ring_attention import plain_causal_attention
+from sofa_tpu.workloads.transformer import _rmsnorm, _rope
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    vocab: int = 8192
+    d_model: int = 256
+    n_heads: int = 4
+    d_ff: int = 512
+    layers_per_stage: int = 2
+    n_microbatches: int = 4
+    max_seq: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny() -> "PipelineConfig":
+        return PipelineConfig(vocab=256, d_model=32, n_heads=2, d_ff=64,
+                              layers_per_stage=1, n_microbatches=2,
+                              max_seq=64)
+
+
+def init_params(cfg: PipelineConfig, n_layers: int, key) -> Dict[str, Any]:
+    """n_layers = stages * layers_per_stage; layer leaves are stacked on a
+    leading dim that shards over "stage"."""
+    k = iter(jax.random.split(key, 10))
+    d, f, l = cfg.d_model, cfg.d_ff, n_layers
+
+    def norm(key, *shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "embed": norm(next(k), cfg.vocab, d),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), jnp.float32),
+            "wqkv": norm(next(k), l, d, 3 * d),
+            "wo": norm(next(k), l, d, d),
+            "mlp_norm": jnp.ones((l, d), jnp.float32),
+            "w1": norm(next(k), l, d, f),
+            "w2": norm(next(k), l, f, d),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm(next(k), d, cfg.vocab),
+    }
+
+
+def param_specs() -> Dict[str, Any]:
+    lp = P("stage", None, None)
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P("stage", None),
+            "wqkv": lp,
+            "wo": lp,
+            "mlp_norm": P("stage", None),
+            "w1": lp,
+            "w2": lp,
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+
+
+def _layer(x, lp, cfg: PipelineConfig, positions):
+    b, t, _ = x.shape
+    h = _rmsnorm(x, lp["attn_norm"])
+    qkv = (h @ lp["wqkv"]).reshape(b, t, 3, cfg.n_heads, cfg.d_head)
+    q = _rope(qkv[:, :, 0], positions, 500000.0)
+    kk = _rope(qkv[:, :, 1], positions, 500000.0)
+    o = plain_causal_attention(q, kk, qkv[:, :, 2])
+    x = x + o.reshape(b, t, -1) @ lp["wo"]
+    h = _rmsnorm(x, lp["mlp_norm"])
+    gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(cfg.dtype)
+    return x + gate @ lp["w2"]
+
+
+def _stage(x, stage_layers, cfg: PipelineConfig, positions):
+    """Run this stage's layers_per_stage stacked layers."""
+    def body(x, lp):
+        return _layer(x, lp, cfg, positions), None
+
+    x, _ = lax.scan(body, x, stage_layers)
+    return x
+
+
+def _reference_forward(params, tokens, cfg: PipelineConfig):
+    """Unpipelined twin: all layers sequentially (test ground truth)."""
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _stage(x, params["layers"], cfg, positions)
+    x = _rmsnorm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def pipeline_loss(params, tokens, cfg: PipelineConfig, mesh: Mesh,
+                  data_axis: str = "data", stage_axis: str = "stage"):
+    """Mean next-token loss, computed through the S-stage pipeline.
+
+    tokens: [B, T] sharded over ``data_axis``.  Per shard the local batch
+    splits into n_microbatches; tick t has stage s working on microbatch
+    t - s (bubbles at the ramp ends, the GPipe schedule).
+    """
+
+    def fn(layers, embed, final_norm, lm_head, tokens_local):
+        s_count = lax.psum(1, stage_axis)
+        sid = lax.axis_index(stage_axis)
+        b_loc, t_len = tokens_local.shape
+        m = cfg.n_microbatches
+        if b_loc % m:
+            raise ValueError(f"local batch {b_loc} must divide into "
+                             f"{m} microbatches")
+        mb_b = b_loc // m
+        mbs = tokens_local.reshape(m, mb_b, t_len)
+        positions = jnp.broadcast_to(jnp.arange(t_len), (mb_b, t_len))
+        # Stage 0's injection stream, precomputed per microbatch.
+        injected = embed.astype(cfg.dtype)[mbs]        # [M, mb_b, T, D]
+
+        # The scan carries must enter with the same varying-manual-axes
+        # type they leave with: {V:(data,stage)} — tokens vary over data,
+        # the per-stage layer params add stage.  pcast the zero carries up
+        # front (a bare jnp.zeros is fully invariant and fails the check).
+        out0 = lax.pcast(injected * 0.0, (stage_axis,),
+                         to="varying")                 # [M, mb_b, T, D]
+        carry0 = out0[0]
+        fwd_perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+
+        def tick(state, t):
+            carry, outs = state
+            mb_in = jnp.clip(t, 0, m - 1)
+            x_in = jnp.where(sid == 0, injected[mb_in], carry)
+            y = _stage(x_in, layers, cfg, positions)
+            # Last stage completes microbatch t - (S-1) at this tick.
+            done = t - (s_count - 1)
+            slot = jnp.clip(done, 0, m - 1)
+            write = (done >= 0) & (sid == s_count - 1)
+            cur = lax.dynamic_slice_in_dim(outs, slot, 1, axis=0)
+            upd = jnp.where(write, y[None], cur)
+            outs = lax.dynamic_update_slice_in_dim(outs, upd, slot, axis=0)
+            carry = lax.ppermute(y, stage_axis, fwd_perm)
+            return (carry, outs), None
+
+        (_, outs), _ = lax.scan(tick, (carry0, out0),
+                                jnp.arange(m + s_count - 1))
+        # Loss on the last stage only; psum makes it global + replicated
+        # (every other stage contributes 0).
+        x = _rmsnorm(outs.reshape(b_loc, t_len, cfg.d_model),
+                     final_norm)
+        logits = (x @ lm_head).astype(jnp.float32)[:, :-1]
+        # outs rows are in microbatch order == tokens_local order.
+        targets = tokens_local.reshape(b_loc, t_len)[:, 1:]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        local = jnp.where(sid == s_count - 1, jnp.mean(logz - gold), 0.0)
+        return lax.pmean(lax.psum(local, stage_axis), data_axis)
+
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs()["layers"], P(None, None), P(None),
+                  P(None, None), P(data_axis, None)),
+        out_specs=P())(params["layers"], params["embed"],
+                       params["final_norm"], params["lm_head"], tokens)
+
+
+def build(cfg: PipelineConfig, mesh: Mesh, batch: int, seq: int,
+          seed: int = 0):
+    import optax
+
+    s_count = mesh.shape["stage"]
+    key = jax.random.PRNGKey(seed)
+    params = init_params(cfg, s_count * cfg.layers_per_stage, key)
+    specs = param_specs()
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: pipeline_loss(p, tokens, cfg, mesh))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab)
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+    return params, opt_state, step, tokens
+
+
+def main(argv=None):
+    from sofa_tpu.workloads.common import (make_mesh, parse_workload_args,
+                                           steps_per_sec)
+
+    args = parse_workload_args(argv, {
+        "batch": 8, "seq": 256, "steps": 10, "d_model": 256, "n_heads": 4,
+        "d_ff": 512, "layers_per_stage": 2, "n_microbatches": 4,
+        "vocab": 8192, "data": 0, "stage": 0,
+    })
+    cfg = PipelineConfig(vocab=args.vocab, d_model=args.d_model,
+                         n_heads=args.n_heads, d_ff=args.d_ff,
+                         layers_per_stage=args.layers_per_stage,
+                         n_microbatches=args.n_microbatches,
+                         max_seq=args.seq)
+    sizes = None
+    if args.data or args.stage:
+        sizes = (args.data or -1, args.stage or -1)
+    mesh = make_mesh(("data", "stage"), sizes)
+    params, opt_state, step, tokens = build(cfg, mesh, args.batch, args.seq)
+
+    def one(state):
+        p, o, _ = state
+        return step(p, o, tokens)
+
+    sps, state = steps_per_sec(one, (params, opt_state, 0.0), args.steps)
+    print(f"pipeline: {sps:.3f} steps/s  {sps * args.batch * args.seq:,.0f} "
+          f"tokens/s  loss={float(state[2]):.3f}  mesh={dict(mesh.shape)}")
+
+
+if __name__ == "__main__":
+    main()

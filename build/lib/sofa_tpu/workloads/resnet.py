@@ -1,0 +1,160 @@
+"""JAX/Flax ResNet-50: the single-chip profiling target (BASELINE config #2).
+
+The reference validated against tf_cnn_benchmarks resnet50
+(/root/reference/validation/framework_eval.py:56-64); the TPU build ships its
+own Flax implementation so `sofa record "python -m sofa_tpu.workloads.resnet"`
+works with no external checkout.  NHWC layout and bfloat16 compute (float32
+batch-norm statistics) — the conv layout and dtype the TPU convolution
+lowering wants; batch is sharded over a "data" mesh axis when more than one
+device is present.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    projection: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if self.projection:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype,
+                                 param_dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = 64 * 2 ** i
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(filters, strides, projection=(j == 0),
+                               dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)
+
+
+def create(batch: int, image_size: int = 224, num_classes: int = 1000,
+           stage_sizes=(3, 4, 6, 3), seed: int = 0):
+    """Returns (model, variables, example_batch)."""
+    model = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes)
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(key, x, train=False)
+    return model, variables, x
+
+
+def make_infer_step(model):
+    @jax.jit
+    def infer(variables, x):
+        return model.apply(variables, x, train=False)
+    return infer
+
+
+def make_train_step(model, learning_rate: float = 0.1):
+    import optax
+
+    tx = optax.sgd(learning_rate, momentum=0.9)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, x, labels):
+        def loss_fn(p):
+            logits, updated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+            loss = jnp.mean(jnp.sum(
+                -onehot * jax.nn.log_softmax(logits), axis=-1))
+            return loss, updated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    return tx, step
+
+
+def main(argv=None):
+    from sofa_tpu.workloads.common import (make_mesh, parse_workload_args,
+                                           steps_per_sec)
+
+    args = parse_workload_args(argv, {
+        "batch": 64, "image_size": 224, "steps": 20, "train": False,
+        "num_classes": 1000,
+    })
+    model, variables, x = create(args.batch, args.image_size,
+                                 args.num_classes)
+    n = len(jax.devices())
+    if n > 1 and args.batch % n == 0:
+        mesh = make_mesh(("data",))
+        put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+        x = put(x, P("data"))
+        variables = jax.tree.map(lambda a: put(a, P()), variables)
+
+    if args.train:
+        labels = jnp.zeros((args.batch,), jnp.int32)
+        tx, step = make_train_step(model)
+        opt_state = tx.init(variables["params"])
+
+        def one(state):
+            p, bs, o, _ = state
+            return step(p, bs, o, x, labels)
+
+        state0 = (variables["params"], variables["batch_stats"], opt_state, 0.0)
+        sps, state = steps_per_sec(one, state0, args.steps)
+        print(f"resnet50 train: {sps:.3f} steps/s  "
+              f"{sps * args.batch:.1f} images/s  loss={float(state[3]):.3f}")
+    else:
+        infer = make_infer_step(model)
+
+        def one(state):
+            return infer(variables, x)
+
+        sps, _ = steps_per_sec(one, None, args.steps)
+        print(f"resnet50 infer: {sps:.3f} steps/s  "
+              f"{sps * args.batch:.1f} images/s")
+
+
+if __name__ == "__main__":
+    main()

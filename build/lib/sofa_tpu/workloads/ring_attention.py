@@ -1,0 +1,128 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context sequence parallelism for the transformer workload.  Queries stay
+resident on their shard; key/value blocks rotate around the mesh axis with
+`lax.ppermute` (one hop per step, riding ICI neighbor links), and each hop is
+folded into the running output with the online-softmax (flash) recurrence, so
+the full [T, T] score matrix never materializes and per-chip memory is
+O(T_local^2).  After axis_size hops every query has seen every key exactly
+once — numerically identical to full causal attention.
+
+The reference profiler *observed* sequence/model-parallel traffic (P2P copy
+matrices, /root/reference/bin/sofa_common.py:97-157) but executed none; this
+module is both a first-class long-context workload and the canonical
+ppermute-traffic generator for the ICI collective-trace subsystem
+(SURVEY.md §2.9).
+
+All shapes are static, the hop loop is a `lax.scan`, and accumulation is
+float32 regardless of input dtype — the bf16-in/f32-accumulate pattern the
+MXU wants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_pos, k_pos, causal: bool):
+    """One (q-block, kv-block) flash step.  q,k,v: [B,T,H,D] (local block).
+
+    Returns (scores_max [B,H,Tq], exp-weights [B,H,Tq,Tk], pv [B,Tq,H,D])
+    pieces needed by the online-softmax combine.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+        s = jnp.where(mask, NEG_INF, s)
+    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    # A fully-masked row (early ring hops for leading queries) keeps m=NEG_INF;
+    # subtracting would make exp(0)=1 garbage, so clamp the reference point.
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe[..., None])           # [B,H,Tq,Tk]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_safe, p.sum(axis=-1), pv
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
+    """Attention body that runs *inside* shard_map over ``axis_name``.
+
+    q, k, v: [B, T_local, H, D] — this chip's sequence shard.
+    Returns [B, T_local, H, D] in q.dtype.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def hop(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # Block i arrived from shard (my_idx - i) mod axis_size.
+        src = (my_idx - i) % axis_size
+        k_pos = src * t_local + jnp.arange(t_local)
+        m_blk, l_blk, pv = _block_attn(q, k_blk, v_blk, q_pos, k_pos, causal)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)               # rescale old accumulators
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l * alpha + l_blk * beta
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + pv * beta.transpose(0, 2, 1)[..., None])
+        # Rotate K/V to the next chip; after axis_size hops they are home.
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    # Derive the accumulators from q so they carry q's varying-manual-axes
+    # type: a plain jnp.zeros is device-invariant and the scan carry would
+    # fail shard_map's VMA check (in/out carry types must match).
+    zero = q.astype(jnp.float32) * 0.0
+    o0 = zero
+    m0 = zero[..., 0].transpose(0, 2, 1) + NEG_INF   # [B,H,Tq]
+    l0 = zero[..., 0].transpose(0, 2, 1)
+    (o, m, l, _, _), _ = lax.scan(
+        hop, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    # Causal masking guarantees every query attends to at least itself, so
+    # l > 0 everywhere by the time the ring closes.
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+                   batch_axis: Optional[str] = "data",
+                   head_axis: Optional[str] = "model",
+                   causal: bool = True):
+    """shard_map-wrapped ring attention over a global [B, T, H, D] array.
+
+    Batch is sharded over ``batch_axis``, sequence over ``seq_axis``, heads
+    over ``head_axis`` (tensor parallelism composes freely: heads are
+    independent, so the ring only ever moves the local head slice).
+    """
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    fn = functools.partial(ring_attention_local, axis_name=seq_axis,
+                           causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def plain_causal_attention(q, k, v):
+    """Reference single-device causal attention (for tests and the sp=1 path)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    t = q.shape[1]
+    mask = jnp.arange(t)[None, :] > jnp.arange(t)[:, None]
+    s = jnp.where(mask[None, None], NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,351 @@
+"""Llama-style decoder-only transformer, sharded over a TPU mesh.
+
+The flagship workload (BASELINE.json configs #4/#5: Llama-style inference and
+pretrain).  Pure-functional JAX: params are a pytree of stacked per-layer
+arrays scanned with `lax.scan` (one compiled layer body, L iterations), every
+matmul is bfloat16-in/float32-accumulate for the MXU, and parallelism is
+declared, not hand-coded:
+
+  data  axis — batch (DP); optionally also FSDP param sharding
+  seq   axis — sequence (SP) via ring attention (ppermute over ICI)
+  model axis — attention heads + MLP hidden (TP); XLA inserts the
+               all-reduces on the wo/w2 contractions
+
+Architecture follows Llama-3: RMSNorm, rotary position embeddings, grouped-
+query attention, SwiGLU MLP, untied LM head.  The reference profiler only
+*observed* such workloads (NCCL kernel attribution,
+/root/reference/bin/sofa_analyze.py:363-368); here the workload ships with the
+profiler so every collective class the analyzer attributes is generated
+in-repo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sofa_tpu.workloads.flash_pallas import (
+    flash_causal_attention,
+    supports as flash_supports,
+)
+from sofa_tpu.workloads.ring_attention import (
+    plain_causal_attention,
+    ring_attention,
+)
+from sofa_tpu.workloads.ring_flash import (
+    ring_flash_attention,
+    zigzag_indices,
+    zigzag_ring_flash_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+    rope_theta: float = 500000.0
+    # None = auto: fused Pallas attention on TPU when the single-chip path
+    # runs and T divides the kernel's block size; True/False force it.
+    flash: Optional[bool] = None
+    # Load-balanced causal sequence parallelism: shard r holds zig-zag
+    # chunks (r, 2S-1-r) so every shard does equal work around the ring.
+    # Requires flash; sequences are permuted at the embedding and
+    # un-permuted before the LM head.
+    zigzag: bool = False
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "TransformerConfig":
+        return TransformerConfig(vocab=128256, d_model=4096, n_layers=32,
+                                 n_heads=32, n_kv_heads=8, d_ff=14336,
+                                 max_seq=8192)
+
+    @staticmethod
+    def tiny(seq: int = 128) -> "TransformerConfig":
+        return TransformerConfig(vocab=256, d_model=64, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, d_ff=128,
+                                 max_seq=seq)
+
+
+def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
+    """Stacked-layer param pytree; leaves are [n_layers, ...] where per-layer."""
+    k = iter(jax.random.split(key, 10))
+    d, h, kvh, dh, f, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.d_head, cfg.d_ff, cfg.n_layers)
+
+    def norm(key, *shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    return {
+        "embed": norm(next(k), cfg.vocab, d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": norm(next(k), L, d, h * dh),
+            "wk": norm(next(k), L, d, kvh * dh),
+            "wv": norm(next(k), L, d, kvh * dh),
+            "wo": norm(next(k), L, h * dh, d),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            "w1": norm(next(k), L, d, f),
+            "w3": norm(next(k), L, d, f),
+            "w2": norm(next(k), L, f, d),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": norm(next(k), d, cfg.vocab),
+    }
+
+
+def param_specs(cfg: TransformerConfig, fsdp: bool = False) -> Dict[str, Any]:
+    """PartitionSpecs per param leaf: TP over "model", FSDP over "data"."""
+    dp = "data" if fsdp else None
+    return {
+        "embed": P("model", dp),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, dp, "model"),
+            "wk": P(None, dp, "model"),
+            "wv": P(None, dp, "model"),
+            "wo": P(None, "model", dp),
+            "mlp_norm": P(None, None),
+            "w1": P(None, dp, "model"),
+            "w3": P(None, dp, "model"),
+            "w2": P(None, "model", dp),
+        },
+        "final_norm": P(None),
+        "lm_head": P(dp, "model"),
+    }
+
+
+def _rmsnorm(x, w):
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (y * w).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding over [B, T, H, D]; pairs are (x[..., :D/2], x[..., D/2:])."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,T,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def layer_body(x, lp, cfg: TransformerConfig, positions, attn):
+    """One decoder layer, parameterized by the attention implementation.
+
+    ``attn(q, kk, v) -> (o, aux)`` receives *unrepeated* KV heads
+    ([B, T, KVH, Dh]) so cache-based attention (workloads/inference.py) can
+    store them compactly; training attention repeats them for GQA itself.
+    The single copy of the layer math keeps training forward() and the
+    inference block numerically identical by construction.
+    """
+    b, t = x.shape[:2]
+    h = _rmsnorm(x, lp["attn_norm"])
+    q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.d_head)
+    kk = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
+    v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.d_head)
+    q = _rope(q, positions, cfg.rope_theta)
+    kk = _rope(kk, positions, cfg.rope_theta)
+    o, aux = attn(q, kk, v)
+    x = x + o.reshape(b, t, -1) @ lp["wo"]
+    h = _rmsnorm(x, lp["mlp_norm"])
+    gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(cfg.dtype)
+    x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+    return x, aux
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """Logits [B, T, vocab].  With a mesh whose "seq" axis is >1, attention
+    runs as ring attention; otherwise plain fused causal attention."""
+    b, t = tokens.shape
+    if t > cfg.max_seq:
+        raise ValueError(f"sequence length {t} exceeds max_seq {cfg.max_seq}")
+    use_ring = mesh is not None and mesh.shape.get("seq", 1) > 1
+    t_local = t // mesh.shape["seq"] if use_ring else t
+    if cfg.zigzag and use_ring:
+        # Zig-zag runs the kernel per half-chunk, so the tiling gate must
+        # check that size, not the full local length.
+        t_local //= 2
+    if cfg.flash is None:
+        # Auto: fused Pallas kernel on TPU (per-shard inside the ring when
+        # sequence-parallel).  Off-TPU the kernel only runs interpreted
+        # (slow), so auto stays off there.
+        use_flash = flash_supports(t_local) and jax.default_backend() == "tpu"
+    else:
+        use_flash = cfg.flash
+        if use_flash and not flash_supports(t_local):
+            raise ValueError(
+                f"flash=True but local seq len {t_local} is not supported by "
+                f"the fused kernel (needs a 16-multiple block dividing it)")
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+    use_zigzag = cfg.zigzag and use_ring and use_flash
+    if use_zigzag:
+        # Static permutation into the balanced layout, applied to the
+        # token ids (not the d_model-wide activations); rope reads the
+        # permuted *global* positions so the math is order-invariant.
+        perm, inv_perm = zigzag_indices(t, mesh.shape["seq"])
+        positions = positions[:, perm]
+        tokens = tokens[:, perm]
+
+    emb = params["embed"].astype(cfg.dtype)
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        # Iota one-hot contraction instead of a gather: the table is sharded
+        # over vocab ("model" axis) and a cross-shard gather forces the SPMD
+        # partitioner into involuntary full rematerialization (replicate the
+        # table, then re-partition).  A dot contracting over vocab partitions
+        # cleanly — each shard contracts its vocab slice and XLA inserts one
+        # psum over "model" — and the one-hot fuses into the MXU matmul.
+        one_hot = (tokens[..., None] == lax.broadcasted_iota(
+            jnp.int32, (1, 1, cfg.vocab), 2)).astype(cfg.dtype)
+        x = one_hot @ emb
+    else:
+        # Unsharded vocab (model axis 1, or no mesh): the gather is local.
+        x = emb[tokens]
+    if mesh is not None:
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("data", "seq", None)))
+
+    def attn(q, kk, v):
+        # GQA: replicate each KV head over its query-head group.
+        rep = cfg.n_heads // cfg.n_kv_heads
+        kk = jnp.repeat(kk, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        if use_zigzag:
+            return zigzag_ring_flash_attention(q, kk, v, mesh), None
+        if use_ring and use_flash:
+            return ring_flash_attention(q, kk, v, mesh), None
+        if use_ring:
+            return ring_attention(q, kk, v, mesh), None
+        if use_flash:
+            return flash_causal_attention(q, kk, v), None
+        return plain_causal_attention(q, kk, v), None
+
+    def layer(x, lp):
+        x, _ = layer_body(x, lp, cfg, positions, attn)
+        if mesh is not None:
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("data", "seq", None)))
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    if use_zigzag:
+        x = x[:, inv_perm]
+    x = _rmsnorm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """Next-token cross entropy; targets are tokens shifted left.
+
+    The forward pass sees the full sequence (so T stays divisible by the
+    "seq" mesh axis) and the last position's logits are dropped instead.
+    """
+    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh,
+                 fsdp: bool = False):
+    specs = param_specs(cfg, fsdp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh],
+                    learning_rate: float = 1e-3):
+    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss).
+
+    Optimizer is adamw from optax; optimizer state inherits the param
+    shardings through jit's sharding propagation.
+    """
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg, mesh))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return tx, step
+
+
+def build(cfg: TransformerConfig, mesh: Optional[Mesh], batch: int,
+          seq: int, seed: int = 0, fsdp: bool = False):
+    """Init params + optimizer + a data batch, all placed on the mesh."""
+    key = jax.random.PRNGKey(seed)
+    params = init_params(cfg, key)
+    if mesh is not None:
+        params = shard_params(params, cfg, mesh, fsdp)
+    tx, step = make_train_step(cfg, mesh)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab)
+    if mesh is not None:
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P("data", None)))
+    return params, opt_state, step, tokens
+
+
+def main(argv=None):
+    from sofa_tpu.workloads.common import (make_mesh, parse_workload_args,
+                                           steps_per_sec)
+
+    args = parse_workload_args(argv, {
+        "batch": 8, "seq": 512, "steps": 10, "d_model": 512, "n_layers": 4,
+        "n_heads": 8, "n_kv_heads": 4, "d_ff": 1408, "vocab": 32000,
+        "fsdp": False, "data": 0, "seq_par": 0, "model": 0,
+    })
+    cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,
+                            n_layers=args.n_layers, n_heads=args.n_heads,
+                            n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
+                            max_seq=args.seq)
+    n = len(jax.devices())
+    sizes = None
+    if args.data or args.seq_par or args.model:
+        sizes = [args.data or 1, args.seq_par or 1, args.model or 1]
+    mesh = make_mesh(("data", "seq", "model"), sizes) if n > 1 else None
+    params, opt_state, step, tokens = build(cfg, mesh, args.batch, args.seq)
+
+    def one(state):
+        p, o, _ = state
+        p, o, loss = step(p, o, tokens)
+        return p, o, loss
+
+    sps, state = steps_per_sec(one, (params, opt_state, 0.0), args.steps)
+    toks = sps * args.batch * args.seq
+    mesh_desc = dict(mesh.shape) if mesh else {"single": 1}
+    print(f"transformer: {sps:.3f} steps/s  {toks:,.0f} tokens/s  "
+          f"loss={float(state[2]):.3f}  mesh={mesh_desc}")
+
+
+if __name__ == "__main__":
+    main()

@@ -528,9 +528,16 @@ def test_sofa_clean_keeps_raw(logdir):
         f.write("derived\n")
     with open(cfg.path("report.js"), "w") as f:
         f.write("derived\n")
+    # The full derived surface a report leaves behind (style.css and
+    # hints.txt/tpu_meta.json/sofa_hints once escaped the clean).
+    for name in ("style.css", "hints.txt", "tpu_meta.json"):
+        with open(cfg.path(name), "w") as f:
+            f.write("derived\n")
+    os.makedirs(cfg.path("sofa_hints"), exist_ok=True)
     sofa_clean(cfg)
-    assert not os.path.exists(cfg.path("cputrace.csv"))
-    assert not os.path.exists(cfg.path("report.js"))
+    for name in ("cputrace.csv", "report.js", "style.css", "hints.txt",
+                 "tpu_meta.json", "sofa_hints"):
+        assert not os.path.exists(cfg.path(name)), name
     assert os.path.isfile(cfg.path("misc.txt"))
     assert os.path.isfile(cfg.path("mpstat.txt"))
 
