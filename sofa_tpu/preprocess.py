@@ -194,7 +194,8 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         with open(cfg.path("tpu_meta.json"), "w") as f:
             json.dump(tpu_meta, f, indent=1)
     print_progress(
-        f"preprocess wrote {n_csv} csv files and report.js ({len(series)} series)"
+        f"preprocess wrote {n_csv} {trace_format} frames and report.js "
+        f"({len(series)} series)"
     )
     return frames
 
