@@ -835,3 +835,21 @@ def test_tpu_profile_respects_roi(cfg):
     cfg2 = SofaConfig(logdir=cfg.logdir)
     tpu.tpu_profile(frames, cfg2, full)
     assert f.get("tpu0_kernel_time") < full.get("tpu0_kernel_time")
+
+
+def test_board_nav_consistent():
+    """Every board page links every page (incl. itself as the active tab) —
+    nav drift broke discoverability twice while pages were being added."""
+    import glob
+    import re
+
+    board = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "sofa_tpu", "board")
+    pages = sorted(os.path.basename(p)
+                   for p in glob.glob(os.path.join(board, "*.html")))
+    assert len(pages) >= 11
+    for page in pages:
+        html = open(os.path.join(board, page)).read()
+        linked = set(re.findall(r'href="([a-z-]+\.html)"', html))
+        missing = set(pages) - linked
+        assert not missing, f"{page} nav missing links to {sorted(missing)}"
