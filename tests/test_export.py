@@ -183,6 +183,37 @@ def test_export_perfetto_native_writer_equivalence(tmp_path, capsys,
     assert r.returncode != 0
 
 
+def test_export_perfetto_clamps_nonfinite_times(tmp_path):
+    """inf/NaN/huge-finite timestamps must never reach either writer's
+    float formatting: nan_to_num BEFORE the 1e6 scale would re-overflow to
+    inf and both writers would emit the invalid JSON token `inf`."""
+    import gzip
+    import json
+    import math
+
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.export_perfetto import export_perfetto
+    from sofa_tpu.trace import make_frame, write_csv
+
+    d = str(tmp_path / "clog") + "/"
+    os.makedirs(d)
+    write_csv(make_frame([
+        {"timestamp": float("inf"), "duration": 1e-3, "deviceId": 0,
+         "category": 0, "name": "inf_ts", "device_kind": "tpu"},
+        {"timestamp": 0.1, "duration": float("nan"), "deviceId": 0,
+         "category": 0, "name": "nan_dur", "device_kind": "tpu"},
+        {"timestamp": 1e200, "duration": -5.0, "deviceId": 0,
+         "category": 0, "name": "huge_ts_neg_dur", "device_kind": "tpu"},
+    ]), d + "tputrace.csv")
+    path = export_perfetto(SofaConfig(logdir=d))
+    evs = json.load(gzip.open(path, "rt"))["traceEvents"]  # valid JSON
+    by = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    assert math.isfinite(by["inf_ts"]["ts"]) and by["inf_ts"]["ts"] <= 1e15
+    assert by["nan_dur"]["dur"] == 0.0
+    assert by["huge_ts_neg_dur"]["ts"] <= 1e15
+    assert by["huge_ts_neg_dur"]["dur"] == 0.0  # negative clips to 0
+
+
 def test_export_perfetto_multihost_host_processes(tmp_path):
     """Per-host host timelines stay separate Perfetto processes: host rows
     carry their host's ordinal base in deviceId (host 1 -> 256), and thread
