@@ -340,6 +340,15 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         print_error("interrupted")
         return 130
+    except BrokenPipeError:
+        # `sofa <anything> | head` closing our stdout mid-print is normal
+        # pipeline behavior, not an error.  Point stdout at devnull so the
+        # interpreter's exit flush can't raise a second time.
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
     print_error(f"unknown command {cmd!r}")
     return 2
 

@@ -244,3 +244,5 @@ def sofa_top(cfg, interval: float = 2.0, once: bool = False) -> int:
         return 1
     except KeyboardInterrupt:
         return 0
+    # BrokenPipeError (`sofa top --once | head`) propagates to cli.main's
+    # global handler — every printing subcommand shares the one fix.

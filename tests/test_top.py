@@ -166,3 +166,22 @@ def test_export_folded(tmp_path):
     # caller-first order; the dso annotation stays on the LEAF frame
     assert "outer;caller;do_work 1" in cpu
     assert "outer;caller;memcpy [libc.so.6] 1" in cpu
+
+
+def test_top_once_into_closed_pipe(tmp_path):
+    """`sofa top --once | head -1` must exit cleanly, not traceback with
+    BrokenPipeError (found live during the round-4 acceptance pass)."""
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    _seed_logdir(d)
+    r = subprocess.run(
+        ["bash", "-c",
+         "set -o pipefail; "
+         f"{sys.executable} -m sofa_tpu top --logdir {d} --once | head -1"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    # pipefail makes this sofa's OWN exit code, not head's
+    assert r.returncode == 0, r.stderr[-400:]
+    assert "Traceback" not in r.stderr, r.stderr[-400:]
+    assert "sofa top" in r.stdout
