@@ -31,6 +31,7 @@ from sofa_tpu import __version__
 from sofa_tpu.config import Filter, SofaConfig
 from sofa_tpu.plugins import load_plugins
 from sofa_tpu import printing
+from sofa_tpu.printing import SofaUserError
 from sofa_tpu.printing import print_error, print_main_progress
 
 
@@ -192,6 +193,27 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
 
 
 def main(argv=None) -> int:
+    rc = _run(argv)
+    # Flush INSIDE the pipe guard: output smaller than the block buffer
+    # would otherwise first hit a dead pipe in the interpreter's exit
+    # flush, where no handler can catch it (exit status 120 + "Exception
+    # ignored" noise).  The work already finished — rc stands.
+    try:
+        sys.stdout.flush()
+    except BrokenPipeError:
+        _stdout_to_devnull()
+    return rc
+
+
+def _stdout_to_devnull() -> None:
+    """Neutralize further writes so the exit flush can't re-raise EPIPE."""
+    try:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    except OSError:
+        pass
+
+
+def _run(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
         cfg = config_from_args(args)
@@ -340,15 +362,19 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         print_error("interrupted")
         return 130
+    except SofaUserError as e:
+        # Curated guard raises only (missing logdir, ...): one clean line.
+        # A plain FileNotFoundError from deeper code keeps its traceback —
+        # that's a bug report, not a usage error.
+        print_error(str(e))
+        return 1
     except BrokenPipeError:
         # `sofa <anything> | head` closing our stdout mid-print is normal
-        # pipeline behavior, not an error.  Point stdout at devnull so the
-        # interpreter's exit flush can't raise a second time.
-        try:
-            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
-        except OSError:
-            pass
-        return 0
+        # pipeline behavior — but for subcommands whose product is files
+        # on disk, the break also aborted the remaining work, so only the
+        # streaming commands may report success.
+        _stdout_to_devnull()
+        return 0 if cmd in ("top", "viz") else 1
     print_error(f"unknown command {cmd!r}")
     return 2
 

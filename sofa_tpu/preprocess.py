@@ -71,7 +71,9 @@ def read_misc(cfg: SofaConfig) -> Dict[str, str]:
 
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     if not os.path.isdir(cfg.logdir):
-        raise FileNotFoundError(
+        from sofa_tpu.printing import SofaUserError
+
+        raise SofaUserError(
             f"logdir {cfg.logdir} does not exist — run `sofa record` first"
         )
     time_base = read_time_base(cfg)

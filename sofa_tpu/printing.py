@@ -28,6 +28,14 @@ enabled = True
 verbose = False
 
 
+class SofaUserError(FileNotFoundError):
+    """A usage error with a curated message (missing logdir, ...).
+
+    The CLI prints these as one [ERROR] line without a traceback; any OTHER
+    exception keeps its stack so bug reports stay diagnosable.  Subclasses
+    FileNotFoundError so library callers' existing except clauses hold."""
+
+
 def _use_color(stream) -> bool:
     if os.environ.get("NO_COLOR"):
         return False

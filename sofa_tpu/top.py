@@ -215,7 +215,9 @@ def render_cluster_frame(cfg, now: Optional[float] = None) -> str:
         seen_any = True
         blocks.append(render_frame(host_cfg.logdir, now, title=hostname))
     if not seen_any:
-        raise FileNotFoundError(
+        from sofa_tpu.printing import SofaUserError
+
+        raise SofaUserError(
             f"no host logdirs under {cfg.logdir.rstrip('/')}-<host>/ — "
             "start a `sofa record --cluster_hosts ...` first")
     return "\n\n".join(blocks)

@@ -137,3 +137,20 @@ def test_board_parallel_coords_surface():
         assert len(dims) >= 5, (page, dims)
         for d in dims:
             assert d in COLUMNS, (page, d)
+
+
+def test_report_missing_logdir_clean_error(tmp_path):
+    """report/preprocess on a never-recorded logdir: one [ERROR] line and
+    rc 1, not a FileNotFoundError traceback (found in adversarial drives)."""
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "report",
+         "--logdir", str(tmp_path / "never") + "/"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 1
+    assert "Traceback" not in r.stderr
+    assert "does not exist" in r.stderr + r.stdout
