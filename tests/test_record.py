@@ -571,3 +571,35 @@ def test_chained_sitecustomize_hang_is_bounded(tmp_path):
     assert time.time() - t0 < 30, "chain guard did not fire"
     assert "program ran" in r.stdout
     assert "chained sitecustomize" in r.stderr and "exceeded" in r.stderr
+
+
+def test_record_sigterm_runs_epilogue_and_kills_tree(tmp_path):
+    """SIGTERM mid-record (drivers, CI timeouts) rides the SIGINT path:
+    the profiled tree is terminated via its process group, the collector
+    epilogue still writes the logdir, and the exit code folds to 143."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    d = str(tmp_path / "sig") + "/"
+    p = subprocess.Popen(
+        [_sys.executable, "-m", "sofa_tpu", "record", "sleep 60",
+         "--logdir", d],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    deadline = _time.time() + 60
+    while _time.time() < deadline and not os.path.isfile(d + "sofa_time.txt"):
+        _time.sleep(0.2)   # prologue done = child launched
+    _time.sleep(2.0)
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=60)
+    assert p.returncode == 143, out[-400:]
+    assert "interrupted; terminating profiled command" in out
+    misc = dict(line.split(None, 1)
+                for line in open(d + "misc.txt").read().splitlines())
+    assert misc["rc"].strip() == "143"
+    child_pid = int(misc["pid"])
+    _time.sleep(0.5)
+    assert not os.path.exists(f"/proc/{child_pid}"), "child survived"
+    assert os.path.isfile(d + "mpstat.txt")  # epilogue harvested
