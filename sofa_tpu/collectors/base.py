@@ -105,4 +105,11 @@ class ProcessCollector(Collector):
 
 
 def ensure_logdir(path: str) -> None:
-    os.makedirs(path, exist_ok=True)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except (FileExistsError, NotADirectoryError):
+        from sofa_tpu.printing import SofaUserError
+
+        raise SofaUserError(
+            f"cannot create logdir {path}: a path component exists and is "
+            "not a directory — pick another --logdir") from None

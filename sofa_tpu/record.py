@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import re
+import contextlib
 import subprocess
 import time
 
@@ -288,25 +289,25 @@ def sofa_record(command: str, cfg) -> int:
     _clean_stale(cfg)
     collectors = build_collectors(cfg)
 
+    # SIGTERM/SIGHUP (drivers, CI timeouts, ssh teardown) ride the SIGINT
+    # path: the profiled child is terminated and every collector's
+    # stop/harvest epilogue still runs — the default handlers would orphan
+    # the child and leave the logdir without its epilogue files.
+    import signal as _signal
+
+    with _term_as_interrupt((_signal.SIGHUP,)):
+        return _record_body(command, cfg, collectors)
+
+
+def _record_body(command: str, cfg, collectors) -> int:
+    import signal as _signal
+
     started = []
     prefix = []
     child_env = dict(os.environ)
     rc = 1
     is_docker = cfg.pid is None and _DOCKER_RUN_RE.match(command) is not None
     docker_perf = None
-    # SIGTERM (drivers, CI timeouts, systemd) rides the SIGINT path: the
-    # profiled child is terminated and every collector's stop/harvest
-    # epilogue still runs — the default handler would orphan the child and
-    # leave the logdir without its epilogue files.
-    import signal as _signal
-
-    def _on_term(signum, frame):  # noqa: ARG001
-        raise KeyboardInterrupt
-
-    try:
-        old_term = _signal.signal(_signal.SIGTERM, _on_term)
-    except ValueError:  # non-main thread (library use): no handler
-        old_term = None
     try:
         for col in collectors:
             reason = col.probe()
@@ -363,14 +364,16 @@ def sofa_record(command: str, cfg) -> int:
             try:
                 rc = child.wait()
             except KeyboardInterrupt:
-                print_warning("interrupted; terminating profiled command")
-                _signal_tree(child, _signal.SIGTERM)
                 try:
+                    # EVERYTHING here sits inside the inner try: a second
+                    # impatient signal at any point (even mid-print) must
+                    # fall through to the SIGKILL escalation — the child is
+                    # in its own session now, so WE are the only path that
+                    # can still kill it.
+                    print_warning("interrupted; terminating profiled command")
+                    _signal_tree(child, _signal.SIGTERM)
                     rc = child.wait(timeout=10)
                 except (subprocess.TimeoutExpired, KeyboardInterrupt):
-                    # grace expired OR an impatient second signal: the
-                    # child is in its own session now, so WE are the only
-                    # path that can still kill it — never leave it behind
                     _signal_tree(child, _signal.SIGKILL)
                     rc = child.wait()
             finally:
@@ -391,10 +394,10 @@ def sofa_record(command: str, cfg) -> int:
                 pass
         raise
     finally:
-        # Epilogue FIRST, handler restore after: a TERM arriving during a
-        # slow harvest must still ride the cleanup path, not the default
-        # die-now handler — the epilogue is exactly what the handler exists
-        # to protect.
+        # The epilogue runs with the _term_as_interrupt handlers still
+        # installed (the caller's `with` exits after us): a TERM arriving
+        # during a slow harvest rides the cleanup path, not the default
+        # die-now handler.
         for col in reversed(started):
             try:
                 col.stop()
@@ -405,11 +408,6 @@ def sofa_record(command: str, cfg) -> int:
                 col.harvest()
             except Exception as e:
                 print_warning(f"{col.name}: harvest failed: {e}")
-        if old_term is not None:
-            try:
-                _signal.signal(_signal.SIGTERM, old_term)
-            except ValueError:
-                pass
 
     if rc != 0:
         print_warning(f"profiled command exited with rc={rc}")
@@ -418,6 +416,38 @@ def sofa_record(command: str, cfg) -> int:
     # must be visible to scripts/CI (the reference always returns success,
     # which VERDICT r1 flagged: a failed workload was undetectable).
     return rc
+
+
+@contextlib.contextmanager
+def _term_as_interrupt(extra_signals=()):
+    """Route SIGTERM (+extras, e.g. SIGHUP for ssh session teardown) into
+    KeyboardInterrupt for the duration, so drivers/CI timeouts ride the
+    same child-termination + collector-epilogue path as Ctrl-C.
+
+    Restore is exception-safe (finally) and never leaks our handler: a
+    previous handler installed from C reads back as None, which restores
+    to SIG_DFL — the closest reachable state from Python.
+    """
+    import signal as _signal
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        raise KeyboardInterrupt
+
+    saved = []
+    for sig in (_signal.SIGTERM,) + tuple(extra_signals):
+        try:
+            saved.append((sig, _signal.signal(sig, _on_term)))
+        except (ValueError, OSError):  # non-main thread / platform
+            pass
+    try:
+        yield
+    finally:
+        for sig, old in saved:
+            try:
+                _signal.signal(sig, old if old is not None
+                               else _signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
 
 
 def _signal_tree(child: "subprocess.Popen", sig: int) -> None:
@@ -553,61 +583,86 @@ def cluster_record(command: str, cfg) -> int:
     if pkg_root not in parts:
         parts.append(pkg_root)
     child_env["PYTHONPATH"] = os.pathsep.join(parts)
-    launches = []
-    for host in cfg.cluster_hosts:
-        host_logdir = cfg.logdir.rstrip("/") + f"-{host}/"
-        if host in ("localhost", "127.0.0.1"):
-            argv = [sys.executable, "-m", "sofa_tpu", "record", command,
-                    "--logdir", host_logdir] + flags
-            remote_dir = None
-        else:
-            remote_dir = f"/tmp/sofa_tpu_record_{os.getpid()}/"
-            tail = " ".join(
-                ["record", shlex.quote(command),
-                 "--logdir", shlex.quote(remote_dir)]
-                + [shlex.quote(f) for f in flags])
-            # A host may have the package importable but no `sofa` console
-            # script on a non-interactive ssh PATH — fall back to the module
-            # entry point, mirroring how local launches already work.
-            remote = (f"if command -v sofa >/dev/null 2>&1; "
-                      f"then sofa {tail}; "
-                      f"else python3 -m sofa_tpu {tail}; fi")
-            argv = ["ssh", "-o", "BatchMode=yes", host, remote]
-        print_progress(f"cluster: recording on {host}")
-        try:
-            proc = subprocess.Popen(argv, env=child_env)
-        except OSError as e:
-            print_error(f"cluster: cannot launch on {host}: {e}")
-            return 1
-        launches.append((host, proc, host_logdir, remote_dir))
-
-    # TERM to the coordinator forwards to every per-host recorder: local
-    # children run the single-host path above (whose own handler cleans
-    # up), and terminating the ssh transport ends the remote session.
-    # Rides the same raise-KeyboardInterrupt trick as sofa_record.
+    # The whole launch+wait+fetch span runs with TERM routed into
+    # KeyboardInterrupt: a CI timeout mid-launch or mid-fetch must
+    # terminate every per-host recorder, not just the coordinator.
     import signal as _signal
 
-    def _on_term(signum, frame):  # noqa: ARG001
-        raise KeyboardInterrupt
+    with _term_as_interrupt((_signal.SIGHUP,)):
+        return _cluster_record_body(command, cfg, flags, child_env)
 
-    try:
-        old_term = _signal.signal(_signal.SIGTERM, _on_term)
-    except ValueError:
-        old_term = None
 
-    rc = 0
+def _cluster_record_body(command: str, cfg, flags, child_env) -> int:
+    import shlex
+    import sys
+
+    launches = []
     interrupted = False
+
+    def _interrupt_all() -> None:
+        """Terminate every per-host recorder, once.  Local children run the
+        single-host TERM path (their own epilogue).  Terminating an ssh
+        client does NOT signal the remote side, so remotes get a targeted
+        pkill on their unique logdir — the remote record's own handler
+        then runs ITS epilogue before the scp fetch below."""
+        nonlocal interrupted
+        if interrupted:
+            return
+        interrupted = True
+        print_warning("cluster: interrupted; terminating per-host recorders")
+        for h, p, _ld, rd in launches:
+            if rd is not None:
+                try:
+                    subprocess.run(
+                        ["ssh", "-o", "BatchMode=yes", h,
+                         f"pkill -f {shlex.quote(rd)} || true"],
+                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                        timeout=20)
+                except subprocess.SubprocessError:
+                    pass
+            if p.poll() is None:
+                p.terminate()
+
+    launch_failed = False
+    try:
+        for host in cfg.cluster_hosts:
+            host_logdir = cfg.logdir.rstrip("/") + f"-{host}/"
+            if host in ("localhost", "127.0.0.1"):
+                argv = [sys.executable, "-m", "sofa_tpu", "record", command,
+                        "--logdir", host_logdir] + flags
+                remote_dir = None
+            else:
+                remote_dir = f"/tmp/sofa_tpu_record_{os.getpid()}/"
+                tail = " ".join(
+                    ["record", shlex.quote(command),
+                     "--logdir", shlex.quote(remote_dir)]
+                    + [shlex.quote(f) for f in flags])
+                # A host may have the package importable but no `sofa`
+                # console script on a non-interactive ssh PATH — fall back
+                # to the module entry point, like local launches.
+                remote = (f"if command -v sofa >/dev/null 2>&1; "
+                          f"then sofa {tail}; "
+                          f"else python3 -m sofa_tpu {tail}; fi")
+                argv = ["ssh", "-o", "BatchMode=yes", host, remote]
+            print_progress(f"cluster: recording on {host}")
+            try:
+                proc = subprocess.Popen(argv, env=child_env)
+            except OSError as e:
+                # Already-launched hosts must not record forever.
+                print_error(f"cluster: cannot launch on {host}: {e}")
+                launch_failed = True
+                _interrupt_all()
+                break
+            launches.append((host, proc, host_logdir, remote_dir))
+    except KeyboardInterrupt:
+        _interrupt_all()
+
+    rc = 1 if launch_failed else 0
     for host, proc, host_logdir, remote_dir in launches:
         try:
             host_rc = proc.wait()
         except KeyboardInterrupt:
-            if not interrupted:
-                interrupted = True
-                print_warning("cluster: interrupted; terminating per-host "
-                              "recorders")
-                for _h, p, _ld, _rd in launches:
-                    if p.poll() is None:
-                        p.terminate()
+            _interrupt_all()
             try:
                 host_rc = proc.wait(timeout=15)
             except (subprocess.TimeoutExpired, KeyboardInterrupt):
@@ -630,11 +685,6 @@ def cluster_record(command: str, cfg) -> int:
                 ["ssh", "-o", "BatchMode=yes", host, f"rm -rf {remote_dir}"],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             )
-    if old_term is not None:
-        try:
-            _signal.signal(_signal.SIGTERM, old_term)
-        except ValueError:
-            pass
     print_progress(f"cluster: recorded {len(launches)} hosts into "
                    f"{cfg.logdir.rstrip('/')}-<host>/")
     return rc

@@ -603,3 +603,20 @@ def test_record_sigterm_runs_epilogue_and_kills_tree(tmp_path):
     _time.sleep(0.5)
     assert not os.path.exists(f"/proc/{child_pid}"), "child survived"
     assert os.path.isfile(d + "mpstat.txt")  # epilogue harvested
+
+
+def test_record_logdir_is_a_file_clean_error(tmp_path):
+    """--logdir pointing at an existing FILE: one [ERROR] line, rc 1."""
+    import subprocess
+    import sys as _sys
+
+    flat = tmp_path / "flat"
+    flat.write_text("x")
+    r = subprocess.run(
+        [_sys.executable, "-m", "sofa_tpu", "record", "true",
+         "--logdir", str(flat)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 1
+    assert "Traceback" not in r.stderr
+    assert "not a directory" in r.stderr + r.stdout  # curated msg
