@@ -189,6 +189,27 @@ class SofaConfig:
                     Filter.parse(v) if isinstance(v, str) else Filter(**v)
                     for v in kwargs[key]
                 ]
+        # Type-check against the field defaults so a mistyped TOML value
+        # ("logdir = 123") is a curated config error at load time, not an
+        # AttributeError deep in whatever touches the field first.  int is
+        # acceptable where the default is float; None-defaulted (Optional)
+        # and container fields take whatever TOML produced.
+        defaults = cls()
+        for key, value in kwargs.items():
+            if key in ("cpu_filters", "tpu_filters"):
+                continue
+            default = getattr(defaults, key)
+            if default is None or isinstance(default, (list, dict)):
+                continue
+            want = type(default)
+            if want is float and isinstance(value, int) \
+                    and not isinstance(value, bool):
+                continue
+            if not isinstance(value, want) or (
+                    want is not bool and isinstance(value, bool)):
+                raise ValueError(
+                    f"config key {key!r}: expected {want.__name__}, "
+                    f"got {type(value).__name__} ({value!r})")
         return cls(**kwargs)
 
     def to_dict(self) -> dict:

@@ -436,6 +436,11 @@ def _term_as_interrupt(extra_signals=()):
     saved = []
     for sig in (_signal.SIGTERM,) + tuple(extra_signals):
         try:
+            if _signal.getsignal(sig) is _signal.SIG_IGN:
+                # Deliberately ignored (nohup'd SIGHUP, daemon managers):
+                # overriding would abort exactly the detached run the user
+                # set the ignore up to protect.
+                continue
             saved.append((sig, _signal.signal(sig, _on_term)))
         except (ValueError, OSError):  # non-main thread / platform
             pass
@@ -569,9 +574,6 @@ def cluster_record(command: str, cfg) -> int:
     cluster_analyze uses to align the merged timeline.  Returns the max child
     rc so CI sees any host's workload failure.
     """
-    import shlex
-    import sys
-
     flags = _record_flags(cfg)
     # Local launches spawn `python -m sofa_tpu`, which must import from
     # the package checkout regardless of the caller's cwd (the bin/sofa
@@ -604,24 +606,36 @@ def _cluster_record_body(command: str, cfg, flags, child_env) -> int:
         single-host TERM path (their own epilogue).  Terminating an ssh
         client does NOT signal the remote side, so remotes get a targeted
         pkill on their unique logdir — the remote record's own handler
-        then runs ITS epilogue before the scp fetch below."""
+        then runs ITS epilogue before the scp fetch below.
+
+        Order matters: ALL local terminates first (instant), remote pkills
+        after (each can block on a dead host) — and a second impatient
+        signal mid-cleanup re-enters the terminate loop rather than
+        escaping with recorders still running."""
         nonlocal interrupted
         if interrupted:
             return
         interrupted = True
         print_warning("cluster: interrupted; terminating per-host recorders")
-        for h, p, _ld, rd in launches:
-            if rd is not None:
-                try:
-                    subprocess.run(
-                        ["ssh", "-o", "BatchMode=yes", h,
-                         f"pkill -f {shlex.quote(rd)} || true"],
-                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                        timeout=20)
-                except subprocess.SubprocessError:
-                    pass
-            if p.poll() is None:
-                p.terminate()
+        while True:
+            try:
+                for _h, p, _ld, _rd in launches:
+                    if p.poll() is None:
+                        p.terminate()
+                break
+            except KeyboardInterrupt:
+                continue  # re-enter: the REST must still be terminated
+        for h, _p, _ld, rd in launches:
+            if rd is None:
+                continue
+            try:
+                subprocess.run(
+                    ["ssh", "-o", "BatchMode=yes", h,
+                     f"pkill -f {shlex.quote(rd)} || true"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    timeout=10)
+            except (subprocess.SubprocessError, KeyboardInterrupt):
+                continue  # dead host / impatient signal: next host
 
     launch_failed = False
     try:

@@ -48,3 +48,23 @@ def test_from_toml(tmp_path):
     assert cfg.logdir == "run1/"
     assert cfg.sys_mon_rate == 25
     assert cfg.cpu_filters[1] == Filter("memcpy", "red")
+
+
+def test_from_dict_type_validation():
+    """Mistyped TOML values are curated config errors at load time, not an
+    AttributeError deep in whatever touches the field first (found live:
+    `logdir = 123` tracebacked in __post_init__)."""
+    import pytest
+
+    with pytest.raises(ValueError, match="logdir.*expected str.*int"):
+        SofaConfig.from_dict({"logdir": 123})
+    with pytest.raises(ValueError, match="verbose.*expected bool"):
+        SofaConfig.from_dict({"verbose": 1})
+    with pytest.raises(ValueError, match="num_iterations.*expected int"):
+        SofaConfig.from_dict({"num_iterations": "many"})
+    # int where the default is float is fine (TOML writers do this)
+    assert SofaConfig.from_dict({"tpu_time_offset_ms": 5}).tpu_time_offset_ms == 5
+    # Optional/None-defaulted and list fields take whatever TOML produced
+    assert SofaConfig.from_dict({"hint_server": "h:1"}).hint_server == "h:1"
+    assert SofaConfig.from_dict({"network_filters": ["10.0.0.1"]}
+                                ).network_filters == ["10.0.0.1"]
