@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""One-command reproduction of the off-chip performance numbers.
+
+Generates the synthetic pod-scale capture (tools/pod_synth.py: 8 devices x
+200k ops, static per-op cost metadata), times the headline paths, and
+writes a dated markdown table to PERF_EVIDENCE.md — so the README's
+numbers are a `python tools/perf_evidence.py` away from re-measurement
+rather than self-reported in commit messages.
+
+On-chip numbers (profiling overhead on the real chip) come from bench.py /
+tools/validate_tpu.py instead; this file covers everything measurable
+without the chip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _timed(label, fn, rows, reps: int = 3):
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    rows.append((label, best))
+    print(f"  {label}: {best:.2f}s")
+    return out
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="sofa_evidence_") + "/"
+    logdir = workdir + "podlog/"
+    print(f"generating the synthetic pod capture in {logdir} ...")
+    subprocess.run([sys.executable, os.path.join(ROOT, "tools",
+                                                 "pod_synth.py"), logdir],
+                   check=True, capture_output=True)
+
+    from sofa_tpu.analyze import load_frames, sofa_analyze
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.export_perfetto import export_perfetto
+
+    cfg = SofaConfig(logdir=logdir)
+    rows = []
+
+    def quiet(fn):
+        def run():
+            with contextlib.redirect_stdout(io.StringIO()):
+                return fn()
+        return run
+
+    frames = _timed("load 1.6M-op frames (arrow CSV reader, parallel)",
+                    quiet(lambda: load_frames(cfg)), rows)
+    _timed("analysis passes, in-memory frames (report path)",
+           quiet(lambda: sofa_analyze(cfg, frames=dict(frames))), rows)
+    _timed("Perfetto export, native writer",
+           quiet(lambda: export_perfetto(cfg)), rows)
+    os.environ["SOFA_NATIVE_PERFETTO"] = "0"
+    _timed("Perfetto export, pure-Python fallback",
+           quiet(lambda: export_perfetto(cfg)), rows)
+    del os.environ["SOFA_NATIVE_PERFETTO"]
+
+    import jax  # noqa: F401 — backend name for the provenance line
+
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    out_path = os.path.join(ROOT, "PERF_EVIDENCE.md")
+    with open(out_path, "w") as f:
+        f.write("# Off-chip performance evidence\n\n")
+        f.write(f"Measured {stamp} by `python tools/perf_evidence.py` "
+                "(best of 3) on the synthetic 8-device x 200k-op capture "
+                "(`tools/pod_synth.py`; 1.6M HLO events).  Regenerate "
+                "anytime — the table is not hand-edited.\n\n")
+        f.write("| Path | best-of-3 wall time |\n|---|---|\n")
+        for label, dt in rows:
+            f.write(f"| {label} | {dt:.2f} s |\n")
+        f.write("\nOn-chip overhead evidence: `python bench.py` (paired "
+                "bare/profiled ResNet-50 runs + HLO coverage guard) and "
+                "`python tools/validate_tpu.py` when the chip is "
+                "reachable.\n")
+    print(f"wrote {out_path}")
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
