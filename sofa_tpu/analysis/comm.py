@@ -76,11 +76,14 @@ def _wire_bytes(sel: pd.DataFrame, kind: int, n_devices: int) -> float:
 
 
 def comm_profile(frames, cfg, features: Features) -> None:
-    from sofa_tpu.trace import roi_clip
+    from sofa_tpu.trace import narrow, roi_clip
 
     df = frames.get("tputrace")
     if df is None or df.empty:
         return
+    # Only the columns this pass reads (see trace.narrow's rationale).
+    df = narrow(df, ["timestamp", "duration", "deviceId", "category",
+                     "copyKind", "payload", "groups"])
     # Same ROI window as tpu_profile, so comm_ratio's numerator and
     # denominator come from one clock interval.
     df = roi_clip(df, cfg)

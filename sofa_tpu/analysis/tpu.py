@@ -12,13 +12,18 @@ import pandas as pd
 
 from sofa_tpu.analysis.features import Features
 from sofa_tpu.printing import print_hint, print_title, print_warning
-from sofa_tpu.trace import CopyKind, roi_bounds as _roi_bounds, roi_clip
+from sofa_tpu.trace import CopyKind, narrow, roi_bounds as _roi_bounds, roi_clip
 
 
 def tpu_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty:
         return
+    # Only the columns this pass reads: the row masks below copy every
+    # kept column, and the unused big string columns dominate at pod scale.
+    df = narrow(df, ["timestamp", "duration", "deviceId", "category",
+                     "copyKind", "name", "hlo_category", "phase", "flops",
+                     "bytes_accessed", "source"])
     # Spotlight/manual ROI clips warmup+teardown like the reference's
     # profile_region did for its GPU profile (bin/sofa:302-309).
     df = roi_clip(df, cfg)
@@ -103,6 +108,7 @@ def overlap_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty:
         return
+    df = narrow(df, ["timestamp", "duration", "deviceId", "category"])
     df = roi_clip(df, cfg)
     for device_id, rows in df.groupby("deviceId"):
         sync = rows[rows["category"] == 0]
@@ -223,6 +229,8 @@ def input_pipeline_profile(frames, cfg, features: Features) -> None:
     ops = frames.get("tputrace")
     if steps is None or steps.empty or ops is None or ops.empty:
         return
+    ops = narrow(ops, ["timestamp", "duration", "deviceId", "category",
+                       "copyKind"])
     ops = roi_clip(ops, cfg)
     # Steps get the same ROI as the ops they are measured against, or
     # every step outside the window scores as 100% gap.

@@ -122,6 +122,19 @@ def roi_bounds(cfg) -> "Optional[tuple]":
     return None
 
 
+def narrow(df: pd.DataFrame, cols) -> pd.DataFrame:
+    """Project a frame to the columns a pass actually reads, BEFORE any
+    boolean-mask row filtering: each mask materializes every column it
+    keeps, and on a pod-scale arrow-backed frame the unused string columns
+    (op_path, module, ...) dominate that copy.  A frame missing any of the
+    requested columns passes through unchanged (exotic callers keep the
+    old behavior; the pass then fails loudly on the absent column only if
+    it genuinely needs it)."""
+    if all(c in df.columns for c in cols):
+        return df[list(cols)]
+    return df
+
+
 def roi_clip(df: pd.DataFrame, cfg) -> pd.DataFrame:
     """Clip a frame to the region of interest when one is set.
 
