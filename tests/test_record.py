@@ -620,3 +620,22 @@ def test_record_logdir_is_a_file_clean_error(tmp_path):
     assert r.returncode == 1
     assert "Traceback" not in r.stderr
     assert "not a directory" in r.stderr + r.stdout  # curated msg
+
+
+def test_term_as_interrupt_respects_sig_ign():
+    """A deliberately ignored signal (nohup'd SIGHUP) must stay ignored
+    inside _term_as_interrupt, while SIGTERM is routed and restored."""
+    import signal
+
+    from sofa_tpu.record import _term_as_interrupt
+
+    old_hup = signal.signal(signal.SIGHUP, signal.SIG_IGN)
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        with _term_as_interrupt((signal.SIGHUP,)):
+            assert signal.getsignal(signal.SIGHUP) is signal.SIG_IGN
+            assert signal.getsignal(signal.SIGTERM) is not old_term
+        assert signal.getsignal(signal.SIGTERM) is old_term
+        assert signal.getsignal(signal.SIGHUP) is signal.SIG_IGN
+    finally:
+        signal.signal(signal.SIGHUP, old_hup)
