@@ -87,7 +87,20 @@ def profile(logdir: str = "sofalog/", cfg: SofaConfig | None = None):
     try:
         yield cfg
     finally:
+        # End marker: a second (session_ns, unix_ns) anchor in the same
+        # trace.  Two markers let ingest/validation confirm the session
+        # clock's offset is consistent WITHIN a capture — the only
+        # stability that alignment correctness needs (the session origin
+        # legitimately moves between captures on tunneled backends).
+        te = time.time_ns()
+        with jax.profiler.TraceAnnotation(f"sofa_timebase_marker:{te}"):
+            pass
         jax.profiler.stop_trace()
+        try:
+            with open(cfg.path("xprof_marker.txt"), "a") as f:
+                f.write(f"{te} {time.time_ns()}\n")
+        except OSError:
+            pass
         if tpumon_stop is not None:
             tpumon_stop.set()
             # Join so the sampler's last tick can't publish a snapshot

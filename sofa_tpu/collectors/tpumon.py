@@ -70,16 +70,140 @@ def _backend_ready():
 _MEMPROF = {"snap": 0, "last": 0.0}   # bytes at / time of last snapshot
 
 
+def _pprof_encode(samples):
+    """Minimal pprof ``Profile`` wire encoding (proto3, unpacked repeateds).
+
+    samples: [(frames [(func, file, line), ...leaf-first], device, kind,
+    count, bytes)].  Hand-rolled so the injected sampler stays import-free;
+    every conformant protobuf parser accepts unpacked repeated scalars.
+    """
+    def vi(n):
+        out = bytearray()
+        n &= (1 << 64) - 1
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    def tagv(field, n):                       # wire type 0 (varint)
+        return vi(field << 3) + vi(n)
+
+    def tagl(field, payload):                 # wire type 2 (length-delim)
+        return vi((field << 3) | 2) + vi(len(payload)) + payload
+
+    strings = [""]
+    sidx = {"": 0}
+
+    def s(x):
+        if x not in sidx:
+            sidx[x] = len(strings)
+            strings.append(x)
+        return sidx[x]
+
+    functions = {}                            # (name, file) -> id
+    fn_msgs = []
+    locations = {}                            # (name, file, line) -> id
+    loc_msgs = []
+
+    def loc_id(fr):
+        if fr not in locations:
+            name, fname, line = fr
+            fkey = (name, fname)
+            if fkey not in functions:
+                fid = len(functions) + 1
+                functions[fkey] = fid
+                fn_msgs.append(tagl(5, tagv(1, fid) + tagv(2, s(name))
+                                    + tagv(4, s(fname))))
+            lid = len(locations) + 1
+            locations[fr] = lid
+            line_msg = tagv(1, functions[fkey]) + tagv(2, max(int(line), 0))
+            loc_msgs.append(tagl(4, tagv(1, lid) + tagl(4, line_msg)))
+        return locations[fr]
+
+    out = bytearray()
+    # sample_type: (allocations, count), (space, bytes) — the column pair
+    # ingest/memprof.py resolves by unit.
+    out += tagl(1, tagv(1, s("allocations")) + tagv(2, s("count")))
+    out += tagl(1, tagv(1, s("space")) + tagv(2, s("bytes")))
+    for frames, device, kind, cnt, nbytes in samples:
+        msg = bytearray()
+        for fr in frames:
+            msg += tagv(1, loc_id(fr))
+        msg += tagv(2, max(int(cnt), 0)) + tagv(2, max(int(nbytes), 0))
+        msg += tagl(3, tagv(1, s("device")) + tagv(2, s(device)))
+        msg += tagl(3, tagv(1, s("kind")) + tagv(2, s(kind)))
+        out += tagl(2, bytes(msg))
+    for m in loc_msgs:
+        out += m
+    for m in fn_msgs:
+        out += m
+    for st in strings:
+        out += tagl(6, st.encode("utf-8", "replace"))
+    return bytes(out)
+
+
+def _live_buffer_samples(jax):
+    """Aggregate live device arrays into pprof samples by allocation stack.
+
+    Covers buffers only: PyClient::HeapProfile additionally walks live
+    *executables*, and that branch calls a PJRT C-API method
+    (PJRT_Executable_SizeOfGeneratedCodeInBytes) that tunneled plugins may
+    leave unimplemented — absl LOG(FATAL), aborting the profiled process
+    (observed on the axon tunnel 2026-07-31).  Buffers are what OOM
+    attribution needs; jit temporaries/donated buffers are invisible either
+    way.
+    """
+    agg = {}
+    for a in jax.live_arrays():
+        try:
+            tb = getattr(a, "traceback", None)
+            frames = tuple(
+                (str(f.function_name), str(f.file_name), int(f.line_num))
+                for f in (tb.frames if tb is not None else ())[:48])
+        except Exception:
+            frames = ()
+        if not frames:
+            frames = (("(stackless buffer)", "", 0),)
+        per = {}
+        try:
+            for sh in a.addressable_shards:
+                d = sh.device
+                label = "%s:%d" % (getattr(d, "platform", "dev"),
+                                   getattr(d, "id", 0))
+                per[label] = per.get(label, 0) + int(sh.data.nbytes)
+        except Exception:
+            # non-empty sentinel: an empty string encodes as string-table
+            # index 0 and decodes as the numeric label 0 -> device "0"
+            per = {"unknown": int(getattr(a, "nbytes", 0) or 0)}
+        for label, nb in per.items():
+            key = (frames, label)
+            c, b = agg.get(key, (0, 0))
+            agg[key] = (c + 1, b + nb)
+    return [(list(fr), dev, "buffer", c, b)
+            for (fr, dev), (c, b) in sorted(agg.items(), key=str)]
+
+
 def snapshot_memprof(jax, path, trigger, total_bytes):
-    """Dump the device memory profile (gzipped pprof) + a meta sidecar.
+    """Dump an HBM attribution snapshot (gzipped pprof) + a meta sidecar.
 
     Best-effort by contract: the profiled program must never die because an
     observability snapshot failed (chip mid-teardown, read-only logdir, ...).
+    The profile is built in-process from jax.live_arrays() stacks; the
+    runtime's own jax.profiler.device_memory_profile() is opt-in via
+    SOFA_MEMPROF_NATIVE=1 because its executable walk can LOG(FATAL) on
+    PJRT plugins that skip the code-size C-API method (see
+    _live_buffer_samples) — an abort no try/except can catch.
     """
+    import gzip
     import json
     import os as _os
     try:
-        blob = jax.profiler.device_memory_profile()
+        if _os.environ.get("SOFA_MEMPROF_NATIVE", "0") == "1":
+            blob = jax.profiler.device_memory_profile()
+        else:
+            blob = gzip.compress(_pprof_encode(_live_buffer_samples(jax)))
         # Writer-unique tmp name: the sampler thread and the at-exit
         # fallback may snapshot concurrently (injection atexit order is not
         # ours to pick); each writes its own tmp and the atomic replace

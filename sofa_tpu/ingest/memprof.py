@@ -32,6 +32,11 @@ _RUNTIME_FRAME_HINTS = (
     "_pjit", "pjit", "cache_miss", "reraise_with_filtered_traceback",
     "backend_compile", "wrapper", "__call__", "_python_pjit_helper",
     "call_impl", "apply_primitive", "lower", "compile", "_cpp_pjit",
+    # eager-dispatch leaves (live_arrays stacks, seen on-chip 2026-07-31:
+    # without these the top "site" is jax plumbing like
+    # EvalTrace.process_primitive, not the allocating user line)
+    "process_primitive", "ExecuteReplicated", "annotate_function",
+    "process_call", "_device_put", "device_put",
 )
 
 

@@ -186,8 +186,15 @@ def _derive_op_fields(label: str, md: Dict[str, object]) -> dict:
     }
 
 
-def find_marker_offset_ns(xspace) -> Optional[int]:
-    """unix_ns - session_ns, from the injected marker annotation."""
+def find_marker_offsets_ns(xspace) -> List[Tuple[int, int]]:
+    """All timebase markers as (session_ns, unix_ns - session_ns), sorted.
+
+    api.profile emits one marker at trace start and one at stop; their
+    offsets agreeing is the within-capture consistency check (the session
+    clock's *origin* legitimately differs between captures on tunneled
+    backends, so cross-capture comparison proves nothing).
+    """
+    out: List[Tuple[int, int]] = []
     for plane in xspace.planes:
         if not plane.name.startswith("/host:"):
             continue
@@ -202,8 +209,16 @@ def find_marker_offset_ns(xspace) -> Optional[int]:
             for ev in line.events:
                 if ev.metadata_id in marker_ids:
                     session_ns = line.timestamp_ns + ev.offset_ps // 1000
-                    return marker_ids[ev.metadata_id] - session_ns
-    return None
+                    out.append((session_ns,
+                                marker_ids[ev.metadata_id] - session_ns))
+    return sorted(out)
+
+
+def find_marker_offset_ns(xspace) -> Optional[int]:
+    """unix_ns - session_ns from the EARLIEST marker (the start-of-trace
+    anchor) — the offset ingest aligns the whole capture with."""
+    offs = find_marker_offsets_ns(xspace)
+    return offs[0][1] if offs else None
 
 
 def _resolve_event_meta(em, sm, metadata_id: int, cache: Dict[int, tuple]):

@@ -95,6 +95,25 @@ def test_marker_offset():
     assert off == MARKER_UNIX_NS - SESSION_MARKER_NS
 
 
+def test_marker_offsets_start_and_stop():
+    """api.profile emits start AND stop markers; all are returned sorted by
+    session time and alignment anchors on the earliest."""
+    from sofa_tpu.ingest.xplane import find_marker_offsets_ns
+
+    xs = build_xspace()
+    host = xs.planes[0]
+    # stop marker 3 s later in session time, 2 us of offset disagreement
+    stop_unix = MARKER_UNIX_NS + 3_000_000_000 + 2_000
+    _add_event(host, host.lines[0], f"sofa_timebase_marker:{stop_unix}",
+               SESSION_MARKER_NS + 3_000_000_000, 1000)
+    offs = find_marker_offsets_ns(xs)
+    assert [s for s, _ in offs] == [SESSION_MARKER_NS,
+                                    SESSION_MARKER_NS + 3_000_000_000]
+    assert offs[0][1] == MARKER_UNIX_NS - SESSION_MARKER_NS
+    assert offs[1][1] - offs[0][1] == 2_000      # within-capture drift
+    assert find_marker_offset_ns(xs) == offs[0][1]
+
+
 def test_xspace_to_frames_alignment_and_stats():
     xs = build_xspace()
     frames = xspace_to_frames(xs, TIME_BASE)

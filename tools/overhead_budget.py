@@ -30,18 +30,15 @@ def _median(xs: List[float]) -> float:
     return xs[len(xs) // 2]
 
 
-def _timed(step, state, tokens, n_steps: int, reps: int) -> float:
+def _timed_once(step, state, tokens, n_steps: int) -> float:
     import jax
 
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        params, opt = state
-        for _ in range(n_steps):
-            params, opt, loss = step(params, opt, tokens)
-        jax.block_until_ready(loss)
-        times.append(time.perf_counter() - t0)
-    return _median(times)
+    t0 = time.perf_counter()
+    params, opt = state
+    for _ in range(n_steps):
+        params, opt, loss = step(params, opt, tokens)
+    jax.block_until_ready(loss)
+    return time.perf_counter() - t0
 
 
 def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
@@ -127,27 +124,67 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
 
     rows = []
     try:
-        # The bare timing IS the baseline: if it cannot be measured there
-        # is no valid table — never silently promote a collector-laden run.
-        t_bare = _timed(step, state, tokens, steps, reps)
-        rows.append(("bare (no collectors)", t_bare, "baseline"))
+        # Warm the whole path untimed first — on the tunneled chip the
+        # first minute of a session runs visibly slower, and a
+        # measure-bare-once-up-front design turned that drift into
+        # *negative* overheads for every config measured later.
+        for _ in range(2):
+            _timed_once(step, state, tokens, steps)
+        # Each rep measures bare IMMEDIATELY before the config run, and the
+        # marginal is the median of the per-pair ratios: slow monotonic
+        # drift (tunnel settling, thermal) cancels within a pair instead of
+        # biasing every config against one stale baseline.
+        bare_times: List[float] = []
+        per_cfg: List[Tuple[str, Optional[float], List[float]]] = []
+        fails: dict = {}
         for name, setup in configs:
-            teardown = None
-            try:
-                teardown = setup()
-                t = _timed(step, state, tokens, steps, reps)
-            except Exception as e:  # noqa: BLE001 — per-config degradation
-                rows.append((name, None, f"unavailable: {e}"))
+            margins, cfg_times = [], []
+            fail = None
+            for _ in range(reps):
+                teardown = None
+                try:
+                    tb = _timed_once(step, state, tokens, steps)
+                    teardown = setup()
+                    tc = _timed_once(step, state, tokens, steps)
+                except Exception as e:  # noqa: BLE001 — per-config degrade
+                    fail = e
+                    break
+                finally:
+                    if teardown is not None:
+                        try:
+                            teardown()
+                        except Exception:  # noqa: BLE001
+                            pass
+                bare_times.append(tb)
+                cfg_times.append(tc)
+                margins.append((tc - tb) / tb * 100.0)
+            if fail is not None:
+                fails[name] = fail
+                per_cfg.append((name, None, []))
                 continue
-            finally:
-                if teardown is not None:
-                    try:
-                        teardown()
-                    except Exception:  # noqa: BLE001
-                        pass
+            per_cfg.append((name, _median(cfg_times), margins))
+        if not bare_times:
+            raise RuntimeError("no bare baseline measured — every config "
+                               "failed before its paired bare run")
+        # Noise floor from the bare runs themselves: on a tunneled chip the
+        # RPC latency jitter between identical runs can exceed any real
+        # sampler cost, and a signed % with no floor reads as a (nonsense)
+        # speedup.  MAD-based so one straggler run doesn't inflate it.
+        b_med = _median(bare_times)
+        noise_pct = 2.0 * _median(
+            [abs(t - b_med) for t in bare_times]) / b_med * 100.0
+        rows.append(("bare (no collectors)", b_med,
+                     f"baseline (noise floor ±{noise_pct:.1f} %)"))
+        for name, t, margins in per_cfg:
+            if t is None:
+                rows.append((name, None, f"unavailable: {fails[name]}"))
+                continue
+            m = _median(margins)
             # signed on purpose: a marginal below the noise floor should
             # read as such, not as a fake exact zero
-            rows.append((name, t, f"{(t - t_bare) / t_bare * 100:+.2f} %"))
+            note = (f"{m:+.2f} %" if abs(m) > noise_pct
+                    else f"{m:+.2f} % (within noise)")
+            rows.append((name, t, note))
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
 
@@ -157,8 +194,9 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         "",
         f"Measured {stamp} on backend **{jax.default_backend()}** "
         f"({len(jax.devices())} device(s)); tiny transformer train loop, "
-        f"batch={batch} seq={seq}, {steps} steps x {reps} reps "
-        "(median), marginal vs bare.",
+        f"batch={batch} seq={seq}, {steps} steps x {reps} paired reps "
+        "(bare re-timed immediately before each config run; overhead = "
+        "median of per-pair marginals).",
         "",
         "| Collector config | median loop time (s) | marginal overhead |",
         "|---|---|---|",

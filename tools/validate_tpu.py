@@ -237,22 +237,28 @@ def memprof_on_chip():
 
 @check("clock_residual")
 def clock_residual():
-    """Marker-vs-timebase agreement: the in-trace marker alignment and the
-    native timebase table are two independent clock bridges; they must agree
-    to ~1 ms or drift fitting / the marker read is broken (VERDICT r2 next
-    #7 — the --tpu_time_offset_ms escape hatch exists for when this fails
-    in the field)."""
+    """Within-capture marker-bridge consistency.
+
+    The in-trace marker is the PRIMARY clock bridge (ingest aligns device
+    time with the earliest one; the native timebase table and
+    --tpu_time_offset_ms are the fallback).  api.profile emits a marker at
+    trace start AND stop; alignment is correct iff both yield the same
+    unix-minus-session offset — the session clock runs at wall rate over
+    the capture.  Cross-capture offsets are NOT comparable (each axon
+    session has its own origin; observed 2026-07-31: ~997 s apparent skew
+    vs the local clock table and ~2.5 s movement between captures — both
+    irrelevant to a bridge that re-anchors per capture).  The residual vs
+    the local posix-clock table is reported for operator context only."""
+    import glob
     import shutil
     import tempfile
-
-    import glob
 
     import jax
     import jax.numpy as jnp
 
     import sofa_tpu.api as sofa
     from sofa_tpu.ingest.timebase_align import load_timebase
-    from sofa_tpu.ingest.xplane import find_marker_offset_ns, load_xspace
+    from sofa_tpu.ingest.xplane import find_marker_offsets_ns, load_xspace
 
     logdir = tempfile.mkdtemp(prefix="sofa_val_clk_") + "/"
     try:
@@ -261,21 +267,29 @@ def clock_residual():
         jax.block_until_ready(f(x))
         with sofa.profile(logdir):
             jax.block_until_ready(f(x))
-            time.sleep(3.0)
+            time.sleep(2.0)
             jax.block_until_ready(f(x))
         pbs = glob.glob(logdir + "xprof/**/*.xplane.pb", recursive=True)
         assert pbs, "no capture"
-        off = find_marker_offset_ns(load_xspace(pbs[0]))
-        assert off is not None, "marker missing from capture"
+        offs = find_marker_offsets_ns(load_xspace(pbs[0]))
+        assert len(offs) >= 2, f"expected start+stop markers, got {len(offs)}"
+        span_s = (offs[-1][0] - offs[0][0]) / 1e9
+        drift = abs(offs[-1][1] - offs[0][1])
+        assert span_s > 1.0, f"markers only {span_s:.3f}s apart"
+        assert drift < 5e6, (f"marker offsets disagree by {drift / 1e6:.3f} "
+                             f"ms across a {span_s:.1f}s capture — session "
+                             "clock rate or marker stamping is broken")
         table = load_timebase(logdir + "timebase.txt")
         assert table is not None, "timebase.txt missing"
-        # The profiler session clock counts from one of the posix clocks
-        # sampled in the table; the residual vs the best-matching one is
-        # the end-to-end alignment error.
-        res = min(abs(off - float((table[:, 0] - table[:, c]).mean()))
+        res = min(abs(offs[0][1]
+                      - float((table[:, 0] - table[:, c]).mean()))
                   for c in (1, 2, 3))
-        assert res < 1e6, f"residual {res / 1e6:.3f} ms >= 1 ms"
-        return f"residual {res / 1e6:.4f} ms over {len(table)} samples"
+        note = (f"local-clock residual {res / 1e6:.3f} ms"
+                if res < 1e6 else
+                f"remote session origin {res / 1e9:.3f} s from local "
+                "clocks (tunneled device; re-anchored per capture)")
+        return (f"start/stop offsets agree to {drift / 1e6:.3f} ms over "
+                f"{span_s:.1f}s; {note}")
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
 
@@ -294,7 +308,9 @@ def overhead_budget():
     import overhead_budget as mod
 
     out = os.path.join(os.path.dirname(here), "docs", "OVERHEAD_BUDGET.md")
-    mod.run_budget(steps=50, reps=3, out=out)
+    # 100-step loops: 50-step runs sit inside the tunnel's RPC jitter and
+    # the table printed negative "overheads" (r4, first capture attempts)
+    mod.run_budget(steps=100, reps=5, out=out)
     return out
 
 
