@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""On-chip block-size / precision sweep for the Pallas flash kernel.
+
+Round-4 on-chip validation measured the fused kernel at 9.40 TFLOP/s for
+T=16384 and +28% over the unfused fwd+bwd path (VALIDATE_r04.txt) — a real
+win but far below the MXU's bf16 ceiling.  A suspected cause is the kernel
+casting q/k/v to f32 *before* its two matmuls, which runs the MXU in f32
+mode; this sweep measures each (precision, block_q, block_k) variant on
+the real chip so the kernel defaults are data, not guesses.
+
+Usage:  python tools/tune_flash.py [--seq 2048 4096 16384] [--json out.json]
+Prints one line per variant and a final ranking.  TPU only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+
+def bench_fwd(f, args, n=20):
+    import jax
+
+    jax.block_until_ready(f(*args))          # compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        o = f(*args)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, nargs="+", default=[2048, 16384])
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--json", default="")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sofa_tpu.workloads.flash_pallas import flash_attention
+    from sofa_tpu.workloads.ring_attention import plain_causal_attention
+
+    if jax.default_backend() != "tpu":
+        print("tune_flash: requires the real TPU backend", file=sys.stderr)
+        return 1
+
+    results = []
+    for t in args.seq:
+        b = max(1, 2048 * 4 // t)           # keep total tokens comparable
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(kk, (b, t, args.heads, args.dim),
+                                     jnp.bfloat16)
+                   for kk in jax.random.split(key, 3))
+        # causal flops: 2 matmuls * 2 flops * B*H*T^2*D / 2
+        flops = 2 * 2 * b * args.heads * t * t * args.dim / 2
+
+        ms = bench_fwd(jax.jit(plain_causal_attention), (q, k, v))
+        results.append({"seq": t, "variant": "plain_xla", "ms": ms,
+                        "tflops": flops / (ms / 1e3) / 1e12})
+        print(f"T={t:6d} plain_xla            {ms:7.2f} ms "
+              f"{results[-1]['tflops']:6.1f} TF/s", flush=True)
+
+        for bq, bk in itertools.product([128, 256, 512], [128, 256, 512]):
+            if t % bq or t % bk:
+                continue
+            try:
+                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, block_q=bq, block_k=bk))
+                ms = bench_fwd(f, (q, k, v))
+            except Exception as e:  # noqa: BLE001 — a variant may not fit VMEM
+                print(f"T={t:6d} flash bq={bq} bk={bk}: FAIL "
+                      f"{type(e).__name__}: {str(e).splitlines()[0][:100]}",
+                      flush=True)
+                continue
+            results.append({"seq": t, "variant": f"flash_bq{bq}_bk{bk}",
+                            "ms": ms, "tflops": flops / (ms / 1e3) / 1e12})
+            print(f"T={t:6d} flash bq={bq:3d} bk={bk:3d}  {ms:7.2f} ms "
+                  f"{results[-1]['tflops']:6.1f} TF/s", flush=True)
+
+    print("\nbest per seq:")
+    for t in args.seq:
+        rs = [r for r in results if r["seq"] == t]
+        best = min(rs, key=lambda r: r["ms"])
+        print(f"  T={t}: {best['variant']} {best['ms']:.2f} ms "
+              f"({best['tflops']:.1f} TF/s)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
