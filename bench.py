@@ -413,13 +413,11 @@ def _cpu_fallback_evidence() -> dict:
 
 
 def _time_steps(step, state_maker, n_steps: int, annotate: bool):
-    import jax
-
-    from sofa_tpu.workloads.common import step_annotation
+    from sofa_tpu.workloads.common import fence, step_annotation
 
     state = state_maker()
     state = step(state)                      # compile
-    jax.block_until_ready(state)
+    fence(state)   # NOT block_until_ready: see workloads/common.py:fence
     t0 = time.perf_counter()
     for i in range(n_steps):
         if annotate:
@@ -427,7 +425,7 @@ def _time_steps(step, state_maker, n_steps: int, annotate: bool):
                 state = step(state)
         else:
             state = step(state)
-    jax.block_until_ready(state)
+    fence(state)
     return time.perf_counter() - t0
 
 

@@ -39,6 +39,30 @@ def balanced_factorization(n: int, num_axes: int) -> Tuple[int, ...]:
     return tuple(sorted(factors, reverse=True))
 
 
+def fence(tree):
+    """Synchronize on `tree`: block_until_ready PLUS a scalar read.
+
+    jax.block_until_ready is the documented barrier and is what fences
+    every device of a sharded tree — but on the tunneled single-chip
+    backend (axon) it can return before execution finishes (measured
+    2026-07-31: twenty ~112 ms kernels "completed" in 0.4 ms of wall time,
+    then materializing the result took 1.6 s).  A device->host transfer of
+    a computed element cannot resolve early, and the chip executes
+    in order, so pulling one scalar afterwards closes that gap.  The pull
+    only covers the device holding the first leaf's element 0 — exactly
+    the single-device case where the axon bug lives; multi-device meshes
+    rely on the block_until_ready barrier as before.  Every timing harness
+    (bench.py, tools/overhead_budget.py, tools/tune_flash.py, validate_tpu
+    timing checks) must use this, not bare block_until_ready.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "ndim")]
+    jax.block_until_ready(leaves)
+    if not leaves:
+        return None
+    leaf = leaves[0]
+    return np.asarray(leaf[(0,) * leaf.ndim])
+
+
 def make_mesh(
     axis_names: Sequence[str],
     axis_sizes: Optional[Sequence[int]] = None,

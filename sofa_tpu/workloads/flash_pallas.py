@@ -102,11 +102,28 @@ def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
 
+def pick_block(n: int, cap: int = 512) -> Optional[int]:
+    """Largest power-of-two block size <= cap (>= 16) that divides n.
+
+    512 is the measured sweet spot on v5e — the on-chip sweep
+    (tools/tune_flash.py, 2026-07-31) put 512x512 blocks at 16.8 ms for a
+    16k-token forward vs 51.8 ms at the old 128x128 default — while
+    smaller powers of two keep every 16-multiple sequence length (the
+    sublane constraint) supported.
+    """
+    b = cap
+    while b >= 16:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
 def _flash_forward(
     q, k, v,
     shift,
-    block_q: int,
-    block_k: int,
+    block_q: Optional[int],
+    block_k: Optional[int],
     interpret: Optional[bool],
     static_causal: bool = False,
 ):
@@ -116,18 +133,28 @@ def _flash_forward(
     i iff j <= i + shift.  0 = aligned causal, >= T = full attention,
     <= -T = fully masked (out 0, lse ~ NEG_INF).
 
+    ``block_q``/``block_k`` = None picks the measured-best size that fits
+    the sequence (pick_block).
+
     ``static_causal`` promises shift <= 0 at trace time.  Then no k-block
     past the q-block's diagonal can ever contribute, so the K/V index maps
     clamp to the diagonal: skipped iterations re-request the previous
     block, and the Pallas pipeline elides the copy — the upper-triangle
     half of K/V HBM traffic disappears.  Must stay False for ring hops,
     whose traced shift can be positive.
+
+    GQA is native: k/v may carry KVH < H heads (H % KVH == 0) and each K/V
+    plane serves its whole query-head group straight from HBM — the
+    [B,T,H,D] repeat the unfused path materializes never exists here.
     """
     b, t, h, d = q.shape
-    tk = k.shape[1]
-    block_q = min(block_q, t)
-    block_k = min(block_k, tk)
-    if t % block_q or tk % block_k:
+    tk, kvh = k.shape[1], k.shape[2]
+    if h % kvh:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {kvh}")
+    group = h // kvh
+    block_q = pick_block(t) if block_q is None else min(block_q, t)
+    block_k = pick_block(tk) if block_k is None else min(block_k, tk)
+    if not block_q or not block_k or t % block_q or tk % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"seq lens ({t}, {tk})")
     if interpret is None:
@@ -136,22 +163,28 @@ def _flash_forward(
     num_k = tk // block_k
     shift = jnp.asarray(shift, jnp.int32).reshape(1)
 
-    # [B, T, H, D] -> [B*H, T, D]: contiguous (T, D) planes per grid row.
+    # [B, T, H', D] -> [B*H', T, D]: contiguous (T, D) planes per head.
     def to_planes(x):
-        tt = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
+        tt, hh = x.shape[1], x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, tt, d)
 
     qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         scale=scale)
+
+    def kv_plane(bh):
+        # grid row bh = batch * H + query head; its K/V plane shares one
+        # kv head across the `group` query heads
+        return (bh // h) * kvh + (bh % h) // group
+
     if static_causal:
         def kv_index(bh, iq, ik):
             last = (iq * block_q + block_q - 1) // block_k
-            return (bh, jnp.minimum(ik, last), 0)
+            return (kv_plane(bh), jnp.minimum(ik, last), 0)
     else:
         def kv_index(bh, iq, ik):
-            return (bh, ik, 0)
+            return (kv_plane(bh), ik, 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, num_k),
@@ -187,25 +220,24 @@ def _flash_forward(
 def flash_attention(
     q, k, v,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
-    """Fused attention over [B, T, H, D] tensors (H == kv heads; expand GQA
-    before calling, as the transformer workload already does)."""
+    """Fused attention: q [B, T, H, D]; k/v may carry KVH <= H heads
+    (GQA runs natively in the kernel — no repeat materialized)."""
     shift = 0 if causal else k.shape[1]
     return _flash_forward(q, k, v, shift, block_q, block_k, interpret,
                           static_causal=causal)[0]
 
 
-def supports(t: int, block: int = 128) -> bool:
+def supports(t: int, block: int = 512) -> bool:
     """True when a [.., T, ..] attention can run through the fused kernel.
 
-    Besides divisibility, the q-block (second-to-minor tile dim) must be a
-    sublane multiple — 16 covers bf16 and f32 on current TPUs.
+    Some power-of-two block >= 16 (the sublane multiple for bf16/f32) and
+    <= ``block`` must divide T — i.e. any 16-multiple sequence length.
     """
-    bq = min(block, t)
-    return t % bq == 0 and bq % 16 == 0
+    return pick_block(t, block) is not None
 
 
 @jax.custom_vjp
@@ -219,16 +251,18 @@ def flash_causal_attention(q, k, v):
     [T, T] score matrix never materializes in either direction and XLA
     still fuses everything onto the MXU.
     """
-    out, _ = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
+    out, _ = _flash_forward(q, k, v, 0, None, None, None, static_causal=True)
     return out
 
 
 def _fwd(q, k, v):
-    out, lse = _flash_forward(q, k, v, 0, 128, 128, None, static_causal=True)
+    out, lse = _flash_forward(q, k, v, 0, None, None, None,
+                              static_causal=True)
     return out, (q, k, v, out, lse)
 
 
-def _grad_block(q, k, v, g, delta, lse, shift, block: int = 128):
+def _grad_block(q, k, v, g, delta, lse, shift,
+                block: Optional[int] = None):
     """Blockwise attention gradients against one visiting K/V block.
 
     All stock lax ops (one scan over k-chunks, probabilities recomputed from
@@ -236,10 +270,18 @@ def _grad_block(q, k, v, g, delta, lse, shift, block: int = 128):
     ``shift`` is the same causal offset the forward kernel uses; q rows are
     local positions, k positions are offset by it.  Returns (dq, dk, dv) in
     f32 — dq for the local q shard, dk/dv for the *visiting* block.
+
+    GQA: k/v may carry KVH < H heads; q/g fold into [B,T,KVH,G,D] so every
+    einsum contracts the shared kv head across its query group, and dk/dv
+    come back in the compact KVH layout (the group axis sums away — the
+    repeated-KV gradient identity).
     """
     b, t, h, d = q.shape
-    tk = k.shape[1]
-    bk = min(block, tk)
+    tk, kvh = k.shape[1], k.shape[2]
+    grp = h // kvh
+    bk = pick_block(tk) if block is None else min(block, tk)
+    if not bk or tk % bk:
+        raise ValueError(f"k-chunk {bk} must divide key length {tk}")
     scale = d ** -0.5
     # Operands keep their storage dtype into every einsum with f32
     # accumulation (preferred_element_type): bf16 inputs run the MXU in
@@ -249,34 +291,40 @@ def _grad_block(q, k, v, g, delta, lse, shift, block: int = 128):
     cdt = q.dtype
     f32 = jnp.float32
     q_pos = jnp.arange(t)[:, None]                     # [T, 1]
-    kb = k.reshape(b, tk // bk, bk, h, d)
-    vb = v.reshape(b, tk // bk, bk, h, d)
+    q5 = q.reshape(b, t, kvh, grp, d)
+    g5 = g.reshape(b, t, kvh, grp, d)
+    lse5 = lse.reshape(b, kvh, grp, t)
+    delta5 = delta.reshape(b, kvh, grp, t)
+    kb = k.reshape(b, tk // bk, bk, kvh, d)
+    vb = v.reshape(b, tk // bk, bk, kvh, d)
 
     def body(dq, blk):
-        kj, vj, j = blk
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kj,
+        kj, vj, j = blk                               # [B,bk,KVH,D]
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kj,
                        preferred_element_type=f32) * scale
         k_pos = j * bk + jnp.arange(bk)[None, :]
-        s = jnp.where((k_pos > q_pos + shift)[None, None], NEG_INF, s)
-        p = jnp.exp(s - lse[..., None])                # [B,H,T,bk] f32
-        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p.astype(cdt), g,
+        s = jnp.where((k_pos > q_pos + shift)[None, None, None],
+                      NEG_INF, s)
+        p = jnp.exp(s - lse5[..., None])              # [B,KVH,G,T,bk] f32
+        dv_j = jnp.einsum("bhgqk,bqhgd->bkhd", p.astype(cdt), g5,
                           preferred_element_type=f32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", g, vj,
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", g5, vj,
                         preferred_element_type=f32)
-        ds = (p * (dp - delta[..., None])).astype(cdt)  # [B,H,T,bk]
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kj,
+        ds = (p * (dp - delta5[..., None])).astype(cdt)
+        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds, kj,
                              preferred_element_type=f32) * scale
-        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+        dk_j = jnp.einsum("bhgqk,bqhgd->bkhd", ds, q5,
                           preferred_element_type=f32) * scale
         return dq, (dk_j, dv_j)
 
-    dq0 = jnp.zeros((b, t, h, d), jnp.float32)
+    dq0 = jnp.zeros((b, t, kvh, grp, d), jnp.float32)
     dq, (dk, dv) = jax.lax.scan(
         body, dq0,
         (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
          jnp.arange(tk // bk)))
-    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
-    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, tk, h, d)
+    dq = dq.reshape(b, t, h, d)
+    dk = dk.transpose(1, 0, 2, 3, 4).reshape(b, tk, kvh, d)
+    dv = dv.transpose(1, 0, 2, 3, 4).reshape(b, tk, kvh, d)
     return dq, dk, dv
 
 

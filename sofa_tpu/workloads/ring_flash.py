@@ -80,7 +80,7 @@ def _ring_fwd_impl(q, k, v, axis_name):
     def hop(carry, i):
         o, lse, k_blk, v_blk = carry
         shift = _hop_shift(i, r, n, t)
-        o_i, lse_i = _flash_forward(q, k_blk, v_blk, shift, 128, 128, None)
+        o_i, lse_i = _flash_forward(q, k_blk, v_blk, shift, None, None, None)
         o, lse = _lse_merge(o, lse, o_i, lse_i)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -199,9 +199,9 @@ def _zz_fwd_impl(q, k, v, axis_name):
         s_ll, s_hl, s_hh = _zigzag_hop_shifts(i, r, n, c)
         k_lo, k_hi = k_blk[:, :c], k_blk[:, c:]
         v_lo, v_hi = v_blk[:, :c], v_blk[:, c:]
-        o_ll, lse_ll = _flash_forward(q_lo, k_lo, v_lo, s_ll, 128, 128, None)
-        o_hl, lse_hl = _flash_forward(q_hi, k_lo, v_lo, s_hl, 128, 128, None)
-        o_hh, lse_hh = _flash_forward(q_hi, k_hi, v_hi, s_hh, 128, 128, None)
+        o_ll, lse_ll = _flash_forward(q_lo, k_lo, v_lo, s_ll, None, None, None)
+        o_hl, lse_hl = _flash_forward(q_hi, k_lo, v_lo, s_hl, None, None, None)
+        o_hh, lse_hh = _flash_forward(q_hi, k_hi, v_hi, s_hh, None, None, None)
         o_lo, lse_lo = _lse_merge(o[:, :c], lse[..., :c], o_ll, lse_ll)
         o_hi, lse_hi = _lse_merge(o[:, c:], lse[..., c:], o_hl, lse_hl)
         o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_hh, lse_hh)

@@ -228,18 +228,28 @@ def forward(params, tokens, cfg: TransformerConfig,
             x, NamedSharding(mesh, P("data", "seq", None)))
 
     def attn(q, kk, v):
-        # GQA: replicate each KV head over its query-head group.
+        # The fused (flash) paths take GQA natively — compact KV heads go
+        # straight to the kernel (and over the ring's ppermute hops, which
+        # cuts ICI bytes by the group factor).  The unfused paths
+        # materialize the repeat, as does any path whose shard_map splits
+        # the head axis more ways than there are KV heads (tensor-parallel
+        # over "model": compact heads must still divide the axis).
+        tp = mesh.shape.get("model", 1) if mesh is not None else 1
         rep = cfg.n_heads // cfg.n_kv_heads
-        kk = jnp.repeat(kk, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        if use_zigzag:
-            return zigzag_ring_flash_attention(q, kk, v, mesh), None
-        if use_ring and use_flash:
-            return ring_flash_attention(q, kk, v, mesh), None
+
+        def repeated():
+            return (jnp.repeat(kk, rep, axis=2), jnp.repeat(v, rep, axis=2))
+
+        if use_flash:
+            kr, vr = (kk, v) if cfg.n_kv_heads % tp == 0 else repeated()
+            if use_zigzag:
+                return zigzag_ring_flash_attention(q, kr, vr, mesh), None
+            if use_ring:
+                return ring_flash_attention(q, kr, vr, mesh), None
+            return flash_causal_attention(q, kr, vr), None
+        kk, v = repeated()
         if use_ring:
             return ring_attention(q, kk, v, mesh), None
-        if use_flash:
-            return flash_causal_attention(q, kk, v), None
         return plain_causal_attention(q, kk, v), None
 
     def layer(x, lp):

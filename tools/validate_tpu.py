@@ -83,16 +83,17 @@ def long_context_16k():
 
     from sofa_tpu.workloads.flash_pallas import flash_causal_attention
 
+    from sofa_tpu.workloads.common import fence
+
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(kk, (1, 16384, 8, 128), jnp.bfloat16)
                for kk in jax.random.split(key, 3))
     f = jax.jit(lambda q, k, v: flash_causal_attention(q, k, v))
-    o = f(q, k, v)
-    o.block_until_ready()
+    fence(f(q, k, v))   # compile + settle (block_until_ready lies on axon)
     t0 = time.perf_counter()
     for _ in range(3):
         o = f(q, k, v)
-    o.block_until_ready()
+    fence(o)
     ms = (time.perf_counter() - t0) / 3 * 1e3
     tf = (1 * 8 * 16384 * 16384 * 128 * 2 * 2 / 2) / (ms / 1e3) / 1e12
     return f"{ms:.1f} ms/fwd, {tf:.2f} TFLOP/s"
@@ -110,12 +111,14 @@ def fwd_bwd_vs_unfused():
     q, k, v = (jax.random.normal(kk, (4, 2048, 8, 128), jnp.bfloat16)
                for kk in jax.random.split(key, 3))
 
+    from sofa_tpu.workloads.common import fence
+
     def bench(f, n=20):
-        jax.block_until_ready(f(q, k, v))
+        fence(f(q, k, v))   # block_until_ready lies on axon; fence pulls
         t0 = time.perf_counter()
         for _ in range(n):
             o = f(q, k, v)
-        jax.block_until_ready(o)
+        fence(o)
         return (time.perf_counter() - t0) / n * 1e3
 
     gf = jax.jit(jax.grad(lambda *a: (flash_causal_attention(*a).astype(
