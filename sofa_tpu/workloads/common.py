@@ -60,6 +60,10 @@ def fence(tree):
     if not leaves:
         return None
     leaf = leaves[0]
+    if not getattr(leaf, "is_fully_addressable", True):
+        # multi-host shardings can't be indexed from one process; the
+        # block_until_ready barrier above is the whole fence there
+        return None
     return np.asarray(leaf[(0,) * leaf.ndim])
 
 

@@ -261,6 +261,218 @@ def _fwd(q, k, v):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_kv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   block_q: int, block_k: int, num_q: int,
+                   num_inner: int, scale: float):
+    # dK/dV for one K/V block, accumulated over every (group head, q-block)
+    # that attends to it.  Everything is computed in the TRANSPOSED [bk, bq]
+    # layout so lse/delta enter as the [1, bq] rows the forward already
+    # emits and no in-kernel transposes (Mosaic relayouts) are needed:
+    #   s^T = K Q^T;  p^T = exp(s^T - lse);  dV += p^T dO
+    #   dp^T = V dO^T;  ds^T = p^T (dp^T - delta);  dK += ds^T Q
+    jk = pl.program_id(1)
+    inner = pl.program_id(2)
+    iq = inner % num_q
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # q-blocks strictly above this k-block's diagonal see none of it
+    @pl.when(iq >= (jk * block_k) // block_q)
+    def _step():
+        k = k_ref[0]                                   # [bk, D]
+        v = v_ref[0]
+        q = q_ref[0]                                   # [bq, D]
+        do = do_ref[0]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bk, bq]
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_q), 1)
+        st = jnp.where(k_pos > q_pos, NEG_INF, st)
+        lse_row = lse_ref[0, :1, :]                    # [1, bq] f32
+        pt = jnp.exp(st - lse_row)
+        dv_acc[...] = dv_acc[...] + jnp.dot(
+            pt.astype(do.dtype), do, preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, bq]
+        dst = (pt * (dpt - dta_ref[0, :1, :])).astype(q.dtype)
+        dk_acc[...] = dk_acc[...] + jnp.dot(
+            dst, q, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(inner == num_inner - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+                  dqt_ref, dqt_acc, *,
+                  block_q: int, block_k: int, num_k: int, scale: float):
+    # dQ for one q-block, accumulated over its visible K/V blocks — in the
+    # same transposed layout; the accumulator holds dQ^T [D, bq]
+    # (dQ^T = K^T ds^T), un-transposed by XLA outside the kernel.
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dqt_acc[...] = jnp.zeros_like(dqt_acc)
+
+    @pl.when(jk * block_k <= iq * block_q + block_q - 1)
+    def _step():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bk, bq]
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_q), 1)
+        st = jnp.where(k_pos > q_pos, NEG_INF, st)
+        pt = jnp.exp(st - lse_ref[0, :1, :])
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dst = (pt * (dpt - dta_ref[0, :1, :])).astype(q.dtype)
+        dqt_acc[...] = dqt_acc[...] + jax.lax.dot_general(
+            k, dst, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [D, bq]
+
+    @pl.when(jk == num_k - 1)
+    def _emit():
+        dqt_ref[0] = dqt_acc[...]
+
+
+def _flash_backward(q, k, v, g, out, lse,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """Fused causal-attention backward: two Pallas kernels (dK/dV and dQ),
+    probabilities recomputed per block from the forward's lse so the [T,T]
+    matrix never leaves VMEM in either direction.  GQA-native like the
+    forward: compact K/V heads, each dK/dV block accumulating over its
+    whole query-head group.  Returns (dq, dk, dv) in the input dtypes.
+    """
+    b, t, h, d = q.shape
+    tk, kvh = k.shape[1], k.shape[2]
+    grp = h // kvh
+    block_q = pick_block(t) if block_q is None else min(block_q, t)
+    block_k = pick_block(tk) if block_k is None else min(block_k, tk)
+    if not block_q or not block_k or t % block_q or tk % block_k:
+        # same contract as _flash_forward — a non-dividing block here would
+        # silently leave gradient rows uncovered, not just misperform
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq lens ({t}, {tk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = d ** -0.5
+    num_q, num_k = t // block_q, tk // block_k
+    bh, bkv = b * h, b * kvh
+
+    def to_planes(x):
+        tt, hh = x.shape[1], x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, tt, d)
+
+    qp, kp, vp, gp = (to_planes(x) for x in (q, k, v, g))
+    # delta_i = sum_d(dO_i * O_i); both it and lse ride the same [8, T]
+    # sublane-broadcast tile layout the forward emits lse in, so the
+    # kernels read them as [1, bq] rows with no relayout.
+    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
+                       out.astype(jnp.float32)).reshape(bh, 1, t)
+    lse_t = jnp.broadcast_to(lse.reshape(bh, 1, t), (bh, 8, t))
+    delta_t = jnp.broadcast_to(delta, (bh, 8, t))
+
+    def kv_plane(i):
+        return (i // h) * kvh + (i % h) // grp
+
+    # --- dK/dV: grid over compact K/V planes; inner walks (group, q) ---
+    num_inner = grp * num_q
+
+    def qplane(bkvi, jk, inner):
+        return ((bkvi // kvh) * h + (bkvi % kvh) * grp + inner // num_q)
+
+    def q_index(bkvi, jk, inner):
+        # clamp skipped pre-diagonal q-blocks onto the first contributor so
+        # the pipeline elides their copies (mirrors the forward's trick)
+        iq = jnp.maximum(inner % num_q, (jk * block_k) // block_q)
+        return (qplane(bkvi, jk, inner), iq, 0)
+
+    def row_index(bkvi, jk, inner):
+        iq = jnp.maximum(inner % num_q, (jk * block_k) // block_q)
+        return (qplane(bkvi, jk, inner), 0, iq)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, block_q=block_q, block_k=block_k,
+                          num_q=num_q, num_inner=num_inner, scale=scale),
+        grid=(bkv, num_k, num_inner),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, 8, block_q), row_index),
+            pl.BlockSpec((1, 8, block_q), row_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bkv, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(kp, vp, qp, gp, lse_t, delta_t)
+
+    # --- dQ: grid over query planes; inner walks visible K/V blocks ---
+    def kv_index(i, iq, jk):
+        last = (iq * block_q + block_q - 1) // block_k
+        return (kv_plane(i), jnp.minimum(jk, last), 0)
+
+    dqt = pl.pallas_call(
+        functools.partial(_bwd_q_kernel, block_q=block_q, block_k=block_k,
+                          num_k=num_k, scale=scale),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
+            pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, d, block_q), lambda i, iq, jk: (i, 0, iq)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, d, t), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(kp, vp, qp, gp, lse_t, delta_t)[0]
+
+    dq = dqt.reshape(b, h, d, t).transpose(0, 3, 1, 2).astype(q.dtype)
+    dk = dk.reshape(b, kvh, tk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, kvh, tk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
 def _grad_block(q, k, v, g, delta, lse, shift,
                 block: Optional[int] = None):
     """Blockwise attention gradients against one visiting K/V block.
@@ -329,12 +541,10 @@ def _grad_block(q, k, v, g, delta, lse, shift,
 
 
 def _bwd(res, g):
+    # Fused Pallas backward (dK/dV kernel + dQ kernel); the lax fallback
+    # _grad_block remains for ring hops, whose causal shift is traced.
     q, k, v, out, lse = res
-    # delta_i = sum_d(dout_i * out_i) — the softmax-jacobian diagonal term.
-    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
-                       out.astype(jnp.float32))
-    dq, dk, dv = _grad_block(q, k, v, g, delta, lse, 0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(q, k, v, g, out, lse)
 
 
 flash_causal_attention.defvjp(_fwd, _bwd)

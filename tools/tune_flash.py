@@ -39,29 +39,53 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, nargs="+", default=[2048, 16384])
     p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv_heads", type=int, default=0,
+                   help="compact KV heads (GQA); 0 = same as --heads")
     p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--bwd", action="store_true",
+                   help="measure fwd+bwd (grad) instead of forward only")
     p.add_argument("--json", default="")
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
 
-    from sofa_tpu.workloads.flash_pallas import flash_attention
+    from sofa_tpu.workloads.flash_pallas import (
+        flash_attention, flash_causal_attention)
     from sofa_tpu.workloads.ring_attention import plain_causal_attention
 
     if jax.default_backend() != "tpu":
         print("tune_flash: requires the real TPU backend", file=sys.stderr)
         return 1
 
+    kvh = args.kv_heads or args.heads
+    mode = "fwd+bwd" if args.bwd else "fwd"
+
+    def plain_full(q, k, v):
+        rep = args.heads // kvh
+        if rep > 1:
+            k, v = jnp.repeat(k, rep, 2), jnp.repeat(v, rep, 2)
+        return plain_causal_attention(q, k, v)
+
+    def as_loss(f):
+        if not args.bwd:
+            return jax.jit(f)
+        return jax.jit(jax.grad(
+            lambda *a: (f(*a).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))
+
     results = []
     for t in args.seq:
         b = max(1, 2048 * 4 // t)           # keep total tokens comparable
         key = jax.random.PRNGKey(0)
-        q, k, v = (jax.random.normal(kk, (b, t, args.heads, args.dim),
-                                     jnp.bfloat16)
-                   for kk in jax.random.split(key, 3))
-        # causal flops: 2 matmuls * 2 flops * B*H*T^2*D / 2
+        kq, kk_, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, t, args.heads, args.dim), jnp.bfloat16)
+        k = jax.random.normal(kk_, (b, t, kvh, args.dim), jnp.bfloat16)
+        v = jax.random.normal(kv_, (b, t, kvh, args.dim), jnp.bfloat16)
+        # causal flops: 2 matmuls * 2 flops * B*H*T^2*D / 2; bwd ~ 2.5x fwd
         flops = 2 * 2 * b * args.heads * t * t * args.dim / 2
+        if args.bwd:
+            flops *= 3.5
 
         try:
             # the unfused path materializes [B,H,T,T] scores — skip where
@@ -69,30 +93,36 @@ def main() -> int:
             if b * args.heads * t * t * 4 > 8e9:
                 raise MemoryError(f"scores would need "
                                   f"{b * args.heads * t * t * 4 / 1e9:.0f} GB")
-            ms = bench_fwd(jax.jit(plain_causal_attention), (q, k, v))
-            results.append({"seq": t, "variant": "plain_xla", "ms": ms,
-                            "tflops": flops / (ms / 1e3) / 1e12})
-            print(f"T={t:6d} plain_xla            {ms:7.2f} ms "
+            ms = bench_fwd(as_loss(plain_full), (q, k, v))
+            results.append({"seq": t, "mode": mode, "variant": "plain_xla",
+                            "ms": ms, "tflops": flops / (ms / 1e3) / 1e12})
+            print(f"T={t:6d} {mode} plain_xla        {ms:7.2f} ms "
                   f"{results[-1]['tflops']:6.1f} TF/s", flush=True)
         except Exception as e:  # noqa: BLE001
-            print(f"T={t:6d} plain_xla: SKIP {type(e).__name__}: "
+            print(f"T={t:6d} {mode} plain_xla: SKIP {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:80]}", flush=True)
 
-        for bq, bk in itertools.product([128, 256, 512], [128, 256, 512]):
-            if t % bq or t % bk:
-                continue
+        if args.bwd:
+            variants = [("flash_vjp", lambda *a: flash_causal_attention(*a))]
+        else:
+            variants = [
+                (f"flash_bq{bq}_bk{bk}",
+                 lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                     q, k, v, block_q=bq, block_k=bk))
+                for bq, bk in itertools.product([128, 256, 512],
+                                                [128, 256, 512])
+                if t % bq == 0 and t % bk == 0]
+        for name, fn in variants:
             try:
-                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                    q, k, v, block_q=bq, block_k=bk))
-                ms = bench_fwd(f, (q, k, v))
+                ms = bench_fwd(as_loss(fn), (q, k, v))
             except Exception as e:  # noqa: BLE001 — a variant may not fit VMEM
-                print(f"T={t:6d} flash bq={bq} bk={bk}: FAIL "
+                print(f"T={t:6d} {mode} {name}: FAIL "
                       f"{type(e).__name__}: {str(e).splitlines()[0][:100]}",
                       flush=True)
                 continue
-            results.append({"seq": t, "variant": f"flash_bq{bq}_bk{bk}",
+            results.append({"seq": t, "mode": mode, "variant": name,
                             "ms": ms, "tflops": flops / (ms / 1e3) / 1e12})
-            print(f"T={t:6d} flash bq={bq:3d} bk={bk:3d}  {ms:7.2f} ms "
+            print(f"T={t:6d} {mode} {name:16s} {ms:7.2f} ms "
                   f"{results[-1]['tflops']:6.1f} TF/s", flush=True)
 
     print("\nbest per seq:")
