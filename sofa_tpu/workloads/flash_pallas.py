@@ -119,6 +119,36 @@ def pick_block(n: int, cap: int = 512) -> Optional[int]:
     return None
 
 
+def _to_planes(x):
+    """[B, T, H', D] -> [B*H', T, D]: one contiguous (T, D) plane per head —
+    the layout every kernel grid row indexes (forward and backward must
+    agree on it, so it lives here once)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _kv_plane(i, h: int, kvh: int):
+    """K/V plane serving query plane-row ``i``: grid row i = batch * H +
+    query head; its compact KV head is shared by the whole query group."""
+    return (i // h) * kvh + (i % h) // (h // kvh)
+
+
+def _check_static_shift(static_causal: bool, shift) -> None:
+    """static_causal index-map clamps assume shift <= 0 at trace time; a
+    traced or positive shift under them silently fetches the wrong blocks
+    (the in-kernel masks honor shift, the clamps don't) — make that a
+    trace-time error instead of wrong numbers."""
+    if not static_causal:
+        return
+    if isinstance(shift, jax.core.Tracer):
+        raise ValueError("static_causal=True needs a compile-time shift; "
+                         "pass static_causal=False for traced (ring-hop) "
+                         "shifts")
+    if int(shift) > 0:
+        raise ValueError(f"static_causal=True promises shift <= 0, got "
+                         f"{int(shift)}; pass static_causal=False")
+
+
 def _flash_forward(
     q, k, v,
     shift,
@@ -157,34 +187,25 @@ def _flash_forward(
     if not block_q or not block_k or t % block_q or tk % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"seq lens ({t}, {tk})")
+    _check_static_shift(static_causal, shift)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = d ** -0.5
     num_k = tk // block_k
     shift = jnp.asarray(shift, jnp.int32).reshape(1)
 
-    # [B, T, H', D] -> [B*H', T, D]: contiguous (T, D) planes per head.
-    def to_planes(x):
-        tt, hh = x.shape[1], x.shape[2]
-        return x.transpose(0, 2, 1, 3).reshape(b * hh, tt, d)
-
-    qp, kp, vp = to_planes(q), to_planes(k), to_planes(v)
+    qp, kp, vp = _to_planes(q), _to_planes(k), _to_planes(v)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         scale=scale)
 
-    def kv_plane(bh):
-        # grid row bh = batch * H + query head; its K/V plane shares one
-        # kv head across the `group` query heads
-        return (bh // h) * kvh + (bh % h) // group
-
     if static_causal:
         def kv_index(bh, iq, ik):
             last = (iq * block_q + block_q - 1) // block_k
-            return (kv_plane(bh), jnp.minimum(ik, last), 0)
+            return (_kv_plane(bh, h, kvh), jnp.minimum(ik, last), 0)
     else:
         def kv_index(bh, iq, ik):
-            return (kv_plane(bh), ik, 0)
+            return (_kv_plane(bh, h, kvh), ik, 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, num_k),
@@ -261,7 +282,7 @@ def _fwd(q, k, v):
     return out, (q, k, v, out, lse)
 
 
-def _bwd_kv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+def _bwd_kv_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
                    dk_ref, dv_ref, dk_acc, dv_acc, *,
                    block_q: int, block_k: int, num_q: int,
                    num_inner: int, scale: float):
@@ -271,17 +292,20 @@ def _bwd_kv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
     # emits and no in-kernel transposes (Mosaic relayouts) are needed:
     #   s^T = K Q^T;  p^T = exp(s^T - lse);  dV += p^T dO
     #   dp^T = V dO^T;  ds^T = p^T (dp^T - delta);  dK += ds^T Q
+    # shift_ref is the forward's dynamic causal offset (SMEM scalar): one
+    # compiled kernel serves aligned-causal (0) and every ring-hop shift.
     jk = pl.program_id(1)
     inner = pl.program_id(2)
     iq = inner % num_q
+    shift = shift_ref[0]
 
     @pl.when(inner == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    # q-blocks strictly above this k-block's diagonal see none of it
-    @pl.when(iq >= (jk * block_k) // block_q)
+    # q-blocks whose every row sits before this k-block's frontier see none
+    @pl.when(iq * block_q + block_q - 1 + shift >= jk * block_k)
     def _step():
         k = k_ref[0]                                   # [bk, D]
         v = v_ref[0]
@@ -294,7 +318,7 @@ def _bwd_kv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
             jnp.int32, (block_k, 1), 0)
         q_pos = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1)
-        st = jnp.where(k_pos > q_pos, NEG_INF, st)
+        st = jnp.where(k_pos > q_pos + shift, NEG_INF, st)
         lse_row = lse_ref[0, :1, :]                    # [1, bq] f32
         pt = jnp.exp(st - lse_row)
         dv_acc[...] = dv_acc[...] + jnp.dot(
@@ -312,7 +336,7 @@ def _bwd_kv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+def _bwd_q_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
                   dqt_ref, dqt_acc, *,
                   block_q: int, block_k: int, num_k: int, scale: float):
     # dQ for one q-block, accumulated over its visible K/V blocks — in the
@@ -320,12 +344,13 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
     # (dQ^T = K^T ds^T), un-transposed by XLA outside the kernel.
     iq = pl.program_id(1)
     jk = pl.program_id(2)
+    shift = shift_ref[0]
 
     @pl.when(jk == 0)
     def _init():
         dqt_acc[...] = jnp.zeros_like(dqt_acc)
 
-    @pl.when(jk * block_k <= iq * block_q + block_q - 1)
+    @pl.when(jk * block_k <= iq * block_q + block_q - 1 + shift)
     def _step():
         k = k_ref[0]
         v = v_ref[0]
@@ -338,7 +363,7 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
             jnp.int32, (block_k, 1), 0)
         q_pos = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1)
-        st = jnp.where(k_pos > q_pos, NEG_INF, st)
+        st = jnp.where(k_pos > q_pos + shift, NEG_INF, st)
         pt = jnp.exp(st - lse_ref[0, :1, :])
         dpt = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
@@ -354,6 +379,10 @@ def _bwd_q_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
 
 
 def _flash_backward(q, k, v, g, out, lse,
+                    shift=0,
+                    static_causal: bool = True,
+                    delta=None,
+                    grad_dtype=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
@@ -361,7 +390,14 @@ def _flash_backward(q, k, v, g, out, lse,
     probabilities recomputed per block from the forward's lse so the [T,T]
     matrix never leaves VMEM in either direction.  GQA-native like the
     forward: compact K/V heads, each dK/dV block accumulating over its
-    whole query-head group.  Returns (dq, dk, dv) in the input dtypes.
+    whole query-head group.
+
+    ``shift``/``static_causal`` follow _flash_forward: a traced shift (ring
+    hops) needs static_causal=False, which drops the pre-diagonal
+    index-map clamps (the copies stream; compute is still skipped).
+    ``delta`` (rowsum(dO*O), [B,H,T]) may be passed precomputed — ring
+    reuses one delta across hops — otherwise it is derived from ``out``.
+    Returns (dq, dk, dv) in ``grad_dtype`` (default: the input dtypes).
     """
     b, t, h, d = q.shape
     tk, kvh = k.shape[1], k.shape[2]
@@ -373,27 +409,27 @@ def _flash_backward(q, k, v, g, out, lse,
         # silently leave gradient rows uncovered, not just misperform
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"seq lens ({t}, {tk})")
+    _check_static_shift(static_causal, shift)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = d ** -0.5
     num_q, num_k = t // block_q, tk // block_k
     bh, bkv = b * h, b * kvh
+    shift_arr = jnp.asarray(shift, jnp.int32).reshape(1)
+    dq_dt = grad_dtype or q.dtype
+    dk_dt = grad_dtype or k.dtype
+    dv_dt = grad_dtype or v.dtype
 
-    def to_planes(x):
-        tt, hh = x.shape[1], x.shape[2]
-        return x.transpose(0, 2, 1, 3).reshape(b * hh, tt, d)
-
-    qp, kp, vp, gp = (to_planes(x) for x in (q, k, v, g))
+    qp, kp, vp, gp = (_to_planes(x) for x in (q, k, v, g))
     # delta_i = sum_d(dO_i * O_i); both it and lse ride the same [8, T]
     # sublane-broadcast tile layout the forward emits lse in, so the
     # kernels read them as [1, bq] rows with no relayout.
-    delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
-                       out.astype(jnp.float32)).reshape(bh, 1, t)
+    if delta is None:
+        delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
+                           out.astype(jnp.float32))
     lse_t = jnp.broadcast_to(lse.reshape(bh, 1, t), (bh, 8, t))
-    delta_t = jnp.broadcast_to(delta, (bh, 8, t))
-
-    def kv_plane(i):
-        return (i // h) * kvh + (i % h) // grp
+    delta_t = jnp.broadcast_to(
+        delta.astype(jnp.float32).reshape(bh, 1, t), (bh, 8, t))
 
     # --- dK/dV: grid over compact K/V planes; inner walks (group, q) ---
     num_inner = grp * num_q
@@ -401,21 +437,28 @@ def _flash_backward(q, k, v, g, out, lse,
     def qplane(bkvi, jk, inner):
         return ((bkvi // kvh) * h + (bkvi % kvh) * grp + inner // num_q)
 
-    def q_index(bkvi, jk, inner):
+    if static_causal:
         # clamp skipped pre-diagonal q-blocks onto the first contributor so
-        # the pipeline elides their copies (mirrors the forward's trick)
-        iq = jnp.maximum(inner % num_q, (jk * block_k) // block_q)
-        return (qplane(bkvi, jk, inner), iq, 0)
+        # the pipeline elides their copies (mirrors the forward's trick);
+        # only valid when shift <= 0 is promised at trace time
+        def q_block(jk, inner):
+            return jnp.maximum(inner % num_q, (jk * block_k) // block_q)
+    else:
+        def q_block(jk, inner):
+            return inner % num_q
+
+    def q_index(bkvi, jk, inner):
+        return (qplane(bkvi, jk, inner), q_block(jk, inner), 0)
 
     def row_index(bkvi, jk, inner):
-        iq = jnp.maximum(inner % num_q, (jk * block_k) // block_q)
-        return (qplane(bkvi, jk, inner), 0, iq)
+        return (qplane(bkvi, jk, inner), 0, q_block(jk, inner))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_kv_kernel, block_q=block_q, block_k=block_k,
                           num_q=num_q, num_inner=num_inner, scale=scale),
         grid=(bkv, num_k, num_inner),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
             pl.BlockSpec((1, block_q, d), q_index),
@@ -428,8 +471,8 @@ def _flash_backward(q, k, v, g, out, lse,
             pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bkv, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((bkv, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), dk_dt),
+            jax.ShapeDtypeStruct((bkv, tk, d), dv_dt),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -438,18 +481,23 @@ def _flash_backward(q, k, v, g, out, lse,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(kp, vp, qp, gp, lse_t, delta_t)
+    )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)
 
     # --- dQ: grid over query planes; inner walks visible K/V blocks ---
-    def kv_index(i, iq, jk):
-        last = (iq * block_q + block_q - 1) // block_k
-        return (kv_plane(i), jnp.minimum(jk, last), 0)
+    if static_causal:
+        def kv_index(i, iq, jk):
+            last = (iq * block_q + block_q - 1) // block_k
+            return (_kv_plane(i, h, kvh), jnp.minimum(jk, last), 0)
+    else:
+        def kv_index(i, iq, jk):
+            return (_kv_plane(i, h, kvh), jk, 0)
 
     dqt = pl.pallas_call(
         functools.partial(_bwd_q_kernel, block_q=block_q, block_k=block_k,
                           num_k=num_k, scale=scale),
         grid=(bh, num_q, num_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
@@ -465,9 +513,9 @@ def _flash_backward(q, k, v, g, out, lse,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(kp, vp, qp, gp, lse_t, delta_t)[0]
+    )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)[0]
 
-    dq = dqt.reshape(b, h, d, t).transpose(0, 3, 1, 2).astype(q.dtype)
+    dq = dqt.reshape(b, h, d, t).transpose(0, 3, 1, 2).astype(dq_dt)
     dk = dk.reshape(b, kvh, tk, d).transpose(0, 2, 1, 3)
     dv = dv.reshape(b, kvh, tk, d).transpose(0, 2, 1, 3)
     return dq, dk, dv

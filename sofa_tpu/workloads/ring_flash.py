@@ -32,8 +32,33 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from sofa_tpu.workloads.flash_pallas import _flash_forward, _grad_block
+from sofa_tpu.workloads.flash_pallas import (
+    _flash_backward,
+    _flash_forward,
+    _grad_block,
+)
 from sofa_tpu.workloads.ring_attention import NEG_INF
+
+# Tests pin this to force one implementation; None = auto (Pallas kernels
+# on TPU, the lax fallback elsewhere — interpreted Pallas is exact but
+# slow, and the CPU suite runs every ring test through the lax path).
+FORCE_PALLAS_BWD: Optional[bool] = None
+
+
+def _hop_grad(q, k, v, g, delta, lse, shift):
+    """Per-hop blockwise gradients with the hop's traced causal shift.
+
+    The fused Pallas backward (static_causal=False: no index-map clamps,
+    compute still skipped per block) on TPU; _grad_block's lax scan
+    elsewhere.  Both return f32 — the ring accumulates across hops.
+    """
+    use_pallas = (FORCE_PALLAS_BWD if FORCE_PALLAS_BWD is not None
+                  else jax.default_backend() == "tpu")
+    if use_pallas:
+        return _flash_backward(q, k, v, g, None, lse, shift=shift,
+                               static_causal=False, delta=delta,
+                               grad_dtype=jnp.float32)
+    return _grad_block(q, k, v, g, delta, lse, shift)
 
 
 def _hop_shift(i, r, n, t_local):
@@ -109,7 +134,7 @@ def _ring_bwd(axis_name, res, g):
     def hop(carry, i):
         dq, k_blk, v_blk, dk_acc, dv_acc = carry
         shift = _hop_shift(i, r, n, t)
-        dq_i, dk_i, dv_i = _grad_block(q, k_blk, v_blk, g, delta, lse, shift)
+        dq_i, dk_i, dv_i = _hop_grad(q, k_blk, v_blk, g, delta, lse, shift)
         dq = dq + dq_i
         dk_acc = dk_acc + dk_i
         dv_acc = dv_acc + dv_i
@@ -238,12 +263,12 @@ def _zz_bwd(axis_name, res, g):
         s_ll, s_hl, s_hh = _zigzag_hop_shifts(i, r, n, c)
         k_lo, k_hi = k_blk[:, :c], k_blk[:, c:]
         v_lo, v_hi = v_blk[:, :c], v_blk[:, c:]
-        dq_ll, dk_ll, dv_ll = _grad_block(q_lo, k_lo, v_lo, g_lo, d_lo,
-                                          l_lo, s_ll)
-        dq_hl, dk_hl, dv_hl = _grad_block(q_hi, k_lo, v_lo, g_hi, d_hi,
-                                          l_hi, s_hl)
-        dq_hh, dk_hh, dv_hh = _grad_block(q_hi, k_hi, v_hi, g_hi, d_hi,
-                                          l_hi, s_hh)
+        dq_ll, dk_ll, dv_ll = _hop_grad(q_lo, k_lo, v_lo, g_lo, d_lo,
+                                        l_lo, s_ll)
+        dq_hl, dk_hl, dv_hl = _hop_grad(q_hi, k_lo, v_lo, g_hi, d_hi,
+                                        l_hi, s_hl)
+        dq_hh, dk_hh, dv_hh = _hop_grad(q_hi, k_hi, v_hi, g_hi, d_hi,
+                                        l_hi, s_hh)
         dq = dq + jnp.concatenate([dq_ll, dq_hl + dq_hh], axis=1)
         dk_acc = dk_acc + jnp.concatenate([dk_ll + dk_hl, dk_hh], axis=1)
         dv_acc = dv_acc + jnp.concatenate([dv_ll + dv_hl, dv_hh], axis=1)

@@ -314,6 +314,43 @@ def test_ring_flash_attention_gqa_compact_kv():
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("variant", ["ring", "zigzag"])
+def test_ring_flash_grads_pallas_hop_backward(monkeypatch, variant):
+    """Ring and zigzag hops can run the fused Pallas backward with the
+    hop's TRACED causal shift (static_causal=False).  Forced on here (auto
+    only picks it on TPU) over a small interpreted ring, against plain
+    autodiff — zigzag exercises all three shift patterns, including the
+    sign-flipped hi-x-hi one."""
+    from sofa_tpu.workloads import ring_flash
+
+    monkeypatch.setattr(ring_flash, "FORCE_PALLAS_BWD", True)
+    key = jax.random.PRNGKey(10)
+    b, t, h, d = 2, 64, 2, 8
+    S = 2
+    mesh = make_mesh(("data", "seq", "model"), (2, S, 2), platform="cpu")
+    spec = NamedSharding(mesh, P("data", "seq", "model", None))
+    with jax.default_matmul_precision("highest"):
+        q, k, v = jax.random.normal(key, (3, b, t, h, d), jnp.float32)
+        if variant == "zigzag":
+            perm, inv = ring_flash.zigzag_indices(t, S)
+            qz, kz, vz = (jax.device_put(a[:, perm], spec)
+                          for a in (q, k, v))
+            gf = jax.grad(
+                lambda *a: (ring_flash.zigzag_ring_flash_attention(
+                    *a, mesh) ** 2).sum(), argnums=(0, 1, 2))(qz, kz, vz)
+            gf = tuple(np.asarray(a)[:, inv] for a in gf)
+        else:
+            qs, ks, vs = (jax.device_put(a, spec) for a in (q, k, v))
+            gf = jax.grad(
+                lambda *a: (ring_flash.ring_flash_attention(
+                    *a, mesh) ** 2).sum(), argnums=(0, 1, 2))(qs, ks, vs)
+        gp = jax.grad(lambda *a: (plain_causal_attention(*a) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-3)
+
+
 def test_ring_flash_attention_grads_match_plain():
     from sofa_tpu.workloads.ring_flash import ring_flash_attention
 
