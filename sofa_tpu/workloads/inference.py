@@ -66,15 +66,20 @@ def _attend_cache(q, k_cache, v_cache, cur_len):
     b, t, h, dh = q.shape
     kvh = k_cache.shape[2]
     rep = h // kvh
-    qg = q.reshape(b, t, kvh, rep, dh).astype(jnp.float32)
+    qg = q.reshape(b, t, kvh, rep, dh)
     scale = dh ** -0.5
-    s = jnp.einsum("btkrd,bskd->bkrts", qg,
-                   k_cache.astype(jnp.float32)) * scale
+    # Operands keep their storage dtype with f32 accumulation: an explicit
+    # astype(f32) on the cache both materializes a second full-cache copy
+    # in HBM (decode's whole cost IS reading the cache) and runs the MXU
+    # in f32 mode — the same ~4x penalty fixed in the flash kernel.
+    s = jnp.einsum("btkrd,bskd->bkrts", qg, k_cache,
+                   preferred_element_type=jnp.float32) * scale
     j = jnp.arange(k_cache.shape[1])[None, None, None, None, :]
     q_pos = cur_len + jnp.arange(t)[None, None, None, :, None]
     s = jnp.where(j > q_pos, NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkrts,bskd->btkrd", p, v_cache.astype(jnp.float32))
+    o = jnp.einsum("bkrts,bskd->btkrd", p.astype(v_cache.dtype), v_cache,
+                   preferred_element_type=jnp.float32)
     return o.reshape(b, t, h, dh).astype(q.dtype)
 
 
