@@ -100,10 +100,16 @@ def param_specs(cfg: MoEConfig) -> Dict[str, Any]:
 
 
 def _dispatch_tensors(logits, n_experts: int, capacity: int):
-    """Top-1 routing -> (dispatch [N,E,C] one-hot, combine [N,E,C], aux).
+    """Top-1 routing -> (dispatch [N,E,C] one-hot, combine [N,E,C],
+    gate [N] f32, aux).
 
     Position of each token inside its expert's buffer is its rank among
     same-expert tokens (cumsum); ranks >= capacity are dropped.
+    combine == dispatch * gate[n] — callers wanting MXU-friendly precision
+    use the factorized form: the {0,1} dispatch is exact in bf16, so the
+    return gather runs in storage dtype and the gate (a softmax
+    probability, NOT exactly representable in bf16) applies afterwards as
+    an f32 per-token scale.
     """
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [N, E]
     expert = jnp.argmax(probs, axis=-1)                           # [N]
@@ -118,7 +124,7 @@ def _dispatch_tensors(logits, n_experts: int, capacity: int):
     # Switch aux loss: E * sum_e(fraction_dispatched_e * mean_prob_e).
     frac = onehot.mean(axis=0)
     aux = n_experts * jnp.sum(frac * probs.mean(axis=0))
-    return dispatch, combine, aux
+    return dispatch, combine, gate, aux
 
 
 def _expert_ffn(xs, w_up, w_down, dtype, upcast: bool = False):
@@ -141,6 +147,18 @@ def _expert_ffn(xs, w_up, w_down, dtype, upcast: bool = False):
                       preferred_element_type=jnp.float32)
 
 
+def _gather_dtype(cfg: MoEConfig, upcast: bool):
+    """Dtype for the dispatch/combine contractions.
+
+    The dispatch one-hot is exactly representable in bf16, so on TPU the
+    token-gather matmul runs the MXU in native bf16 mode with f32
+    accumulation — these contractions are ~N^2-scale flops at pod batch
+    sizes, the same 4x f32-mode penalty the flash kernel fixed.  CPU
+    (upcast) keeps f32: XLA:CPU rejects bf16 batched dots.
+    """
+    return jnp.float32 if upcast else cfg.dtype
+
+
 def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig,
                   upcast: bool = False):
     """Single-device reference: every expert runs on every token's slot.
@@ -150,16 +168,21 @@ def moe_ffn_dense(x, router_w, w_up, w_down, cfg: MoEConfig,
     """
     n = x.shape[0]
     capacity = _capacity(n, cfg)
+    gdt = _gather_dtype(cfg, upcast)
     logits = x.astype(jnp.float32) @ router_w                      # [N, E]
-    dispatch, combine, aux = _dispatch_tensors(logits, cfg.n_experts,
+    dispatch, _, gate, aux = _dispatch_tensors(logits, cfg.n_experts,
                                                capacity)
-    xs = jnp.einsum("nec,nd->ecd", dispatch,
-                    x.astype(jnp.float32)).astype(cfg.dtype)       # [E, C, D]
+    xs = jnp.einsum("nec,nd->ecd", dispatch.astype(gdt), x.astype(gdt),
+                    preferred_element_type=jnp.float32
+                    ).astype(cfg.dtype)                            # [E, C, D]
     # Round-trip through cfg.dtype exactly like the expert-parallel path
     # does at its return all-to-all, so the two paths stay bit-identical.
     ys = _expert_ffn(xs, w_up, w_down, cfg.dtype,
                      upcast=upcast).astype(cfg.dtype)
-    out = jnp.einsum("nec,ecd->nd", combine, ys.astype(jnp.float32))
+    # factorized combine: exact {0,1} gather in storage dtype, then the
+    # f32 gate scale — full gate precision at bf16 gather speed
+    out = jnp.einsum("nec,ecd->nd", dispatch.astype(gdt), ys.astype(gdt),
+                     preferred_element_type=jnp.float32) * gate[:, None]
     return out.astype(x.dtype), aux
 
 
@@ -175,14 +198,16 @@ def moe_ffn_expert_parallel(x, router_w, w_up, w_down, cfg: MoEConfig,
     e_local = w_up.shape[0]
     n_local, d = x.shape
     capacity = _capacity(n_local, cfg)
+    gdt = _gather_dtype(cfg, upcast)
     logits = x.astype(jnp.float32) @ router_w
-    dispatch, combine, aux = _dispatch_tensors(logits, cfg.n_experts,
+    dispatch, _, gate, aux = _dispatch_tensors(logits, cfg.n_experts,
                                                capacity)
-    # Dispatch math stays f32 (one-hot sums), but the dispatched slots ride
+    # Routing math stays f32 (one-hot sums); the gather contraction runs
+    # in storage dtype (see _gather_dtype) and the dispatched slots ride
     # the wire and the MXU in cfg.dtype — the ICI byte counts a profiled
     # run observes are the real bf16 deployment numbers.
-    xs = jnp.einsum("nec,nd->ecd", dispatch,
-                    x.astype(jnp.float32)).astype(cfg.dtype)
+    xs = jnp.einsum("nec,nd->ecd", dispatch.astype(gdt), x.astype(gdt),
+                    preferred_element_type=jnp.float32).astype(cfg.dtype)
     # [E, C, D] -> [S, E_local, C, D]; all_to_all swaps the shard dim for
     # the token-source dim, landing every token on its expert's chip.
     xs = xs.reshape(shards, e_local, capacity, d)
@@ -192,8 +217,10 @@ def moe_ffn_expert_parallel(x, router_w, w_up, w_down, cfg: MoEConfig,
                      upcast=upcast).astype(cfg.dtype)
     ys = lax.all_to_all(ys, axis_name, split_axis=0, concat_axis=0,
                         tiled=False)                   # [S, E_local, C, D]
-    ys = ys.reshape(cfg.n_experts, capacity, d).astype(jnp.float32)
-    out = jnp.einsum("nec,ecd->nd", combine, ys)
+    ys = ys.reshape(cfg.n_experts, capacity, d)
+    # factorized combine (see moe_ffn_dense): exact bf16 gather, f32 gate
+    out = jnp.einsum("nec,ecd->nd", dispatch.astype(gdt), ys.astype(gdt),
+                     preferred_element_type=jnp.float32) * gate[:, None]
     # Per-device aux averaged across shards — the actual Switch/GShard
     # formulation (each device balances its own batch).  This is a
     # different statistic from the dense path's global-batch aux, so the

@@ -325,3 +325,39 @@ def test_cpu_fallback_evidence_parses_child_json(monkeypatch):
 
     monkeypatch.setenv("SOFA_BENCH_CPU_FALLBACK", "0")
     assert bench._cpu_fallback_evidence() == {}
+
+
+def test_perf_evidence_merge_preserves_onchip_section(monkeypatch):
+    """tools/perf_evidence.py owns ONLY the off-chip section; the
+    hand-written on-chip evidence above it survives regeneration (a
+    whole-file rewrite once deleted it)."""
+    import os
+
+    monkeypatch.syspath_prepend(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import perf_evidence as mod
+
+    onchip = ("# Performance evidence\n\n## On-chip (TPU)\n\n"
+              "- headline overhead 0.0 %\n\n")
+    old = onchip + "## Off-chip performance evidence\n\nold table\n"
+    new_section = "## Off-chip performance evidence\n\nnew table\n"
+    merged = mod.merge_evidence(old, new_section)
+    assert merged == onchip + new_section
+    # no prior file / empty file: a fresh document gets the title
+    assert mod.merge_evidence("", new_section).startswith(
+        "# Performance evidence")
+    # a file with no off-chip heading keeps all its content
+    assert mod.merge_evidence("# custom notes\n", new_section).startswith(
+        "# custom notes")
+    # prose MENTIONING the heading text must not truncate the document
+    mention = (onchip.rstrip() + "\nsee the ## Off-chip performance "
+               "evidence table below\n\n")
+    merged = mod.merge_evidence(
+        mention + "## Off-chip performance evidence\n\nold\n", new_section)
+    assert merged == mention + new_section
+    # hand-written sections AFTER the off-chip table survive regeneration
+    appendix = "## Appendix\n\nnotes\n"
+    merged = mod.merge_evidence(
+        onchip + "## Off-chip performance evidence\n\nold\n\n" + appendix,
+        new_section)
+    assert merged == onchip + new_section.rstrip() + "\n\n" + appendix

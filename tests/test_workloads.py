@@ -431,9 +431,13 @@ def test_moe_capacity_drops_tokens():
 
     # 6 tokens all preferring expert 0 with capacity 2: 4 dropped.
     logits = jnp.array([[5.0, 0.0]] * 6, jnp.float32)
-    dispatch, combine, aux = _dispatch_tensors(logits, 2, 2)
+    dispatch, combine, gate, aux = _dispatch_tensors(logits, 2, 2)
     assert float(dispatch.sum()) == 2.0
     assert float(aux) > 0
+    # combine factorizes as dispatch * gate[n] — the identity the bf16
+    # gather + f32 gate-scale execution path relies on
+    np.testing.assert_allclose(np.asarray(combine),
+                               np.asarray(dispatch * gate[:, None, None]))
 
 
 def test_pipeline_matches_unpipelined():

@@ -118,22 +118,58 @@ def _measure(workdir: str) -> int:
 
     stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
     out_path = os.path.join(ROOT, "PERF_EVIDENCE.md")
+    section = [
+        "## Off-chip performance evidence\n\n",
+        f"Measured {stamp} by `python tools/perf_evidence.py` "
+        "(best of 3) on the synthetic 8-device x 200k-op capture "
+        "(`tools/pod_synth.py`; 1.6M HLO events).  Regenerate "
+        "anytime — this section is tool-owned, never hand-edited.\n\n",
+        "| Path | best-of-3 wall time |\n|---|---|\n",
+    ]
+    section += [f"| {label} | {dt:.2f} s |\n" for label, dt in rows]
+    section.append(
+        "\nOther evidence paths: `python bench.py` (on-chip paired "
+        "overhead + HLO coverage guard), `python tools/"
+        "validate_tpu.py` (on-chip checklist), `python -m pytest "
+        "tests/test_native_scan.py` (ingest scanner equivalence + "
+        "fuzz), `python __graft_entry__.py 8` (multichip dryrun).\n")
+    try:
+        with open(out_path) as f:
+            existing = f.read()
+    except OSError:
+        existing = ""
     with open(out_path, "w") as f:
-        f.write("# Off-chip performance evidence\n\n")
-        f.write(f"Measured {stamp} by `python tools/perf_evidence.py` "
-                "(best of 3) on the synthetic 8-device x 200k-op capture "
-                "(`tools/pod_synth.py`; 1.6M HLO events).  Regenerate "
-                "anytime — the table is not hand-edited.\n\n")
-        f.write("| Path | best-of-3 wall time |\n|---|---|\n")
-        for label, dt in rows:
-            f.write(f"| {label} | {dt:.2f} s |\n")
-        f.write("\nOther evidence paths: `python bench.py` (on-chip paired "
-                "overhead + HLO coverage guard), `python tools/"
-                "validate_tpu.py` (on-chip checklist), `python -m pytest "
-                "tests/test_native_scan.py` (ingest scanner equivalence + "
-                "fuzz), `python __graft_entry__.py 8` (multichip dryrun).\n")
+        f.write(merge_evidence(existing, "".join(section)))
     print(f"wrote {out_path}")
     return 0
+
+
+def merge_evidence(existing: str, off_chip_section: str) -> str:
+    """Replace only the tool-owned off-chip section of PERF_EVIDENCE.md.
+
+    Hand-written content before the '## Off-chip performance evidence'
+    heading AND any '## ...' sections after it are preserved verbatim —
+    the heading must sit at a line start, so prose merely *mentioning* it
+    can't truncate the document.  (A whole-file rewrite here once deleted
+    the committed on-chip section.)
+    """
+    marker = "## Off-chip performance evidence"
+    if existing.startswith(marker):
+        idx = 0
+    else:
+        at = existing.find("\n" + marker)
+        idx = at + 1 if at >= 0 else -1
+    if idx < 0:
+        head = (existing.rstrip() + "\n\n" if existing.strip()
+                else "# Performance evidence\n\n")
+        return head + off_chip_section
+    head = existing[:idx]
+    # later hand-written sections survive regeneration too
+    nxt = existing.find("\n## ", idx + len(marker))
+    tail = existing[nxt + 1:] if nxt >= 0 else ""
+    if tail:
+        return head + off_chip_section.rstrip() + "\n\n" + tail
+    return head + off_chip_section
 
 
 if __name__ == "__main__":
