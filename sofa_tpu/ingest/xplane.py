@@ -237,9 +237,41 @@ def _resolve_event_meta(em, sm, metadata_id: int, cache: Dict[int, tuple]):
         disp = (meta.display_name
                 if meta is not None and meta.display_name else name)
         md = _event_stats(meta, sm) if meta is not None else {}
+        disp = _enrich_custom_call(name, disp, md)
         r = (name, disp, md)
         cache[metadata_id] = r
     return r
+
+
+_CUSTOM_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+
+
+def _enrich_custom_call(name: str, disp: str, md: Dict) -> str:
+    """Readable display names for custom-call ops.
+
+    Real captures (v2 fixture) show every custom call as an opaque
+    "custom-call.N" / "closed_call.N": Pallas kernels — the hottest
+    hand-written ops — were unattributable in top-ops and the board.  The
+    HLO text carries the target, and Mosaic calls carry the launching
+    Python line in their `source` stat, so:
+
+      tpu_custom_call + source -> "pallas@transformer.py:249"
+      AllocateBuffer          -> "AllocateBuffer" (grouped, not per-instr)
+
+    Applied at the shared per-metadata cache so the native-scanner and
+    pure-Python paths stay row-identical.
+    """
+    if "custom-call" not in name:
+        return disp
+    m = _CUSTOM_TARGET_RE.search(name)
+    if not m:
+        return disp
+    target = m.group(1)
+    if target == "tpu_custom_call":
+        src = str(md.get("source", "") or "")
+        return ("pallas@" + src.rsplit("/", 1)[-1]) if src else \
+            ("pallas:" + disp)
+    return target
 
 
 def _iter_line_events(plane, line) -> Iterable[Tuple[str, str, int, int, Dict]]:

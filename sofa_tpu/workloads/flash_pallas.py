@@ -198,6 +198,20 @@ def _flash_forward(
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
         scale=scale)
+    # XLA's cost model cannot see inside a Mosaic kernel: without this the
+    # trace reports flops=0/bytes=0 for exactly the hottest op and the
+    # roofline/top-ops passes undercount it (observed on the real v2
+    # fixture).  Causal halves the work when promised at trace time; a
+    # dynamic ring-hop shift can be anything, so it reports the full-block
+    # upper bound.  bytes = operand + result HBM traffic (causal elision
+    # makes it an upper bound too).
+    frac = 0.5 if static_causal else 1.0
+    cost = pl.CostEstimate(
+        flops=int(4 * b * h * t * tk * d * frac),
+        transcendentals=int(b * h * t * tk * frac),
+        bytes_accessed=int(qp.size * qp.dtype.itemsize * 2
+                           + (kp.size + vp.size) * kp.dtype.itemsize
+                           + b * h * t * 4))
 
     if static_causal:
         def kv_index(bh, iq, ik):
@@ -230,6 +244,8 @@ def _flash_forward(
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=cost,
+        name="sofa_flash_fwd",
         interpret=interpret,
     )(shift, qp, kp, vp)
     return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
@@ -453,6 +469,12 @@ def _flash_backward(q, k, v, g, out, lse,
     def row_index(bkvi, jk, inner):
         return (qplane(bkvi, jk, inner), 0, q_block(jk, inner))
 
+    # cost estimates mirror the forward's rationale (flops=0 otherwise):
+    # the dK/dV kernel runs 4 MXU matmuls per visible block pair, dQ 3.
+    frac = 0.5 if static_causal else 1.0
+    kv_bytes = int((kp.size + vp.size) * kp.dtype.itemsize * 2
+                   + (qp.size + gp.size) * qp.dtype.itemsize
+                   + 2 * bh * t * 4)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_kv_kernel, block_q=block_q, block_k=block_k,
                           num_q=num_q, num_inner=num_inner, scale=scale),
@@ -480,6 +502,11 @@ def _flash_backward(q, k, v, g, out, lse,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(8 * b * h * t * tk * d * frac),
+            transcendentals=int(b * h * t * tk * frac),
+            bytes_accessed=kv_bytes),
+        name="sofa_flash_bwd_kv",
         interpret=interpret,
     )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)
 
@@ -512,6 +539,15 @@ def _flash_backward(q, k, v, g, out, lse,
         scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=int(6 * b * h * t * tk * d * frac),
+            transcendentals=int(b * h * t * tk * frac),
+            # reads K/V/Q/dO + lse/delta; writes the f32 dQ^T output
+            bytes_accessed=int(
+                (kp.size + vp.size) * kp.dtype.itemsize
+                + (qp.size + gp.size) * qp.dtype.itemsize
+                + 2 * bh * t * 4 + bh * t * d * 4)),
+        name="sofa_flash_bwd_dq",
         interpret=interpret,
     )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)[0]
 

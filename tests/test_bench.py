@@ -361,3 +361,10 @@ def test_perf_evidence_merge_preserves_onchip_section(monkeypatch):
         onchip + "## Off-chip performance evidence\n\nold\n\n" + appendix,
         new_section)
     assert merged == onchip + new_section.rstrip() + "\n\n" + appendix
+    # an ARCHIVED heading that merely starts with the text is hand-written
+    archived = ("## Off-chip performance evidence (2026-06, archived)\n\n"
+                "old history\n\n")
+    merged = mod.merge_evidence(
+        onchip + archived + "## Off-chip performance evidence\n\nlive\n",
+        new_section)
+    assert merged == onchip + archived + new_section

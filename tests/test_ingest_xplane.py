@@ -95,6 +95,31 @@ def test_marker_offset():
     assert off == MARKER_UNIX_NS - SESSION_MARKER_NS
 
 
+def test_custom_call_display_enrichment():
+    """Opaque custom calls get readable, groupable names: Mosaic (Pallas)
+    kernels attribute to their launching Python line via the `source`
+    stat; runtime allocs group under their target."""
+    xs = build_xspace()
+    dev = xs.planes[1]
+    oline = dev.lines[2]
+    _add_event(dev, oline,
+               '%closed_call.6 = bf16[8]{0} custom-call(), '
+               'custom_call_target="tpu_custom_call"',
+               2_950_000, 10_000, "closed_call.6",
+               mstats=[("hlo_category", "custom-call"),
+                       ("source", "/repo/sofa_tpu/workloads/x.py:42")])
+    _add_event(dev, oline,
+               '%custom-call.9 = f32[4]{0} custom-call(), '
+               'custom_call_target="AllocateBuffer"',
+               2_960_000, 1_000, "custom-call.9",
+               mstats=[("hlo_category", "custom-call")])
+    frames = xspace_to_frames(xs, TIME_BASE)
+    names = set(frames["tputrace"]["name"])
+    assert "pallas@x.py:42" in names
+    assert "AllocateBuffer" in names
+    assert "closed_call.6" not in names and "custom-call.9" not in names
+
+
 def test_marker_offsets_start_and_stop():
     """api.profile emits start AND stop markers; all are returned sorted by
     session time and alignment anchors on the earliest."""

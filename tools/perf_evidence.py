@@ -153,12 +153,14 @@ def merge_evidence(existing: str, off_chip_section: str) -> str:
     can't truncate the document.  (A whole-file rewrite here once deleted
     the committed on-chip section.)
     """
+    import re
+
+    # full-line anchor: a hand-written heading that merely STARTS with the
+    # text (e.g. "## Off-chip performance evidence (archived)") is not the
+    # tool-owned section
+    m = re.search(r"(?m)^## Off-chip performance evidence[ \t]*$", existing)
     marker = "## Off-chip performance evidence"
-    if existing.startswith(marker):
-        idx = 0
-    else:
-        at = existing.find("\n" + marker)
-        idx = at + 1 if at >= 0 else -1
+    idx = m.start() if m else -1
     if idx < 0:
         head = (existing.rstrip() + "\n\n" if existing.strip()
                 else "# Performance evidence\n\n")
