@@ -65,6 +65,15 @@ class TransformerConfig:
     # Requires flash; sequences are permuted at the embedding and
     # un-permuted before the LM head.
     zigzag: bool = False
+    # Rematerialize each layer in the backward pass (jax.checkpoint on the
+    # scanned layer body): live activation memory drops from O(L*T*D) to
+    # one layer's worth + residuals, at ~1 forward replay of FLOPs — the
+    # standard trade for long-context / large-batch training.  `remat`
+    # turns it on; `remat_policy` names a jax.checkpoint_policies entry
+    # (e.g. "dots_with_no_batch_dims_saveable" keeps matmul outputs and
+    # replays only the cheap elementwise work).
+    remat: bool = False
+    remat_policy: Optional[str] = None
 
     @property
     def d_head(self) -> int:
@@ -259,6 +268,15 @@ def forward(params, tokens, cfg: TransformerConfig,
                 x, NamedSharding(mesh, P("data", "seq", None)))
         return x, None
 
+    if cfg.remat or cfg.remat_policy:
+        # a named policy implies remat — a policy with remat=False would
+        # silently train without checkpointing (OOM surprise at scale)
+        policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                  if cfg.remat_policy else None)
+        # checkpoint the scanned body: the classic scan-over-remat-layer —
+        # backward holds one layer's activations and replays the rest
+        layer = jax.checkpoint(layer, policy=policy,
+                               prevent_cse=False)
     x, _ = lax.scan(layer, x, params["layers"])
     if use_zigzag:
         x = x[:, inv_perm]

@@ -240,6 +240,38 @@ def test_transformer_train_step_runs_and_descends():
     assert losses[-1] < losses[0]
 
 
+def test_transformer_remat_matches_no_remat():
+    """jax.checkpoint on the scanned layer must not change loss or grads —
+    it only changes WHEN activations are (re)computed.  Covers both the
+    bare policy and a named jax.checkpoint_policies entry, on a mesh so
+    remat composes with sharding constraints."""
+    import dataclasses
+
+    from sofa_tpu.workloads.transformer import loss_fn
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(seq=32),
+                              dtype=jnp.float32)
+    mesh = make_mesh(("data", "seq", "model"), (2, 2, 2), platform="cpu")
+    params, _, _, tokens = build(cfg, mesh, batch=4, seq=32)
+
+    def loss_of(c):
+        return jax.jit(lambda p, t: loss_fn(p, t, c, mesh))
+
+    with jax.default_matmul_precision("highest"):
+        base, gbase = jax.value_and_grad(loss_of(cfg))(params, tokens)
+        for kwargs in ({"remat": True},
+                       {"remat": True,
+                        "remat_policy": "dots_with_no_batch_dims_saveable"}):
+            c = dataclasses.replace(cfg, **kwargs)
+            val, grad = jax.value_and_grad(loss_of(c))(params, tokens)
+            np.testing.assert_allclose(float(val), float(base),
+                                       rtol=1e-6, atol=1e-6)
+            jax.tree.map(
+                lambda a, b_: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-5),
+                grad, gbase)
+
+
 def test_transformer_fsdp_sharding_runs():
     cfg = TransformerConfig.tiny(seq=32)
     mesh = make_mesh(("data", "seq", "model"), (2, 2, 2), platform="cpu")
