@@ -20,6 +20,7 @@ sequence parallelism does not apply at decode (T=1 per step).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -131,18 +132,64 @@ def decode_step(params, token, cache, cur_len, cfg: TransformerConfig):
     return logits[:, 0], cache
 
 
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    """Decode-time sampling.  All fields are trace-time constants, so each
+    combination compiles its own (fully static) decode scan — TPU-friendly:
+    no data-dependent control flow, top-k via lax.top_k threshold, nucleus
+    via one sort.
+
+    temperature 0.0 = greedy (the deterministic default everywhere);
+    top_k 0 = unrestricted; top_p 1.0 = nucleus off.
+    """
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+GREEDY = SampleConfig()
+
+
+def sample_token(logits, key, sc: SampleConfig):
+    """Next-token choice from [B, vocab] f32 logits under ``sc``."""
+    if sc.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    l = logits / sc.temperature
+    if sc.top_k > 0:
+        kth = lax.top_k(l, sc.top_k)[0][:, -1:]         # [B, 1]
+        l = jnp.where(l < kth, NEG_INF, l)
+    if sc.top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the first token always survives).
+        # Dropped entries become +inf so the min yields the smallest KEPT
+        # logit — always finite, since position 0 is never dropped.
+        sorted_l = jnp.sort(l, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_mask = (cum - probs) >= sc.top_p          # drop after mass
+        cut = jnp.where(cutoff_mask, jnp.inf, sorted_l).min(
+            axis=-1, keepdims=True)
+        l = jnp.where(l < cut, NEG_INF, l)
+    return jax.random.categorical(key, l, axis=-1)
+
+
 def decode_loop(params, first_tok, cache, t_prompt: int, max_new: int,
-                cfg: TransformerConfig) -> jax.Array:
-    """Greedy scan from the first generated token: returns [B, max_new].
+                cfg: TransformerConfig,
+                sample: SampleConfig = GREEDY,
+                key: Optional[jax.Array] = None) -> jax.Array:
+    """Sampled/greedy scan from the first generated token: [B, max_new].
 
     Runs max_new - 1 decode steps (the first new token came from prefill;
     the token produced by the final step would be position max_new + 1 and
-    is never computed)."""
+    is never computed).  The PRNG key splits per step inside the scan."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     def step(carry, i):
         tok, cache = carry
         logits, cache = decode_step(params, tok, cache, t_prompt + i, cfg)
-        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        nxt = sample_token(logits, jax.random.fold_in(key, i),
+                           sample).astype(tok.dtype)
         return (nxt, cache), nxt
 
     (_, _), toks = lax.scan(step, (first_tok, cache),
@@ -151,20 +198,30 @@ def decode_loop(params, first_tok, cache, t_prompt: int, max_new: int,
 
 
 def generate(params, prompt, max_new: int, cfg: TransformerConfig,
-             mesh: Optional[Mesh] = None) -> jax.Array:
-    """Greedy decode: [B, T_prompt] -> [B, T_prompt + max_new].
+             mesh: Optional[Mesh] = None,
+             sample: SampleConfig = GREEDY,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Decode: [B, T_prompt] -> [B, T_prompt + max_new].
 
-    jit-able end to end; the decode loop is one lax.scan.
+    Greedy (deterministic) by default; pass a SampleConfig for
+    temperature / top-k / nucleus sampling, with a PRNG key for
+    reproducibility.  jit-able end to end; the decode loop is one
+    lax.scan.
     """
     b, t_prompt = prompt.shape
     if t_prompt + max_new > cfg.max_seq:
         raise ValueError(f"{t_prompt} + {max_new} exceeds max_seq "
                          f"{cfg.max_seq}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
     cache = init_cache(cfg, b, mesh)
     logits, cache = prefill(params, prompt, cache, cfg)
-    next_tok = jnp.argmax(logits[:, t_prompt - 1], axis=-1).astype(
-        prompt.dtype)
-    new = decode_loop(params, next_tok, cache, t_prompt, max_new, cfg)
+    # fold_in(max_new): disjoint from the decode steps' 0..max_new-2
+    first = sample_token(logits[:, t_prompt - 1].astype(jnp.float32),
+                         jax.random.fold_in(key, max_new), sample)
+    next_tok = first.astype(prompt.dtype)
+    new = decode_loop(params, next_tok, cache, t_prompt, max_new, cfg,
+                      sample, key)
     return jnp.concatenate([prompt, new], axis=1)
 
 

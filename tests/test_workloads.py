@@ -414,6 +414,75 @@ def test_transformer_ring_flash_train_step():
     assert np.isfinite(float(loss))
 
 
+def test_generate_sampling_modes():
+    """Sampling semantics: temperature 0 == greedy exactly; top_k=1 is
+    greedy at any temperature; a fixed key is reproducible and different
+    keys explore; nucleus with tiny top_p collapses to near-greedy."""
+    import dataclasses
+
+    from sofa_tpu.workloads import inference
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(seq=64),
+                              dtype=jnp.float32)
+    key = jax.random.PRNGKey(3)
+    params = init_params(cfg, key)
+    prompt = jax.random.randint(key, (2, 8), 0, cfg.vocab)
+    greedy = inference.generate(params, prompt, 12, cfg)
+
+    t0 = inference.generate(params, prompt, 12, cfg,
+                            sample=inference.SampleConfig(temperature=0.0),
+                            key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(t0), np.asarray(greedy))
+
+    k1 = inference.generate(
+        params, prompt, 12, cfg,
+        sample=inference.SampleConfig(temperature=5.0, top_k=1),
+        key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    sc = inference.SampleConfig(temperature=1.0)
+    a = inference.generate(params, prompt, 12, cfg, sample=sc,
+                           key=jax.random.PRNGKey(7))
+    b_ = inference.generate(params, prompt, 12, cfg, sample=sc,
+                            key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    diffs = sum(
+        int((np.asarray(inference.generate(
+            params, prompt, 12, cfg, sample=sc,
+            key=jax.random.PRNGKey(100 + i))) != np.asarray(a)).any())
+        for i in range(3))
+    assert diffs > 0, "three different keys all produced identical samples"
+
+    # an untrained model's next-token distribution is near-uniform, so a
+    # tiny nucleus keeps only the (near-)argmax token
+    tiny = inference.generate(
+        params, prompt, 12, cfg,
+        sample=inference.SampleConfig(temperature=1.0, top_p=1e-6),
+        key=jax.random.PRNGKey(7))
+    assert (np.asarray(tiny) == np.asarray(greedy)).mean() > 0.9
+
+
+def test_sample_token_nucleus_mid_range():
+    """top_p must carve the actual nucleus: probs [.5,.3,.15,.05] at
+    top_p=0.9 keeps exactly tokens {0,1,2} — never the tail, and more than
+    one distinct token across keys (the regression mode was collapsing to
+    pure greedy whenever any token was dropped)."""
+    from sofa_tpu.workloads.inference import SampleConfig, sample_token
+
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+    sc = SampleConfig(temperature=1.0, top_p=0.9)
+    seen = {int(sample_token(logits, jax.random.PRNGKey(i), sc)[0])
+            for i in range(40)}
+    assert seen <= {0, 1, 2}, f"tail token sampled: {seen}"
+    assert len(seen) > 1, "nucleus collapsed to greedy"
+    # top_p big enough to keep everything restricts nothing
+    seen_all = {int(sample_token(logits, jax.random.PRNGKey(i),
+                                 SampleConfig(temperature=1.0,
+                                              top_p=0.999))[0])
+                for i in range(80)}
+    assert 3 in seen_all, "full-mass nucleus should reach the tail"
+
+
 def test_moe_expert_parallel_matches_dense():
     import dataclasses
 
