@@ -10,8 +10,10 @@ TPU-first shape discipline: the cache is a static [L, B, max_seq, KVH, Dh]
 buffer, decode positions are masked (`j > cur_len` -> NEG_INF) instead of
 sliced, prefill is one full forward pass that also emits per-layer K/V,
 and the decode loop is a single `lax.scan` (one compiled step, N
-iterations).  Sampling is greedy argmax so runs are deterministic and the
-step-vs-full-forward equivalence is testable.
+iterations).  Sampling defaults to greedy argmax so runs are deterministic
+and the step-vs-full-forward equivalence is testable; a SampleConfig adds
+temperature / top-k / nucleus sampling with a per-step-folded PRNG key
+(trace-time constants — the compiled scan stays fully static).
 
 Tensor parallelism composes: with a mesh, the cache shards over "model"
 (the KV heads) and batch over "data", matching transformer.param_specs;

@@ -41,6 +41,10 @@ class PipelineConfig:
     n_microbatches: int = 4
     max_seq: int = 512
     dtype: Any = jnp.bfloat16
+    # jax.checkpoint per layer (see transformer.TransformerConfig.remat):
+    # pipeline stages additionally keep one activation per in-flight
+    # microbatch, so the remat trade is per (stage, microbatch)
+    remat: bool = False
 
     @property
     def d_head(self) -> int:
@@ -114,6 +118,8 @@ def _stage(x, stage_layers, cfg: PipelineConfig, positions):
     def body(x, lp):
         return _layer(x, lp, cfg, positions), None
 
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
     x, _ = lax.scan(body, x, stage_layers)
     return x
 
