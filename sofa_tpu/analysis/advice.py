@@ -163,6 +163,20 @@ def generate_hints(features: Features, cfg) -> List[str]:
                 f" {100.0 - gap:.0f}% of step time — {cause}"
                 " (see tpu_input_pipeline.csv)")
 
+    unattr = get("tpu_customcall_unattributed_time")
+    if unattr:
+        op_total = sum(v for _, v in features.by_regex(r"tpu\d+_op_time"))
+        if op_total and unattr > 0.05 * op_total:
+            hints.append(
+                f"unattributed kernel time: custom-call ops take "
+                f"{unattr / op_total:.0%} of device time but carry no "
+                "flops/bytes metadata — XLA cannot cost hand-written "
+                "(Mosaic/Pallas) kernels, so the roofline and top-ops "
+                "flops undercount exactly the hottest ops; annotate "
+                "pallas_call with name= and pl.CostEstimate "
+                "(docs/KERNELS.md)"
+            )
+
     skew = get("step_skew_mean")
     step_mean = get("step_time_mean") or get("aisi_step_time_mean")
     if skew is not None and step_mean and skew > 0.05 * step_mean:

@@ -79,6 +79,21 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         features.add(f"hlo_time_{_slug(name)}", float(value))
     cat.to_csv(cfg.path("tpu_categories.csv"))
 
+    # Pallas-kernel time with no cost metadata: XLA cannot see inside
+    # Mosaic kernels, so un-annotated ones report flops=0/bytes=0 and
+    # vanish from the roofline/top-ops accounting exactly when they are
+    # the hottest ops.  Positive match on the ingest's Mosaic naming
+    # (pallas@file:line / pallas:...) so host callbacks and runtime
+    # markers (AllocateBuffer) can't draw inapplicable advice; a
+    # bytes-annotated memory-bound kernel (flops=0 by design) is already
+    # attributed.  Feeds the pl.CostEstimate advice rule.
+    unattr = sync[sync["name"].str.startswith("pallas")
+                  & (sync["flops"] <= 0)
+                  & (sync["bytes_accessed"] <= 0)]
+    if len(unattr):
+        features.add("tpu_customcall_unattributed_time",
+                     float(unattr["duration"].sum()))
+
     # Per-module (jit function) totals.
     mods = frames.get("tpumodules")
     if mods is not None and not mods.empty:

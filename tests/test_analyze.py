@@ -409,6 +409,51 @@ def test_hint_rules():
     assert "I/O-wait" in text
 
 
+def test_hint_unattributed_custom_kernels():
+    """Custom-call time with flops=0 above 5% of device time advises
+    pl.CostEstimate; attributed or negligible custom time stays silent."""
+    f = Features()
+    f.add("tpu0_op_time", 10.0)
+    f.add("tpu_customcall_unattributed_time", 2.0)
+    text = " ".join(advice.generate_hints(f, SofaConfig()))
+    assert "CostEstimate" in text and "20%" in text
+
+    quiet = Features()
+    quiet.add("tpu0_op_time", 10.0)
+    quiet.add("tpu_customcall_unattributed_time", 0.2)  # 2% < threshold
+    assert "CostEstimate" not in " ".join(
+        advice.generate_hints(quiet, SofaConfig()))
+
+
+def test_tpu_profile_unattributed_feature(cfg):
+    """The feature counts zero-cost Mosaic (pallas-named) kernels only:
+    not annotated kernels (flops or bytes present), not host callbacks or
+    alloc markers (no pallas name)."""
+    from sofa_tpu.analysis.tpu import tpu_profile
+
+    rows = [
+        # unattributed Mosaic kernels: counted
+        dict(name="pallas@x.py:1", flops=0.0, duration=0.5),
+        dict(name="pallas:closed_call.2", flops=0.0, duration=0.25),
+        # flops- or bytes-annotated kernels (CostEstimate): not counted
+        dict(name="sofa_flash_fwd", flops=1e9, duration=0.4),
+        dict(name="pallas@y.py:9", flops=0.0, bytes_accessed=1e9,
+             duration=0.4),
+        # zero-cost NON-pallas custom calls (alloc marker, host callback):
+        # not counted — CostEstimate advice cannot apply to them
+        dict(name="AllocateBuffer", flops=0.0, duration=0.3),
+        dict(name="xla_ffi_python_cpu_callback", flops=0.0, duration=0.3),
+    ]
+    tput = make_frame([
+        {"timestamp": i * 0.001, "deviceId": 0,
+         "copyKind": int(CopyKind.KERNEL), "hlo_category": "custom-call",
+         **r} for i, r in enumerate(rows)])
+    feats = Features()
+    tpu_profile({"tputrace": tput}, cfg, feats)
+    assert feats.get("tpu_customcall_unattributed_time") == \
+        pytest.approx(0.75)
+
+
 def test_analyze_end_to_end(logdir, capsys):
     from sofa_tpu.analyze import sofa_analyze
     from sofa_tpu.preprocess import sofa_preprocess
