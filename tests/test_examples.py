@@ -31,6 +31,13 @@ def test_train_tiny_runs(capsys):
     assert "final loss" in capsys.readouterr().out
 
 
+def test_long_context_runs(capsys):
+    mod = runpy.run_path(_example("long_context.py"), run_name="not_main")
+    mod["main"](steps=2, seq=64)
+    out = capsys.readouterr().out
+    assert "final loss" in out and "remat=on" in out
+
+
 def test_serve_tiny_runs(capsys):
     mod = runpy.run_path(_example("serve_tiny.py"), run_name="not_main")
     mod["main"](requests=2, prompt=16, new_tokens=4)
