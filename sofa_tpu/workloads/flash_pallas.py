@@ -31,9 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 from sofa_tpu.workloads.ring_attention import NEG_INF
 
 
-def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                  m_ref, l_ref, *, block_q: int, block_k: int, num_k: int,
-                  scale: float):
+def _flash_kernel(shift_ref, *refs, block_q: int, block_k: int, num_k: int,
+                  scale: float, segmented: bool = False):
     # shift_ref: [1] int32 in SMEM — the causal offset: key j is visible to
     #   query i iff j <= i + shift.  shift=0 is aligned causal attention,
     #   shift>=T sees everything (non-causal), shift<=-block sees nothing
@@ -41,9 +40,17 @@ def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     #   shift lets one compiled kernel serve every hop of ring attention,
     #   where the visiting K/V block's global offset is a traced value.
     # q_ref: [1, block_q, D]; k_ref, v_ref: [1, block_k, D] (streamed per ik)
+    # segmented adds sq/sk refs ([1, block] int32 rows of the per-BATCH
+    #   segment ids): keys in a different segment are masked like
+    #   out-of-causal keys — packed-sequence training.
     # o_ref: [1, block_q, D]; lse_ref: [1, 8, block_q] (sublane-broadcast so
     # the block satisfies TPU (8, 128) tiling)
     # scratch: acc [block_q, D] f32; m, l [block_q, 128] f32 lane-broadcast
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     shift = shift_ref[0]
@@ -74,7 +81,10 @@ def _flash_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
             jnp.int32, (block_q, 1), 0)
         k_pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        s = jnp.where(k_pos > q_pos + shift, NEG_INF, s)
+        masked = k_pos > q_pos + shift
+        if segmented:
+            masked = masked | (sq_ref[0][:, None] != sk_ref[0][None, :])
+        s = jnp.where(masked, NEG_INF, s)
         m_prev = m_ref[:, :1]                            # [bq, 1]
         l_prev = l_ref[:, :1]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -133,6 +143,22 @@ def _kv_plane(i, h: int, kvh: int):
     return (i // h) * kvh + (i % h) // (h // kvh)
 
 
+def _normalize_segments(segment_ids, kv_segment_ids, b, t, tk):
+    """(seg_q [B,T] i32, seg_kv [B,Tk] i32) or (None, None); the one
+    shape-validation point for forward AND backward — a [B,T] default
+    silently indexing past a longer kv side would corrupt results."""
+    if segment_ids is None:
+        if kv_segment_ids is not None:
+            raise ValueError("kv_segment_ids given without segment_ids")
+        return None, None
+    kv = segment_ids if kv_segment_ids is None else kv_segment_ids
+    if segment_ids.shape != (b, t) or kv.shape != (b, tk):
+        raise ValueError(f"segment ids must be [B, T]/[B, Tk] = "
+                         f"({b}, {t})/({b}, {tk}); got "
+                         f"{segment_ids.shape}/{kv.shape}")
+    return segment_ids.astype(jnp.int32), kv.astype(jnp.int32)
+
+
 def _check_static_shift(static_causal: bool, shift) -> None:
     """static_causal index-map clamps assume shift <= 0 at trace time; a
     traced or positive shift under them silently fetches the wrong blocks
@@ -156,6 +182,8 @@ def _flash_forward(
     block_k: Optional[int],
     interpret: Optional[bool],
     static_causal: bool = False,
+    segment_ids=None,
+    kv_segment_ids=None,
 ):
     """Runs the kernel; returns (out [B,T,H,D], lse [B,H,T]).
 
@@ -195,9 +223,12 @@ def _flash_forward(
     shift = jnp.asarray(shift, jnp.int32).reshape(1)
 
     qp, kp, vp = _to_planes(q), _to_planes(k), _to_planes(v)
+    segment_ids, kv_segment_ids = _normalize_segments(
+        segment_ids, kv_segment_ids, b, t, tk)
+    segmented = segment_ids is not None
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
-        scale=scale)
+        scale=scale, segmented=segmented)
     # XLA's cost model cannot see inside a Mosaic kernel: without this the
     # trace reports flops=0/bytes=0 for exactly the hottest op and the
     # roofline/top-ops passes undercount it (observed on the real v2
@@ -220,15 +251,28 @@ def _flash_forward(
     else:
         def kv_index(bh, iq, ik):
             return (_kv_plane(bh, h, kvh), ik, 0)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    inputs = [shift, qp, kp, vp]
+    if segmented:
+        # per-BATCH rows (no per-head copy): index maps divide the plane
+        # row back down to its batch; the k-side map reuses kv_index's
+        # block clamp so segment rows stream with their K/V blocks
+        in_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda bh, iq, ik: (bh // h, iq)),
+            pl.BlockSpec((1, block_k),
+                         lambda bh, iq, ik: (bh // h, kv_index(bh, iq, ik)[1])),
+        ]
+        inputs += [segment_ids, kv_segment_ids]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, num_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
             pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
@@ -247,7 +291,7 @@ def _flash_forward(
         cost_estimate=cost,
         name="sofa_flash_fwd",
         interpret=interpret,
-    )(shift, qp, kp, vp)
+    )(*inputs)
     return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
             lse[:, 0, :].reshape(b, h, t))
 
@@ -260,12 +304,19 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    segment_ids=None,
+    kv_segment_ids=None,
 ):
     """Fused attention: q [B, T, H, D]; k/v may carry KVH <= H heads
-    (GQA runs natively in the kernel — no repeat materialized)."""
+    (GQA runs natively in the kernel — no repeat materialized).
+
+    ``segment_ids`` [B, T] int masks cross-segment pairs on top of the
+    causal rule — packed-sequence training; ``kv_segment_ids`` defaults to
+    the same array (self-attention)."""
     shift = 0 if causal else k.shape[1]
     return _flash_forward(q, k, v, shift, block_q, block_k, interpret,
-                          static_causal=causal)[0]
+                          static_causal=causal, segment_ids=segment_ids,
+                          kv_segment_ids=kv_segment_ids)[0]
 
 
 def supports(t: int, block: int = 512) -> bool:
@@ -298,10 +349,39 @@ def _fwd(q, k, v):
     return out, (q, k, v, out, lse)
 
 
-def _bwd_kv_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
-                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+@jax.custom_vjp
+def flash_causal_segmented_attention(q, k, v, segment_ids):
+    """Differentiable fused causal attention over PACKED sequences:
+    [B, T, H, D] with segment_ids [B, T] — tokens attend causally within
+    their own segment only.  Same kernels, fwd and bwd, with the segment
+    mask fused in; GQA-native like the unsegmented wrapper."""
+    out, _ = _flash_forward(q, k, v, 0, None, None, None,
+                            static_causal=True, segment_ids=segment_ids)
+    return out
+
+
+def _seg_fwd(q, k, v, segment_ids):
+    out, lse = _flash_forward(q, k, v, 0, None, None, None,
+                              static_causal=True, segment_ids=segment_ids)
+    return out, (q, k, v, segment_ids, out, lse)
+
+
+def _seg_bwd(res, g):
+    import numpy as np
+
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, g, out, lse, segment_ids=seg)
+    # integer primal -> float0 cotangent (jax's "no gradient" sentinel)
+    dseg = np.zeros(seg.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+flash_causal_segmented_attention.defvjp(_seg_fwd, _seg_bwd)
+
+
+def _bwd_kv_kernel(shift_ref, *refs,
                    block_q: int, block_k: int, num_q: int,
-                   num_inner: int, scale: float):
+                   num_inner: int, scale: float, segmented: bool = False):
     # dK/dV for one K/V block, accumulated over every (group head, q-block)
     # that attends to it.  Everything is computed in the TRANSPOSED [bk, bq]
     # layout so lse/delta enter as the [1, bq] rows the forward already
@@ -310,6 +390,13 @@ def _bwd_kv_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
     #   dp^T = V dO^T;  ds^T = p^T (dp^T - delta);  dK += ds^T Q
     # shift_ref is the forward's dynamic causal offset (SMEM scalar): one
     # compiled kernel serves aligned-causal (0) and every ring-hop shift.
+    # segmented adds sk/sq id rows masking cross-segment pairs.
+    if segmented:
+        (k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref, sq_ref, sk_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
     jk = pl.program_id(1)
     inner = pl.program_id(2)
     iq = inner % num_q
@@ -334,7 +421,10 @@ def _bwd_kv_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
             jnp.int32, (block_k, 1), 0)
         q_pos = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1)
-        st = jnp.where(k_pos > q_pos + shift, NEG_INF, st)
+        masked = k_pos > q_pos + shift
+        if segmented:
+            masked = masked | (sk_ref[0][:, None] != sq_ref[0][None, :])
+        st = jnp.where(masked, NEG_INF, st)
         lse_row = lse_ref[0, :1, :]                    # [1, bq] f32
         pt = jnp.exp(st - lse_row)
         dv_acc[...] = dv_acc[...] + jnp.dot(
@@ -352,12 +442,18 @@ def _bwd_kv_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_q_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
-                  dqt_ref, dqt_acc, *,
-                  block_q: int, block_k: int, num_k: int, scale: float):
+def _bwd_q_kernel(shift_ref, *refs,
+                  block_q: int, block_k: int, num_k: int, scale: float,
+                  segmented: bool = False):
     # dQ for one q-block, accumulated over its visible K/V blocks — in the
     # same transposed layout; the accumulator holds dQ^T [D, bq]
     # (dQ^T = K^T ds^T), un-transposed by XLA outside the kernel.
+    if segmented:
+        (k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref, sq_ref, sk_ref,
+         dqt_ref, dqt_acc) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
+         dqt_ref, dqt_acc) = refs
     iq = pl.program_id(1)
     jk = pl.program_id(2)
     shift = shift_ref[0]
@@ -379,7 +475,10 @@ def _bwd_q_kernel(shift_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dta_ref,
             jnp.int32, (block_k, 1), 0)
         q_pos = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1)
-        st = jnp.where(k_pos > q_pos + shift, NEG_INF, st)
+        masked = k_pos > q_pos + shift
+        if segmented:
+            masked = masked | (sk_ref[0][:, None] != sq_ref[0][None, :])
+        st = jnp.where(masked, NEG_INF, st)
         pt = jnp.exp(st - lse_ref[0, :1, :])
         dpt = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
@@ -401,7 +500,9 @@ def _flash_backward(q, k, v, g, out, lse,
                     grad_dtype=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    segment_ids=None,
+                    kv_segment_ids=None):
     """Fused causal-attention backward: two Pallas kernels (dK/dV and dQ),
     probabilities recomputed per block from the forward's lse so the [T,T]
     matrix never leaves VMEM in either direction.  GQA-native like the
@@ -435,6 +536,9 @@ def _flash_backward(q, k, v, g, out, lse,
     dq_dt = grad_dtype or q.dtype
     dk_dt = grad_dtype or k.dtype
     dv_dt = grad_dtype or v.dtype
+    segment_ids, kv_segment_ids = _normalize_segments(
+        segment_ids, kv_segment_ids, b, t, tk)
+    segmented = segment_ids is not None
 
     qp, kp, vp, gp = (_to_planes(x) for x in (q, k, v, g))
     # delta_i = sum_d(dO_i * O_i); both it and lse ride the same [8, T]
@@ -475,19 +579,29 @@ def _flash_backward(q, k, v, g, out, lse,
     kv_bytes = int((kp.size + vp.size) * kp.dtype.itemsize * 2
                    + (qp.size + gp.size) * qp.dtype.itemsize
                    + 2 * bh * t * 4)
+    kv_in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, 8, block_q), row_index),
+        pl.BlockSpec((1, 8, block_q), row_index),
+    ]
+    kv_inputs = [shift_arr, kp, vp, qp, gp, lse_t, delta_t]
+    if segmented:
+        kv_in_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda i, jk, n: (i // kvh, q_block(jk, n))),
+            pl.BlockSpec((1, block_k), lambda i, jk, n: (i // kvh, jk)),
+        ]
+        kv_inputs += [segment_ids, kv_segment_ids]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_kv_kernel, block_q=block_q, block_k=block_k,
-                          num_q=num_q, num_inner=num_inner, scale=scale),
+                          num_q=num_q, num_inner=num_inner, scale=scale,
+                          segmented=segmented),
         grid=(bkv, num_k, num_inner),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, 8, block_q), row_index),
-            pl.BlockSpec((1, 8, block_q), row_index),
-        ],
+        in_specs=kv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, jk, n: (i, jk, 0)),
@@ -508,7 +622,7 @@ def _flash_backward(q, k, v, g, out, lse,
             bytes_accessed=kv_bytes),
         name="sofa_flash_bwd_kv",
         interpret=interpret,
-    )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)
+    )(*kv_inputs)
 
     # --- dQ: grid over query planes; inner walks visible K/V blocks ---
     if static_causal:
@@ -519,19 +633,28 @@ def _flash_backward(q, k, v, g, out, lse,
         def kv_index(i, iq, jk):
             return (_kv_plane(i, h, kvh), jk, 0)
 
+    q_in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
+        pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
+        pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
+    ]
+    q_inputs = [shift_arr, kp, vp, qp, gp, lse_t, delta_t]
+    if segmented:
+        q_in_specs += [
+            pl.BlockSpec((1, block_q), lambda i, iq, jk: (i // h, iq)),
+            pl.BlockSpec((1, block_k),
+                         lambda i, iq, jk: (i // h, kv_index(i, iq, jk)[1])),
+        ]
+        q_inputs += [segment_ids, kv_segment_ids]
     dqt = pl.pallas_call(
         functools.partial(_bwd_q_kernel, block_q=block_q, block_k=block_k,
-                          num_k=num_k, scale=scale),
+                          num_k=num_k, scale=scale, segmented=segmented),
         grid=(bh, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, iq, jk: (i, iq, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
-            pl.BlockSpec((1, 8, block_q), lambda i, iq, jk: (i, 0, iq)),
-        ],
+        in_specs=q_in_specs,
         out_specs=[
             pl.BlockSpec((1, d, block_q), lambda i, iq, jk: (i, 0, iq)),
         ],
@@ -549,7 +672,7 @@ def _flash_backward(q, k, v, g, out, lse,
                 + 2 * bh * t * 4 + bh * t * d * 4)),
         name="sofa_flash_bwd_dq",
         interpret=interpret,
-    )(shift_arr, kp, vp, qp, gp, lse_t, delta_t)[0]
+    )(*q_inputs)[0]
 
     dq = dqt.reshape(b, h, d, t).transpose(0, 3, 1, 2).astype(dq_dt)
     dk = dk.reshape(b, kvh, tk, d).transpose(0, 2, 1, 3)
