@@ -126,3 +126,21 @@ def plain_causal_attention(q, k, v):
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+def plain_segmented_causal_attention(q, k, v, segment_ids):
+    """Reference causal attention over packed sequences: tokens attend
+    within their own segment only.  The ONE materialized-mask reference
+    the flash kernels' segment support is validated against (CPU tests
+    and the on-chip checklist share it — two copies would let the
+    references silently diverge)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    t = q.shape[1]
+    keep = (jnp.tril(jnp.ones((t, t), bool))[None]
+            & (segment_ids[:, :, None] == segment_ids[:, None, :]))
+    s = jnp.where(keep[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

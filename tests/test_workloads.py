@@ -145,16 +145,9 @@ def test_flash_attention_gqa_grads_match_repeated_kv():
                                    atol=1e-4, rtol=1e-3)
 
 
-def _masked_reference(q, k, v, seg):
-    """Plain attention with an explicit causal-AND-same-segment mask."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d ** -0.5)
-    t = q.shape[1]
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    same = (seg[:, :, None] == seg[:, None, :])
-    mask = (causal[None] & same)[:, None]
-    s = jnp.where(mask, s, -1e30)
-    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+from sofa_tpu.workloads.ring_attention import (  # noqa: E402 — shared ref
+    plain_segmented_causal_attention as _masked_reference,
+)
 
 
 def test_flash_attention_segmented_matches_masked_plain():
