@@ -202,6 +202,32 @@ def test_flash_segmented_grads_match_masked_plain():
                                    atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_flash_segmented_random_layouts(seed):
+    """Property test: random segment layouts (random doc lengths, including
+    length-1 docs and a doc spanning block boundaries) match the masked
+    reference under random block sizes."""
+    from sofa_tpu.workloads.flash_pallas import flash_attention
+
+    rng = np.random.RandomState(seed)
+    b, t, h, d = 1, 64, 2, 8
+    # random cut points -> contiguous segment ids
+    n_cuts = rng.randint(1, 6)
+    cuts = np.sort(rng.choice(np.arange(1, t), size=n_cuts, replace=False))
+    seg = np.zeros((b, t), np.int32)
+    for c in cuts:
+        seg[:, c:] += 1
+    bq, bk = rng.choice([16, 32, 64]), rng.choice([16, 32, 64])
+    key = jax.random.PRNGKey(seed)
+    q, k, v = jax.random.normal(key, (3, b, t, h, d), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, block_q=int(bq), block_k=int(bk),
+                              interpret=True, segment_ids=jnp.asarray(seg))
+        ref = _masked_reference(q, k, v, jnp.asarray(seg))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)
+
+
 def test_flash_backward_multiblock_matches_plain():
     """The fused Pallas backward across a real multi-block grid — unequal
     block_q/block_k both ways, GQA — against the autodiff reference.  The
