@@ -354,7 +354,9 @@ def flash_causal_segmented_attention(q, k, v, segment_ids):
     """Differentiable fused causal attention over PACKED sequences:
     [B, T, H, D] with segment_ids [B, T] — tokens attend causally within
     their own segment only.  Same kernels, fwd and bwd, with the segment
-    mask fused in; GQA-native like the unsegmented wrapper."""
+    mask fused in; GQA-native like the unsegmented wrapper.  Masking is
+    pure id equality: ids should be contiguous runs (the standard packed
+    layout) — a reused id attends across both of its runs."""
     out, _ = _flash_forward(q, k, v, 0, None, None, None,
                             static_causal=True, segment_ids=segment_ids)
     return out

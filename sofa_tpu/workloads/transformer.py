@@ -33,10 +33,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sofa_tpu.workloads.flash_pallas import (
     flash_causal_attention,
+    flash_causal_segmented_attention,
     supports as flash_supports,
 )
 from sofa_tpu.workloads.ring_attention import (
     plain_causal_attention,
+    plain_segmented_causal_attention,
     ring_attention,
 )
 from sofa_tpu.workloads.ring_flash import (
@@ -184,13 +186,27 @@ def layer_body(x, lp, cfg: TransformerConfig, positions, attn):
 
 
 def forward(params, tokens, cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None) -> jax.Array:
+            mesh: Optional[Mesh] = None,
+            segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Logits [B, T, vocab].  With a mesh whose "seq" axis is >1, attention
-    runs as ring attention; otherwise plain fused causal attention."""
+    runs as ring attention; otherwise plain fused causal attention.
+
+    ``segment_ids`` [B, T] packs multiple documents per row: attention is
+    masked within segments (fused into the flash kernels; explicit mask on
+    the unfused path) and rope positions restart at each segment — a
+    packed batch is numerically identical to processing the documents
+    separately.  Ids must be CONTIGUOUS along T (e.g. 0,0,1,1,2: each id
+    appears in one run — the standard packed layout); a reused id would
+    attend across its earlier run while positions restart, with no error.
+    Not supported together with sequence parallelism (the ring exchanges
+    would need segment blocks too)."""
     b, t = tokens.shape
     if t > cfg.max_seq:
         raise ValueError(f"sequence length {t} exceeds max_seq {cfg.max_seq}")
     use_ring = mesh is not None and mesh.shape.get("seq", 1) > 1
+    if segment_ids is not None and use_ring:
+        raise ValueError("segment_ids are not supported with the "
+                         "sequence-parallel (ring) path yet")
     t_local = t // mesh.shape["seq"] if use_ring else t
     if cfg.zigzag and use_ring:
         # Zig-zag runs the kernel per half-chunk, so the tiling gate must
@@ -207,7 +223,16 @@ def forward(params, tokens, cfg: TransformerConfig,
             raise ValueError(
                 f"flash=True but local seq len {t_local} is not supported by "
                 f"the fused kernel (needs a 16-multiple block dividing it)")
-    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    if segment_ids is None:
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    else:
+        # rope positions restart at each packed document: position =
+        # global index - running max of segment-start indices (cummax)
+        idx = jnp.broadcast_to(jnp.arange(t), (b, t))
+        is_start = jnp.concatenate(
+            [jnp.ones((b, 1), bool),
+             segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+        positions = idx - lax.cummax(jnp.where(is_start, idx, 0), axis=1)
 
     use_zigzag = cfg.zigzag and use_ring and use_flash
     if use_zigzag:
@@ -255,10 +280,16 @@ def forward(params, tokens, cfg: TransformerConfig,
                 return zigzag_ring_flash_attention(q, kr, vr, mesh), None
             if use_ring:
                 return ring_flash_attention(q, kr, vr, mesh), None
+            if segment_ids is not None:
+                return flash_causal_segmented_attention(
+                    q, kr, vr, segment_ids), None
             return flash_causal_attention(q, kr, vr), None
         kk, v = repeated()
         if use_ring:
             return ring_attention(q, kk, v, mesh), None
+        if segment_ids is not None:
+            return plain_segmented_causal_attention(
+                q, kk, v, segment_ids), None
         return plain_causal_attention(q, kk, v), None
 
     def layer(x, lp):
@@ -285,17 +316,27 @@ def forward(params, tokens, cfg: TransformerConfig,
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None) -> jax.Array:
+            mesh: Optional[Mesh] = None,
+            segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Next-token cross entropy; targets are tokens shifted left.
 
     The forward pass sees the full sequence (so T stays divisible by the
     "seq" mesh axis) and the last position's logits are dropped instead.
+    With ``segment_ids`` (packed documents), positions whose target falls
+    in a DIFFERENT segment are excluded — the last token of one document
+    must not be trained to predict the first token of the next — and the
+    mean runs over the kept positions, so a packed batch's loss equals the
+    token-weighted mean of the documents' separate losses.
     """
-    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    logits = forward(params, tokens, cfg, mesh, segment_ids)[:, :-1]
     targets = tokens[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    nll = logz - gold
+    if segment_ids is None:
+        return jnp.mean(nll)
+    keep = (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(nll.dtype)
+    return jnp.sum(nll * keep) / jnp.maximum(jnp.sum(keep), 1.0)
 
 
 def shard_params(params, cfg: TransformerConfig, mesh: Mesh,
