@@ -801,3 +801,34 @@ def test_transformer_zigzag_matches_plain_forward():
                         dataclasses.replace(cfg, flash=False, zigzag=False))
     np.testing.assert_allclose(np.asarray(out_z), np.asarray(out_p),
                                atol=1e-3, rtol=1e-3)
+
+
+def test_fence_semantics():
+    """fence() returns element (0,...,0) of the first leaf after a full
+    block_until_ready; tolerates scalars, pytrees, non-array leaves, and
+    empty trees; skips its scalar pull on non-addressable arrays (the
+    multi-host case, where block_until_ready is the whole barrier)."""
+    from sofa_tpu.workloads.common import fence
+
+    x = jnp.arange(6.0).reshape(2, 3) + 1.0
+    assert float(fence(x)) == 1.0
+    assert float(fence(jnp.float32(7.0))) == 7.0           # 0-d scalar
+    assert float(fence({"a": x, "b": jnp.zeros(2)})) == 1.0  # pytree
+    assert fence(None) is None
+    assert fence([]) is None
+    assert fence([3, "not-an-array"]) is None              # no array leaves
+
+    class _NonAddressable:
+        ndim = 2
+        is_fully_addressable = False
+
+        def __getitem__(self, idx):  # pragma: no cover — must not be hit
+            raise AssertionError("fence pulled from a non-addressable array")
+
+    import sofa_tpu.workloads.common as common
+    orig = common.jax.block_until_ready
+    try:
+        common.jax.block_until_ready = lambda leaves: None
+        assert fence([_NonAddressable()]) is None
+    finally:
+        common.jax.block_until_ready = orig
