@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--disable_tpu_mon", action="store_true")
     g.add_argument("--disable_memprof", action="store_true",
                    help="skip the peak-HBM allocation-site snapshot")
+    g.add_argument("--epilogue_deadline_s", type=float,
+                   help="seconds past the child's atexit trace-stop "
+                        "breadcrumb before record presumes it wedged and "
+                        "kills its process group (default: derived from "
+                        "the in-child stop timeouts)")
 
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
@@ -162,7 +167,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "enable_strace", "strace_min_time", "enable_py_stacks", "enable_tcpdump",
         "netstat_interface", "blkdev", "pid",
         "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
-        "xprof_duration_s", "tpu_mon_rate",
+        "xprof_duration_s", "tpu_mon_rate", "epilogue_deadline_s",
         "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
         "trace_format",
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",

@@ -335,11 +335,67 @@ def start_sampler(rate_hz, out_path, stop=None, memprof_path=None):
             stop.set()
             t.join(timeout=2.0)
             # No peak ever cleared the gate (or xprof's own exit fallback is
-            # absent because tracing was off): leave a final snapshot.
+            # absent because tracing was off): leave a final snapshot — but
+            # ONLY on a strictly-initialized backend.  The merely-IMPORTED
+            # jax module is not enough: live_arrays() on an uninitialized
+            # backend *triggers* backend init, and with the device tunnel
+            # down that is an unbounded claim loop at interpreter exit
+            # (observed live: `sofa stat "python -c 'print(42)'"` printed
+            # 42 then wedged forever in exactly this call).  No
+            # grace-period fallback here — a wrong guess wedges the
+            # process at the worst possible moment.
             jax = sys.modules.get("jax")
-            if memprof_path and jax is not None \\
-                    and not os.path.exists(memprof_path):
-                snapshot_memprof(jax, memprof_path, "final", 0)
+            try:
+                xb = sys.modules.get("jax._src.xla_bridge")
+                ready = (jax is not None and xb is not None
+                         and bool(getattr(xb, "_backends", None)))
+            except Exception:
+                ready = False
+            if not (memprof_path and ready
+                    and not os.path.exists(memprof_path)):
+                return
+            # Even an initialized backend can block if the tunnel died
+            # mid-run: thread-deadline the snapshot; a stuck daemon
+            # thread dies with the process.
+            try:
+                timeout = float(os.environ.get(
+                    "SOFA_TPU_STOP_TIMEOUT_S", "30") or 0)
+            except ValueError:
+                timeout = 30.0
+
+            # Same breadcrumb contract as the xprof epilogue: the parent
+            # `sofa record` TERM/KILLs us if this stalls past its deadline
+            # (covers even a snapshot wedged while holding the GIL).
+            def _mark(payload):
+                try:
+                    import json as _json
+                    d = os.path.join(
+                        os.path.dirname(os.path.abspath(memprof_path)),
+                        "_inject")
+                    if not os.path.isdir(d):
+                        return
+                    p = os.path.join(d, "atexit_stop.json")
+                    with open(p + ".tmp", "w") as f:
+                        _json.dump(payload, f)
+                    os.replace(p + ".tmp", p)
+                except Exception:
+                    pass
+
+            _mark({"pid": os.getpid(), "t": time.time(),
+                   "timeout_s": timeout, "grace_s": 0})
+            snap = threading.Thread(
+                target=lambda: snapshot_memprof(
+                    jax, memprof_path, "final", 0),
+                daemon=True, name="sofa_tpu_final_memprof")
+            snap.start()
+            snap.join(timeout if timeout > 0 else None)
+            if snap.is_alive():
+                sys.stderr.write(
+                    "sofa_tpu: final memprof exceeded %gs (device tunnel "
+                    "down?) — skipped\\n" % timeout)
+            _mark({"pid": os.getpid(), "t": time.time(),
+                   "timeout_s": timeout, "grace_s": 0,
+                   "done": True, "ok": not snap.is_alive()})
 
         atexit.register(_shutdown)
     return t

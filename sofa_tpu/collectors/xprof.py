@@ -161,24 +161,127 @@ def _snapshot_topology(jax, logdir):
         sys.stderr.write("sofa_tpu: topology snapshot failed: %r\\n" % (e,))
 
 
-def _stop(jax):
+def _stop_timeout_s():
+    try:
+        return float(os.environ.get("SOFA_TPU_STOP_TIMEOUT_S", "30") or 0)
+    except ValueError:
+        return 30.0
+
+
+def _hard_exit_grace_s():
+    try:
+        return float(os.environ.get("SOFA_TPU_HARD_EXIT_GRACE_S", "20") or 0)
+    except ValueError:
+        return 20.0
+
+
+def _bounded(fn, timeout, label):
+    """Run fn with a thread deadline; True iff it finished (ok or raised).
+
+    stop_trace()/memprof talk to the device runtime, which blocks forever
+    when the device tunnel is dead (observed live: `sofa stat` of a
+    completed command wedged in atexit for 240 s+).  SIGALRM cannot
+    preempt a C call that never returns to the interpreter, so the risky
+    call runs on a daemon thread instead and we give up on the *wait*;
+    a stuck daemon thread blocked in C without the GIL dies with the
+    process.  timeout <= 0 disables the guard (direct call).
+    """
+    if timeout <= 0:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — epilogue must continue
+            sys.stderr.write("sofa_tpu: %s failed: %r\\n" % (label, e))
+        return True
+    done = {"err": None}
+
+    def _run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001
+            done["err"] = e
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="sofa_tpu_stop_" + label)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        sys.stderr.write(
+            "sofa_tpu: %s exceeded %gs (device tunnel down?) — giving up "
+            "on it; the trace may be partial.  Set SOFA_TPU_STOP_TIMEOUT_S "
+            "to adjust or 0 to wait forever.\\n" % (label, timeout))
+        return False
+    if done["err"] is not None:
+        sys.stderr.write("sofa_tpu: %s failed: %r\\n" % (label, done["err"]))
+    return True
+
+
+def _marker_path():
+    return os.path.join(_OPTS["logdir"], "_inject", "atexit_stop.json")
+
+
+def _write_marker(payload):
+    try:
+        tmp = _marker_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, _marker_path())
+    except OSError:
+        pass
+
+
+def _stop(jax, at_exit=False):
     if _DONE["stopped"] or not _DONE["started"]:
         return
     _DONE["stopped"] = True
+    timeout = _stop_timeout_s()
+    grace = _hard_exit_grace_s()
+    if at_exit:
+        # Breadcrumb for the parent `sofa record`: main is done and the
+        # epilogue has begun.  If this file never gains "done" and the
+        # process outlives t + timeout + grace, record may TERM/KILL the
+        # process group — the in-process guards below failed (e.g. a C
+        # call wedged while holding the GIL).
+        _write_marker({"pid": os.getpid(), "t": time.time(),
+                       "timeout_s": timeout, "grace_s": grace})
+    ok = True
     # HBM attribution fallback: if the tpumon sampler never caught a peak
     # (sampler off, or memory never grew past the gate), take one final
     # snapshot so the report always has *some* allocation-site table.
     mp = os.environ.get("SOFA_TPU_MEMPROF_OUT")
     if mp and not os.path.exists(mp):
-        try:
+        def _final_memprof():
             from sofa_tpu_tpumon import snapshot_memprof
             snapshot_memprof(jax, mp, "final", 0)
-        except Exception as e:  # noqa: BLE001
-            sys.stderr.write("sofa_tpu: final memprof failed: %r\\n" % (e,))
-    try:
-        jax.profiler.stop_trace()
-    except Exception as e:  # noqa: BLE001
-        sys.stderr.write("sofa_tpu: stop_trace failed: %r\\n" % (e,))
+        ok = _bounded(_final_memprof, timeout, "final memprof") and ok
+    ok = _bounded(jax.profiler.stop_trace, timeout, "stop_trace") and ok
+    if at_exit:
+        _write_marker({"pid": os.getpid(), "t": time.time(),
+                       "timeout_s": timeout, "grace_s": grace,
+                       "done": True, "ok": ok})
+    if at_exit and not ok and grace > 0:
+        # Last resort: a timed-out stop left a daemon thread stuck in the
+        # device runtime.  Normally the process still exits (daemon threads
+        # die with it), but if that thread wedges interpreter teardown —
+        # e.g. inside malloc/runtime locks a finalizer needs — nothing
+        # in-process can recover.  Arm a watchdog that force-exits after a
+        # grace period; if teardown completes first the process is gone and
+        # the watchdog dies unfired.  Exit code 120 is the contract with
+        # `sofa record` ("wedged at exit; partial trace").
+        def _force_exit():
+            time.sleep(grace)
+            sys.stderr.write(
+                "sofa_tpu: interpreter teardown wedged %gs after a "
+                "timed-out trace stop; force-exiting (120)\\n" % grace)
+            try:
+                sys.stderr.flush()
+                sys.stdout.flush()
+            except Exception:  # noqa: BLE001
+                pass
+            os._exit(120)
+
+        w = threading.Thread(target=_force_exit, daemon=True,
+                             name="sofa_tpu_force_exit")
+        w.start()
 
 
 def _start(jax):
@@ -209,7 +312,7 @@ def _start(jax):
         t1 = time.time_ns()
     with open(os.path.join(logdir, "xprof_marker.txt"), "w") as f:
         f.write("%d %d\\n" % (t0, t1))
-    atexit.register(lambda: _stop(jax))
+    atexit.register(lambda: _stop(jax, at_exit=True))
     _snapshot_topology(jax, logdir)
     dur = float(_OPTS.get("duration_s", 0) or 0)
     if dur > 0:
@@ -262,6 +365,20 @@ def _platform_guard():
     # Best-effort by design: a program whose own config.update races our
     # first poll can be re-overridden (hence the stderr breadcrumb), and
     # later program updates always win because we write exactly once.
+    #
+    # Reconsidered (the env var can name a platform whose backend cannot
+    # init, e.g. a TPU tunnel that is down — restoring then pins the dead
+    # platform): the restore stays.  It reproduces exactly what jax would
+    # do in a hook-free environment (jax honors JAX_PLATFORMS), so the
+    # guard never makes a run worse than the no-injection baseline, and
+    # an in-thread init *probe* would either trigger the very backend init
+    # the watcher carefully defers or race the program's own first use.
+    # The dead-tunnel wedge is fixed where it lives instead: backend init
+    # by a chained site hook is SIGALRM-bounded above, the watcher never
+    # initiates init, the atexit stop is thread-deadline-bounded, and
+    # `sofa record` TERM/KILLs a child that outlives the stop deadline.
+    # A restore here leaves a breadcrumb file so a post-mortem can tell
+    # which platform the child actually ran on.
     p = os.environ.get("JAX_PLATFORMS", "")
     if not p:
         return
@@ -272,10 +389,21 @@ def _platform_guard():
                 and getattr(jax, "version", None) is not None:
             try:
                 if jax.config.jax_platforms != p:
+                    was = jax.config.jax_platforms
                     jax.config.update("jax_platforms", p)
                     print("sofa_tpu: restored JAX_PLATFORMS=%s over a "
                           "site-hook platform override" % p,
                           file=sys.stderr)
+                    if _OPTS.get("logdir"):
+                        try:
+                            with open(os.path.join(
+                                    _OPTS["logdir"],
+                                    "platform_restore.txt"), "w") as f:
+                                f.write("pid %d restored jax_platforms "
+                                        "%r -> %r (env)\\n"
+                                        % (os.getpid(), was, p))
+                        except OSError:
+                            pass
             except Exception as e:
                 print("sofa_tpu: platform restore failed: %r" % (e,),
                       file=sys.stderr)

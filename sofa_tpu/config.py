@@ -97,6 +97,11 @@ class SofaConfig:
     tpu_mon_rate: int = 1            # TPU runtime metrics sampler Hz
     enable_mem_prof: bool = True     # HBM attribution snapshot (pprof) at
                                      # the observed occupancy peak
+    epilogue_deadline_s: Optional[float] = None
+                                     # override the wedge-detection allowance
+                                     # after the child's atexit trace-stop
+                                     # breadcrumb appears (None = derive from
+                                     # the breadcrumb's own timeouts)
 
     # --- preprocess --------------------------------------------------------
     cpu_time_offset_ms: int = 0      # manual host-clock fudge (bin/sofa:111)

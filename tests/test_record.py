@@ -639,3 +639,166 @@ def test_term_as_interrupt_respects_sig_ign():
         assert signal.getsignal(signal.SIGHUP) is signal.SIG_IGN
     finally:
         signal.signal(signal.SIGHUP, old_hup)
+
+
+def test_atexit_stop_trace_hang_is_bounded(tmp_path, monkeypatch):
+    """stop_trace wedged on a dead device tunnel must not wedge the child:
+    the injected _stop runs it on a thread deadline, gives up, records the
+    breadcrumb, and the process exits with ITS OWN exit code (the live
+    VERDICT-r4 repro: `sofa stat` of a completed command hung 240 s+ in
+    atexit; the reference's kill-all property, sofa_record.py:480-523)."""
+    import json
+    import sys as _sys
+    import time as _time
+
+    prog = tmp_path / "wedge_stop.py"
+    prog.write_text(
+        "import os, sys, time\n"
+        "import jax\n"
+        "jax.devices()\n"  # init the (cpu) backend so the watcher attaches
+        "def _wedge():\n"
+        "    time.sleep(600)\n"
+        "jax.profiler.stop_trace = _wedge\n"
+        "print('program ran')\n"
+        "logdir = sys.argv[1]\n"
+        "for _ in range(500):\n"  # wait until the injection has attached
+        "    if os.path.exists(os.path.join(logdir, 'xprof_marker.txt')):\n"
+        "        break\n"
+        "    time.sleep(0.02)\n"
+        "sys.exit(7)\n"
+    )
+    d = str(tmp_path / "log") + "/"
+    monkeypatch.setenv("SOFA_TPU_STOP_TIMEOUT_S", "2")
+    monkeypatch.setenv("SOFA_TPU_HARD_EXIT_GRACE_S", "10")
+    cfg = SofaConfig(logdir=d, enable_tpu_mon=False, enable_mem_prof=False)
+    t0 = _time.time()
+    rc = sofa_record(f"{_sys.executable} {prog} {d}", cfg)
+    assert _time.time() - t0 < 120, "bounded-stop guard did not fire"
+    assert rc == 7  # exit-code fidelity: no force-exit was needed
+    with open(os.path.join(cfg.inject_dir, "atexit_stop.json")) as f:
+        m = json.load(f)
+    assert m["done"] is True
+    assert m["ok"] is False  # the stop really did time out
+
+
+def test_record_kills_child_wedged_in_epilogue(tmp_path):
+    """In-process guards can be defeated (a C call wedged while HOLDING the
+    GIL): once the atexit breadcrumb goes stale past the deadline, record
+    TERM/KILLs the process group and returns — no hang, no orphans."""
+    import time as _time
+    import sys as _sys
+
+    prog = tmp_path / "wedge_hard.py"
+    prog.write_text(
+        "import json, os, sys, time\n"
+        "inject = sys.argv[1]\n"
+        "os.makedirs(inject, exist_ok=True)\n"
+        "with open(os.path.join(inject, 'atexit_stop.json'), 'w') as f:\n"
+        "    json.dump({'pid': os.getpid(), 't': time.time(),\n"
+        "               'timeout_s': 0, 'grace_s': 0}, f)\n"
+        "print('wedging', flush=True)\n"
+        "time.sleep(600)\n"
+    )
+    d = str(tmp_path / "log") + "/"
+    cfg = SofaConfig(logdir=d, enable_tpu_mon=False, enable_mem_prof=False,
+                     epilogue_deadline_s=2.0)
+    t0 = _time.time()
+    rc = sofa_record(f"{_sys.executable} {prog} {cfg.inject_dir}", cfg)
+    assert _time.time() - t0 < 60, "epilogue deadline did not fire"
+    assert rc == 143  # SIGTERM, folded to the shell convention
+    misc = dict(line.split(None, 1)
+                for line in open(cfg.path("misc.txt")).read().splitlines())
+    child_pid = int(misc["pid"])
+    _time.sleep(0.3)
+    assert not os.path.exists(f"/proc/{child_pid}"), "orphan survived"
+
+
+def test_epilogue_deadline_policy():
+    """done+ok => never kill; done+!ok => grace window; pending => full
+    two-call allowance; explicit config override wins."""
+    from sofa_tpu.record import _epilogue_deadline
+
+    cfg = SofaConfig(logdir="/tmp/x/")
+    assert _epilogue_deadline(cfg, {"t": 100.0, "done": True, "ok": True}) is None
+    assert _epilogue_deadline(
+        cfg, {"t": 100.0, "done": True, "ok": False, "grace_s": 20}
+    ) == 100.0 + 20 + 60
+    assert _epilogue_deadline(
+        cfg, {"t": 100.0, "timeout_s": 30, "grace_s": 20}
+    ) == 100.0 + 2 * 30 + 20 + 60
+    cfg.epilogue_deadline_s = 5.0
+    assert _epilogue_deadline(
+        cfg, {"t": 100.0, "done": True, "ok": False, "grace_s": 20}
+    ) == 105.0
+
+
+def test_default_env_stat_smoke_is_bounded(tmp_path):
+    """The flagship verb in the environment sofa actually ships in: no cpu
+    pin, whatever JAX_PLATFORMS the image forces (a dead device tunnel
+    included) — `sofa stat` of a trivial command must return in bounded
+    time with no orphan processes.  Opt-in (slow, environment-dependent):
+    SOFA_TPU_TEST_REALENV=1."""
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    import pytest
+
+    if not os.environ.get("SOFA_TPU_TEST_REALENV"):
+        pytest.skip("set SOFA_TPU_TEST_REALENV=1 to run the real-env smoke")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    # Tight child-side deadlines so the smoke stays CI-sized even when the
+    # tunnel is dead; the defaults would still be bounded, just slower.
+    env["SOFA_TPU_STOP_TIMEOUT_S"] = "15"
+    env["SOFA_TPU_HARD_EXIT_GRACE_S"] = "10"
+    env["SOFA_TPU_CHAIN_TIMEOUT_S"] = "60"
+    d = str(tmp_path / "log") + "/"
+    t0 = _time.time()
+    r = subprocess.run(
+        [_sys.executable, "-m", "sofa_tpu", "stat", "python -c 'print(42)'",
+         "--logdir", d],
+        capture_output=True, text=True, env=env, timeout=420)
+    elapsed = _time.time() - t0
+    out = r.stdout + r.stderr
+    assert "42" in out, out[-800:]
+    assert elapsed < 400, f"stat took {elapsed:.0f}s: not bounded"
+    misc = dict(line.split(None, 1)
+                for line in open(d + "misc.txt").read().splitlines())
+    child_pid = int(misc["pid"])
+    _time.sleep(0.5)
+    assert not os.path.exists(f"/proc/{child_pid}"), "orphan survived"
+
+
+def test_tpumon_final_memprof_never_triggers_backend_init(tmp_path):
+    """The at-exit memprof fallback must only run on a strictly-initialized
+    backend: jax.live_arrays() on a merely-imported jax *triggers* backend
+    init, which with a dead device tunnel is an unbounded claim loop at
+    interpreter exit (the VERDICT-r4 flagship hang, root-caused live:
+    `sofa stat "python -c 'print(42)'"` printed 42, then the axon backend
+    initialized 2 s later from inside this fallback and never returned)."""
+    import subprocess
+    import sys as _sys
+
+    from sofa_tpu.collectors import tpumon
+
+    inject = tmp_path / "inject"
+    inject.mkdir()
+    tpumon.write_sampler_module(str(inject))
+    (inject / "sitecustomize.py").write_text(
+        "import os\n"
+        "from sofa_tpu_tpumon import start_sampler\n"
+        "start_sampler(float(os.environ['SOFA_TPU_TPUMON_HZ']),\n"
+        "              os.environ['SOFA_TPU_TPUMON_OUT'],\n"
+        "              memprof_path=os.environ.get('SOFA_TPU_MEMPROF_OUT'))\n")
+    mp = tmp_path / "memprof.pb.gz"
+    env = dict(os.environ, PYTHONPATH=str(inject),
+               SOFA_TPU_TPUMON_HZ="5",
+               SOFA_TPU_TPUMON_OUT=str(tmp_path / "tpumon.txt"),
+               SOFA_TPU_MEMPROF_OUT=str(mp))
+    # The program imports jax but never initializes a backend.
+    r = subprocess.run([_sys.executable, "-c", "import jax; print('ok')"],
+                       capture_output=True, text=True, env=env, timeout=120)
+    assert "ok" in r.stdout
+    assert not mp.exists(), \
+        "at-exit memprof fallback touched an uninitialized backend"
