@@ -368,3 +368,40 @@ def test_perf_evidence_merge_preserves_onchip_section(monkeypatch):
         onchip + archived + "## Off-chip performance evidence\n\nlive\n",
         new_section)
     assert merged == onchip + archived + new_section
+
+
+def test_last_good_round_trip(tmp_path, monkeypatch):
+    """A successful on-chip result persists with timestamp + sha; reading
+    it back tags it `cached` so a dead-tunnel error line can carry it."""
+    import json
+    import os
+
+    p = str(tmp_path / "bench_last_good.json")
+    monkeypatch.setattr(bench, "_LAST_GOOD_PATH", p)
+    bench._write_last_good({"metric": "resnet50_profiling_overhead",
+                            "value": 0.7, "unit": "percent",
+                            "hlo_rows": 123, "backend": "tpu"})
+    doc = json.load(open(p))
+    assert doc["value"] == 0.7
+    assert doc["captured_utc"].endswith("Z")
+    assert "git_sha" in doc and "captured_unix" in doc
+    back = bench._read_last_good()
+    assert back["cached"] is True
+    assert back["value"] == 0.7
+    # absent / null-value files never come back
+    os.unlink(p)
+    assert bench._read_last_good() is None
+    with open(p, "w") as f:
+        json.dump({"value": None}, f)
+    assert bench._read_last_good() is None
+
+
+def test_committed_last_good_is_valid():
+    """The repo-root bench_last_good.json (the r4 on-chip seed) must parse
+    through _read_last_good: a dead-tunnel BENCH_r05 run rides on it."""
+    doc = bench._read_last_good()
+    assert doc is not None, "bench_last_good.json missing or unparseable"
+    assert doc["backend"] == "tpu"
+    assert doc["value"] is not None
+    assert doc["hlo_rows"] > 0
+    assert doc["cached"] is True
