@@ -258,6 +258,70 @@ def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.
     return pd.DataFrame(mat, index=labels, columns=labels)
 
 
+def comm_scatter(frames, cfg, features: Features) -> None:
+    """Time-resolved communication events for the board's comm scatter —
+    the reference's zoomable d3 time-scatter (x=time, y=peer, dot
+    radius=payload, color=destination, tooltips;
+    /root/reference/sofaboard/comm-report.html:74-244) rebuilt as ONE
+    contract CSV merging both comm planes on one time axis:
+
+      cls=ici  XPlane collective ops + DMA copies (peer = chip, dst = kind
+               — a collective has no single destination, its kind is the
+               meaningful hue);
+      cls=dcn  pcap packets (peer = source address, dst = destination).
+
+    Downsampled per class with the straggler-preserving sampler so the big
+    transfers the user zooms toward never vanish (trace.downsample)."""
+    from sofa_tpu.trace import (downsample, narrow, read_net_addrs, roi_clip,
+                                unpack_ip)
+
+    parts = []
+    df = frames.get("tputrace")
+    if df is not None and not df.empty:
+        df = narrow(df, ["timestamp", "duration", "deviceId", "category",
+                         "copyKind", "payload"])
+        df = roi_clip(df, cfg)
+        sync = df[df["category"] == 0]
+        async_ = df[df["category"] == 2]
+        coll = sync[sync["copyKind"] >= 20]
+        copies = async_[(async_["copyKind"] > 0) & (async_["copyKind"] < 20)]
+        if copies.empty:
+            copies = sync[(sync["copyKind"] > 0) & (sync["copyKind"] < 20)]
+        ici = pd.concat([coll, copies], ignore_index=True)
+        if not ici.empty:
+            kinds = ici["copyKind"].map(
+                lambda k: CK_NAMES.get(int(k), str(int(k))))
+            out = pd.DataFrame({
+                "timestamp": ici["timestamp"],
+                "duration": ici["duration"],
+                "payload": ici["payload"],
+                "peer": "tpu" + ici["deviceId"].astype(int).astype(str),
+                "dst": kinds,
+                "kind": kinds,
+                "cls": "ici",
+            })
+            parts.append(downsample(out, cfg.viz_downsample_to))
+    net = frames.get("nettrace")
+    if net is not None and not net.empty:
+        net = roi_clip(net, cfg)
+    if net is not None and not net.empty:
+        addrs = read_net_addrs(cfg.path("net_addrs.csv"))
+        out = pd.DataFrame({
+            "timestamp": net["timestamp"],
+            "duration": net["duration"],
+            "payload": net["payload"],
+            "peer": net["pkt_src"].map(lambda v: unpack_ip(v, addrs)),
+            "dst": net["pkt_dst"].map(lambda v: unpack_ip(v, addrs)),
+            "kind": "packet",
+            "cls": "dcn",
+        })
+        parts.append(downsample(out, cfg.viz_downsample_to))
+    if not parts:
+        return
+    merged = pd.concat(parts, ignore_index=True).sort_values("timestamp")
+    merged.to_csv(cfg.path("commtrace.csv"), index=False)
+
+
 def dcn_step_correlation(frames, n_bins: int = 64) -> Optional[float]:
     """Pearson correlation between host-network (DCN) tx bandwidth and TPU
     step activity — the cluster question BASELINE config #5 asks ("is DCN

@@ -51,6 +51,7 @@ _PASSES = [
     ("tpumon_profile", tpu.tpumon_profile),
     ("memprof_profile", tpu.memprof_profile),
     ("comm_profile", comm.comm_profile),
+    ("comm_scatter", comm.comm_scatter),
     ("concurrency_breakdown", concurrency.concurrency_breakdown),
     ("mesh_advice", advice.mesh_advice),
 ]

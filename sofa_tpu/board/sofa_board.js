@@ -124,6 +124,19 @@ class Timeline {
           }
           ctx.stroke();
         }
+      } else if (s.kind === "bubble") {
+        // comm scatter marks: per-point radius (payload) + color (dst)
+        ctx.globalAlpha = 0.75;
+        for (const p of s.data) {
+          const sx = this._sx(p.x), sy = this._sy(this._y(p.y));
+          if (sx < this.margin.l - 10 || sx > W - this.margin.r + 10) continue;
+          ctx.fillStyle = p.c || s.color;
+          ctx.beginPath();
+          ctx.arc(sx, sy, p.r || 2, 0, 2 * Math.PI);
+          ctx.fill();
+        }
+        ctx.globalAlpha = 1;
+        ctx.fillStyle = s.color;
       } else {
         for (const p of s.data) {
           const sx = this._sx(p.x), sy = this._sy(this._y(p.y));
@@ -146,14 +159,26 @@ class Timeline {
       ctx.beginPath(); ctx.moveTo(sx, this.margin.t); ctx.lineTo(sx, H - this.margin.b); ctx.stroke();
       ctx.fillText(fmt(t), sx - 12, H - this.margin.b + 14);
     }
-    const yt = this._ticks(this.view.y0, this.view.y1, 6);
+    const yt = this.opts.yLabels
+      ? this._intTicks(this.view.y0, this.view.y1, this.opts.yLabels.length)
+      : this._ticks(this.view.y0, this.view.y1, 6);
     for (const t of yt) {
       const sy = this._sy(t);
       ctx.beginPath(); ctx.moveTo(this.margin.l, sy); ctx.lineTo(W - this.margin.r, sy); ctx.stroke();
-      const label = this.opts.logY ? "1e" + fmt(t) : fmt(t);
+      const label = this.opts.yLabels
+        ? String(this.opts.yLabels[t] || "").slice(0, 15)
+        : (this.opts.logY ? "1e" + fmt(t) : fmt(t));
       ctx.fillText(label, 4, sy + 4);
     }
     ctx.fillText(this.opts.xLabel, W / 2 - 20, H - 4);
+  }
+  _intTicks(a, b, n) {
+    // categorical axis: integer positions only, at most ~12 labels shown
+    const lo = Math.max(0, Math.ceil(a)), hi = Math.min(n - 1, Math.floor(b));
+    const step = Math.max(1, Math.ceil((hi - lo + 1) / 12));
+    const out = [];
+    for (let v = lo; v <= hi; v += step) out.push(v);
+    return out;
   }
   _ticks(a, b, n) {
     const span = b - a;

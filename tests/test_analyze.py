@@ -898,3 +898,38 @@ def test_board_nav_consistent():
         linked = set(re.findall(r'href="([a-z-]+\.html)"', html))
         missing = set(pages) - linked
         assert not missing, f"{page} nav missing links to {sorted(missing)}"
+
+
+def test_comm_scatter_contract(cfg):
+    """commtrace.csv is the comm page's time-scatter contract (reference
+    sofaboard/comm-report.html:74-244 rebuilt): both comm planes — XPlane
+    collectives/copies (cls=ici) and pcap packets (cls=dcn) — merge onto
+    one time axis with exactly the columns the page JS reads."""
+    from sofa_tpu.trace import packed_ip
+
+    pkts = [{"timestamp": 0.5 + i * 0.1, "duration": 1e-6, "payload": 1500,
+             "pkt_src": packed_ip("10.0.0.1"), "pkt_dst": packed_ip("10.0.0.2"),
+             "name": "tcp", "device_kind": "net"} for i in range(5)]
+    frames = {"tputrace": tpu_frame(), "nettrace": make_frame(pkts)}
+    f = Features()
+    comm.comm_scatter(frames, cfg, f)
+    df = pd.read_csv(cfg.path("commtrace.csv"))
+    # The exact header the page's col("...") lookups resolve against.
+    assert list(df.columns) == ["timestamp", "duration", "payload", "peer",
+                                "dst", "kind", "cls"]
+    ici = df[df["cls"] == "ici"]
+    dcn = df[df["cls"] == "dcn"]
+    assert len(ici) == 10 and len(dcn) == 5
+    assert set(ici["peer"]) == {"tpu0"}
+    assert set(ici["kind"]) == {"ALL_REDUCE"}
+    assert set(dcn["peer"]) == {"10.0.0.1"}
+    assert set(dcn["dst"]) == {"10.0.0.2"}
+    # merged and time-sorted: the page renders one shared x axis
+    assert df["timestamp"].is_monotonic_increasing
+    # every column the page JS references by name exists in the header
+    import re
+
+    page = open(os.path.join(os.path.dirname(comm.__file__), "..", "board",
+                             "comm-report.html")).read()
+    for name in re.findall(r'col\("([a-z_]+)"\)', page):
+        assert name in df.columns, f"page reads missing column {name}"
