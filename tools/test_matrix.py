@@ -115,25 +115,53 @@ def _deps_importable(python: str, env: dict) -> str | None:
 
 
 def venv_case(python: str, label: str, wheel: str, workdir: str):
-    """Fresh venv for `python`; install the wheel; record+report in it."""
+    """Fresh venv for `python`; install the wheel; record+report in it.
+
+    Degradation ladder (each rung logged explicitly, never silently):
+      - `-m venv` fails (Debian pythons shipped without ensurepip /
+        python3.X-venv): retry `--without-pip` and install the wheel from
+        the outside via the host pip's ``--python`` re-exec, which needs
+        no pip inside the target venv.
+      - The running env's site-packages overlay only resolves numpy/pandas
+        for same-ABI interpreters; a foreign-ABI interpreter retries
+        against its own system dist-packages instead.
+      - Analyze deps (pandas) unresolvable offline: the pandas-free
+        `sofa record` half still runs — PASS scoped "record-only" in the
+        row, because it genuinely proves wheel+console-script+record
+        portability on that interpreter.
+    """
     t0 = time.time()
     venv = os.path.join(workdir, f"venv-{label}")
+    pipless = False
     r = _run([python, "-m", "venv", venv])
     if r.returncode != 0:
-        return (label, "SKIP", "venv creation unavailable", time.time() - t0)
+        # --system-site-packages: offline, the interpreter's own
+        # dist-packages are the only possible source of the analyze deps.
+        r = _run([python, "-m", "venv", "--without-pip",
+                  "--system-site-packages", venv])
+        pipless = True
+        if r.returncode != 0:
+            return (label, "SKIP", "venv creation unavailable",
+                    time.time() - t0)
     vpy = os.path.join(venv, "bin", "python")
     # Offline dependency story (same trick as tests/test_install.py): the
     # running env's site-packages ride PYTHONPATH; the venv's own
-    # site-packages still win for the package under test.  This only works
-    # for same-ABI interpreters — others SKIP below.
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=sysconfig.get_paths()["purelib"])
+    # site-packages still win for the package under test.
+    overlay = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=sysconfig.get_paths()["purelib"])
+    bare = dict(os.environ, JAX_PLATFORMS="cpu")
+    bare.pop("PYTHONPATH", None)
+    env = overlay
     missing = _deps_importable(vpy, env)
     if missing:
-        return (label, "SKIP", f"deps unresolvable offline: {missing}",
-                time.time() - t0)
-    r = _run([vpy, "-m", "pip", "install", "--no-deps", "--quiet", wheel],
-             env=env)
+        env = bare
+        missing = _deps_importable(vpy, env)
+    if pipless:
+        r = _run([sys.executable, "-m", "pip", "--python", vpy, "install",
+                  "--no-deps", "--quiet", wheel], env=env)
+    else:
+        r = _run([vpy, "-m", "pip", "install", "--no-deps", "--quiet",
+                  wheel], env=env)
     if r.returncode != 0:
         return (label, "FAIL", "pip install: " + r.stderr[-120:].strip(),
                 time.time() - t0)
@@ -145,6 +173,11 @@ def venv_case(python: str, label: str, wheel: str, workdir: str):
               "--disable_xprof"], env=env, cwd=workdir)
     if r.returncode != 0:
         return (label, "FAIL", "record rc=%d" % r.returncode,
+                time.time() - t0)
+    if missing:
+        dep = missing.split("'")[1] if "'" in missing else missing
+        return (label, "PASS",
+                f"record-only ({dep} unresolvable offline; report needs it)",
                 time.time() - t0)
     r = _run([sofa, "report", "--logdir", logdir], env=env, cwd=workdir)
     if r.returncode != 0 or "Complete!!" not in r.stdout:
