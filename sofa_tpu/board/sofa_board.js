@@ -487,6 +487,57 @@ async function mountParallelCoords(canvasId, countId, file, dims, filter) {
   return pc;
 }
 
+/* ---------- stacked bar chart ---------- */
+function drawStackedBars(canvas, labels, series, legendEl) {
+  // series: [{title, color, values:[...]}] — one stack segment per series,
+  // one bar per label (the run-report per-iteration breakdown).
+  const ctx = canvas.getContext("2d");
+  const W = canvas.width, H = canvas.height;
+  ctx.clearRect(0, 0, W, H);
+  const n = labels.length;
+  if (!n || !series.length) return;
+  let max = 1e-12;
+  for (let i = 0; i < n; i++) {
+    let t = 0;
+    for (const sr of series) t += Number(sr.values[i]) || 0;
+    if (t > max) max = t;
+  }
+  const left = 54, bottom = 20, top = 8;
+  const bw = Math.min(48, (W - left - 10) / n);
+  ctx.font = "11px sans-serif";
+  labels.forEach((label, i) => {
+    const x = left + i * bw;
+    let y = H - bottom;
+    for (const sr of series) {
+      const v = Number(sr.values[i]) || 0;
+      const hpx = (H - bottom - top) * (v / max);
+      ctx.fillStyle = sr.color;
+      ctx.fillRect(x + 1, y - hpx, Math.max(bw - 2, 1), hpx);
+      y -= hpx;
+    }
+    ctx.fillStyle = "#888";
+    if (n <= 40 || i % Math.ceil(n / 40) === 0) {
+      ctx.fillText(String(label), x + 1, H - 6);
+    }
+  });
+  ctx.fillStyle = "#888";
+  ctx.fillText(fmt(max) + "s", 4, top + 10);
+  ctx.fillText("0", 4, H - bottom);
+  if (legendEl) {
+    legendEl.innerHTML = "";
+    for (const sr of series) {
+      const item = document.createElement("span");
+      item.className = "legend-item";
+      const sw = document.createElement("span");
+      sw.className = "swatch";
+      sw.style.background = sr.color;
+      item.appendChild(sw);
+      item.appendChild(document.createTextNode(sr.title));
+      legendEl.appendChild(item);
+    }
+  }
+}
+
 /* ---------- bar chart ---------- */
 function drawBars(canvas, labels, values, color) {
   const ctx = canvas.getContext("2d");

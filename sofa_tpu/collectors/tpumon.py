@@ -201,8 +201,10 @@ def snapshot_memprof(jax, path, trigger, total_bytes):
     import os as _os
     try:
         if _os.environ.get("SOFA_MEMPROF_NATIVE", "0") == "1":
+            encoder = "native"
             blob = jax.profiler.device_memory_profile()
         else:
+            encoder = "live_arrays"
             blob = gzip.compress(_pprof_encode(_live_buffer_samples(jax)))
         # Writer-unique tmp name: the sampler thread and the at-exit
         # fallback may snapshot concurrently (injection atexit order is not
@@ -212,9 +214,19 @@ def snapshot_memprof(jax, path, trigger, total_bytes):
         with open(tmp, "wb") as f:
             f.write(blob)
         _os.replace(tmp, path)   # readers never see a half-written profile
+        meta = {"unix_ns": time.time_ns(), "trigger": trigger,
+                "total_bytes": int(total_bytes), "encoder": encoder}
+        if encoder == "live_arrays":
+            # Readers must know what this profile CANNOT show: the
+            # live-arrays encoder sees only arrays this process holds —
+            # executable/code memory attribution (and jit temporaries)
+            # need the native profile, opt-in because its executable walk
+            # can LOG(FATAL) on PJRT plugins without the code-size C-API.
+            meta["note"] = ("no executable/code rows; set "
+                            "SOFA_MEMPROF_NATIVE=1 on backends whose "
+                            "plugin implements the code-size C-API")
         with open(path + ".meta.json", "w") as f:
-            json.dump({"unix_ns": time.time_ns(), "trigger": trigger,
-                       "total_bytes": int(total_bytes)}, f)
+            json.dump(meta, f)
         return True
     except Exception as e:
         sys.stderr.write("sofa_tpu: memprof snapshot failed: %r\\n" % (e,))

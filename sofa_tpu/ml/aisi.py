@@ -309,6 +309,15 @@ def sofa_aisi(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
                 ops.loc[ops["phase"] == "fw", "duration"].sum())
             row["bw_time"] = float(
                 ops.loc[ops["phase"] == "bw", "duration"].sum())
+            # compute-only variants for stacked views: collectives carry a
+            # phase too (a gradient all-reduce is "bw"), so fw/bw_time
+            # overlap collective_time — these exclude it, making
+            # fw_compute + bw_compute + collective disjoint slices.
+            comp = ops[ops["copyKind"] < 20]
+            row["fw_compute_time"] = float(
+                comp.loc[comp["phase"] == "fw", "duration"].sum())
+            row["bw_compute_time"] = float(
+                comp.loc[comp["phase"] == "bw", "duration"].sum())
             copies = tputrace[
                 (tputrace["timestamp"] >= t0) & (tputrace["timestamp"] < t1)
                 & (tputrace["copyKind"].isin([int(CopyKind.H2D), int(CopyKind.D2H)]))

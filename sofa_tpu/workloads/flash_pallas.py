@@ -112,15 +112,22 @@ def _flash_kernel(shift_ref, *refs, block_q: int, block_k: int, num_k: int,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
 
 
-def pick_block(n: int, cap: int = 512) -> Optional[int]:
+def pick_block(n: int, cap: int = 512, head_dim: int = 128) -> Optional[int]:
     """Largest power-of-two block size <= cap (>= 16) that divides n.
 
-    512 is the measured sweet spot on v5e — the on-chip sweep
+    512 is the measured sweet spot on v5e AT d=128 — the on-chip sweep
     (tools/tune_flash.py, 2026-07-31) put 512x512 blocks at 16.8 ms for a
     16k-token forward vs 51.8 ms at the old 128x128 default — while
     smaller powers of two keep every 16-multiple sequence length (the
-    sublane constraint) supported.
+    sublane constraint) supported.  The sweep only measured d=128; larger
+    head dims grow the q/k/v tiles (and the backward's accumulators)
+    linearly in d, so the cap halves per doubling of head_dim past 128 to
+    stay inside VMEM instead of failing Mosaic compilation loudly with no
+    fallback.
     """
+    while head_dim > 128 and cap > 128:
+        head_dim //= 2
+        cap //= 2
     b = cap
     while b >= 16:
         if n % b == 0:
@@ -210,8 +217,10 @@ def _flash_forward(
     if h % kvh:
         raise ValueError(f"query heads {h} not a multiple of kv heads {kvh}")
     group = h // kvh
-    block_q = pick_block(t) if block_q is None else min(block_q, t)
-    block_k = pick_block(tk) if block_k is None else min(block_k, tk)
+    block_q = pick_block(t, head_dim=d) if block_q is None \
+        else min(block_q, t)
+    block_k = pick_block(tk, head_dim=d) if block_k is None \
+        else min(block_k, tk)
     if not block_q or not block_k or t % block_q or tk % block_k:
         raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
                          f"seq lens ({t}, {tk})")
@@ -427,7 +436,10 @@ def _bwd_kv_kernel(shift_ref, *refs,
         if segmented:
             masked = masked | (sk_ref[0][:, None] != sq_ref[0][None, :])
         st = jnp.where(masked, NEG_INF, st)
-        lse_row = lse_ref[0, :1, :]                    # [1, bq] f32
+        # Clamp like the forward's m: a fully-masked row carries
+        # lse ~ NEG_INF, and exp(NEG_INF - NEG_INF) = 1 would inject
+        # garbage into dK/dV; clamped, exp(NEG_INF + 1e29) underflows to 0.
+        lse_row = jnp.maximum(lse_ref[0, :1, :], -1e29)  # [1, bq] f32
         pt = jnp.exp(st - lse_row)
         dv_acc[...] = dv_acc[...] + jnp.dot(
             pt.astype(do.dtype), do, preferred_element_type=jnp.float32)
@@ -481,7 +493,8 @@ def _bwd_q_kernel(shift_ref, *refs,
         if segmented:
             masked = masked | (sk_ref[0][:, None] != sq_ref[0][None, :])
         st = jnp.where(masked, NEG_INF, st)
-        pt = jnp.exp(st - lse_ref[0, :1, :])
+        # same fully-masked-row clamp as the dK/dV kernel
+        pt = jnp.exp(st - jnp.maximum(lse_ref[0, :1, :], -1e29))
         dpt = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -520,9 +533,13 @@ def _flash_backward(q, k, v, g, out, lse,
     """
     b, t, h, d = q.shape
     tk, kvh = k.shape[1], k.shape[2]
+    if h % kvh:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {kvh}")
     grp = h // kvh
-    block_q = pick_block(t) if block_q is None else min(block_q, t)
-    block_k = pick_block(tk) if block_k is None else min(block_k, tk)
+    block_q = pick_block(t, head_dim=d) if block_q is None \
+        else min(block_q, t)
+    block_k = pick_block(tk, head_dim=d) if block_k is None \
+        else min(block_k, tk)
     if not block_q or not block_k or t % block_q or tk % block_k:
         # same contract as _flash_forward — a non-dividing block here would
         # silently leave gradient rows uncovered, not just misperform

@@ -1,0 +1,263 @@
+"""Board data-contract tests (the GUI data contract made testable — the
+reference binds sofa_analyze.py:1050-1052 CSV output to its sofaboard JS by
+convention only, and this repo did the same until a renamed column could
+ship a silently-blank page).
+
+Three layers:
+  1. a kitchen-sink logdir: synthetic frames through the REAL frame writer
+     plus the full sofa_analyze pass list (+ aisi + diff), so the emitted
+     headers are what production emits;
+  2. CONTRACT: for every CSV a board page indexes by column name, the
+     exact columns its JS reads — each must exist in the emitted header;
+  3. a static scan of board/*.html + sofa_board.js: every fetchCSV file
+     must be contracted (or declared table-only), and every literal column
+     reference must appear in some contracted header — so a NEW page
+     reference forces a contract (and therefore an emitter) update.
+"""
+
+import glob
+import os
+import re
+import shutil
+
+import pandas as pd
+import pytest
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.trace import CopyKind, make_frame, packed_ip
+
+BOARD = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "sofa_tpu", "board")
+
+# csv -> columns the pages' JS reads by name (indexOf / col() / dims keys /
+# the run-report stack comps).  Maintained WITH the pages; the static scan
+# below fails when a page references something missing here.
+CONTRACT = {
+    "mpstat.csv": ["timestamp", "event", "deviceId", "name"],
+    "cputrace.csv": ["timestamp", "event", "duration", "deviceId",
+                     "pid", "tid"],
+    "tputrace.csv": ["timestamp", "duration", "flops", "bytes_accessed",
+                     "copyKind", "deviceId", "category"],
+    "tpuutil.csv": ["timestamp", "event", "name"],
+    "roofline.csv": ["deviceId", "name", "efficiency"],
+    "tpu_input_pipeline.csv": ["deviceId", "step", "busy_pct"],
+    "tpu_memprof.csv": ["site", "bytes"],
+    "commtrace.csv": ["timestamp", "duration", "payload", "peer", "dst",
+                      "kind", "cls"],
+    "netbandwidth.csv": ["timestamp", "event", "name"],
+    "diskstat.csv": ["timestamp", "event", "name"],
+    "pystacks.csv": ["module"],
+    "tpu_op_tree.csv": ["path", "depth", "time", "time_pct", "count",
+                        "flops"],
+    "features.csv": ["name", "value"],
+    "iterations.csv": ["iteration", "fw_compute_time", "bw_compute_time",
+                       "collective_time", "transfer_time", "syscall_time",
+                       "host_python_time"],
+    "tpu_diff.csv": ["name", "delta"],
+    "mem_diff.csv": ["site", "delta"],
+}
+
+# fetched but only renderTable'd (header-agnostic) or produced by flows the
+# sink doesn't exercise (swarm diff needs two --enable_hsg runs)
+TABLE_ONLY = {
+    "comm.csv", "ici_matrix.csv", "netrank.csv", "cpu_top.csv",
+    "pystacks_top.csv", "strace_top.csv", "disk_summary.csv",
+    "performance.csv", "tpu_categories.csv", "tpu_top_ops.csv",
+    "tpu_modules_summary.csv", "swarm_diff.csv",
+}
+
+
+def _kitchen_sink_frames():
+    """Synthetic frames that light up every analysis pass at once: 4 steps
+    x 2 devices of kernels (fw/bw phases, op paths, serving modules),
+    collectives with payloads, async copies, host samplers, packets."""
+    tpu_rows, step_rows, mod_rows = [], [], []
+    for dev in (0, 1):
+        for it in range(4):
+            t0 = it * 0.1
+            step_rows.append({"timestamp": t0, "duration": 0.1,
+                              "deviceId": dev, "name": str(it),
+                              "device_kind": "tpu"})
+            mod_rows.append({"timestamp": t0, "duration": 0.09,
+                             "deviceId": dev, "name": "jit_step",
+                             "device_kind": "tpu"})
+            for j, phase in enumerate(("fw", "fw", "bw")):
+                tpu_rows.append({
+                    "timestamp": t0 + 0.01 + 0.02 * j, "duration": 0.015,
+                    "deviceId": dev, "category": 0,
+                    "copyKind": int(CopyKind.KERNEL),
+                    "name": f"fusion.{j}", "hlo_category": "convolution",
+                    "flops": 2e9, "bytes_accessed": 4e6, "phase": phase,
+                    "module": "jit_step",
+                    "op_path": f"jit(step)/layer{j}/dot_general",
+                    "device_kind": "tpu",
+                })
+            tpu_rows.append({
+                "timestamp": t0 + 0.07, "duration": 0.01, "deviceId": dev,
+                "category": 0, "copyKind": int(CopyKind.ALL_REDUCE),
+                "name": "all-reduce.1", "hlo_category": "all-reduce",
+                "payload": int(1e6), "bytes_accessed": 1e6,
+                "module": "jit_step", "phase": "bw", "device_kind": "tpu",
+            })
+            tpu_rows.append({
+                "timestamp": t0 + 0.005, "duration": 0.004, "deviceId": dev,
+                "category": 2, "copyKind": int(CopyKind.H2D),
+                "name": "copy-start.1", "payload": int(5e5),
+                "device_kind": "tpu",
+            })
+    # serving phases so serving_profile emits its features
+    for j in range(3):
+        tpu_rows.append({"timestamp": 0.41 + 0.01 * j, "duration": 0.008,
+                         "deviceId": 0, "category": 0,
+                         "copyKind": int(CopyKind.KERNEL),
+                         "name": f"serve.{j}", "flops": 1e10,
+                         "bytes_accessed": 1e8,
+                         "module": "jit_run_prefill", "device_kind": "tpu"})
+        tpu_rows.append({"timestamp": 0.45 + 0.01 * j, "duration": 0.008,
+                         "deviceId": 0, "category": 0,
+                         "copyKind": int(CopyKind.KERNEL),
+                         "name": f"serve.d{j}", "flops": 1e8,
+                         "bytes_accessed": 1e8,
+                         "module": "jit_run_decode", "device_kind": "tpu"})
+
+    frames = {
+        "tputrace": make_frame(tpu_rows),
+        "tpusteps": make_frame(step_rows),
+        "tpumodules": make_frame(mod_rows),
+        "tpuutil": make_frame(
+            [{"timestamp": 0.01 * i, "event": 50.0 + i % 7, "deviceId": 0,
+              "name": m, "device_kind": "tpu"}
+             for i in range(40) for m in ("tc_util", "hbm_gbps")]),
+        "mpstat": make_frame(
+            [{"timestamp": 0.05 * i, "event": 30.0 + i % 5, "deviceId": c,
+              "name": "usr", "device_kind": "cpu"}
+             for i in range(8) for c in range(2)]),
+        "cputrace": make_frame(
+            [{"timestamp": 0.01 * i, "event": 14.2, "duration": 0.01,
+              "deviceId": i % 2, "pid": 100, "tid": 100 + i % 3,
+              "name": "python;main;work", "device_kind": "cpu"}
+             for i in range(40)]),
+        "diskstat": make_frame(
+            [{"timestamp": 0.1 * i, "event": 1e6, "deviceId": -1,
+              "name": f"sda.{d}", "device_kind": "disk"}
+             for i in range(4) for d in ("r_bw", "w_bw")]),
+        "netbandwidth": make_frame(
+            [{"timestamp": 0.1 * i, "event": 2e6, "payload": int(2e5),
+              "deviceId": -1, "name": f"eth0.{d}", "device_kind": "net"}
+             for i in range(4) for d in ("tx", "rx")]),
+        "nettrace": make_frame(
+            [{"timestamp": 0.02 * i, "duration": 1e-6, "payload": 1500,
+              "pkt_src": packed_ip("10.0.0.1"),
+              "pkt_dst": packed_ip("10.0.0.2"),
+              "name": "tcp", "device_kind": "net"} for i in range(20)]),
+        "pystacks": make_frame(
+            [{"timestamp": 0.01 * i, "event": 1.0, "deviceId": -1,
+              "name": "work", "module": "main;train;step",
+              "device_kind": "cpu"} for i in range(40)]),
+        "strace": make_frame(
+            [{"timestamp": 0.03 * i, "duration": 0.002, "deviceId": -1,
+              "name": "read", "device_kind": "cpu"} for i in range(12)]),
+        "hosttrace": make_frame(
+            [{"timestamp": 0.04 * i, "duration": 0.003, "deviceId": -1,
+              "name": "ExecuteSharded", "device_kind": "host"}
+             for i in range(10)]),
+    }
+    return frames
+
+
+@pytest.fixture(scope="module")
+def sink(tmp_path_factory):
+    """Kitchen-sink logdir built through the real writers + pass list."""
+    import jax
+    import jax.numpy as jnp
+
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.collectors.tpumon import snapshot_memprof
+    from sofa_tpu.ml.diff import sofa_mem_diff, sofa_tpu_diff
+    from sofa_tpu.trace import write_frame
+
+    d = str(tmp_path_factory.mktemp("sink")) + "/"
+    cfg = SofaConfig(logdir=d, enable_aisi=True)
+    frames = _kitchen_sink_frames()
+    for name, df in frames.items():
+        write_frame(df, cfg.path(name), "csv")
+    # a real memprof blob (live-arrays encoder over this process's arrays)
+    held = jnp.ones((256, 256))
+    assert snapshot_memprof(jax, cfg.path("memprof.pb.gz"), "peak",
+                            held.nbytes)
+    # roofline needs the chip peaks sidecar the XPlane ingest writes
+    import json
+
+    with open(cfg.path("tpu_meta.json"), "w") as f:
+        json.dump({str(dev): {"peak_teraflops_per_second": 197.0,
+                              "peak_hbm_bw_gigabytes_per_second": 819.0}
+                   for dev in (0, 1)}, f)
+    sofa_analyze(cfg, frames=frames)
+    # diff inputs: base run = the same capture
+    base = str(tmp_path_factory.mktemp("base")) + "/"
+    write_frame(frames["tputrace"], base + "tputrace", "csv")
+    shutil.copy(cfg.path("memprof.pb.gz"), base + "memprof.pb.gz")
+    shutil.copy(cfg.path("memprof.pb.gz") + ".meta.json",
+                base + "memprof.pb.gz.meta.json")
+    cfg.base_logdir, cfg.match_logdir = base, d
+    sofa_tpu_diff(cfg)
+    sofa_mem_diff(cfg)
+    del held
+    return cfg
+
+
+def test_board_csv_contract(sink):
+    """Every contracted CSV exists in the sink and carries every column
+    the board JS reads — a renamed emitter column fails here."""
+    missing_files = [c for c in CONTRACT if not os.path.isfile(sink.path(c))]
+    assert not missing_files, f"sink did not produce {missing_files}"
+    for csvname, cols in CONTRACT.items():
+        header = list(pd.read_csv(sink.path(csvname), nrows=0).columns)
+        missing = [c for c in cols if c not in header]
+        assert not missing, (csvname, missing, header)
+
+
+def test_board_static_references_covered():
+    """Every fetchCSV target is contracted (or declared table-only) and
+    every literal column reference in the board JS appears in some
+    contracted header — a new page reference forces a contract update."""
+    files = glob.glob(os.path.join(BOARD, "*.html"))
+    files.append(os.path.join(BOARD, "sofa_board.js"))
+    fetched, cols = set(), set()
+    for f in files:
+        src = open(f).read()
+        fetched |= set(re.findall(r'fetchCSV\("([\w.]+\.csv)"\)', src))
+        cols |= set(re.findall(r'\.indexOf\("(\w+)"\)', src))
+        cols |= set(re.findall(r'col\(r, "(\w+)"\)', src))
+        cols |= set(re.findall(r'col\("(\w+)"\)', src))
+        cols |= set(re.findall(r'key: "(\w+)"', src))
+    unknown = fetched - set(CONTRACT) - TABLE_ONLY
+    assert not unknown, f"pages fetch uncontracted CSVs: {sorted(unknown)}"
+    contracted = set().union(*CONTRACT.values())
+    missing = cols - contracted
+    assert not missing, f"pages read uncontracted columns: {sorted(missing)}"
+    # files indexed by column must be contracted, not just table-only
+    assert not (set(CONTRACT) & TABLE_ONLY)
+
+
+def test_serving_feature_names_contract(sink):
+    """serving.html reads specific feature NAMES (values of the name
+    column), not columns — bind those too."""
+    f = pd.read_csv(sink.path("features.csv"))
+    names = set(f["name"])
+    for needed in ("serving_prefill_time", "serving_decode_time"):
+        assert needed in names, f"features.csv lacks {needed}"
+
+
+def test_iterations_stack_has_signal(sink):
+    """The run-report stacked bar needs nonzero device AND host components
+    from the sink — guards the aisi attribution plumbing end to end."""
+    it = pd.read_csv(sink.path("iterations.csv"))
+    assert len(it) >= 3
+    for col in ("fw_compute_time", "bw_compute_time", "collective_time",
+                "syscall_time", "host_python_time"):
+        assert it[col].sum() > 0, f"{col} never attributed"
+    # the stack's device slices are disjoint: the compute phases exclude
+    # the collectives the sink booked with phase "bw"
+    assert it["bw_compute_time"].sum() == pytest.approx(
+        it["bw_time"].sum() - it["collective_time"].sum())

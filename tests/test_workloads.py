@@ -832,3 +832,56 @@ def test_fence_semantics():
         assert fence([_NonAddressable()]) is None
     finally:
         common.jax.block_until_ready = orig
+
+
+def test_flash_backward_guards_and_block_scaling():
+    """ADVICE r4 hardening: (a) _flash_backward rejects mismatched head
+    counts instead of silently misattributing query planes; (b) a direct
+    backward call on fully-masked rows (lse ~ NEG_INF from a clampless
+    producer) yields zero — not exp(0)=1 garbage — gradients; (c)
+    pick_block halves its cap per head-dim doubling past 128 so default
+    blocks stay inside VMEM."""
+    from sofa_tpu.workloads.flash_pallas import (
+        _flash_backward,
+        _flash_forward,
+        pick_block,
+    )
+
+    # (c) head-dim-aware default block cap
+    assert pick_block(4096) == 512
+    assert pick_block(4096, head_dim=256) == 256
+    assert pick_block(4096, head_dim=512) == 128
+    assert pick_block(4096, head_dim=1024) == 128  # floor stays MXU-sized
+
+    key = jax.random.PRNGKey(11)
+    b, t, h, kvh, d = 1, 32, 2, 1, 16
+    q = jax.random.normal(key, (b, t, h, d), jnp.float32)
+    k, v = jax.random.normal(key, (2, b, t, kvh, d), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(12), (b, t, h, d), jnp.float32)
+    out, lse = _flash_forward(q, k, v, 0, 32, 32, True, static_causal=True)
+
+    # (a) mirror of the forward's GQA divisibility check
+    bad_k = jax.random.normal(key, (b, t, 3, d), jnp.float32)
+    with pytest.raises(ValueError, match="not a multiple"):
+        _flash_backward(q, bad_k, bad_k, g, out, lse,
+                        block_q=32, interpret=True)
+
+    # (b) a fully-masked ROW inside a contributing block: shift=-1 hides
+    # every key from query row 0 while the block still passes the kernels'
+    # frontier @pl.when (shift=-t would skip _step entirely and never
+    # execute the clamp).  Row 0's lse is forced to the raw mask floor
+    # (-1e30, what an unclamped producer emits); without the backward
+    # clamp, pt = exp(NEG_INF - NEG_INF) = 1 injects garbage into dK/dV,
+    # so the gradients must match the clamped-forward reference lse run.
+    out1, lse1 = _flash_forward(q, k, v, -1, 32, 32, True,
+                                static_causal=True)
+    dead = jnp.where(
+        jnp.arange(lse1.shape[-1]) == 0, -1e30, lse1)
+    ref_g = _flash_backward(q, k, v, g, out1, lse1, shift=-1,
+                            static_causal=True, block_q=32, interpret=True)
+    dead_g = _flash_backward(q, k, v, g, out1, dead, shift=-1,
+                             static_causal=True, block_q=32, interpret=True)
+    for a, b_ in zip(dead_g, ref_g):
+        arr = np.asarray(a)
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(arr, np.asarray(b_), atol=1e-6)
