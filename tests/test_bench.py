@@ -164,18 +164,38 @@ def test_overhead_budget_smoke(tmp_path, monkeypatch):
     import overhead_budget as mod
 
     out = tmp_path / "OVERHEAD_BUDGET.md"
-    table = mod.run_budget(steps=2, reps=1, out=str(out))
+    table = mod.run_budget(steps=2, reps=1, max_reps=1, out=str(out))
     assert out.is_file() and out.read_text() == table
     assert "baseline" in table
     for row in ("procmon @ 10 Hz", "tpumon @ 20 Hz", "xprof trace",
                 "full sofa.profile() stack"):
         assert row in table, row
-    # every non-baseline row carries a signed marginal (possibly flagged as
-    # inside the paired-run noise floor) or an explicit unavailable
-    import re
-    marked = len(re.findall(r"%(?: \(within noise\))? \|", table))
-    assert marked + table.count("unavailable") >= 7
+    # single-pair rows must refuse to print a CI (a sample range is not a
+    # 95% CI) — they say "too few" instead of a fake "resolved ±0.00 %"
+    assert table.count("too few for a 95% CI") + \
+        table.count("unavailable") >= 8
+    assert "[95% CI" not in table
     assert "noise floor" in table  # baseline row documents the floor
+
+
+def test_overhead_budget_ci_math():
+    """_median_ci: distribution-free order-statistic CI; None below 6
+    samples (a sample range must never masquerade as a 95% CI)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    from overhead_budget import _median_ci
+
+    assert _median_ci([1.0]) is None
+    assert _median_ci([1.0, 2.0, 3.0, 4.0, 5.0]) is None
+    lo, hi = _median_ci(list(range(20)))
+    assert lo <= 9.5 <= hi
+    assert 0 < hi - lo < 19  # tighter than the range, wider than a point
+    # CI tightens with n
+    lo2, hi2 = _median_ci([x / 5 for x in range(100)])
+    assert (hi2 - lo2) < (hi - lo)
 
 
 def test_provisional_line_emitted_once_on_retry(fake_time, monkeypatch,
@@ -405,3 +425,36 @@ def test_committed_last_good_is_valid():
     assert doc["value"] is not None
     assert doc["hlo_rows"] > 0
     assert doc["cached"] is True
+
+
+def test_kernel_perf_tool_pure_parts(tmp_path):
+    """kernel_perf's FLOPs model, peak lookup, and markdown rendering are
+    CPU-testable; the sweep itself is chip-only (validate_tpu runs it)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "kernel_perf", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "kernel_perf.py"))
+    kp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kp)
+
+    # causal halves each matmul; bwd adds 5 matmuls to fwd's 2
+    fwd = kp.attention_flops(2, 1024, 8, 128)
+    assert fwd == 2 * 2 * 1024 * 1024 * 8 * 128 * 0.5 * 2
+    assert kp.attention_flops(2, 1024, 8, 128, bwd=True) == fwd * 3.5
+    assert kp.attention_flops(2, 1024, 8, 128, causal=False) == fwd * 2
+
+    assert kp.peak_from_kind("TPU v5e") == 197.0
+    assert kp.peak_from_kind("TPU v5p") == 459.0  # v5p beats the v5 prefix
+    assert kp.peak_from_kind("weird accelerator") is None
+
+    rows = [{"kernel": "flash fwd", "T": 16384, "gqa": False,
+             "ms": 16.8, "tflops": 9.4},
+            {"kernel": "flash fwd", "T": 2048, "gqa": True,
+             "ms": 1.0, "tflops": 20.0}]
+    md = kp.render_md(rows, 197.0, "datasheet")
+    assert "| flash fwd | 16384 | off | 16.80 | 9.40 | 4.8% |" in md
+    assert "NOT MET" in md  # 4.8% < the 40% target
+    md2 = kp.render_md(rows, None, "unknown")
+    assert "MFU column unavailable" in md2

