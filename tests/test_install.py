@@ -55,17 +55,27 @@ def test_matrix_log_rows_are_dated_and_appended(tmp_path, monkeypatch):
     assert lines[0].split()[0].endswith("Z")  # dated, UTC
 
 
-def test_matrix_venv_case_skips_bare_interpreter(tmp_path):
-    """An interpreter that cannot host the deps offline must produce an
-    explicit SKIP row with the reason — never a silent pass or a crash."""
-    bare = "/usr/bin/python3.11"
-    if not os.access(bare, os.X_OK) or bare == os.path.realpath(sys.executable):
-        pytest.skip("no second bare interpreter on this host")
+def test_matrix_venv_case_degradation_ladder(tmp_path):
+    """The ensurepip-less interpreter climbs the ladder (--without-pip
+    venv + host-pip --python), so with a bogus wheel it reaches and FAILS
+    at the install step — venv creation is no longer the blocker; an
+    interpreter that cannot create ANY venv still yields the explicit
+    SKIP row, never a silent pass or a crash."""
     mod = _matrix_mod()
-    label, status, detail, _dt = mod.venv_case(
-        bare, "bare", wheel="unused.whl", workdir=str(tmp_path))
+    bare = "/usr/bin/python3.11"
+    if os.access(bare, os.X_OK) \
+            and bare != os.path.realpath(sys.executable):
+        _label, status, detail, _dt = mod.venv_case(
+            bare, "bare", wheel="unused.whl", workdir=str(tmp_path))
+        assert status == "FAIL"
+        assert "pip install" in detail
+    broken = tmp_path / "notpython"
+    broken.write_text("#!/bin/sh\nexit 1\n")
+    broken.chmod(0o755)
+    _label, status, detail, _dt = mod.venv_case(
+        str(broken), "broken", wheel="unused.whl", workdir=str(tmp_path))
     assert status == "SKIP"
-    assert detail
+    assert "venv creation unavailable" in detail
 
 
 def test_fresh_venv_install_and_record(tmp_path):

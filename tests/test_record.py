@@ -802,3 +802,44 @@ def test_tpumon_final_memprof_never_triggers_backend_init(tmp_path):
     assert "ok" in r.stdout
     assert not mp.exists(), \
         "at-exit memprof fallback touched an uninitialized backend"
+
+
+def test_marker_authoritative_paths(tmp_path):
+    """The epilogue-kill breadcrumb is only authoritative from the main
+    workload process (the sh wrapper or its direct child) while that
+    writer is still alive — injected deeper descendants and already-exited
+    writers must never arm the kill."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+
+    from sofa_tpu.record import _marker_authoritative
+
+    child = subprocess.Popen(
+        [_sys.executable, "-c",
+         "import subprocess, sys, time\n"
+         "p = subprocess.Popen([sys.executable, '-c',"
+         " 'import time; time.sleep(30)'])\n"
+         "print(p.pid, flush=True)\n"
+         "time.sleep(30)\n"],
+        stdout=subprocess.PIPE, text=True, start_new_session=True)
+    try:
+        grandchild = int(child.stdout.readline())
+        # the wrapper itself (sh `exec`s a single command)
+        assert _marker_authoritative(child, {"pid": child.pid})
+        # a live DIRECT child of the wrapper: the usual python main
+        assert _marker_authoritative(child, {"pid": grandchild})
+        # garbage pids
+        assert not _marker_authoritative(child, {"pid": 0})
+        assert not _marker_authoritative(child, {"pid": "x"})
+        assert not _marker_authoritative(child, {})
+        # a live process OUTSIDE the wrapper's direct children
+        assert not _marker_authoritative(child, {"pid": os.getpid()})
+        # an already-exited writer: leftover breadcrumb, not a live wedge
+        p2 = subprocess.Popen([_sys.executable, "-c", "pass"])
+        p2.wait()
+        assert not _marker_authoritative(child, {"pid": p2.pid})
+    finally:
+        os.killpg(child.pid, signal.SIGKILL)
+        child.wait()
