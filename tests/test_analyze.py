@@ -933,3 +933,21 @@ def test_comm_scatter_contract(cfg):
                              "comm-report.html")).read()
     for name in re.findall(r'col\("([a-z_]+)"\)', page):
         assert name in df.columns, f"page reads missing column {name}"
+
+
+def test_comm_scatter_downsample_keeps_big_payloads(cfg):
+    """Pod-scale packet floods downsample BEFORE the per-row ip maps, rank
+    by payload, and the whale transfer survives even off-stride."""
+    from sofa_tpu.trace import packed_ip
+
+    cfg.viz_downsample_to = 500
+    pkts = [{"timestamp": i * 1e-4, "duration": 1e-6, "payload": 100,
+             "pkt_src": packed_ip("10.0.0.1"), "pkt_dst": packed_ip("10.0.0.2"),
+             "name": "tcp", "device_kind": "net"} for i in range(30000)]
+    pkts[12345]["payload"] = 10 ** 9   # off-stride whale
+    frames = {"nettrace": make_frame(pkts)}
+    f = Features()
+    comm.comm_scatter(frames, cfg, f)
+    df = pd.read_csv(cfg.path("commtrace.csv"))
+    assert len(df) <= 700            # ~viz_downsample_to + top-K union
+    assert df["payload"].max() == 10 ** 9
