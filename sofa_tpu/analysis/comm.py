@@ -273,23 +273,33 @@ def comm_scatter(frames, cfg, features: Features) -> None:
     Downsampled per class with the straggler-preserving sampler so the big
     transfers the user zooms toward never vanish (trace.downsample)."""
     from sofa_tpu.trace import (downsample, downsample_indices,
-                                read_net_addrs, roi_clip, unpack_ip)
+                                read_net_addrs, roi_bounds, roi_clip,
+                                unpack_ip)
 
     parts = []
     df = frames.get("tputrace")
     if df is not None and not df.empty:
-        df = roi_clip(df, cfg)
-    if df is not None and not df.empty:
         # One boolean pass over the raw arrays instead of narrow+concat
         # (copying 7 columns of a 1.6M-row pod frame twice cost ~0.2 s);
-        # only the selected rows are ever materialized.
+        # only the selected rows are ever materialized.  The ROI rides the
+        # same mask — roi_clip on the frame would copy the full 21-column
+        # schema (op_path/module strings included) before the cheap pass.
         ck = df["copyKind"].to_numpy()
         cat = df["category"].to_numpy()
         coll_m = (cat == 0) & (ck >= 20)
         async_m = (cat == 2) & (ck > 0) & (ck < 20)
         if not async_m.any():
             async_m = (cat == 0) & (ck > 0) & (ck < 20)
-        sel = np.flatnonzero(coll_m | async_m)
+        mask = coll_m | async_m
+        bounds = roi_bounds(cfg)
+        if bounds is not None:
+            begin, end = bounds
+            starts = df["timestamp"].to_numpy(dtype=float)
+            ends = starts + df["duration"].to_numpy(dtype=float)
+            mask &= (starts <= end) & (ends >= begin)  # overlap, like
+            sel = np.flatnonzero(mask)                 # trace.roi_clip
+        else:
+            sel = np.flatnonzero(mask)
         if sel.size:
             # pick kept rows on indices first, then take ONLY the five
             # columns this pass emits — never 266k rows x the full schema

@@ -229,10 +229,50 @@ def _write_marker(payload):
         pass
 
 
+def _arm_force_exit(grace):
+    # Last resort: a timed-out stop left a daemon thread stuck in the
+    # device runtime.  Normally the process still exits (daemon threads
+    # die with it), but if that thread wedges interpreter teardown —
+    # e.g. inside malloc/runtime locks a finalizer needs — nothing
+    # in-process can recover.  Arm a watchdog that force-exits after a
+    # grace period; if teardown completes first the process is gone and
+    # the watchdog dies unfired.  Exit code 120 is the contract with
+    # `sofa record` ("wedged at exit; partial trace").
+    def _force_exit():
+        time.sleep(grace)
+        sys.stderr.write(
+            "sofa_tpu: interpreter teardown wedged %gs after a "
+            "timed-out trace stop; force-exiting (120)\\n" % grace)
+        try:
+            sys.stderr.flush()
+            sys.stdout.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(120)
+
+    w = threading.Thread(target=_force_exit, daemon=True,
+                         name="sofa_tpu_force_exit")
+    w.start()
+
+
 def _stop(jax, at_exit=False):
     if _DONE["stopped"] or not _DONE["started"]:
+        if at_exit and _DONE["started"] and not _DONE.get("ok", True):
+            # A mid-run stop (duration timer) already timed out and left a
+            # stuck daemon thread; teardown can still wedge on it, so the
+            # breadcrumb + force-exit contract applies at exit too.
+            grace = _hard_exit_grace_s()
+            _write_marker({"pid": os.getpid(), "t": time.time(),
+                           "timeout_s": _stop_timeout_s(), "grace_s": grace,
+                           "done": True, "ok": False})
+            if grace > 0:
+                _arm_force_exit(grace)
         return
     _DONE["stopped"] = True
+    # Pessimistic until proven otherwise: an atexit racing an IN-FLIGHT
+    # duration stop (still blocked in its bounded calls) must read not-ok
+    # and arm the breadcrumb/watchdog, not default to "fine".
+    _DONE["ok"] = False
     timeout = _stop_timeout_s()
     grace = _hard_exit_grace_s()
     if at_exit:
@@ -254,34 +294,13 @@ def _stop(jax, at_exit=False):
             snapshot_memprof(jax, mp, "final", 0)
         ok = _bounded(_final_memprof, timeout, "final memprof") and ok
     ok = _bounded(jax.profiler.stop_trace, timeout, "stop_trace") and ok
+    _DONE["ok"] = ok
     if at_exit:
         _write_marker({"pid": os.getpid(), "t": time.time(),
                        "timeout_s": timeout, "grace_s": grace,
                        "done": True, "ok": ok})
     if at_exit and not ok and grace > 0:
-        # Last resort: a timed-out stop left a daemon thread stuck in the
-        # device runtime.  Normally the process still exits (daemon threads
-        # die with it), but if that thread wedges interpreter teardown —
-        # e.g. inside malloc/runtime locks a finalizer needs — nothing
-        # in-process can recover.  Arm a watchdog that force-exits after a
-        # grace period; if teardown completes first the process is gone and
-        # the watchdog dies unfired.  Exit code 120 is the contract with
-        # `sofa record` ("wedged at exit; partial trace").
-        def _force_exit():
-            time.sleep(grace)
-            sys.stderr.write(
-                "sofa_tpu: interpreter teardown wedged %gs after a "
-                "timed-out trace stop; force-exiting (120)\\n" % grace)
-            try:
-                sys.stderr.flush()
-                sys.stdout.flush()
-            except Exception:  # noqa: BLE001
-                pass
-            os._exit(120)
-
-        w = threading.Thread(target=_force_exit, daemon=True,
-                             name="sofa_tpu_force_exit")
-        w.start()
+        _arm_force_exit(grace)
 
 
 def _start(jax):

@@ -951,3 +951,17 @@ def test_comm_scatter_downsample_keeps_big_payloads(cfg):
     df = pd.read_csv(cfg.path("commtrace.csv"))
     assert len(df) <= 700            # ~viz_downsample_to + top-K union
     assert df["payload"].max() == 10 ** 9
+
+
+def test_comm_scatter_respects_roi(cfg):
+    """The ROI rides the array mask (roi_clip on the full frame would copy
+    the whole schema): only overlapping comm events survive."""
+    frames = {"tputrace": tpu_frame()}
+    f = Features()
+    comm.comm_scatter(frames, cfg, f)
+    full = pd.read_csv(cfg.path("commtrace.csv"))
+    cfg.roi_begin, cfg.roi_end = 0.0, 0.05   # first half of the 0.1s trace
+    comm.comm_scatter(frames, cfg, f)
+    clipped = pd.read_csv(cfg.path("commtrace.csv"))
+    assert 0 < len(clipped) < len(full)
+    assert (clipped["timestamp"] <= 0.05).all()

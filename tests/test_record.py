@@ -843,3 +843,39 @@ def test_marker_authoritative_paths(tmp_path):
     finally:
         os.killpg(child.pid, signal.SIGKILL)
         child.wait()
+
+
+def test_duration_stop_timeout_still_leaves_exit_breadcrumb(tmp_path,
+                                                            monkeypatch):
+    """--xprof_duration_s stops the trace mid-run; if THAT stop times out
+    on a dead tunnel, the later atexit must still write the done/not-ok
+    breadcrumb (and arm the force-exit watchdog) even though the stop
+    itself already ran — teardown can wedge on the stuck thread."""
+    import json
+    import sys as _sys
+    import time as _time
+
+    prog = tmp_path / "wedge_duration.py"
+    prog.write_text(
+        "import os, sys, time\n"
+        "import jax\n"
+        "jax.devices()\n"
+        "def _wedge():\n"
+        "    time.sleep(600)\n"
+        "jax.profiler.stop_trace = _wedge\n"
+        "print('program ran')\n"
+        "time.sleep(6)\n"  # duration timer (0.5s) fires + stop times out
+        "sys.exit(7)\n"
+    )
+    d = str(tmp_path / "log") + "/"
+    monkeypatch.setenv("SOFA_TPU_STOP_TIMEOUT_S", "2")
+    monkeypatch.setenv("SOFA_TPU_HARD_EXIT_GRACE_S", "10")
+    cfg = SofaConfig(logdir=d, enable_tpu_mon=False, enable_mem_prof=False,
+                     xprof_duration_s=0.5)
+    t0 = _time.time()
+    rc = sofa_record(f"{_sys.executable} {prog}", cfg)
+    assert _time.time() - t0 < 120
+    assert rc == 7
+    with open(os.path.join(cfg.inject_dir, "atexit_stop.json")) as f:
+        m = json.load(f)
+    assert m["done"] is True and m["ok"] is False
