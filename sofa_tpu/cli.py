@@ -146,6 +146,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--empower", action="append", dest="empower", default=None,
                    help="setup: utility to grant profiling capabilities "
                         "(e.g. --empower tcpdump); repeatable")
+    g.add_argument("--no-device-probe", dest="no_device_probe",
+                   action="store_true",
+                   help="setup: skip the bounded device-backend health "
+                        "probe (host-only checks)")
 
     p.add_argument("--plugin", action="append", dest="plugins",
                    help="module[:func] called with the config at startup")
@@ -363,7 +367,9 @@ def _run(argv=None) -> int:
         if cmd == "setup":
             from sofa_tpu.setup_env import sofa_setup
             print_main_progress("SOFA setup")
-            return sofa_setup(utilities=args.empower, apply=args.apply)
+            return sofa_setup(utilities=args.empower, apply=args.apply,
+                              probe_device=not getattr(
+                                  args, "no_device_probe", False))
     except KeyboardInterrupt:
         print_error("interrupted")
         return 130

@@ -59,7 +59,8 @@ def _sudo_prefix() -> str:
     return "sudo " if shutil.which("sudo") and os.geteuid() != 0 else ""
 
 
-def check(utilities: Optional[List[str]] = None) -> Tuple[List[str], int]:
+def check(utilities: Optional[List[str]] = None,
+          probe_device: bool = True) -> Tuple[List[str], int]:
     """Returns (fix commands, number of problems found) and prints a report."""
     fixes: List[str] = []
     problems = 0
@@ -112,24 +113,75 @@ def check(utilities: Optional[List[str]] = None) -> Tuple[List[str], int]:
             fixes.append(f"{sudo}setcap {cap} {path}")
             problems += 1
 
-    # TPU side: purely file-level checks; never touch the JAX backend here
-    # (its init can hang when the chip is busy, and `setup` must always work).
+    # TPU side: file-level checks plus a SUBPROCESS-bounded backend probe —
+    # in-process init can hang forever on a dead/busy device tunnel, and
+    # `setup` must always return.  The probe is how users diagnose "every
+    # JAX program hangs" before sinking a training run into it.
     accel = [d for d in ("/dev/accel0", "/dev/vfio/0") if os.path.exists(d)]
     if accel:
         print_info(f"setup: TPU device node present: {', '.join(accel)}")
     else:
         print_info("setup: no local TPU device node (remote/tunneled chips "
                    "are still usable via JAX)")
+    if probe_device and not _probe_backend():
+        problems += 1   # an unusable device backend IS a setup problem:
+        # scripts gating on the exit code must not read 'fully enabled'
     return fixes, problems
 
 
+def _probe_backend(timeout_s: float = 30.0) -> bool:
+    """Bounded device-backend health report (never raises, never hangs);
+    True iff the backend initialized."""
+    import sys
+
+    # The env-over-config re-apply is NOT redundant: this image's site
+    # hook force-prepends its platform after jax reads JAX_PLATFORMS, so
+    # a JAX_PLATFORMS=cpu probe would otherwise probe the tunnel (same
+    # rule as bench.py's _PROBE_SNIPPET).
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS', '')\n"
+            "if p and jax.config.jax_platforms != p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
+            "d = jax.devices()\n"
+            "print(jax.default_backend(), len(d),\n"
+            "      getattr(d[0], 'device_kind', ''))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print_warning(
+            f"setup: device backend init hung > {timeout_s:.0f}s — the "
+            "device tunnel/runtime is down; JAX programs (and `sofa "
+            "record` of them) will hang at jax.devices().  Host-side "
+            "collectors still work; pin JAX_PLATFORMS=cpu for CPU runs")
+        return False
+    except OSError as e:
+        print_warning(f"setup: backend probe could not launch: {e}")
+        return False
+    if r.returncode == 0 and r.stdout.strip():
+        parts = r.stdout.strip().split(None, 2)
+        backend = parts[0]
+        n = parts[1] if len(parts) > 1 else "?"
+        kind = parts[2] if len(parts) > 2 else ""
+        # print_progress, not print_info: the health verdict is the answer
+        # the user ran `sofa setup` for — it must show without --verbose
+        print_progress(f"setup: device backend healthy: {backend} "
+                       f"({n} device(s){', ' + kind if kind else ''})")
+        return True
+    tail = (r.stderr.strip().splitlines() or ["?"])[-1]
+    print_warning(f"setup: device backend init failed: {tail[:160]}")
+    return False
+
+
 def sofa_setup(utilities: Optional[List[str]] = None, apply: bool = False,
-               runner: Callable[[str], int] = None) -> int:
+               runner: Callable[[str], int] = None,
+               probe_device: bool = True) -> int:
     """Report (and with apply=True, fix) host prerequisites.
 
     runner is injectable for tests; defaults to shell execution.
     """
-    fixes, problems = check(utilities)
+    fixes, problems = check(utilities, probe_device)
     if not fixes:
         if problems:
             print_hint(f"setup: {problems} issue(s), none auto-fixable "

@@ -62,7 +62,7 @@ def test_setup_apply_uses_runner(monkeypatch):
 
     ran = []
     monkeypatch.setattr(setup_env, "check",
-                        lambda utilities=None: (["sysctl -w a=b"], 1))
+                        lambda utilities=None, probe_device=True: (["sysctl -w a=b"], 1))
     rc = setup_env.sofa_setup(apply=True, runner=lambda c: ran.append(c) or 0)
     assert rc == 0
     assert ran == ["sysctl -w a=b"]
@@ -72,7 +72,7 @@ def test_setup_reports_fixes_without_apply(monkeypatch, capsys):
     from sofa_tpu import setup_env
 
     monkeypatch.setattr(setup_env, "check",
-                        lambda utilities=None: (["setcap x /bin/tcpdump"], 1))
+                        lambda utilities=None, probe_device=True: (["setcap x /bin/tcpdump"], 1))
     rc = setup_env.sofa_setup(apply=False)
     assert rc == 1
     assert "setcap x /bin/tcpdump" in capsys.readouterr().out
@@ -154,3 +154,32 @@ def test_report_missing_logdir_clean_error(tmp_path):
     assert r.returncode == 1
     assert "Traceback" not in r.stderr
     assert "does not exist" in r.stderr + r.stdout
+
+
+def test_setup_backend_probe_is_bounded(monkeypatch, capsys):
+    """`sofa setup` diagnoses a dead device tunnel (subprocess-bounded
+    probe) instead of hanging like in-process jax.devices() would."""
+    import subprocess as sp
+
+    from sofa_tpu import setup_env
+
+    def hang(*a, **k):
+        raise sp.TimeoutExpired(cmd="probe", timeout=30)
+
+    monkeypatch.setattr(setup_env.subprocess, "run", hang)
+    setup_env._probe_backend()
+    out = capsys.readouterr()
+    text = out.out + out.err
+    assert "hung" in text and "tunnel" in text
+
+    def healthy(*a, **k):
+        class R:
+            returncode = 0
+            stdout = "tpu 1 TPU v5e\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(setup_env.subprocess, "run", healthy)
+    setup_env._probe_backend()
+    text = capsys.readouterr().out
+    assert "healthy: tpu" in text
