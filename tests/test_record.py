@@ -879,3 +879,35 @@ def test_duration_stop_timeout_still_leaves_exit_breadcrumb(tmp_path,
     with open(os.path.join(cfg.inject_dir, "atexit_stop.json")) as f:
         m = json.load(f)
     assert m["done"] is True and m["ok"] is False
+
+
+def test_record_never_kills_healthy_slow_teardown(tmp_path):
+    """A clean trace stop (breadcrumb done+ok) disarms the epilogue
+    deadline entirely: app atexit work running AFTER our stop (registered
+    earlier => runs later, LIFO) may take arbitrarily long — killing a
+    final checkpoint write would be worse than the hang we fixed."""
+    import sys as _sys
+    import time as _time
+
+    prog = tmp_path / "slow_teardown.py"
+    prog.write_text(
+        "import atexit, os, sys, time\n"
+        "atexit.register(lambda: time.sleep(4))\n"  # runs AFTER our stop
+        "import jax\n"
+        "jax.devices()\n"
+        "print('program ran')\n"
+        "logdir = sys.argv[1]\n"
+        "for _ in range(500):\n"
+        "    if os.path.exists(os.path.join(logdir, 'xprof_marker.txt')):\n"
+        "        break\n"
+        "    time.sleep(0.02)\n"
+        "sys.exit(0)\n"
+    )
+    d = str(tmp_path / "log") + "/"
+    cfg = SofaConfig(logdir=d, enable_tpu_mon=False, enable_mem_prof=False,
+                     epilogue_deadline_s=1.0)   # aggressive on purpose
+    t0 = _time.time()
+    rc = sofa_record(f"{_sys.executable} {prog} {d}", cfg)
+    elapsed = _time.time() - t0
+    assert rc == 0, "healthy slow teardown was killed"
+    assert elapsed >= 4.0  # the atexit sleep really ran to completion
