@@ -61,11 +61,11 @@ def load_frames(cfg: SofaConfig,
                 only: "List[str] | None" = None) -> Dict[str, pd.DataFrame]:
     """Read trace frames from the logdir; ``only`` restricts to a subset so
     narrow consumers (sofa export) skip deserializing pod-scale traces they
-    never chart.  Reads overlap on a small thread pool — the arrow CSV and
-    parquet decoders release the GIL, so the 15 small frames hide behind
-    the one pod-scale tputrace."""
-    from concurrent.futures import ThreadPoolExecutor
-
+    never chart.  Reads overlap on a thread pool (width = the shared --jobs
+    setting, sofa_tpu/pool.py) — the arrow CSV and parquet decoders release
+    the GIL, so the 15 small frames hide behind the one pod-scale
+    tputrace."""
+    from sofa_tpu import pool
     from sofa_tpu.trace import read_frame
 
     names = list(only if only is not None else CSV_SOURCES)
@@ -78,10 +78,7 @@ def load_frames(cfg: SofaConfig,
             df = empty_frame()
         return df if df is not None else empty_frame()
 
-    if len(names) <= 1:
-        return {n: load_one(n) for n in names}
-    with ThreadPoolExecutor(max_workers=4) as pool:
-        loaded = list(pool.map(load_one, names))
+    loaded = pool.thread_map(load_one, names, pool.cfg_jobs(cfg))
     return dict(zip(names, loaded))
 
 
@@ -150,14 +147,21 @@ def load_cluster_frames(cfg: SofaConfig,
 
     from sofa_tpu.preprocess import read_time_base
 
+    from sofa_tpu import pool
+
     merged: Dict[str, List[pd.DataFrame]] = {}
     time_bases: Dict[str, float] = {}
-    host_frames = []
+    present = []
     for i, hostname, host_cfg in cluster_host_cfgs(cfg):
         if not os.path.isdir(host_cfg.logdir):
             print_warning(f"cluster: missing logdir {host_cfg.logdir}")
             continue
-        host_frames.append((i, hostname, load_frames(host_cfg, only=only)))
+        present.append((i, hostname, host_cfg))
+    # hosts deserialize concurrently; assembly below stays in host order
+    host_frames = pool.thread_map(
+        lambda item: (item[0], item[1], load_frames(item[2], only=only)),
+        present, pool.cfg_jobs(cfg))
+    for i, hostname, host_cfg in present:
         time_bases[hostname] = read_time_base(host_cfg)
     _, shifts = cluster_clock_shifts(time_bases)
     for i, hostname, frames in host_frames:
@@ -310,6 +314,7 @@ def cluster_analyze(
     just ran in this process (the report path hands them through so the
     pod-scale CSVs written a moment ago aren't re-deserialized).
     """
+    from sofa_tpu import pool
     from sofa_tpu.analysis.comm import dcn_step_correlation
     from sofa_tpu.preprocess import build_series, read_time_base
     from sofa_tpu.trace import series_to_report_js
@@ -320,24 +325,39 @@ def cluster_analyze(
     host_frames: Dict[str, Dict[str, pd.DataFrame]] = {}
     time_bases: Dict[str, float] = {}
     host_cfgs: Dict[str, SofaConfig] = {}
+    host_list = []
     for _i, hostname, host_cfg in cluster_host_cfgs(cfg):
         if not os.path.isdir(host_cfg.logdir):
             print_warning(f"cluster: missing logdir {host_cfg.logdir}")
             continue
+        host_list.append((hostname, host_cfg))
+
+    def analyze_host(item):
+        """Per-host load + analyze — the parallel leg.  Hosts write only
+        into their own logdirs, so workers never share files; the merged
+        timeline below is the single join point."""
+        hostname, host_cfg = item
         print_progress(f"cluster: analyzing {hostname}")
-        host_cfgs[hostname] = host_cfg
-        host_frames[hostname] = (
-            preloaded[hostname] if preloaded and hostname in preloaded
-            else load_frames(host_cfg))
-        results[hostname] = sofa_analyze(host_cfg, host_frames[hostname])
-        time_bases[hostname] = read_time_base(host_cfg)
+        frames = (preloaded[hostname]
+                  if preloaded and hostname in preloaded
+                  else load_frames(host_cfg))
+        features = sofa_analyze(host_cfg, frames)
+        return (hostname, frames, features, read_time_base(host_cfg),
+                dcn_step_correlation(frames))
+
+    cfg_by_host = dict(host_list)
+    for hostname, frames, features, time_base, corr in pool.thread_map(
+            analyze_host, host_list, pool.cfg_jobs(cfg)):
+        host_cfgs[hostname] = cfg_by_host[hostname]
+        host_frames[hostname] = frames
+        results[hostname] = features
+        time_bases[hostname] = time_base
         row = {"host": hostname}
         for key in ("elapsed_time", "cpu_util", "tpu0_op_time", "comm_ratio",
                     "net_tx_total_bytes", "net_rx_total_bytes", "tc_util_mean"):
             value = results[hostname].get(key)
             if value is not None:
                 row[key] = value
-        corr = dcn_step_correlation(host_frames[hostname])
         if corr is not None:
             row["dcn_step_corr"] = round(corr, 4)
         rows.append(row)

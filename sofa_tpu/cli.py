@@ -60,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--config", default=None, help="TOML config file; explicit CLI flags override it")
     g.add_argument("--verbose", action="store_true")
     g.add_argument("--skip_preprocess", action="store_true")
+    g.add_argument("--jobs", type=int,
+                   help="worker count for the pipeline pools (ingest, "
+                        "frame IO, per-host cluster analysis); 0 = auto "
+                        "from cpu count")
+    g.add_argument("--no_ingest_cache", action="store_true",
+                   help="bypass the content-keyed ingest cache "
+                        "(always reparse raw collector files)")
     g.add_argument("--with-gui", dest="with_gui", action="store_true", default=False,
                    help="serve the board after `report`")
     g.add_argument("--perfetto", action="store_true", default=False,
@@ -165,7 +172,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
 
     # Flags that map 1:1 onto SofaConfig fields.
     for name in (
-        "logdir", "verbose", "skip_preprocess",
+        "logdir", "verbose", "skip_preprocess", "jobs",
         "perf_events", "no_perf_events", "cpu_sample_rate", "perf_call_graph",
         "sys_mon_rate",
         "enable_strace", "strace_min_time", "enable_py_stacks", "enable_tcpdump",
@@ -181,6 +188,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
     ):
         if was_set(name):
             setattr(cfg, name, passed[name])
+    if was_set("no_ingest_cache"):
+        cfg.ingest_cache = not passed["no_ingest_cache"]
     if was_set("disable_xprof"):
         cfg.enable_xprof = not passed["disable_xprof"]
     if was_set("disable_tpu_mon"):

@@ -66,6 +66,15 @@ class SofaConfig:
     command: str = ""
     verbose: bool = False
     skip_preprocess: bool = False
+    # Worker count for every pipeline pool (ingest fan-out, frame writes,
+    # analyze reads, per-host cluster analysis, xplane per-file processes).
+    # 0 = auto: os.cpu_count() capped, SOFA_JOBS env override — resolution
+    # lives in sofa_tpu/pool.py so the policy exists in exactly one place.
+    jobs: int = 0
+    # Content-keyed ingest cache (ingest/cache.py): re-runs over unchanged
+    # raw files load cached parquet instead of reparsing.  --no_ingest_cache
+    # bypasses; `sofa clean` removes the cache directory.
+    ingest_cache: bool = True
 
     # --- record: host collectors ------------------------------------------
     perf_events: str = ""            # extra `perf record -e` events

@@ -1,0 +1,157 @@
+"""Content-keyed ingest cache: parsed frames beside the logdir.
+
+Re-running ``sofa preprocess`` / ``sofa report`` used to reparse every raw
+collector file from scratch.  Parsed frames are pure functions of (raw file
+bytes, parser version, parse parameters), so each ingest source's output is
+cached under ``<logdir>/_ingest_cache/`` keyed on:
+
+  * every raw file's (path, size, mtime_ns) — an absent file is recorded as
+    absent, so a source appearing later invalidates cleanly;
+  * the source's entry in :data:`PARSER_VERSIONS` — bump it whenever a
+    parser's OUTPUT for the same input changes;
+  * parse parameters that shape the output (time_base, strace min_time, ...).
+
+On a key match the cached parquet loads instead of reparsing (pickle
+fallback when pyarrow is absent); any mismatch reparses and overwrites.
+Frames are cached PRE time-offset: ``--cpu_time_offset_ms`` /
+``--tpu_time_offset_ms`` are applied by preprocess after loading, so
+changing an offset never invalidates the cache.
+
+Escape hatches: ``--no_ingest_cache`` bypasses both read and write;
+``sofa clean`` removes the cache directory with the other derived files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+CACHE_DIR_NAME = "_ingest_cache"
+
+# Cache container format; a bump invalidates every cached source at once.
+CACHE_FORMAT = 1
+
+# Per-source parser versions — bump a source's entry whenever its parser's
+# output for unchanged input changes (new columns, fixed math, ...).
+PARSER_VERSIONS: Dict[str, int] = {
+    "mpstat": 1,
+    "diskstat": 1,
+    "netbandwidth": 1,
+    "cpuinfo": 1,
+    "vmstat": 1,
+    "cputrace": 1,
+    "strace": 1,
+    "pystacks": 1,
+    "nettrace": 1,
+    "tpumon": 1,
+    "blktrace": 1,
+    "xplane": 1,
+}
+
+
+def _file_sig(path: str) -> List:
+    """(path, size, mtime_ns); absent files sign as (-1, -1) so presence
+    changes flip the key."""
+    try:
+        st = os.stat(path)
+        return [path, int(st.st_size), int(st.st_mtime_ns)]
+    except OSError:
+        return [path, -1, -1]
+
+
+def make_key(source: str, raw_paths, params: "dict | None" = None) -> dict:
+    return {
+        "format": CACHE_FORMAT,
+        "source": source,
+        "parser_version": PARSER_VERSIONS.get(source, 0),
+        "files": [_file_sig(p) for p in sorted(raw_paths)],
+        "params": params or {},
+    }
+
+
+def raw_files_present(key: dict) -> bool:
+    """Whether ANY raw input exists — sources with nothing on disk parse to
+    an empty frame instantly and are not worth a cache entry."""
+    return any(size >= 0 for _p, size, _m in key["files"])
+
+
+class IngestCache:
+    """One logdir's ingest cache.  ``enabled=False`` turns every operation
+    into a no-op so ``--no_ingest_cache`` needs no branching in callers."""
+
+    def __init__(self, root: str, enabled: bool = True):
+        self.root = root
+        self.enabled = enabled
+        self.hits: List[str] = []
+        self.misses: List[str] = []
+
+    def _key_path(self, source: str) -> str:
+        return os.path.join(self.root, f"{source}.key.json")
+
+    def _frame_path(self, source: str, frame: str, ext: str) -> str:
+        return os.path.join(self.root, f"{source}__{frame}{ext}")
+
+    def load(self, source: str, key: dict) -> "Optional[dict]":
+        """Cached ``{"frames": {name: df}, "meta": {...}}`` on a key match,
+        else None.  Any read/parse problem degrades to a miss."""
+        if not self.enabled:
+            return None
+        try:
+            with open(self._key_path(source)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if doc.get("key") != key:
+            return None
+        from sofa_tpu.trace import _conform
+
+        frames: Dict[str, pd.DataFrame] = {}
+        try:
+            for name in doc.get("frames", []):
+                pq = self._frame_path(source, name, ".parquet")
+                pk = self._frame_path(source, name, ".pkl")
+                if os.path.isfile(pq):
+                    frames[name] = _conform(pd.read_parquet(pq))
+                elif os.path.isfile(pk):
+                    frames[name] = _conform(pd.read_pickle(pk))
+                else:
+                    return None
+        except Exception:  # noqa: BLE001 — a corrupt cache entry is a miss
+            return None
+        self.hits.append(source)
+        return {"frames": frames, "meta": doc.get("meta") or {}}
+
+    def store(self, source: str, key: dict,
+              frames: Dict[str, pd.DataFrame],
+              meta: "dict | None" = None) -> None:
+        """Persist a parse result; best-effort (a read-only logdir must not
+        fail preprocess)."""
+        if not self.enabled:
+            return
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            for name, df in frames.items():
+                pq = self._frame_path(source, name, ".parquet")
+                pk = self._frame_path(source, name, ".pkl")
+                try:
+                    df.to_parquet(pq + ".tmp", index=False)
+                    os.replace(pq + ".tmp", pq)
+                    if os.path.isfile(pk):
+                        os.unlink(pk)  # never shadow a fresh parquet
+                except Exception:  # noqa: BLE001 — no pyarrow: pickle fallback
+                    df.to_pickle(pk + ".tmp")
+                    os.replace(pk + ".tmp", pk)
+                    if os.path.isfile(pq):
+                        os.unlink(pq)
+            doc = {"key": key, "frames": sorted(frames), "meta": meta or {}}
+            tmp = self._key_path(source) + ".tmp"
+            # Key json LAST — a crash mid-store leaves a stale key that
+            # simply mismatches, never a key pointing at missing frames.
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self._key_path(source))
+        except OSError:
+            pass

@@ -826,7 +826,8 @@ def _ingest_one(args) -> Tuple[Dict[str, pd.DataFrame], Dict]:
 
 
 def ingest_xprof_dir(
-    xprof_dir: str, time_base: float, window_s: float = 0.1
+    xprof_dir: str, time_base: float, window_s: float = 0.1,
+    jobs: "int | None" = None,
 ) -> Dict[str, pd.DataFrame]:
     """Ingest every XSpace under an xprof dir, concatenating multi-host files.
 
@@ -834,7 +835,12 @@ def ingest_xprof_dir(
     process pool — proto decode + frame building is CPU-bound Python, so
     this is the mp.Pool.map the reference used for its per-GPU nvvp files
     (sofa_preprocess.py:1343-1456).  Single files stay in-process.
+    ``jobs`` caps the pool width (None = the shared auto policy,
+    sofa_tpu/pool.py; preprocess passes its --jobs setting through).
     """
+    from sofa_tpu.pool import pool_size, resolve_jobs
+
+    max_jobs = resolve_jobs(jobs or 0)
     paths = find_xplane_files(xprof_dir)
     if not paths:
         return {}
@@ -863,9 +869,11 @@ def ingest_xprof_dir(
             total_bytes += os.path.getsize(p)
         except OSError:
             pass
+    # `always` overrides even a --jobs 1 / single-CPU resolution (tests use
+    # it to keep the pool path covered); auto requires real parallelism.
     use_pool = len(jobs) > 1 and policy != "never" and (
-        policy == "always" or len(jobs) >= 12
-        or total_bytes >= 48 * 2 ** 20)
+        policy == "always" or (max_jobs > 1 and (
+            len(jobs) >= 12 or total_bytes >= 48 * 2 ** 20)))
     serial_from = None if use_pool else 0
     if use_pool:
         try:
@@ -879,7 +887,8 @@ def ingest_xprof_dir(
                 "forkserver" if "forkserver" in methods else "spawn")
             print_info(f"xplane: ingesting {len(jobs)} host files in "
                        f"parallel")
-            with ProcessPoolExecutor(max_workers=min(len(jobs), 8),
+            with ProcessPoolExecutor(max_workers=pool_size(max_jobs,
+                                                           len(jobs)),
                                      mp_context=ctx) as ex:
                 futures = [ex.submit(_ingest_one, job) for job in jobs]
                 for job, fut in zip(jobs, futures):

@@ -1,0 +1,73 @@
+"""Shared worker-pool policy for the preprocess/analyze report path.
+
+Every pool on the report path sizes itself HERE: the ingest fan-out and the
+frame writes in preprocess, the frame reads in analyze, the per-host
+cluster_analyze workers, and the xplane multi-file process pool all take
+their width from one ``--jobs`` setting (SofaConfig.jobs, 0 = auto from
+``os.cpu_count()``, env override ``SOFA_JOBS`` for the auto default).
+
+Thread pools are the default — pandas/pyarrow readers and writers release
+the GIL, and the pure-Python parsers still overlap their file IO.  Process
+pools (CPU-heavy parsers: perf script, pcap, xplane protos) are built from
+:func:`process_context` — forkserver when available, else spawn, never fork:
+callers may hold collector/sampler threads and a forked child of a threaded
+process can deadlock (same rule as ingest/xplane.py's pool).
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+# Auto mode caps here: past ~32 workers the report path is IO- or
+# join-bound, and a 256-core host should not build 256-thread pools.
+MAX_AUTO_JOBS = 32
+
+
+def resolve_jobs(jobs: int = 0) -> int:
+    """Materialize a jobs setting: explicit positive value wins; 0/negative
+    means auto — ``SOFA_JOBS`` if set, else ``os.cpu_count()`` (capped)."""
+    if jobs and jobs > 0:
+        return int(jobs)
+    env = os.environ.get("SOFA_JOBS", "").strip()
+    if env.isdigit() and int(env) > 0:
+        return min(int(env), MAX_AUTO_JOBS)
+    try:  # cgroup/affinity-restricted containers: usable CPUs, not present
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover — non-Linux
+        n = os.cpu_count() or 1
+    return max(1, min(n, MAX_AUTO_JOBS))
+
+
+def cfg_jobs(cfg) -> int:
+    """The resolved worker count for a SofaConfig (0/absent = auto)."""
+    return resolve_jobs(getattr(cfg, "jobs", 0))
+
+
+def pool_size(jobs: int, n_items: int) -> int:
+    """Workers to actually start: never more than items, never less than 1."""
+    return max(1, min(jobs, n_items))
+
+
+def thread_map(fn: Callable[[T], R], items: "Iterable[T] | Sequence[T]",
+               jobs: int) -> List[R]:
+    """Ordered ``map`` over a thread pool; serial when jobs==1 or one item
+    (so ``--jobs 1`` is a true no-pool path with clean tracebacks)."""
+    items = list(items)
+    if jobs <= 1 or len(items) <= 1:
+        return [fn(x) for x in items]
+    with ThreadPoolExecutor(max_workers=pool_size(jobs, len(items))) as pool:
+        return list(pool.map(fn, items))
+
+
+def process_context():
+    """Multiprocessing context for CPU-heavy parser pools: forkserver when
+    available, else spawn — never fork (see module docstring)."""
+    import multiprocessing as mp
+
+    methods = mp.get_all_start_methods()
+    return mp.get_context("forkserver" if "forkserver" in methods else "spawn")

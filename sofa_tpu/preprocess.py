@@ -5,22 +5,33 @@ and writes `<source>.csv` in the unified schema, then all timeline series are
 serialized to report.js for the board.  Each source is optional and failures
 degrade per-source (the reference wraps every pass in try/except,
 sofa_analyze.py:873-977; we do the same here at ingest).
+
+The ~12 ingest sources are independent, so they fan out across a worker
+pool (threads by default; the CPU-heavy parsers — perf script, pcap, the
+xplane protos' internal pool — may move to a process pool when their raw
+bytes justify worker spawn).  Results are assembled in a fixed task order,
+so ``--jobs 1`` and ``--jobs N`` produce identical frames.  Parsed frames
+are also cached content-keyed beside the logdir (ingest/cache.py): a re-run
+over unchanged raw files loads parquet instead of reparsing.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List
+import time
+from typing import Dict, List, NamedTuple, Tuple
 
 import pandas as pd
 
+from sofa_tpu import pool
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.ingest import procfs
+from sofa_tpu.ingest.cache import (CACHE_DIR_NAME, IngestCache, make_key,
+                                   raw_files_present)
 from sofa_tpu.ingest.pcap import ingest_pcap
 from sofa_tpu.ingest.perf_script import ingest_perf
-from sofa_tpu.ingest.strace_parse import parse_pystacks, parse_strace
 from sofa_tpu.ingest.timebase_align import converter
-from sofa_tpu.ingest.xplane import ingest_xprof_dir
+from sofa_tpu.ingest.xplane import find_xplane_files, ingest_xprof_dir
 from sofa_tpu.printing import print_progress, print_warning
 from sofa_tpu.trace import (SofaSeries, downsample, empty_frame, write_csv,
                             write_frame)
@@ -46,6 +57,10 @@ _SERIES_STYLE = {
     "blktrace": ("Block IO latency (ms)", "peru"),
 }
 
+# Frames the xplane ingest contributes, in deterministic output order.
+_XPLANE_FRAMES = ("tputrace", "tpumodules", "hosttrace", "tpusteps",
+                  "customtrace")
+
 
 def read_time_base(cfg: SofaConfig) -> float:
     try:
@@ -69,6 +84,239 @@ def read_misc(cfg: SofaConfig) -> Dict[str, str]:
     return out
 
 
+# --- ingest workers ---------------------------------------------------------
+# Module-level (picklable for the process pool) and resolving their parser by
+# attribute at CALL time, so tests can monkeypatch individual parsers.
+
+def _ingest_procfs(path: str, parser_name: str, time_base: float,
+                   **kw) -> pd.DataFrame:
+    return procfs.load(path, getattr(procfs, parser_name), time_base, **kw)
+
+
+def _ingest_vmstat(path: str, time_base: float) -> pd.DataFrame:
+    return procfs.load(path, procfs.parse_vmstat, time_base,
+                       record_start=time_base)
+
+
+def _ingest_text(path: str, parser_name: str, time_base: float,
+                 **kw) -> pd.DataFrame:
+    from sofa_tpu.ingest import strace_parse
+
+    if not os.path.isfile(path):
+        return empty_frame()
+    with open(path) as f:
+        return getattr(strace_parse, parser_name)(
+            f.read(), time_base=time_base, **kw)
+
+
+def _ingest_cputrace(logdir: str, time_base: float) -> pd.DataFrame:
+    """perf samples need the MHz interpolator + clock bridge; both are built
+    from small logdir files, so the worker rebuilds them locally (closures
+    don't cross a process-pool boundary)."""
+    mono_to_unix = converter(os.path.join(logdir, "timebase.txt"), "monotonic")
+    cpuinfo = procfs.load(os.path.join(logdir, "cpuinfo.txt"),
+                          procfs.parse_cpuinfo, time_base)
+    return ingest_perf(logdir, time_base, mono_to_unix,
+                       procfs.cpu_mhz_interpolator(cpuinfo))
+
+
+def _ingest_tpumon(logdir: str, time_base: float) -> pd.DataFrame:
+    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
+
+    return ingest_tpumon(logdir, time_base)
+
+
+def _ingest_blktrace(logdir: str) -> pd.DataFrame:
+    # blkparse times are already trace-relative -> time_base 0
+    from sofa_tpu.ingest.blktrace_parse import ingest_blktrace
+
+    return ingest_blktrace(logdir, 0.0)
+
+
+def _ingest_xplane(xprof_dir: str, time_base: float,
+                   jobs: int) -> Dict[str, pd.DataFrame]:
+    return ingest_xprof_dir(xprof_dir, time_base, jobs=jobs)
+
+
+class _IngestTask(NamedTuple):
+    name: str                 # source name == cache key == primary frame
+    kind: str                 # "thread" (small/IO) | "proc" (CPU-heavy parse)
+    fn: object                # module-level callable
+    args: tuple
+    kwargs: dict
+    raw_paths: tuple          # raw files the cache key signs
+    params: dict              # parse params that shape the output
+    frame_names: tuple        # frames produced, in output order
+
+
+def _ingest_tasks(cfg: SofaConfig, time_base: float,
+                  jobs: int) -> List[_IngestTask]:
+    """THE task table — declaration order is frame output order, so the
+    parallel fan-out stays frame-identical to a serial run."""
+    P = cfg.path
+    tasks: List[_IngestTask] = []
+
+    def T(name, kind, fn, args, raw, kwargs=None, params=None, frames=None):
+        merged = {"time_base": time_base}
+        merged.update(params or {})
+        tasks.append(_IngestTask(name, kind, fn, tuple(args), kwargs or {},
+                                 tuple(raw), merged,
+                                 tuple(frames or (name,))))
+
+    # host samplers (tiny text files -> threads)
+    T("mpstat", "thread", _ingest_procfs,
+      (P("mpstat.txt"), "parse_mpstat", time_base), [P("mpstat.txt")])
+    T("diskstat", "thread", _ingest_procfs,
+      (P("diskstat.txt"), "parse_diskstat", time_base), [P("diskstat.txt")])
+    T("netbandwidth", "thread", _ingest_procfs,
+      (P("netstat.txt"), "parse_netstat", time_base), [P("netstat.txt")])
+    T("cpuinfo", "thread", _ingest_procfs,
+      (P("cpuinfo.txt"), "parse_cpuinfo", time_base), [P("cpuinfo.txt")])
+    T("vmstat", "thread", _ingest_vmstat, (P("vmstat.txt"), time_base),
+      [P("vmstat.txt")])
+    # perf CPU samples (regex parse over perf-script text: CPU-heavy)
+    T("cputrace", "proc", _ingest_cputrace, (cfg.logdir, time_base),
+      [P("perf.data"), P("perf.script"), P("kallsyms"), P("timebase.txt"),
+       P("cpuinfo.txt")])
+    # syscalls / python stacks / packets
+    T("strace", "thread", _ingest_text,
+      (P("strace.txt"), "parse_strace", time_base), [P("strace.txt")],
+      kwargs={"min_time": cfg.strace_min_time},
+      params={"min_time": cfg.strace_min_time})
+    T("pystacks", "thread", _ingest_text,
+      (P("pystacks.txt"), "parse_pystacks", time_base), [P("pystacks.txt")])
+    T("nettrace", "proc", ingest_pcap, (P("sofa.pcap"), time_base),
+      [P("sofa.pcap")])
+    # live TPU runtime metrics (works even with --disable_xprof)
+    T("tpumon", "thread", _ingest_tpumon, (cfg.logdir, time_base),
+      [P("tpumon.txt")])
+    T("blktrace", "thread", _ingest_blktrace, (cfg.logdir,),
+      [P("blktrace.txt")])
+    # TPU XPlane (multi-frame; its own per-file process pool sits inside)
+    T("xplane", "thread", _ingest_xplane, (cfg.xprof_dir, time_base, jobs),
+      find_xplane_files(cfg.xprof_dir), frames=_XPLANE_FRAMES)
+    return tasks
+
+
+def _normalize(task: _IngestTask, res) -> Tuple[Dict[str, pd.DataFrame], dict]:
+    """Worker result -> ({frame name: df} in declared order, meta dict)."""
+    if isinstance(res, dict):
+        res = dict(res)
+        meta = res.pop("_meta", {})
+        return {fn: res.get(fn, empty_frame()) for fn in task.frame_names}, meta
+    df = res if res is not None else empty_frame()
+    return {task.name: df}, {}
+
+
+# Raw bytes below this parse faster than a process-pool worker spawns
+# (forkserver + pandas import costs seconds); SOFA_PREPROCESS_POOL
+# overrides (always|never, tests use `always` to keep the path covered).
+_PROC_POOL_MIN_BYTES = 32 * 2 ** 20
+
+
+def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
+    """Execute cache-miss tasks -> {name: (raw result | None, error | None)}.
+
+    CPU-heavy ("proc") tasks go to a process pool when policy/size allow,
+    overlapping with the thread-pool tasks; any pool failure degrades to
+    in-thread execution so per-source try/except semantics are preserved.
+    """
+
+    def run_local(t: _IngestTask) -> tuple:
+        try:
+            return t.fn(*t.args, **t.kwargs), None
+        except Exception as e:  # noqa: BLE001 — per-source degradation
+            return None, str(e)
+
+    outcomes: Dict[str, tuple] = {}
+    policy = os.environ.get("SOFA_PREPROCESS_POOL", "auto")
+    proc_tasks = [t for t in pending if t.kind == "proc"]
+    proc_bytes = 0
+    for t in proc_tasks:
+        for p in t.raw_paths:
+            try:
+                proc_bytes += os.path.getsize(p)
+            except OSError:
+                pass
+    use_proc = (jobs > 1 and proc_tasks and policy != "never"
+                and (policy == "always" or proc_bytes >= _PROC_POOL_MIN_BYTES))
+    procpool, futs = None, {}
+    if use_proc:
+        try:
+            from concurrent.futures import ProcessPoolExecutor
+
+            procpool = ProcessPoolExecutor(
+                max_workers=pool.pool_size(jobs, len(proc_tasks)),
+                mp_context=pool.process_context())
+            for t in proc_tasks:
+                futs[t.name] = procpool.submit(t.fn, *t.args, **t.kwargs)
+        except Exception as e:  # noqa: BLE001 — sandboxed /dev/shm, no spawn
+            print_warning(f"preprocess: process pool unavailable ({e}); "
+                          "parsing in threads")
+            procpool, futs = None, {}
+    local = [t for t in pending if t.name not in futs]
+    for t, out in zip(local, pool.thread_map(run_local, local, jobs)):
+        outcomes[t.name] = out
+    if procpool is not None:
+        from concurrent.futures import BrokenExecutor
+
+        broken = False
+        for t in proc_tasks:
+            if broken:
+                outcomes[t.name] = run_local(t)
+                continue
+            try:
+                outcomes[t.name] = (futs[t.name].result(), None)
+            except BrokenExecutor as e:
+                # A crashed/OOM-killed worker poisons every pending future —
+                # an environment failure, not a parse failure: rerun the
+                # remaining proc tasks in-process.
+                print_warning(f"preprocess: process pool broke ({e!r}); "
+                              "reparsing remaining sources in-process")
+                broken = True
+                outcomes[t.name] = run_local(t)
+            except Exception as e:  # noqa: BLE001 — per-source degradation
+                outcomes[t.name] = (None, str(e))
+        procpool.shutdown()
+    return outcomes
+
+
+def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int):
+    """Cache-or-parse every source -> (tasks, {name: (frames, meta, error)},
+    cache)."""
+    tasks = _ingest_tasks(cfg, time_base, jobs)
+    cache = IngestCache(cfg.path(CACHE_DIR_NAME), enabled=cfg.ingest_cache)
+    keys = {t.name: make_key(t.name, t.raw_paths, t.params) for t in tasks}
+    # cache loads overlap on threads — the parquet decoder releases the GIL
+    loaded = pool.thread_map(lambda t: cache.load(t.name, keys[t.name]),
+                             tasks, jobs)
+    results: Dict[str, tuple] = {}
+    pending: List[_IngestTask] = []
+    for t, hit in zip(tasks, loaded):
+        if hit is not None:
+            results[t.name] = (hit["frames"], hit["meta"], None)
+        else:
+            pending.append(t)
+    if pending:
+        outcomes = _run_pending(pending, jobs)
+        for t in pending:
+            res, err = outcomes[t.name]
+            if err is None:
+                frames, meta = _normalize(t, res)
+                results[t.name] = (frames, meta, None)
+                # Re-key at store time: a parse may materialize one of its
+                # own raw inputs (ingest_perf converts perf.data ->
+                # perf.script), and the key must sign the files' FINAL
+                # state or the very next run misses once for nothing.
+                key = make_key(t.name, t.raw_paths, t.params)
+                if raw_files_present(key):
+                    cache.store(t.name, key, frames, meta)
+            else:
+                results[t.name] = (
+                    {fn: empty_frame() for fn in t.frame_names}, {}, err)
+    return tasks, results, cache
+
+
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     if not os.path.isdir(cfg.logdir):
         from sofa_tpu.printing import SofaUserError
@@ -78,75 +326,39 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         )
     time_base = read_time_base(cfg)
     cfg.time_base = time_base
+    jobs = pool.cfg_jobs(cfg)
     offset = cfg.cpu_time_offset_ms / 1e3
+    # Manual escape hatch mirroring cpu_time_offset_ms for the device side:
+    # when the marker/timebase alignment is wrong (bad marker, NTP step
+    # mid-run), the trace can be salvaged without re-recording.  Offsets are
+    # applied AFTER cache/parse, so changing one never invalidates the cache.
+    tpu_off = cfg.tpu_time_offset_ms / 1e3
+
+    t0 = time.perf_counter()
+    tasks, results, cache = _run_ingest(cfg, time_base, jobs)
     frames: Dict[str, pd.DataFrame] = {}
-
-    def ingest(name: str, fn, *args, **kwargs):
-        try:
-            df = fn(*args, **kwargs)
-        except Exception as e:  # noqa: BLE001 — per-source degradation
-            print_warning(f"preprocess {name}: {e}")
-            df = empty_frame()
-        frames[name] = df
-        if not df.empty and offset:
-            df["timestamp"] = df["timestamp"] + offset
-
-    # --- host samplers ----------------------------------------------------
-    ingest("mpstat", procfs.load, cfg.path("mpstat.txt"), procfs.parse_mpstat, time_base)
-    ingest("diskstat", procfs.load, cfg.path("diskstat.txt"), procfs.parse_diskstat, time_base)
-    ingest("netbandwidth", procfs.load, cfg.path("netstat.txt"), procfs.parse_netstat, time_base)
-    ingest("cpuinfo", procfs.load, cfg.path("cpuinfo.txt"), procfs.parse_cpuinfo, time_base)
-    ingest("vmstat", procfs.load, cfg.path("vmstat.txt"), procfs.parse_vmstat, time_base,
-           record_start=time_base)
-
-    # --- perf CPU samples (needs the MHz interpolator + clock bridge) -----
-    mono_to_unix = converter(cfg.path("timebase.txt"), "monotonic")
-    mhz_at = procfs.cpu_mhz_interpolator(frames.get("cpuinfo", empty_frame()))
-    ingest("cputrace", ingest_perf, cfg.logdir, time_base, mono_to_unix, mhz_at)
-
-    # --- syscalls / python stacks / packets -------------------------------
-    def _load_text(path, parser, **kw):
-        if not os.path.isfile(path):
-            return empty_frame()
-        with open(path) as f:
-            return parser(f.read(), time_base=time_base, **kw)
-
-    ingest("strace", _load_text, cfg.path("strace.txt"), parse_strace,
-           min_time=cfg.strace_min_time)
-    ingest("pystacks", _load_text, cfg.path("pystacks.txt"), parse_pystacks)
-    ingest("nettrace", ingest_pcap, cfg.path("sofa.pcap"), time_base)
-
-    # --- live TPU runtime metrics (works even with --disable_xprof) -------
-    from sofa_tpu.ingest.tpumon_parse import ingest_tpumon
-
-    ingest("tpumon", ingest_tpumon, cfg.logdir, time_base)
-
-    # --- block IO latency (blkparse times are already trace-relative) -----
-    from sofa_tpu.ingest.blktrace_parse import ingest_blktrace
-
-    ingest("blktrace", ingest_blktrace, cfg.logdir, 0.0)
-
-    # --- TPU XPlane -------------------------------------------------------
     tpu_meta: Dict[str, Dict[str, float]] = {}
-    try:
-        xframes = ingest_xprof_dir(cfg.xprof_dir, time_base)
-        tpu_meta = xframes.pop("_meta", {})  # type: ignore[assignment]
-        # Manual escape hatch mirroring cpu_time_offset_ms for the device
-        # side: when the marker/timebase alignment is wrong (bad marker, NTP
-        # step mid-run), the trace can be salvaged without re-recording.
-        tpu_off = cfg.tpu_time_offset_ms / 1e3
-        if tpu_off:
-            for df in xframes.values():
-                if not df.empty:
-                    df["timestamp"] = df["timestamp"] + tpu_off
-        frames.update(xframes)
-    except Exception as e:  # noqa: BLE001
-        print_warning(f"preprocess xplane: {e}")
+    for t in tasks:
+        task_frames, meta, err = results[t.name]
+        if err is not None:
+            print_warning(f"preprocess {t.name}: {err}")
+        shift = tpu_off if t.name == "xplane" else offset
+        for fname in t.frame_names:
+            df = task_frames.get(fname)
+            if df is None:
+                df = empty_frame()
+            if shift and not df.empty:
+                df["timestamp"] = df["timestamp"] + shift
+            frames[fname] = df
+        if meta:
+            tpu_meta = meta
     for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
                 "tpusteps", "customtrace"):
         frames.setdefault(key, empty_frame())
+    t_ingest = time.perf_counter() - t0
 
     # --- write frames -----------------------------------------------------
+    t0 = time.perf_counter()
     trace_format = cfg.trace_format
     if trace_format == "parquet":
         try:
@@ -169,14 +381,13 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
     n_csv = len(to_write)
     # Frames are independent files and the pyarrow CSV/parquet writers
-    # release the GIL, so a small thread pool overlaps the pod-scale
-    # tputrace write with the fifteen small ones.
-    from concurrent.futures import ThreadPoolExecutor
-
-    with ThreadPoolExecutor(max_workers=4) as pool:
-        list(pool.map(_write_one, to_write))
+    # release the GIL, so the thread pool overlaps the pod-scale tputrace
+    # write with the fifteen small ones.
+    pool.thread_map(_write_one, to_write, jobs)
+    t_write = time.perf_counter() - t0
 
     # --- assemble the timeline series -> report.js ------------------------
+    t0 = time.perf_counter()
     series = build_series(cfg, frames)
     misc = read_misc(cfg)
     meta = {
@@ -195,9 +406,15 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
 
         with open(cfg.path("tpu_meta.json"), "w") as f:
             json.dump(tpu_meta, f, indent=1)
+    t_report = time.perf_counter() - t0
     print_progress(
         f"preprocess wrote {n_csv} {trace_format} frames and report.js "
         f"({len(series)} series)"
+    )
+    print_progress(
+        f"preprocess timing: ingest {t_ingest:.2f}s "
+        f"({len(cache.hits)}/{len(tasks)} sources cached), "
+        f"write {t_write:.2f}s, report {t_report:.2f}s (jobs={jobs})"
     )
     return frames
 

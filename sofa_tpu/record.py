@@ -53,7 +53,7 @@ DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".css", ".json.gz",
                     ".pdf", ".png", ".folded")
 DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  "hints.txt", "tpu_meta.json"]
-DERIVED_DIRS = ["board", "sofa_hints"]
+DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache"]
 
 
 def build_collectors(cfg):

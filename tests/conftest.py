@@ -93,6 +93,9 @@ def logdir(tmp_path):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "real_tpu: needs the real TPU chip")
+    config.addinivalue_line(
+        "markers", "slow: long-running regression test (tier-1 runs "
+        "-m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,159 @@
+"""Ingest-cache contract: hit (no reparse), miss (changed file), parser
+version invalidation, --no_ingest_cache bypass, and clean semantics.
+
+Parse counting works by monkeypatching the procfs parser attribute — the
+preprocess workers resolve parsers by attribute at CALL time exactly so
+these tests (and plugins) can interpose."""
+
+import os
+import time
+
+import pandas as pd
+import pytest
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.ingest import cache as ingest_cache
+from sofa_tpu.ingest import procfs
+from sofa_tpu.preprocess import sofa_preprocess
+
+MPSTAT = (
+    "1700000000.0 cpu0 100 0 50 800 10 5 5 0\n"
+    "1700000000.5 cpu0 140 0 60 830 12 6 6 0\n"
+    "1700000001.0 cpu0 200 0 80 860 14 7 7 0\n"
+)
+
+
+def _mklog(tmp_path, name="log"):
+    d = str(tmp_path / name) + "/"
+    os.makedirs(d)
+    with open(d + "mpstat.txt", "w") as f:
+        f.write(MPSTAT)
+    with open(d + "sofa_time.txt", "w") as f:
+        f.write("1700000000.0\n")
+    with open(d + "misc.txt", "w") as f:
+        f.write("elapsed_time 1.0\n")
+    return d
+
+
+def _count_parser(monkeypatch, name="parse_mpstat"):
+    real = getattr(procfs, name)
+    calls = []
+
+    def counting(text, time_base=0.0, **kw):
+        calls.append(1)
+        return real(text, time_base=time_base, **kw)
+
+    monkeypatch.setattr(procfs, name, counting)
+    return calls
+
+
+def test_cache_hit_skips_reparse(tmp_path, monkeypatch):
+    d = _mklog(tmp_path)
+    calls = _count_parser(monkeypatch)
+    cfg = SofaConfig(logdir=d)
+    f1 = sofa_preprocess(cfg)
+    assert calls == [1]
+    f2 = sofa_preprocess(cfg)  # unchanged raw file -> cached parquet
+    assert calls == [1], "cache hit must not reparse"
+    pd.testing.assert_frame_equal(
+        f1["mpstat"].reset_index(drop=True),
+        f2["mpstat"].reset_index(drop=True))
+    assert os.path.isdir(cfg.path("_ingest_cache"))
+
+
+def test_cache_miss_on_changed_raw_file(tmp_path, monkeypatch):
+    d = _mklog(tmp_path)
+    calls = _count_parser(monkeypatch)
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    assert calls == [1]
+    time.sleep(0.01)  # distinct mtime_ns even on coarse filesystems
+    with open(d + "mpstat.txt", "a") as f:
+        f.write("1700000001.5 cpu0 260 0 100 890 16 8 8 0\n")
+    f2 = sofa_preprocess(cfg)
+    assert calls == [1, 1], "touched raw file must reparse"
+    # the new interval actually lands in the reloaded frame
+    assert f2["mpstat"]["timestamp"].max() == pytest.approx(1.5)
+
+
+def test_cache_invalidated_on_parser_version_bump(tmp_path, monkeypatch):
+    d = _mklog(tmp_path)
+    calls = _count_parser(monkeypatch)
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    assert calls == [1]
+    monkeypatch.setitem(ingest_cache.PARSER_VERSIONS, "mpstat",
+                        ingest_cache.PARSER_VERSIONS["mpstat"] + 1)
+    sofa_preprocess(cfg)
+    assert calls == [1, 1], "parser version bump must invalidate the cache"
+
+
+def test_no_ingest_cache_bypass(tmp_path, monkeypatch):
+    d = _mklog(tmp_path)
+    calls = _count_parser(monkeypatch)
+    cfg = SofaConfig(logdir=d, ingest_cache=False)
+    sofa_preprocess(cfg)
+    sofa_preprocess(cfg)
+    assert calls == [1, 1], "--no_ingest_cache must always reparse"
+    assert not os.path.isdir(cfg.path("_ingest_cache"))
+
+
+def test_no_ingest_cache_cli_flag():
+    from sofa_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(["preprocess", "--no_ingest_cache",
+                                      "--jobs", "3"])
+    cfg = config_from_args(args)
+    assert cfg.ingest_cache is False
+    assert cfg.jobs == 3
+
+
+def test_clean_removes_ingest_cache(tmp_path):
+    from sofa_tpu.record import sofa_clean
+
+    d = _mklog(tmp_path)
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    assert os.path.isdir(cfg.path("_ingest_cache"))
+    sofa_clean(cfg)
+    assert not os.path.isdir(cfg.path("_ingest_cache"))
+    assert os.path.isfile(cfg.path("mpstat.txt")), "raw files survive clean"
+
+
+@pytest.mark.slow
+def test_warm_cache_skips_every_unchanged_source(tmp_path, monkeypatch):
+    """Regression: a warm-cache re-run over a multi-source logdir must not
+    invoke ANY parser (the `sofa report` after `sofa preprocess` near-instant
+    ingest contract)."""
+    d = _mklog(tmp_path)
+    with open(d + "vmstat.txt", "w") as f:
+        f.write("r b swpd free buff cache si so bi bo in cs us sy id wa st\n"
+                "1 0 0 100 10 10 0 0 5 6 100 200 10 5 84 1 0\n"
+                "2 0 0 100 10 10 0 0 7 8 120 220 12 6 81 1 0\n")
+    with open(d + "pystacks.txt", "w") as f:
+        f.write("1700000000.2 1 main;loop;work\n"
+                "1700000000.4 1 main;loop;sleep\n")
+    counters = {}
+    for pname in ("parse_mpstat", "parse_vmstat"):
+        counters[pname] = _count_parser(monkeypatch, pname)
+    from sofa_tpu.ingest import strace_parse
+    real_py = strace_parse.parse_pystacks
+    py_calls = []
+
+    def counting_py(text, time_base=0.0, **kw):
+        py_calls.append(1)
+        return real_py(text, time_base=time_base, **kw)
+
+    monkeypatch.setattr(strace_parse, "parse_pystacks", counting_py)
+    cfg = SofaConfig(logdir=d, jobs=4)
+    f1 = sofa_preprocess(cfg)
+    counts1 = {k: len(v) for k, v in counters.items()}
+    assert counts1 == {"parse_mpstat": 1, "parse_vmstat": 1}
+    assert py_calls == [1]
+    f2 = sofa_preprocess(cfg)
+    assert {k: len(v) for k, v in counters.items()} == counts1, \
+        "warm-cache re-run reparsed a procfs source"
+    assert py_calls == [1], "warm-cache re-run reparsed pystacks"
+    for key in ("mpstat", "vmstat", "pystacks"):
+        pd.testing.assert_frame_equal(
+            f1[key].reset_index(drop=True), f2[key].reset_index(drop=True))

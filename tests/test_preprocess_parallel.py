@@ -1,0 +1,178 @@
+"""Parallel preprocess contract: ``--jobs 1`` and ``--jobs N`` produce
+frame-identical output, and a raising parser still degrades to an empty
+frame without killing the run (the per-source try/except semantics the
+fan-out must preserve)."""
+
+import os
+import shutil
+
+import pandas as pd
+import pytest
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.ingest import procfs
+from sofa_tpu.preprocess import sofa_preprocess
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "cpu_host.xplane.pb")
+
+
+def _build_logdir(root, name):
+    """A logdir exercising procfs + text + xplane parsers at once."""
+    d = str(root / name) + "/"
+    prof = os.path.join(d, "xprof", "plugins", "profile", "run1")
+    os.makedirs(prof)
+    shutil.copy(_FIXTURE, os.path.join(prof, "host.xplane.pb"))
+    files = {
+        "sofa_time.txt": "1700000000.0\n",
+        "misc.txt": "elapsed_time 1.0\ncores 8\n",
+        "mpstat.txt": (
+            "1700000000.0 cpuall 100 0 50 800 10 5 5 0\n"
+            "1700000000.5 cpuall 150 0 70 830 12 6 6 0\n"
+            "1700000001.0 cpuall 210 0 85 865 15 7 7 0\n"
+            "1700000000.0 cpu0 100 0 50 800 10 5 5 0\n"
+            "1700000000.5 cpu0 150 0 70 830 12 6 6 0\n"
+        ),
+        "netstat.txt": (
+            "1700000000.0 eth0 1000 2000 10 20\n"
+            "1700000000.5 eth0 5000 9000 40 70\n"
+            "1700000001.0 eth0 9000 16000 70 120\n"
+        ),
+        "vmstat.txt": (
+            "r b swpd free buff cache si so bi bo in cs us sy id wa st\n"
+            "1 0 0 100 10 10 0 0 5 6 100 200 10 5 84 1 0\n"
+            "2 0 0 100 10 10 0 0 7 8 120 220 12 6 81 1 0\n"
+        ),
+        "pystacks.txt": (
+            "1700000000.2 1 main;loop;work\n"
+            "1700000000.4 1 main;loop;sleep\n"
+        ),
+        "tpumon.txt": (
+            "1700000000200000000 -1 0 0 0\n"
+            "1700000000200000000 0 2500000000 8000000000 2600000000\n"
+            "1700000001200000000 0 2600000000 8000000000 2700000000\n"
+        ),
+    }
+    for fname, text in files.items():
+        with open(d + fname, "w") as f:
+            f.write(text)
+    return d
+
+
+def _run(root, name, **cfg_kw):
+    d = _build_logdir(root, name)
+    cfg = SofaConfig(logdir=d, ingest_cache=False, **cfg_kw)
+    return sofa_preprocess(cfg), cfg
+
+
+def test_parallel_matches_serial(tmp_path):
+    f1, cfg1 = _run(tmp_path, "serial", jobs=1)
+    f4, cfg4 = _run(tmp_path, "parallel", jobs=4)
+    assert set(f1) == set(f4)
+    assert list(f1) == list(f4), "frame ordering must be deterministic"
+    nonempty = 0
+    for key in f1:
+        pd.testing.assert_frame_equal(
+            f1[key].reset_index(drop=True), f4[key].reset_index(drop=True),
+            obj=key)
+        nonempty += int(not f1[key].empty)
+    # the comparison must actually cover real data, not 16 empty frames
+    assert nonempty >= 5
+    # and the CSV artifacts byte-match (the files-on-disk contract)
+    for key in ("mpstat", "netbandwidth", "tputrace", "hosttrace"):
+        with open(cfg1.path(f"{key}.csv"), "rb") as a, \
+                open(cfg4.path(f"{key}.csv"), "rb") as b:
+            assert a.read() == b.read(), key
+
+
+def test_parallel_degrades_per_source(tmp_path, monkeypatch):
+    """One raising parser -> ITS frame is empty, everything else survives,
+    no exception escapes (jobs>1 path)."""
+
+    def boom(text, time_base=0.0, **kw):
+        raise RuntimeError("synthetic parser failure")
+
+    monkeypatch.setattr(procfs, "parse_netstat", boom)
+    f, _cfg = _run(tmp_path, "degraded", jobs=4)
+    assert f["netbandwidth"].empty
+    assert not f["mpstat"].empty
+    assert not f["hosttrace"].empty  # the xplane leg still landed
+
+
+def test_degradation_identical_serial_vs_parallel(tmp_path, monkeypatch):
+    def boom(text, time_base=0.0, **kw):
+        raise RuntimeError("synthetic parser failure")
+
+    monkeypatch.setattr(procfs, "parse_mpstat", boom)
+    f1, _ = _run(tmp_path, "deg1", jobs=1)
+    f4, _ = _run(tmp_path, "deg4", jobs=4)
+    for key in f1:
+        pd.testing.assert_frame_equal(
+            f1[key].reset_index(drop=True), f4[key].reset_index(drop=True),
+            obj=key)
+    assert f1["mpstat"].empty
+
+
+def test_cluster_analyze_parallel_matches_serial(tmp_path):
+    """Per-host load+analyze fans out with --jobs; the merged timeline and
+    the summary table must be independent of worker count."""
+    import json
+
+    from sofa_tpu.analyze import cluster_analyze
+    from sofa_tpu.trace import make_frame, write_csv
+
+    hosts = ["hostA", "hostB", "hostC"]
+    docs = {}
+    for jobs, run in ((1, "s"), (4, "p")):
+        base = str(tmp_path / f"clog{run}")
+        for i, host in enumerate(hosts):
+            d = f"{base}-{host}/"
+            os.makedirs(d)
+            with open(d + "sofa_time.txt", "w") as f:
+                f.write(f"{1_700_000_000.0 + i}\n")
+            with open(d + "misc.txt", "w") as f:
+                f.write("elapsed_time 2.0\ncores 4\n")
+            write_csv(make_frame([
+                {"timestamp": 1.0, "duration": 0.5, "deviceId": 0,
+                 "name": f"op_{host}", "device_kind": "tpu"}]),
+                d + "tputrace.csv")
+        cfg = SofaConfig(logdir=base + "/", cluster_hosts=hosts, jobs=jobs)
+        results = cluster_analyze(cfg)
+        assert set(results) == set(hosts)
+        summary = pd.read_csv(cfg.path("cluster_summary.csv"))
+        assert list(summary["host"]) == hosts, "host order must be stable"
+        text = open(cfg.path("report.js")).read()
+        docs[run] = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+        docs[f"{run}_summary"] = summary.drop(columns=["host"])
+    assert [s["name"] for s in docs["s"]["series"]] == \
+        [s["name"] for s in docs["p"]["series"]]
+    assert docs["s"]["series"] == docs["p"]["series"]
+    pd.testing.assert_frame_equal(docs["s_summary"], docs["p_summary"])
+
+
+@pytest.mark.slow
+def test_process_pool_path_matches_threads(tmp_path, monkeypatch):
+    """SOFA_PREPROCESS_POOL=always routes the CPU-heavy parsers through a
+    real process pool; frames must still match the thread-pool run."""
+    # give the proc-pool leg something to parse: a perf.script sample file
+    perf_lines = "".join(
+        f"python 100/100 [0] 1700000000.{i:06d}: 100000 cycles: "
+        f"4a{i:04x} sym_{i % 7}+0x10 (/usr/bin/python)\n"
+        for i in range(200))
+
+    d1 = _build_logdir(tmp_path, "threads")
+    d2 = _build_logdir(tmp_path, "procs")
+    for d in (d1, d2):
+        with open(d + "perf.script", "w") as f:
+            f.write(perf_lines)
+    monkeypatch.delenv("SOFA_PREPROCESS_POOL", raising=False)
+    f_thread = sofa_preprocess(SofaConfig(logdir=d1, ingest_cache=False,
+                                          jobs=2))
+    monkeypatch.setenv("SOFA_PREPROCESS_POOL", "always")
+    f_proc = sofa_preprocess(SofaConfig(logdir=d2, ingest_cache=False,
+                                        jobs=2))
+    assert not f_proc["cputrace"].empty
+    for key in f_thread:
+        pd.testing.assert_frame_equal(
+            f_thread[key].reset_index(drop=True),
+            f_proc[key].reset_index(drop=True), obj=key)

@@ -7,6 +7,11 @@ op names cycle over 700 symbols, timestamps/durations are exponential.
     python tools/pod_synth.py /tmp/podlog/
     sofa analyze --logdir /tmp/podlog/          # report-path timing
     sofa export --logdir /tmp/podlog/ --perfetto
+
+``--raw`` additionally writes RAW collector inputs (perf.script, strace,
+pystacks, mpstat/cpuinfo/netstat/vmstat, tpumon) sized so a timed
+``sofa preprocess`` run is meaningful — the harness behind
+tools/preprocess_bench.py and bench.py's preprocess_wall_time metric.
 """
 import os
 import sys
@@ -16,8 +21,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from sofa_tpu.trace import make_frame, write_csv  # noqa: E402
 
-OUT = os.path.join(sys.argv[1] if len(sys.argv) > 1 else "/tmp/podlog", "")
+_args = [a for a in sys.argv[1:] if not a.startswith("--")]
+RAW = "--raw" in sys.argv[1:]
+OUT = os.path.join(_args[0] if _args else "/tmp/podlog", "")
 N_DEV, N_OPS = 8, 200_000
+TIME_BASE = 1_700_000_000.0
 rng = np.random.default_rng(0)
 
 os.makedirs(OUT, exist_ok=True)
@@ -81,5 +89,86 @@ write_csv(make_frame(mon), OUT + "tpumon.csv")
 with open(OUT + "misc.txt", "w") as f:
     f.write("elapsed_time 2.5\ncores 8\npid 1\nrc 0\n")
 with open(OUT + "sofa_time.txt", "w") as f:
-    f.write("1700000000.0\n")
-print("generated", OUT, len(tput), "op rows")
+    f.write(f"{TIME_BASE}\n")
+
+
+def write_raw_collectors(out: str) -> None:
+    """Raw collector inputs for the preprocess-path benchmarks: the volume
+    lives in the CPU-heavy text parsers (perf script / strace / pystacks),
+    with the /proc samplers at realistic 2.5 s-run sizes."""
+    n_perf, n_strace, n_py = 150_000, 50_000, 40_000
+
+    # perf.script — the pre-converted form ingest_perf prefers (no perf
+    # binary needed); line shape per ingest/perf_script.py's _LINE_RE.
+    syms = [f"do_work_{i}" for i in range(400)]
+    with open(out + "perf.script", "w") as f:
+        f.write("".join(
+            f"python {100 + i % 4}/{100 + i % 16} [{i % 8}] "
+            f"{TIME_BASE + i * 2.5 / n_perf:.6f}: 1010101 cycles: "
+            f"{0x400000 + (i % 4096) * 64:x} {syms[i % 400]}+0x10 "
+            f"(/usr/bin/python3.11)\n"
+            for i in range(n_perf)))
+
+    # strace -tt wall times are time-of-day in LOCAL time (parse_strace
+    # derives the day origin from time_base the same way).
+    import datetime as _dt
+
+    base_dt = _dt.datetime.fromtimestamp(TIME_BASE)
+    day_origin = _dt.datetime(base_dt.year, base_dt.month,
+                              base_dt.day).timestamp()
+    calls = ["read", "write", "ioctl", "recvmsg", "sendmsg", "futex"]
+    with open(out + "strace.txt", "w") as f:
+        rows = []
+        for i in range(n_strace):
+            tod = TIME_BASE - day_origin + i * 2.5 / n_strace
+            hh, rem = divmod(tod, 3600)
+            mm, ss = divmod(rem, 60)
+            rows.append(
+                f"{100 + i % 4} {int(hh):02d}:{int(mm):02d}:{ss:09.6f} "
+                f"{calls[i % 6]}(3, \"buf\", 4096) = 4096 <0.0001{i % 90:02d}>\n")
+        f.write("".join(rows))
+
+    with open(out + "pystacks.txt", "w") as f:
+        f.write("".join(
+            f"{TIME_BASE + i * 2.5 / n_py:.6f} {1 + i % 8} "
+            f"main;train;step_{i % 50};kernel\n"
+            for i in range(n_py)))
+
+    # /proc samplers: cumulative counters at 10 Hz over the 2.5 s run.
+    with open(out + "mpstat.txt", "w") as f:
+        rows = []
+        for tick in range(25):
+            ts = TIME_BASE + tick * 0.1
+            for cpu in ["cpuall"] + [f"cpu{c}" for c in range(8)]:
+                base = tick * 100
+                rows.append(f"{ts:.2f} {cpu} {base * 6} 0 {base} "
+                            f"{base * 2} {base // 10} 5 5 0\n")
+        f.write("".join(rows))
+    with open(out + "cpuinfo.txt", "w") as f:
+        f.write("".join(
+            f"{TIME_BASE + t * 0.1:.2f} " + " ".join(["2000.0"] * 8) + "\n"
+            for t in range(25)))
+    with open(out + "netstat.txt", "w") as f:
+        f.write("".join(
+            f"{TIME_BASE + t * 0.1:.2f} eth0 {t * 1_000_000} "
+            f"{t * 2_000_000} {t * 800} {t * 900}\n"
+            for t in range(25)))
+    with open(out + "vmstat.txt", "w") as f:
+        f.write("r b swpd free buff cache si so bi bo in cs us sy id wa st\n"
+                + "".join(
+                    f"1 0 0 100 10 10 0 0 {5 + t} {6 + t} 100 200 "
+                    f"10 5 84 1 0\n" for t in range(25)))
+    with open(out + "tpumon.txt", "w") as f:
+        rows = []
+        for t in range(2500):
+            ts_ns = int((TIME_BASE + t * 0.001) * 1e9)
+            rows.append(f"{ts_ns} -1 0 0 0\n")
+            for dev in range(N_DEV):
+                rows.append(f"{ts_ns} {dev} {2500000000 + t * 1000} "
+                            f"8000000000 2600000000\n")
+        f.write("".join(rows))
+
+
+if RAW:
+    write_raw_collectors(OUT)
+print("generated", OUT, len(tput), "op rows", "+ raw collectors" if RAW else "")

@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Preprocess-path benchmark: serial vs parallel vs warm-cache.
+
+Generates the pod_synth ``--raw`` logdir (8-device x 200k-op unified trace
+plus raw collector files: 150k perf samples, 50k syscalls, 40k Python
+stacks, /proc samplers), then times ``sofa_preprocess`` three ways:
+
+    serial      --jobs 1,  ingest cache disabled
+    parallel    --jobs N,  ingest cache disabled
+    warm-cache  --jobs N,  second run over the populated cache
+
+Each leg runs in a fresh subprocess and times ONLY the sofa_preprocess call
+(imports excluded), so the table compares parsing work, not process spawn.
+
+    python tools/preprocess_bench.py [--jobs N] [--keep DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+_LEG_SNIPPET = """
+import json, sys, time
+sys.path.insert(0, {root!r})
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import sofa_preprocess
+cfg = SofaConfig(logdir={logdir!r}, jobs={jobs}, ingest_cache={cache})
+t0 = time.perf_counter()
+frames = sofa_preprocess(cfg)
+wall = time.perf_counter() - t0
+rows = int(sum(len(df) for df in frames.values()))
+print(json.dumps({{"wall_s": round(wall, 3), "rows": rows}}))
+"""
+
+
+def run_leg(logdir: str, jobs: int, cache: bool) -> dict:
+    code = _LEG_SNIPPET.format(root=ROOT, logdir=logdir, jobs=jobs,
+                               cache=cache)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=600, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"leg failed (jobs={jobs} cache={cache}): "
+                           f"{r.stderr.strip().splitlines()[-1:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def clear_cache(logdir: str) -> None:
+    shutil.rmtree(os.path.join(logdir, "_ingest_cache"), ignore_errors=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--jobs", type=int, default=0,
+                   help="parallel-leg worker count (0 = auto, min 4)")
+    p.add_argument("--keep", default=None,
+                   help="reuse/keep this logdir instead of a temp dir")
+    args = p.parse_args()
+
+    from sofa_tpu.pool import resolve_jobs
+
+    jobs = args.jobs or max(4, resolve_jobs(0))
+    logdir = os.path.join(args.keep or tempfile.mkdtemp(
+        prefix="sofa_prebench_"), "")
+    try:
+        if not os.path.isfile(os.path.join(logdir, "perf.script")):
+            print(f"generating pod_synth --raw logdir at {logdir} ...",
+                  file=sys.stderr)
+            subprocess.run(
+                [sys.executable, os.path.join(ROOT, "tools", "pod_synth.py"),
+                 logdir, "--raw"], check=True, timeout=600)
+
+        results = {}
+        clear_cache(logdir)
+        results["serial (--jobs 1, no cache)"] = run_leg(logdir, 1, False)
+        clear_cache(logdir)
+        results[f"parallel (--jobs {jobs}, no cache)"] = run_leg(
+            logdir, jobs, False)
+        clear_cache(logdir)
+        run_leg(logdir, jobs, True)  # populate the cache
+        results[f"warm-cache (--jobs {jobs})"] = run_leg(logdir, jobs, True)
+
+        serial = results["serial (--jobs 1, no cache)"]["wall_s"]
+        width = max(len(k) for k in results)
+        print(f"\n{'mode'.ljust(width)}  wall_s  speedup  frame_rows")
+        for mode, res in results.items():
+            speedup = serial / res["wall_s"] if res["wall_s"] else float("inf")
+            print(f"{mode.ljust(width)}  {res['wall_s']:6.2f}  "
+                  f"{speedup:6.2f}x  {res['rows']}")
+        return 0
+    finally:
+        if not args.keep:
+            shutil.rmtree(logdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
