@@ -346,8 +346,24 @@ print(json.dumps({{"cold": round(cold, 3), "warm": round(warm, 3)}}))
         doc = json.loads(r.stdout.strip().splitlines()[-1])
         _log(f"bench: preprocess wall time cold {doc['cold']}s / "
              f"warm-cache {doc['warm']}s (pod_synth --raw)")
-        return {"preprocess_wall_time_s": doc["cold"],
-                "preprocess_warm_wall_time_s": doc["warm"]}
+        out = {"preprocess_wall_time_s": doc["cold"],
+               "preprocess_warm_wall_time_s": doc["warm"]}
+        # Every bench run also asserts the self-telemetry ledger the
+        # preprocess above must have written (tools/manifest_check.py):
+        # a healthy number from an unhealthy pipeline is not evidence.
+        mc = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "manifest_check.py"),
+             logdir, "--require-healthy"],
+            capture_output=True, text=True, timeout=60, env=env)
+        out["manifest_ok"] = mc.returncode == 0
+        if mc.returncode != 0:
+            tail = (mc.stderr.strip().splitlines() or ["?"])[-1]
+            out["manifest_error"] = tail[:160]
+            _log(f"bench: manifest_check FAILED: {tail[:160]}")
+        else:
+            _log("bench: manifest_check OK (run_manifest.json healthy)")
+        return out
     except Exception as e:  # noqa: BLE001 — evidence is best-effort
         return {"preprocess_wall_error": f"{type(e).__name__}: {e}"[:160]}
     finally:

@@ -225,6 +225,14 @@ def generate_hints(features: Features, cfg) -> List[str]:
 
 def hint_report(features: Features, cfg) -> None:
     hints = generate_hints(features, cfg)
+    # Self-health rides the same hints channel: the run manifest's failed /
+    # degraded collectors and sources (sofa_tpu/telemetry.py) are warnings
+    # the user should read BEFORE trusting the workload-level hints above —
+    # a hint computed from a half-captured trace is advice about the gap.
+    from sofa_tpu import telemetry
+
+    for w in telemetry.manifest_warnings(telemetry.load_manifest(cfg.logdir)):
+        hints.append(f"[self] {w}")
     for h in hints:
         print_hint(h)
     if hints:

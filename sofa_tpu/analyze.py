@@ -189,15 +189,31 @@ def load_cluster_frames(cfg: SofaConfig,
 
 
 def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None) -> Features:
+    from sofa_tpu import telemetry
+
+    tel = telemetry.begin("analyze")
+    ok = False
+    try:
+        features = _analyze_body(cfg, frames, tel)
+        ok = True
+        return features
+    finally:
+        tel.write(cfg.logdir, rc=0 if ok else 1, cfg=cfg)
+        telemetry.end(tel)
+
+
+def _analyze_body(cfg: SofaConfig, frames, tel) -> Features:
     if frames is None:
-        frames = load_frames(cfg)
+        with tel.span("load_frames", cat="stage"):
+            frames = load_frames(cfg)
     features = Features()
     misc = read_misc(cfg)
     features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))
 
     for name, fn in _PASSES:
         try:
-            fn(frames, cfg, features)
+            with tel.span(name, cat="analyze"):
+                fn(frames, cfg, features)
         except Exception as e:  # noqa: BLE001 — per-pass degradation
             print_warning(f"analyze pass {name}: {e}")
 
@@ -209,7 +225,8 @@ def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None)
         try:
             from sofa_tpu.ml.aisi import iteration_series, sofa_aisi
 
-            iters = sofa_aisi(frames, cfg, features)
+            with tel.span("aisi", cat="analyze"):
+                iters = sofa_aisi(frames, cfg, features)
             marker = iteration_series(iters)
             if marker is not None:
                 extra_series.append(marker)
@@ -219,7 +236,8 @@ def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None)
         try:
             from sofa_tpu.ml.hsg import sofa_hsg, swarm_series
 
-            clustered = sofa_hsg(frames, cfg, features)
+            with tel.span("hsg", cat="analyze"):
+                clustered = sofa_hsg(frames, cfg, features)
             extra_series.extend(swarm_series(clustered, cfg.num_swarms))
         except Exception as e:  # noqa: BLE001
             print_warning(f"hsg: {e}")
@@ -245,9 +263,11 @@ def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None)
                 print_hint(f"[remote] {hint}")
     except Exception as e:  # noqa: BLE001
         print_warning(f"hint server: {e}")
-    advice.hint_report(features, cfg)
+    with tel.span("hints", cat="stage"):
+        advice.hint_report(features, cfg)
 
-    stage_board(cfg)
+    with tel.span("stage_board", cat="stage"):
+        stage_board(cfg)
     print("Complete!!")
     return features
 

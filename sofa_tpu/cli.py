@@ -12,6 +12,9 @@ CLI (/root/reference/bin/sofa:328-376):
   diff              preprocess base/match logdirs + swarm diff
   export            static sofa_report.pdf/overview.png for headless sharing
   top               live terminal dashboard over a running recording
+  status            render logdir/run_manifest.json (the pipeline's own
+                    health ledger, sofa_tpu/telemetry.py) as a table;
+                    exits nonzero on failed collectors
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -51,9 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "export", "top", "clean", "setup",
+        "export", "top", "status", "clean", "setup",
     ])
-    p.add_argument("usr_command", nargs="?", default="", help="command to profile (record/stat)")
+    p.add_argument("usr_command", nargs="?", default="",
+                   help="command to profile (record/stat); logdir (status)")
 
     g = p.add_argument_group("pipeline")
     g.add_argument("--logdir")
@@ -369,6 +373,14 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
+        if cmd == "status":
+            from sofa_tpu.telemetry import sofa_status
+            if args.usr_command and "logdir" not in vars(args):
+                # `sofa status sofalog/` reads more naturally than
+                # --logdir for a read-only verb; an explicit flag wins.
+                cfg.logdir = args.usr_command
+                cfg.__post_init__()
+            return sofa_status(cfg)
         if cmd == "clean":
             from sofa_tpu.record import sofa_clean
             sofa_clean(cfg)

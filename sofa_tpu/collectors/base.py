@@ -5,6 +5,13 @@ harvest().  All steps are best-effort: a probe failure downgrades the
 collector to a no-op with a console warning, never an error — profiling must
 work on machines missing any subset of tools (the reference probes with
 `command -v` for the same reason, sofa_record.py:217-223,249,264,300).
+
+Every lifecycle transition also lands in the run manifest's collector
+health ledger (sofa_tpu/telemetry.py).  The hook lives HERE, once: record
+drives the ``run_start``/``run_stop``/``run_harvest``/``run_kill`` wrappers
+below, subclasses keep overriding the bare hooks, and all collectors
+inherit the instrumentation — status, start/stop ordering, wall times,
+exit codes, and bytes captured (via :meth:`Collector.outputs`).
 """
 
 from __future__ import annotations
@@ -14,9 +21,18 @@ import os
 import shutil
 import signal
 import subprocess
+import time
 from typing import Dict, List, Optional
 
+from sofa_tpu import telemetry
 from sofa_tpu.printing import print_info, print_warning
+
+
+def _next_seq() -> int:
+    """Monotone start/stop ordinal within the active telemetry run (0 when
+    none) — the manifest's proof that stop order reversed start order."""
+    tel = telemetry.current()
+    return tel.next_seq() if tel is not None else 0
 
 
 class CollectorState(enum.Enum):
@@ -58,6 +74,63 @@ class Collector:
         """Environment variables to inject into the profiled command."""
         return {}
 
+    def outputs(self) -> List[str]:
+        """Paths this collector writes — the manifest's bytes-captured
+        ledger sums their on-disk sizes after harvest."""
+        return []
+
+    # -- instrumented lifecycle (driven by record; do not override) --------
+    def run_start(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            with telemetry.maybe_span(f"{self.name}.start", cat="collector"):
+                self.start()
+        except Exception as e:  # noqa: BLE001 — ledger first, caller decides
+            telemetry.collector_event(
+                self.name, "failed", phase="start", error=str(e)[:300])
+            raise
+        telemetry.collector_event(
+            self.name, "started", start_seq=_next_seq(),
+            start_wall_s=round(time.perf_counter() - t0, 6))
+
+    def run_stop(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            with telemetry.maybe_span(f"{self.name}.stop", cat="collector"):
+                self.stop()
+        except Exception as e:  # noqa: BLE001
+            telemetry.collector_event(
+                self.name, "failed", phase="stop", error=str(e)[:300])
+            raise
+        fields = {"stop_seq": _next_seq(),
+                  "stop_wall_s": round(time.perf_counter() - t0, 6)}
+        proc = getattr(self, "proc", None)
+        if proc is not None and proc.returncode is not None:
+            fields["exit_code"] = int(proc.returncode)
+        telemetry.collector_event(self.name, "stopped", **fields)
+
+    def run_harvest(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            with telemetry.maybe_span(f"{self.name}.harvest",
+                                      cat="collector"):
+                self.harvest()
+        except Exception as e:  # noqa: BLE001
+            telemetry.collector_event(
+                self.name, "failed", phase="harvest", error=str(e)[:300])
+            raise
+        finally:
+            telemetry.collector_event(
+                self.name,
+                bytes_captured=telemetry.collector_bytes(self.outputs()))
+        telemetry.collector_event(
+            self.name, harvest_wall_s=round(time.perf_counter() - t0, 6))
+
+    def run_kill(self) -> None:
+        if hasattr(self, "kill"):
+            self.kill()
+        telemetry.collector_event(self.name, "killed")
+
     # -- helpers -----------------------------------------------------------
     @staticmethod
     def which(tool: str) -> Optional[str]:
@@ -65,6 +138,7 @@ class Collector:
 
     def unavailable(self, reason: str) -> None:
         self.state = CollectorState.UNAVAILABLE
+        telemetry.collector_event(self.name, "skipped", reason=reason)
         print_warning(f"{self.name}: {reason} — skipping this collector")
 
 

@@ -33,6 +33,9 @@ class VmstatCollector(ProcessCollector):
         if getattr(self, "_out", None):
             self._out.close()
 
+    def outputs(self) -> List[str]:
+        return [self.cfg.path("vmstat.txt")]
+
 
 class TcpdumpCollector(ProcessCollector):
     name = "tcpdump"
@@ -51,6 +54,9 @@ class TcpdumpCollector(ProcessCollector):
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
 
+    def outputs(self) -> List[str]:
+        return [self.cfg.path("sofa.pcap")]
+
 
 class BlktraceCollector(ProcessCollector):
     name = "blktrace"
@@ -68,6 +74,9 @@ class BlktraceCollector(ProcessCollector):
              "-D", self.cfg.logdir, "-o", "blktrace"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
+
+    def outputs(self) -> List[str]:
+        return [self.cfg.path("blktrace.txt")]
 
     def harvest(self) -> None:
         if self.which("blkparse") is None:
@@ -98,3 +107,6 @@ class StraceCollector(Collector):
             "strace", "-q", "-T", "-tt", "-f",
             "-o", self.cfg.path("strace.txt"),
         ]
+
+    def outputs(self) -> List[str]:
+        return [self.cfg.path("strace.txt")]

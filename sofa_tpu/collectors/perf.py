@@ -55,23 +55,32 @@ class PerfCollector(Collector):
         # A degraded perf is still a usable collector (time -v fallback), so
         # fallback paths warn here and return None; only "no fallback either"
         # reports unavailable.
+        from sofa_tpu import telemetry
         from sofa_tpu.printing import print_warning
 
         self.mode = "perf"
+        degraded = None
         if self.cfg.no_perf_events:
             self.mode = "time"
         elif self.which("perf") is None:
             self.mode = "time"
+            degraded = "perf not installed; /usr/bin/time -v fallback"
             print_warning("perf: not installed — falling back to /usr/bin/time -v")
         else:
             paranoid = _read_int("/proc/sys/kernel/perf_event_paranoid")
             if paranoid is not None and paranoid > 1 and os.geteuid() != 0:
                 self.mode = "time"
+                degraded = (f"perf_event_paranoid={paranoid}; "
+                            "/usr/bin/time -v fallback")
                 print_warning(
                     f"perf: perf_event_paranoid={paranoid}; run "
                     "`sudo sysctl -w kernel.perf_event_paranoid=-1` to enable "
                     "perf sampling — falling back to /usr/bin/time -v"
                 )
+        if degraded:
+            # An involuntary fallback is a fidelity loss the manifest must
+            # carry (--no-perf-events is a choice, not a degradation).
+            telemetry.collector_event(self.name, "degraded", reason=degraded)
         if self.mode == "time" and not os.path.isfile("/usr/bin/time"):
             return "neither perf nor /usr/bin/time available"
         return None
@@ -118,6 +127,11 @@ class PerfCollector(Collector):
                     if self.cfg.perf_events else 1)
         return self._record_argv() + [
             "-a", "-G", ",".join([cgroup] * n_events)]
+
+    def outputs(self) -> List[str]:
+        cfg = self.cfg
+        return [cfg.path("perf.data"), cfg.path("perf.script"),
+                cfg.path("time.txt"), cfg.path("kallsyms")]
 
     def harvest(self) -> None:
         # Copy kernel symbols for offline `perf script` runs, like the

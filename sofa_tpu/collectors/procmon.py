@@ -155,3 +155,8 @@ class ProcMonCollector(ProcessCollector):
             self._stop_event.set()
             self._thread.join(timeout=5)
         super().stop(**kwargs)
+
+    def outputs(self) -> List[str]:
+        cfg = self.cfg
+        return [cfg.path("mpstat.txt"), cfg.path("diskstat.txt"),
+                cfg.path("netstat.txt"), cfg.path("cpuinfo.txt")]

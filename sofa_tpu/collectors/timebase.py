@@ -70,3 +70,6 @@ class TimebaseCollector(Collector):
                 f.write("\n".join(self._sample_lines()) + "\n")
         except OSError:
             pass
+
+    def outputs(self):
+        return [self.cfg.path("sofa_time.txt"), self.cfg.path("timebase.txt")]

@@ -482,6 +482,15 @@ class XProfCollector(Collector):
         write_sampler_module(cfg.inject_dir)
         tpumon.write_sampler_module(cfg.inject_dir)
 
+    def outputs(self):
+        cfg = self.cfg
+        # Everything the injection family (xplane + tpumon + pystacks +
+        # memprof) captures — the manifest's bytes ledger walks the dir.
+        return [cfg.xprof_dir, cfg.path("tpu_topo.json"),
+                cfg.path("tpumon.txt"), cfg.path("pystacks.txt"),
+                cfg.path("memprof.pb.gz"),
+                cfg.path("memprof.pb.gz.meta.json")]
+
     def child_env(self) -> Dict[str, str]:
         cfg = self.cfg
         opts = {

@@ -34,6 +34,7 @@ from sofa_tpu.printing import print_progress, print_warning
 # Stable synthetic pids per source "process" — Perfetto groups tracks by pid.
 _HOST_PID = 1_000_000
 _CUSTOM_PID = 1_100_000
+_SELF_PID = 1_200_000  # sofa's own pipeline spans (sofa_self_trace.json)
 
 PERFETTO_FRAMES = ["tputrace", "tpusteps", "tpumodules", "hosttrace",
                    "customtrace", "tpuutil", "tpumon", "mpstat",
@@ -211,6 +212,27 @@ def _host_counter_events(df: pd.DataFrame, names: List[str],
                 })
 
 
+def _self_trace_events(cfg) -> List[dict]:
+    """The profiler's own spans (telemetry self-trace), remapped onto a
+    dedicated Perfetto process so a sofa capture and the pipeline that
+    produced it open side by side in one viewer.  The self-trace shares
+    the capture's time zero (telemetry anchors it to sofa_time.txt), so
+    no timestamp surgery is needed — only the pid."""
+    from sofa_tpu.telemetry import load_self_trace
+
+    doc = load_self_trace(cfg.logdir)
+    if doc is None:
+        return []
+    out = []
+    for e in doc["traceEvents"]:
+        if not isinstance(e, dict) or "ph" not in e:
+            continue
+        e = dict(e)
+        e["pid"] = _SELF_PID
+        out.append(e)
+    return out
+
+
 def _meta(events: List[dict], pid: int, name: str,
           threads: Optional[Dict[int, str]] = None) -> None:
     events.append({"name": "process_name", "ph": "M", "pid": pid,
@@ -271,6 +293,9 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
         print_warning("perfetto export: no trace frames — run "
                       "`sofa report` first")
         return None
+    # The pipeline's own spans ride along as one more process: the user's
+    # workload and the profiler that captured it, on the same timeline.
+    events.extend(_self_trace_events(cfg))
 
     device_ids = set()
     for df in (ops, steps, mods, util, mon):

@@ -87,6 +87,7 @@ class IngestCache:
         self.enabled = enabled
         self.hits: List[str] = []
         self.misses: List[str] = []
+        self.stored_bytes: Dict[str, int] = {}
 
     def _key_path(self, source: str) -> str:
         return os.path.join(self.root, f"{source}.key.json")
@@ -103,8 +104,10 @@ class IngestCache:
             with open(self._key_path(source)) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
+            self.misses.append(source)
             return None
         if doc.get("key") != key:
+            self.misses.append(source)
             return None
         from sofa_tpu.trace import _conform
 
@@ -118,11 +121,24 @@ class IngestCache:
                 elif os.path.isfile(pk):
                     frames[name] = _conform(pd.read_pickle(pk))
                 else:
+                    self.misses.append(source)
                     return None
         except Exception:  # noqa: BLE001 — a corrupt cache entry is a miss
+            self.misses.append(source)
             return None
         self.hits.append(source)
         return {"frames": frames, "meta": doc.get("meta") or {}}
+
+    def stats(self) -> dict:
+        """Hit/miss ledger + bytes written this run, for the run manifest
+        (sofa_tpu/telemetry.py) — which sources reparsed, and how much
+        cache the logdir is carrying because of it."""
+        return {
+            "enabled": self.enabled,
+            "hits": sorted(self.hits),
+            "misses": sorted(set(self.misses)),
+            "stored_bytes": dict(self.stored_bytes),
+        }
 
     def store(self, source: str, key: dict,
               frames: Dict[str, pd.DataFrame],
@@ -133,6 +149,7 @@ class IngestCache:
             return
         try:
             os.makedirs(self.root, exist_ok=True)
+            stored = 0
             for name, df in frames.items():
                 pq = self._frame_path(source, name, ".parquet")
                 pk = self._frame_path(source, name, ".pkl")
@@ -141,11 +158,14 @@ class IngestCache:
                     os.replace(pq + ".tmp", pq)
                     if os.path.isfile(pk):
                         os.unlink(pk)  # never shadow a fresh parquet
+                    stored += os.path.getsize(pq)
                 except Exception:  # noqa: BLE001 — no pyarrow: pickle fallback
                     df.to_pickle(pk + ".tmp")
                     os.replace(pk + ".tmp", pk)
                     if os.path.isfile(pq):
                         os.unlink(pq)
+                    stored += os.path.getsize(pk)
+            self.stored_bytes[source] = stored
             doc = {"key": key, "frames": sorted(frames), "meta": meta or {}}
             tmp = self._key_path(source) + ".tmp"
             # Key json LAST — a crash mid-store leaves a stale key that

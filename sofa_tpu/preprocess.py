@@ -214,8 +214,16 @@ def _normalize(task: _IngestTask, res) -> Tuple[Dict[str, pd.DataFrame], dict]:
 _PROC_POOL_MIN_BYTES = 32 * 2 ** 20
 
 
+def _timed_call(fn, args, kwargs) -> tuple:
+    """(result, parse wall seconds) — module-level so the per-source wall
+    time survives a process-pool boundary into the run manifest."""
+    t0 = time.perf_counter()
+    return fn(*args, **kwargs), time.perf_counter() - t0
+
+
 def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
-    """Execute cache-miss tasks -> {name: (raw result | None, error | None)}.
+    """Execute cache-miss tasks -> {name: (raw result | None, error | None,
+    parse wall seconds)}.
 
     CPU-heavy ("proc") tasks go to a process pool when policy/size allow,
     overlapping with the thread-pool tasks; any pool failure degrades to
@@ -223,10 +231,12 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
     """
 
     def run_local(t: _IngestTask) -> tuple:
+        t0 = time.perf_counter()
         try:
-            return t.fn(*t.args, **t.kwargs), None
+            res = t.fn(*t.args, **t.kwargs)
+            return res, None, time.perf_counter() - t0
         except Exception as e:  # noqa: BLE001 — per-source degradation
-            return None, str(e)
+            return None, str(e), time.perf_counter() - t0
 
     outcomes: Dict[str, tuple] = {}
     policy = os.environ.get("SOFA_PREPROCESS_POOL", "auto")
@@ -249,7 +259,8 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
                 max_workers=pool.pool_size(jobs, len(proc_tasks)),
                 mp_context=pool.process_context())
             for t in proc_tasks:
-                futs[t.name] = procpool.submit(t.fn, *t.args, **t.kwargs)
+                futs[t.name] = procpool.submit(
+                    _timed_call, t.fn, t.args, t.kwargs)
         except Exception as e:  # noqa: BLE001 — sandboxed /dev/shm, no spawn
             print_warning(f"preprocess: process pool unavailable ({e}); "
                           "parsing in threads")
@@ -266,7 +277,8 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
                 outcomes[t.name] = run_local(t)
                 continue
             try:
-                outcomes[t.name] = (futs[t.name].result(), None)
+                res, dt = futs[t.name].result()
+                outcomes[t.name] = (res, None, dt)
             except BrokenExecutor as e:
                 # A crashed/OOM-killed worker poisons every pending future —
                 # an environment failure, not a parse failure: rerun the
@@ -276,31 +288,46 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
                 broken = True
                 outcomes[t.name] = run_local(t)
             except Exception as e:  # noqa: BLE001 — per-source degradation
-                outcomes[t.name] = (None, str(e))
+                outcomes[t.name] = (None, str(e), 0.0)
         procpool.shutdown()
     return outcomes
 
 
-def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int):
+def _frame_rows(frames: Dict[str, pd.DataFrame]) -> int:
+    return int(sum(len(df) for df in frames.values() if df is not None))
+
+
+def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
     """Cache-or-parse every source -> (tasks, {name: (frames, meta, error)},
-    cache)."""
+    cache).  ``tel`` (a telemetry.Telemetry) receives one ingest-stats event
+    per source: status, cache outcome, parse/load wall time, event count."""
     tasks = _ingest_tasks(cfg, time_base, jobs)
     cache = IngestCache(cfg.path(CACHE_DIR_NAME), enabled=cfg.ingest_cache)
     keys = {t.name: make_key(t.name, t.raw_paths, t.params) for t in tasks}
+
+    def _load(t: _IngestTask) -> tuple:
+        t0 = time.perf_counter()
+        hit = cache.load(t.name, keys[t.name])
+        return hit, time.perf_counter() - t0
+
     # cache loads overlap on threads — the parquet decoder releases the GIL
-    loaded = pool.thread_map(lambda t: cache.load(t.name, keys[t.name]),
-                             tasks, jobs)
+    loaded = pool.thread_map(_load, tasks, jobs)
     results: Dict[str, tuple] = {}
     pending: List[_IngestTask] = []
-    for t, hit in zip(tasks, loaded):
+    for t, (hit, load_dt) in zip(tasks, loaded):
         if hit is not None:
             results[t.name] = (hit["frames"], hit["meta"], None)
+            if tel is not None:
+                tel.source_event(t.name, status="cached", cache="hit",
+                                 wall_s=round(load_dt, 6),
+                                 events=_frame_rows(hit["frames"]))
         else:
             pending.append(t)
+    cache_outcome = "miss" if cache.enabled else "bypass"
     if pending:
         outcomes = _run_pending(pending, jobs)
         for t in pending:
-            res, err = outcomes[t.name]
+            res, err, parse_dt = outcomes[t.name]
             if err is None:
                 frames, meta = _normalize(t, res)
                 results[t.name] = (frames, meta, None)
@@ -311,22 +338,47 @@ def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int):
                 key = make_key(t.name, t.raw_paths, t.params)
                 if raw_files_present(key):
                     cache.store(t.name, key, frames, meta)
+                if tel is not None:
+                    status = ("parsed" if raw_files_present(keys[t.name])
+                              or _frame_rows(frames) else "empty")
+                    tel.source_event(t.name, status=status,
+                                     cache=cache_outcome,
+                                     wall_s=round(parse_dt, 6),
+                                     events=_frame_rows(frames))
             else:
                 results[t.name] = (
                     {fn: empty_frame() for fn in t.frame_names}, {}, err)
+                if tel is not None:
+                    tel.source_event(t.name, status="degraded",
+                                     cache=cache_outcome,
+                                     wall_s=round(parse_dt, 6),
+                                     events=0, error=str(err)[:300])
     return tasks, results, cache
 
 
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
+    from sofa_tpu import telemetry
+
     if not os.path.isdir(cfg.logdir):
         from sofa_tpu.printing import SofaUserError
 
         raise SofaUserError(
             f"logdir {cfg.logdir} does not exist — run `sofa record` first"
         )
+    tel = telemetry.begin("preprocess")
+    try:
+        return _preprocess_body(cfg, tel)
+    finally:
+        telemetry.end(tel)
+
+
+def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
+    from sofa_tpu import telemetry
+
     time_base = read_time_base(cfg)
     cfg.time_base = time_base
     jobs = pool.cfg_jobs(cfg)
+    tel.set_meta(pool={"jobs": jobs, "cpu_count": os.cpu_count() or 1})
     offset = cfg.cpu_time_offset_ms / 1e3
     # Manual escape hatch mirroring cpu_time_offset_ms for the device side:
     # when the marker/timebase alignment is wrong (bad marker, NTP step
@@ -334,31 +386,31 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     # applied AFTER cache/parse, so changing one never invalidates the cache.
     tpu_off = cfg.tpu_time_offset_ms / 1e3
 
-    t0 = time.perf_counter()
-    tasks, results, cache = _run_ingest(cfg, time_base, jobs)
-    frames: Dict[str, pd.DataFrame] = {}
-    tpu_meta: Dict[str, Dict[str, float]] = {}
-    for t in tasks:
-        task_frames, meta, err = results[t.name]
-        if err is not None:
-            print_warning(f"preprocess {t.name}: {err}")
-        shift = tpu_off if t.name == "xplane" else offset
-        for fname in t.frame_names:
-            df = task_frames.get(fname)
-            if df is None:
-                df = empty_frame()
-            if shift and not df.empty:
-                df["timestamp"] = df["timestamp"] + shift
-            frames[fname] = df
-        if meta:
-            tpu_meta = meta
-    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
-                "tpusteps", "customtrace"):
-        frames.setdefault(key, empty_frame())
-    t_ingest = time.perf_counter() - t0
+    with tel.span("ingest", cat="stage"):
+        tasks, results, cache = _run_ingest(cfg, time_base, jobs, tel)
+        frames: Dict[str, pd.DataFrame] = {}
+        tpu_meta: Dict[str, Dict[str, float]] = {}
+        for t in tasks:
+            task_frames, meta, err = results[t.name]
+            if err is not None:
+                print_warning(f"preprocess {t.name}: {err}")
+            shift = tpu_off if t.name == "xplane" else offset
+            for fname in t.frame_names:
+                df = task_frames.get(fname)
+                if df is None:
+                    df = empty_frame()
+                if shift and not df.empty:
+                    df["timestamp"] = df["timestamp"] + shift
+                frames[fname] = df
+            if meta:
+                tpu_meta = meta
+        for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
+                    "tpusteps", "customtrace"):
+            frames.setdefault(key, empty_frame())
 
     # --- write frames -----------------------------------------------------
     t0 = time.perf_counter()
+    t0_unix = time.time()
     trace_format = cfg.trace_format
     if trace_format == "parquet":
         try:
@@ -384,38 +436,44 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     # release the GIL, so the thread pool overlaps the pod-scale tputrace
     # write with the fifteen small ones.
     pool.thread_map(_write_one, to_write, jobs)
-    t_write = time.perf_counter() - t0
+    tel.add_span("write_frames", "stage", t0_unix,
+                 time.perf_counter() - t0, frames=n_csv, format=trace_format)
 
     # --- assemble the timeline series -> report.js ------------------------
-    t0 = time.perf_counter()
-    series = build_series(cfg, frames)
-    misc = read_misc(cfg)
-    meta = {
-        "elapsed_time": float(misc.get("elapsed_time", 0) or 0),
-        "time_base": time_base,
-        "tpu_meta": tpu_meta,
-        "logdir": cfg.logdir,
-    }
-    from sofa_tpu.trace import series_to_report_js
+    with tel.span("report_js", cat="stage"):
+        series = build_series(cfg, frames)
+        misc = read_misc(cfg)
+        meta = {
+            "elapsed_time": float(misc.get("elapsed_time", 0) or 0),
+            "time_base": time_base,
+            "tpu_meta": tpu_meta,
+            "logdir": cfg.logdir,
+        }
+        from sofa_tpu.trace import series_to_report_js
 
-    series_to_report_js(series, cfg.path("report.js"), cfg.viz_downsample_to, meta)
-    if tpu_meta:
-        # Device peak rates for the analyze-side roofline pass (analysis
-        # reads CSVs, not report.js, so the peaks get their own file).
-        import json
+        series_to_report_js(series, cfg.path("report.js"),
+                            cfg.viz_downsample_to, meta)
+        if tpu_meta:
+            # Device peak rates for the analyze-side roofline pass (analysis
+            # reads CSVs, not report.js, so the peaks get their own file).
+            import json
 
-        with open(cfg.path("tpu_meta.json"), "w") as f:
-            json.dump(tpu_meta, f, indent=1)
-    t_report = time.perf_counter() - t0
+            with open(cfg.path("tpu_meta.json"), "w") as f:
+                json.dump(tpu_meta, f, indent=1)
     print_progress(
         f"preprocess wrote {n_csv} {trace_format} frames and report.js "
         f"({len(series)} series)"
     )
-    print_progress(
-        f"preprocess timing: ingest {t_ingest:.2f}s "
-        f"({len(cache.hits)}/{len(tasks)} sources cached), "
-        f"write {t_write:.2f}s, report {t_report:.2f}s (jobs={jobs})"
-    )
+    tel.set_meta(ingest_cache=cache.stats())
+    # Structured timings land in the manifest; the human-readable summary
+    # is derived by reading the manifest BACK — one source of truth for
+    # what the run did (replaces PR 1's free-form timing print).
+    manifest = tel.write(cfg.logdir, rc=0, cfg=cfg)
+    summary = telemetry.preprocess_summary(
+        manifest if manifest is not None
+        else telemetry.load_manifest(cfg.logdir))
+    if summary:
+        print_progress(summary)
     return frames
 
 

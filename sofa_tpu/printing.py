@@ -4,12 +4,23 @@ Equivalent surface to the reference's sofa_print helpers
 (/root/reference/bin/sofa_print.py:18-49) — title / error / warning / info /
 hint / progress banners with ANSI colors, gated on a module-level verbosity —
 but implemented as a tiny logger object so library users can silence it.
+
+Environment knobs:
+
+  SOFA_LOG_LEVEL       debug | info | warn | error — minimum severity that
+                       reaches the console (default info; debug also shows
+                       print_info lines without --verbose).  Suppression is
+                       display-only: warnings/errors still count into the
+                       run manifest's noise counters (sofa_tpu/telemetry.py).
+  SOFA_LOG_TIMESTAMPS  truthy -> prefix every line with a wall-clock
+                       HH:MM:SS.mmm timestamp (fleet log correlation).
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import time
 
 _COLORS = {
     "red": "\033[1;31m",
@@ -27,6 +38,10 @@ _COLORS = {
 enabled = True
 verbose = False
 
+DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
+_LEVELS = {"debug": DEBUG, "info": INFO, "warn": WARN, "warning": WARN,
+           "error": ERROR}
+
 
 class SofaUserError(FileNotFoundError):
     """A usage error with a curated message (missing logdir, ...).
@@ -36,25 +51,53 @@ class SofaUserError(FileNotFoundError):
     FileNotFoundError so library callers' existing except clauses hold."""
 
 
+def _threshold() -> int:
+    """Read per call: tests and long-lived sessions may flip the env var."""
+    return _LEVELS.get(
+        os.environ.get("SOFA_LOG_LEVEL", "").strip().lower(), INFO)
+
+
+def _timestamp() -> str:
+    if os.environ.get("SOFA_LOG_TIMESTAMPS", "").lower() in ("", "0", "false"):
+        return ""
+    now = time.time()
+    return time.strftime("%H:%M:%S", time.localtime(now)) \
+        + f".{int(now * 1000) % 1000:03d} "
+
+
 def _use_color(stream) -> bool:
     if os.environ.get("NO_COLOR"):
         return False
     return stream.isatty()
 
 
-def _emit(tag: str, color: str, msg: str, stream=None) -> None:
-    if not enabled:
+def _note_telemetry(level: str, msg: str) -> None:
+    """Count every warning/error into the active run's manifest counters —
+    BEFORE any display gating, so SOFA_LOG_LEVEL=error still records how
+    noisy the run was.  Lazy import: telemetry imports this module."""
+    try:
+        from sofa_tpu import telemetry
+
+        telemetry.console_event(level, msg)
+    except Exception:  # noqa: BLE001 — logging must never raise
+        pass
+
+
+def _emit(tag: str, color: str, msg: str, stream=None,
+          level: int = INFO) -> None:
+    if not enabled or level < _threshold():
         return
     stream = stream or sys.stdout
+    ts = _timestamp()
     if _use_color(stream):
-        print(f"{_COLORS[color]}{tag}{_COLORS['end']} {msg}", file=stream)
+        print(f"{ts}{_COLORS[color]}{tag}{_COLORS['end']} {msg}", file=stream)
     else:
-        print(f"{tag} {msg}", file=stream)
+        print(f"{ts}{tag} {msg}", file=stream)
     stream.flush()
 
 
 def print_title(msg: str) -> None:
-    if not enabled:
+    if not enabled or INFO < _threshold():
         return
     bar = "=" * max(8, len(msg))
     if _use_color(sys.stdout):
@@ -67,25 +110,27 @@ def print_title(msg: str) -> None:
 def print_error(msg: str) -> None:
     # Errors and warnings go to stderr: stdout may be piped data
     # (features tables, report output) and must stay parseable.
-    _emit("[ERROR]", "red", msg, stream=sys.stderr)
+    _note_telemetry("error", msg)
+    _emit("[ERROR]", "red", msg, stream=sys.stderr, level=ERROR)
 
 
 def print_warning(msg: str) -> None:
-    _emit("[WARNING]", "yellow", msg, stream=sys.stderr)
+    _note_telemetry("warning", msg)
+    _emit("[WARNING]", "yellow", msg, stream=sys.stderr, level=WARN)
 
 
 def print_info(msg: str) -> None:
-    if verbose:
-        _emit("[INFO]", "white", msg)
+    if verbose or _threshold() <= DEBUG:
+        _emit("[INFO]", "white", msg, level=INFO)
 
 
 def print_hint(msg: str) -> None:
-    _emit("[HINT]", "green", msg)
+    _emit("[HINT]", "green", msg, level=INFO)
 
 
 def print_progress(msg: str) -> None:
-    _emit("[PROGRESS]", "blue", msg)
+    _emit("[PROGRESS]", "blue", msg, level=INFO)
 
 
 def print_main_progress(msg: str) -> None:
-    _emit("[STAGE]", "magenta", msg)
+    _emit("[STAGE]", "magenta", msg, level=INFO)

@@ -52,7 +52,11 @@ RAW_FILES = [
 DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".css", ".json.gz",
                     ".pdf", ".png", ".folded")
 DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
-                 "hints.txt", "tpu_meta.json"]
+                 "hints.txt", "tpu_meta.json",
+                 # self-telemetry artifacts (sofa_tpu/telemetry.py): removed
+                 # by `sofa clean`, and _clean_stale wipes them at record
+                 # start so manifests never mix across runs.
+                 "run_manifest.json", "sofa_self_trace.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache"]
 
 
@@ -285,8 +289,11 @@ def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
 
 
 def sofa_record(command: str, cfg) -> int:
+    from sofa_tpu import telemetry
+
     ensure_logdir(cfg.logdir)
     _clean_stale(cfg)
+    tel = telemetry.begin("record")
     collectors = build_collectors(cfg)
 
     # SIGTERM/SIGHUP (drivers, CI timeouts, ssh teardown) ride the SIGINT
@@ -295,11 +302,20 @@ def sofa_record(command: str, cfg) -> int:
     # the child and leave the logdir without its epilogue files.
     import signal as _signal
 
-    with _term_as_interrupt((_signal.SIGHUP,)):
-        return _record_body(command, cfg, collectors)
+    rc = None
+    try:
+        with _term_as_interrupt((_signal.SIGHUP,)):
+            rc = _record_body(command, cfg, collectors, tel)
+        return rc
+    finally:
+        # The manifest is written on EVERY exit — a kill-all abort must
+        # still leave the health ledger behind (that run is exactly the
+        # one worth diagnosing).
+        tel.write(cfg.logdir, rc=rc, cfg=cfg)
+        telemetry.end(tel)
 
 
-def _record_body(command: str, cfg, collectors) -> int:
+def _record_body(command: str, cfg, collectors, tel) -> int:
     import signal as _signal
 
     started = []
@@ -309,22 +325,31 @@ def _record_body(command: str, cfg, collectors) -> int:
     is_docker = cfg.pid is None and _DOCKER_RUN_RE.match(command) is not None
     docker_perf = None
     try:
-        for col in collectors:
-            reason = col.probe()
-            if reason is not None:
-                col.unavailable(reason)
-                continue
-            col.start()
-            started.append(col)
-            if (is_docker and isinstance(col, PerfCollector)
-                    and col.mode == "perf"):
-                # A perf prefix would sample the docker *client*; the
-                # collector is instead rescoped to the container by
-                # _DockerPerfScope below (its harvest still runs normally).
-                docker_perf = col
-            else:
-                prefix += col.command_prefix()
-            child_env.update(col.child_env())
+        with tel.span("prologue", cat="record"):
+            for col in collectors:
+                reason = col.probe()
+                if reason is not None:
+                    col.unavailable(reason)
+                    continue
+                try:
+                    col.run_start()
+                except Exception as e:  # noqa: BLE001
+                    # Per-collector degradation: one collector failing to
+                    # start costs ITS series, never the recording — the
+                    # manifest carries the failed status (run_start).
+                    print_warning(f"{col.name}: start failed: {e}")
+                    continue
+                started.append(col)
+                if (is_docker and isinstance(col, PerfCollector)
+                        and col.mode == "perf"):
+                    # A perf prefix would sample the docker *client*; the
+                    # collector is instead rescoped to the container by
+                    # _DockerPerfScope below (its harvest still runs
+                    # normally).
+                    docker_perf = col
+                else:
+                    prefix += col.command_prefix()
+                child_env.update(col.child_env())
 
         # The profiled child must be able to import sofa_tpu (built-in
         # workloads) from any cwd.  Appended AFTER the collector env updates
@@ -339,7 +364,8 @@ def _record_body(command: str, cfg, collectors) -> int:
         if cfg.pid is not None:
             perf = next(
                 (c for c in started if isinstance(c, PerfCollector)), None)
-            rc = _attach(cfg, cfg.pid, perf)
+            with tel.span("attach", cat="record", pid=cfg.pid):
+                rc = _attach(cfg, cfg.pid, perf)
         else:
             docker_scope = None
             if docker_perf is not None:
@@ -382,6 +408,8 @@ def _record_body(command: str, cfg, collectors) -> int:
             elapsed = time.time() - t0
             if rc < 0:  # killed by signal: fold to the shell convention
                 rc = 128 - rc
+            tel.add_span("launch", "record", t0, elapsed, rc=rc,
+                         command=command[:200])
             print_progress(f"command finished in {elapsed:.3f} s (rc={rc})")
             _warn_partial_stop(cfg, rc)
             _write_misc(cfg, elapsed, child.pid, rc)
@@ -389,8 +417,7 @@ def _record_body(command: str, cfg, collectors) -> int:
         print_error(f"record failed: {e}")
         for col in reversed(started):
             try:
-                if hasattr(col, "kill"):
-                    col.kill()
+                col.run_kill()
             except Exception:
                 pass
         raise
@@ -399,16 +426,17 @@ def _record_body(command: str, cfg, collectors) -> int:
         # installed (the caller's `with` exits after us): a TERM arriving
         # during a slow harvest rides the cleanup path, not the default
         # die-now handler.
-        for col in reversed(started):
-            try:
-                col.stop()
-            except Exception as e:
-                print_warning(f"{col.name}: stop failed: {e}")
-        for col in started:
-            try:
-                col.harvest()
-            except Exception as e:
-                print_warning(f"{col.name}: harvest failed: {e}")
+        with tel.span("epilogue", cat="record"):
+            for col in reversed(started):
+                try:
+                    col.run_stop()
+                except Exception as e:
+                    print_warning(f"{col.name}: stop failed: {e}")
+            for col in started:
+                try:
+                    col.run_harvest()
+                except Exception as e:
+                    print_warning(f"{col.name}: harvest failed: {e}")
 
     if rc != 0:
         print_warning(f"profiled command exited with rc={rc}")

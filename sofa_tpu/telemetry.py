@@ -1,0 +1,574 @@
+"""Self-telemetry: the profiler's own run, made legible.
+
+SOFA's product is turning an opaque swarm of collectors into one timeline —
+but its OWN pipeline used to be opaque: collector failures and ingest
+degradations surfaced only as transient console warnings.  This module is
+the machine-readable counterpart (the SOLAR / exascale-diagnostics argument:
+an at-scale analysis tool must emit self-diagnostics so users can trust and
+debug the profiler itself).  Every pipeline verb records lightweight spans
+and counters and lands two artifacts in the logdir:
+
+``run_manifest.json`` — schema-versioned health ledger.  Top-level layout::
+
+    schema / schema_version   "sofa_tpu/run_manifest" / 1
+    generated_unix            last write time
+    runs.<verb>               started_unix, wall_s, rc, counters
+                              (warnings/errors), warning_tail
+    env                       python/platform/host/cpu snapshot + the
+                              SOFA_*/JAX_PLATFORMS vars that shape a run
+    config                    SofaConfig snapshot of the writing verb
+    meta                      pool sizing, ingest-cache stats, ...
+    collectors.<name>         status started/stopped/failed/skipped/killed,
+                              degraded flag+reason, exit_code,
+                              bytes_captured, start/stop seq, timings
+    sources.<name>            status parsed/cached/degraded/empty,
+                              cache hit/miss/bypass, wall_s, events, error
+    stages                    flat span list {verb,name,cat,t0_unix,dur_s}
+
+Versioning policy: ``schema_version`` bumps on any BREAKING change (key
+renamed/removed, meaning changed); purely additive keys do not bump it.
+Consumers must ignore unknown keys.  A manifest whose (schema,
+schema_version) does not match exactly is replaced wholesale on the next
+write, never merged into.
+
+``sofa_self_trace.json`` — the same spans in Chrome Trace Event Format
+(one ``X`` event per span, pid 1 = the sofa pipeline, one tid lane per
+verb), so the profiler's own run opens in the exact viz path user traces do
+(``chrome://tracing`` / ui.perfetto.dev, and ``sofa export --perfetto``
+folds it into trace.json.gz as its own process).  Timestamps are µs
+relative to the run's ``sofa_time.txt`` zero so self-spans line up with
+the profiled workload's timeline.
+
+Writes are merge-by-verb: ``sofa record`` then ``sofa preprocess`` on the
+same logdir accumulate one manifest; re-running a verb replaces only that
+verb's sections.  ``sofa record`` cleans stale logs first, so manifests
+never mix across recordings; ``sofa clean`` removes both artifacts
+(record.DERIVED_FILES).
+
+``sofa status [logdir]`` renders the manifest as a health table and exits
+nonzero on failed collectors; ``tools/manifest_check.py`` validates the
+schema (wired into bench.py).  See docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from sofa_tpu.printing import (  # printing imports us lazily, no cycle
+    print_error,
+    print_title,
+    print_warning,
+)
+
+MANIFEST_NAME = "run_manifest.json"
+SELF_TRACE_NAME = "sofa_self_trace.json"
+MANIFEST_SCHEMA = "sofa_tpu/run_manifest"
+MANIFEST_VERSION = 1
+
+COLLECTOR_STATUSES = ("probed", "started", "stopped", "failed", "skipped",
+                      "killed")
+SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty")
+CACHE_OUTCOMES = ("hit", "miss", "bypass")
+
+# Environment variables that shape a run enough to belong in the snapshot.
+_ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
+             "SOFA_NATIVE_PERFETTO", "JAX_PLATFORMS", "NO_COLOR")
+
+# Self-trace thread lanes: one per pipeline verb so the viewer shows the
+# verbs as parallel tracks of the single "sofa" process.
+_SELF_TRACE_LANES = {"record": 1, "preprocess": 2, "analyze": 3}
+_OTHER_LANE = 4
+
+_WARNING_TAIL_MAX = 20
+
+_registry_lock = threading.RLock()
+_active: List["Telemetry"] = []
+
+
+class Telemetry:
+    """One verb's self-telemetry recorder (record / preprocess / analyze).
+
+    Thread-safe: pool workers and collector threads may report while the
+    main thread runs.  Create via :func:`begin`, persist via :meth:`write`,
+    release via :func:`end`.
+    """
+
+    def __init__(self, verb: str):
+        self.verb = verb
+        self.started_unix = time.time()
+        self._lock = threading.RLock()
+        self.spans: List[dict] = []
+        self.counters: Dict[str, int] = {"warnings": 0, "errors": 0}
+        self.collectors: Dict[str, dict] = {}
+        self.sources: Dict[str, dict] = {}
+        self.meta: Dict[str, object] = {}
+        self.warning_tail: List[str] = []
+        self._seq = 0
+
+    # -- spans -------------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "stage", **args):
+        t0_unix = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_span(name, cat, t0_unix,
+                          time.perf_counter() - t0, **args)
+
+    def add_span(self, name: str, cat: str, t0_unix: float, dur_s: float,
+                 **args) -> None:
+        with self._lock:
+            self.spans.append({
+                "verb": self.verb, "name": str(name), "cat": str(cat),
+                "t0_unix": round(float(t0_unix), 6),
+                "dur_s": round(max(float(dur_s), 0.0), 6),
+                "args": args,
+            })
+
+    # -- counters / console ------------------------------------------------
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def console(self, level: str, msg: str) -> None:
+        """A print_warning/print_error passed through this run."""
+        key = "errors" if level == "error" else "warnings"
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + 1
+            if key == "warnings" and len(self.warning_tail) < _WARNING_TAIL_MAX:
+                self.warning_tail.append(str(msg)[:300])
+
+    def next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    # -- ledgers -----------------------------------------------------------
+    def collector_event(self, name: str, status: "str | None" = None,
+                        **fields) -> None:
+        """Merge a lifecycle fact into the collector health ledger.
+
+        ``degraded`` is a flag, not a status (a degraded collector still
+        runs); ``failed``/``killed`` are sticky over the benign
+        started/stopped so a kill-all epilogue's flush cannot whitewash
+        the outcome."""
+        with self._lock:
+            ent = self.collectors.setdefault(name, {"status": "probed"})
+            if status == "degraded":
+                ent["degraded"] = True
+                if "reason" in fields:
+                    ent["degraded_reason"] = fields.pop("reason")
+            elif status is not None:
+                sticky = ent.get("status") in ("failed", "killed")
+                if not (sticky and status in ("started", "stopped")):
+                    ent["status"] = status
+            ent.update(fields)
+
+    def source_event(self, name: str, **fields) -> None:
+        with self._lock:
+            self.sources.setdefault(name, {}).update(fields)
+
+    def set_meta(self, **kw) -> None:
+        with self._lock:
+            self.meta.update(kw)
+
+    # -- persistence -------------------------------------------------------
+    def write(self, logdir: str, rc: "int | None" = None,
+              cfg=None) -> "dict | None":
+        """Merge this run into <logdir>/run_manifest.json + the self-trace.
+
+        Best-effort by contract: a read-only logdir degrades to a warning,
+        never an exception — telemetry must not be able to fail the
+        pipeline it observes."""
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            doc = load_manifest(logdir) or {}
+            if doc.get("schema") != MANIFEST_SCHEMA or \
+                    doc.get("schema_version") != MANIFEST_VERSION:
+                doc = {}  # never merge across schema versions
+            doc["schema"] = MANIFEST_SCHEMA
+            doc["schema_version"] = MANIFEST_VERSION
+            doc["generated_unix"] = round(time.time(), 3)
+            with self._lock:
+                doc.setdefault("runs", {})[self.verb] = {
+                    "started_unix": round(self.started_unix, 3),
+                    "wall_s": round(time.time() - self.started_unix, 6),
+                    "rc": rc,
+                    "counters": dict(self.counters),
+                    "warning_tail": list(self.warning_tail),
+                }
+                doc["env"] = _env_snapshot()
+                if cfg is not None:
+                    doc["config"] = _config_snapshot(cfg)
+                if self.meta:
+                    doc.setdefault("meta", {}).update(self.meta)
+                if self.collectors:
+                    doc["collectors"] = json.loads(
+                        json.dumps(self.collectors))
+                if self.sources:
+                    doc["sources"] = json.loads(json.dumps(self.sources))
+                stages = [s for s in doc.get("stages", [])
+                          if s.get("verb") != self.verb]
+                doc["stages"] = stages + list(self.spans)
+            path = os.path.join(logdir, MANIFEST_NAME)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            self._write_self_trace(logdir)
+            return doc
+        except (OSError, TypeError, ValueError) as e:
+            print_warning(f"telemetry: cannot write {MANIFEST_NAME}: {e}")
+            return None
+
+    def _write_self_trace(self, logdir: str) -> None:
+        path = os.path.join(logdir, SELF_TRACE_NAME)
+        events: List[dict] = []
+        other: Dict[str, object] = {}
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            other = dict(prev.get("otherData") or {})
+            # Keep other verbs' spans; metadata is regenerated each write.
+            events = [e for e in prev.get("traceEvents", [])
+                      if e.get("ph") != "M"
+                      and (e.get("args") or {}).get("verb") != self.verb]
+        except (OSError, ValueError):
+            pass
+        zero = other.get("ts_zero_unix")
+        if not isinstance(zero, (int, float)):
+            zero = _read_time_base(logdir)
+        with self._lock:
+            spans = list(self.spans)
+        if not isinstance(zero, (int, float)) or zero <= 0:
+            t0s = [s["t0_unix"] for s in spans] or [self.started_unix]
+            existing = [e["ts"] / 1e6 for e in events
+                        if isinstance(e.get("ts"), (int, float))]
+            zero = min(t0s) - (max(existing) if existing else 0.0)
+        lane = _SELF_TRACE_LANES.get(self.verb, _OTHER_LANE)
+        for s in spans:
+            events.append({
+                "name": s["name"], "ph": "X", "cat": s["cat"],
+                "ts": round((s["t0_unix"] - zero) * 1e6, 3),
+                "dur": round(s["dur_s"] * 1e6, 3),
+                "pid": 1, "tid": lane,
+                "args": {"verb": s["verb"], **(s.get("args") or {})},
+            })
+        meta = [{"name": "process_name", "ph": "M", "pid": 1,
+                 "args": {"name": "sofa_tpu self-trace"}}]
+        for verb, tid in sorted(_SELF_TRACE_LANES.items(),
+                                key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"name": f"sofa {verb}"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                     "tid": _OTHER_LANE, "args": {"name": "sofa other"}})
+        doc = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {**other, "ts_zero_unix": round(float(zero), 6),
+                          "producer": "sofa_tpu self-telemetry"},
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+
+# --- run registry -----------------------------------------------------------
+
+def begin(verb: str) -> Telemetry:
+    """Open a telemetry run; pair with :func:`end` in a finally."""
+    tel = Telemetry(verb)
+    with _registry_lock:
+        _active.append(tel)
+    return tel
+
+
+def end(tel: Telemetry) -> None:
+    with _registry_lock:
+        try:
+            _active.remove(tel)
+        except ValueError:
+            pass
+
+
+def current() -> "Telemetry | None":
+    with _registry_lock:
+        return _active[-1] if _active else None
+
+
+def collector_event(name: str, status: "str | None" = None,
+                    **fields) -> None:
+    """Forward to the innermost active run; silently a no-op outside one
+    (library users of a bare Collector don't carry telemetry)."""
+    tel = current()
+    if tel is not None:
+        tel.collector_event(name, status, **fields)
+
+
+def console_event(level: str, msg: str) -> None:
+    """Called by printing.print_warning/print_error — EVERY active run
+    counts the line, so a cluster analyze's per-host runs each record
+    their own noise level."""
+    with _registry_lock:
+        active = list(_active)
+    for tel in active:
+        tel.console(level, msg)
+
+
+@contextlib.contextmanager
+def maybe_span(name: str, cat: str = "stage", **args):
+    """Span on the current run when one is active, else a no-op."""
+    tel = current()
+    if tel is None:
+        yield
+        return
+    with tel.span(name, cat, **args):
+        yield
+
+
+# --- snapshots --------------------------------------------------------------
+
+def _env_snapshot() -> dict:
+    import platform
+    import socket
+    import sys
+
+    from sofa_tpu import __version__
+
+    return {
+        "sofa_tpu_version": __version__,
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "hostname": socket.gethostname(),
+        "cpu_count": os.cpu_count() or 1,
+        "pid": os.getpid(),
+        "vars": {k: os.environ[k] for k in _ENV_KEYS if k in os.environ},
+    }
+
+
+def _config_snapshot(cfg) -> dict:
+    try:
+        doc = cfg.to_dict()
+    except Exception:  # noqa: BLE001 — a duck-typed cfg in tests
+        return {}
+    return json.loads(json.dumps(doc, default=str))
+
+
+def _read_time_base(logdir: str) -> "float | None":
+    try:
+        with open(os.path.join(logdir, "sofa_time.txt")) as f:
+            return float(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def collector_bytes(paths: List[str]) -> int:
+    """Bytes on disk across a collector's output files (dirs walked)."""
+    total = 0
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for name in files:
+                    try:
+                        total += os.path.getsize(os.path.join(root, name))
+                    except OSError:
+                        pass
+        else:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+    return total
+
+
+# --- readers ----------------------------------------------------------------
+
+def load_manifest(logdir: str) -> "dict | None":
+    try:
+        with open(os.path.join(logdir, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def load_self_trace(logdir: str) -> "dict | None":
+    try:
+        with open(os.path.join(logdir, SELF_TRACE_NAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("traceEvents"), list):
+        return None
+    return doc
+
+
+def manifest_warnings(doc: "dict | None") -> List[str]:
+    """Human-readable health warnings from a manifest — folded into
+    `sofa analyze`'s hints so self-health rides the same output users
+    already read."""
+    if not doc:
+        return []
+    out: List[str] = []
+    for name, ent in sorted((doc.get("collectors") or {}).items()):
+        status = ent.get("status")
+        if status in ("failed", "killed"):
+            detail = ent.get("error") or ent.get("phase") or ""
+            out.append(f"collector {name} {status}"
+                       + (f" ({detail})" if detail else "")
+                       + " — its timeline series are missing or partial")
+        elif ent.get("degraded"):
+            why = ent.get("degraded_reason") or "reduced fidelity"
+            out.append(f"collector {name} ran degraded: {why}")
+    for name, ent in sorted((doc.get("sources") or {}).items()):
+        if ent.get("status") == "degraded":
+            why = ent.get("error") or "parse failed"
+            out.append(f"ingest source {name} degraded to an empty frame: "
+                       f"{why}")
+    for verb, run in sorted((doc.get("runs") or {}).items()):
+        counters = run.get("counters") or {}
+        if counters.get("errors"):
+            out.append(f"`sofa {verb}` logged {counters['errors']} "
+                       "error line(s) — check the console output")
+        rc = run.get("rc")
+        if isinstance(rc, int) and rc != 0 and verb == "record":
+            out.append(f"the profiled command exited rc={rc}")
+    return out
+
+
+def preprocess_summary(doc: "dict | None") -> "str | None":
+    """One human-readable line from the manifest's structured preprocess
+    timings (replaces the PR 1 free-form timing print)."""
+    if not doc:
+        return None
+    stages = {s["name"]: s for s in doc.get("stages", [])
+              if s.get("verb") == "preprocess"}
+    if not stages:
+        return None
+    sources = doc.get("sources") or {}
+    cached = sum(1 for s in sources.values() if s.get("cache") == "hit")
+    parts = []
+    for name, label in (("ingest", "ingest"), ("write_frames", "write"),
+                        ("report_js", "report")):
+        if name in stages:
+            parts.append(f"{label} {stages[name]['dur_s']:.2f}s")
+    jobs = ((doc.get("meta") or {}).get("pool") or {}).get("jobs")
+    line = "preprocess timing: " + ", ".join(parts)
+    line += f" ({cached}/{len(sources)} sources cached"
+    line += f", jobs={jobs})" if jobs else ")"
+    return line
+
+
+# --- `sofa status` ----------------------------------------------------------
+
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "-"
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "-"
+
+
+def _table(rows: List[List[str]]) -> List[str]:
+    if not rows:
+        return []
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(rows[0]))]
+    return ["  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+            for r in rows]
+
+
+def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
+    """(report lines, exit code) — rc 1 when any collector failed/killed."""
+    lines: List[str] = []
+    rc = 0
+    runs = doc.get("runs") or {}
+    lines.append(f"run manifest: {os.path.join(logdir, MANIFEST_NAME)} "
+                 f"(schema v{doc.get('schema_version')})")
+    for verb in ("record", "preprocess", "analyze"):
+        run = runs.get(verb)
+        if not run:
+            continue
+        counters = run.get("counters") or {}
+        rc_txt = run.get("rc")
+        lines.append(
+            f"  {verb}: wall {run.get('wall_s', 0):.2f}s"
+            + (f", rc={rc_txt}" if rc_txt is not None else "")
+            + f", {counters.get('warnings', 0)} warning(s), "
+            f"{counters.get('errors', 0)} error(s)")
+    for verb in sorted(set(runs) - {"record", "preprocess", "analyze"}):
+        lines.append(f"  {verb}: wall {runs[verb].get('wall_s', 0):.2f}s")
+
+    collectors = doc.get("collectors") or {}
+    if collectors:
+        lines.append("")
+        rows = [["COLLECTOR", "STATUS", "BYTES", "DETAIL"]]
+        for name, ent in sorted(collectors.items()):
+            status = str(ent.get("status", "?"))
+            if status in ("failed", "killed"):
+                rc = 1
+            detail = (ent.get("error") or ent.get("reason")
+                      or ent.get("degraded_reason") or "")
+            if ent.get("degraded"):
+                status += " (degraded)"
+            exit_code = ent.get("exit_code")
+            if isinstance(exit_code, int) and exit_code not in (0, -15):
+                detail = (detail + f" exit_code={exit_code}").strip()
+            rows.append([name, status,
+                         _fmt_bytes(ent.get("bytes_captured")),
+                         str(detail)[:60]])
+        lines += _table(rows)
+
+    sources = doc.get("sources") or {}
+    if sources:
+        lines.append("")
+        rows = [["SOURCE", "STATUS", "CACHE", "EVENTS", "WALL", "DETAIL"]]
+        for name, ent in sorted(sources.items()):
+            wall = ent.get("wall_s")
+            rows.append([
+                name, str(ent.get("status", "?")),
+                str(ent.get("cache", "-")),
+                str(ent.get("events", "-")),
+                f"{wall:.3f}s" if isinstance(wall, (int, float)) else "-",
+                str(ent.get("error") or "")[:60],
+            ])
+        lines += _table(rows)
+
+    problems = manifest_warnings(doc)
+    if problems:
+        lines.append("")
+        lines += [f"! {p}" for p in problems]
+    else:
+        lines.append("")
+        lines.append("all recorded stages healthy")
+    return lines, rc
+
+
+def sofa_status(cfg) -> int:
+    """``sofa status [logdir]`` — render the health ledger; exit 1 on
+    failed collectors, 2 when no manifest exists."""
+    doc = load_manifest(cfg.logdir)
+    if doc is None:
+        print_error(
+            f"no {MANIFEST_NAME} in {cfg.logdir} — run `sofa record` / "
+            "`sofa preprocess` first (older logdirs predate self-telemetry)")
+        return 2
+    if doc.get("schema") != MANIFEST_SCHEMA:
+        print_error(f"{cfg.path(MANIFEST_NAME)} is not a sofa_tpu run "
+                    "manifest")
+        return 2
+    print_title(f"SOFA run health — {cfg.logdir}")
+    lines, rc = render_status(doc, cfg.logdir)
+    print("\n".join(lines))
+    if rc != 0:
+        print_error("one or more collectors failed — see the table above")
+    return rc

@@ -57,6 +57,13 @@ def sofa_viz(cfg, serve_forever: bool = True):
         f"serving {cfg.logdir} at http://{host}:{port}/ (Ctrl-C stops; "
         f"bound to {cfg.viz_bind or 'all interfaces'})"
     )
+    from sofa_tpu.telemetry import MANIFEST_NAME, SELF_TRACE_NAME
+
+    if os.path.isfile(os.path.join(cfg.logdir, SELF_TRACE_NAME)):
+        print_progress(
+            f"self-telemetry: /{SELF_TRACE_NAME} (Chrome-trace of sofa's "
+            f"own run — load in ui.perfetto.dev) and /{MANIFEST_NAME} "
+            "(`sofa status` renders it)")
     if serve_forever:
         try:
             httpd.serve_forever()

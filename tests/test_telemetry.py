@@ -1,0 +1,408 @@
+"""Self-telemetry contract (sofa_tpu/telemetry.py + ISSUE 2 acceptance).
+
+run_manifest.json must cover every collector and ingest source, survive
+collector-lifecycle edge cases (start failure, kill-all epilogue, reverse
+stop order), render via `sofa status` (nonzero on failed collectors),
+validate against tools/manifest_check.py, and sofa_self_trace.json must be
+a loadable Chrome trace that rides the perfetto export.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from sofa_tpu import telemetry
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import sofa_preprocess
+from sofa_tpu.record import build_collectors, sofa_clean, sofa_record
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_manifest_check():
+    spec = importlib.util.spec_from_file_location(
+        "manifest_check", os.path.join(_ROOT, "tools", "manifest_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _manifest(logdir):
+    doc = telemetry.load_manifest(logdir)
+    assert doc is not None, "run_manifest.json missing"
+    return doc
+
+
+def _assert_valid_chrome_trace(path):
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    phases = set()
+    for e in events:
+        assert isinstance(e.get("name"), str) and e["name"]
+        assert e.get("ph") in ("X", "M", "C", "B", "E", "i")
+        phases.add(e["ph"])
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert "X" in phases, "no span events"
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    return doc
+
+
+def _record(logdir, command="true", **cfg_kw):
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, **cfg_kw)
+    rc = sofa_record(command, cfg)
+    return rc, cfg
+
+
+# --- manifest coverage ------------------------------------------------------
+
+def test_record_manifest_covers_every_collector(logdir):
+    rc, cfg = _record(logdir)
+    assert rc == 0
+    doc = _manifest(logdir)
+    assert doc["schema"] == telemetry.MANIFEST_SCHEMA
+    assert doc["schema_version"] == telemetry.MANIFEST_VERSION
+    expected = {c.name for c in build_collectors(cfg)}
+    assert set(doc["collectors"]) == expected
+    for name, ent in doc["collectors"].items():
+        assert ent["status"] in telemetry.COLLECTOR_STATUSES, name
+        if ent["status"] == "skipped":
+            assert ent.get("reason"), name
+        if ent["status"] == "stopped":
+            assert isinstance(ent.get("bytes_captured"), int), name
+    run = doc["runs"]["record"]
+    assert run["rc"] == 0
+    assert run["wall_s"] > 0
+    assert run["counters"]["warnings"] >= 0
+    stage_names = {s["name"] for s in doc["stages"]
+                   if s["verb"] == "record"}
+    assert {"prologue", "launch", "epilogue"} <= stage_names
+    # recorder-side collectors that actually ran captured real bytes
+    assert doc["collectors"]["timebase"]["bytes_captured"] > 0
+    assert doc["env"]["sofa_tpu_version"]
+    assert doc["config"]["logdir"] == cfg.logdir
+
+
+def test_manifest_and_self_trace_are_derived_files(logdir):
+    _record(logdir)
+    assert os.path.isfile(os.path.join(logdir, telemetry.MANIFEST_NAME))
+    assert os.path.isfile(os.path.join(logdir, telemetry.SELF_TRACE_NAME))
+    cfg = SofaConfig(logdir=logdir)
+    sofa_clean(cfg)
+    assert not os.path.exists(os.path.join(logdir, telemetry.MANIFEST_NAME))
+    assert not os.path.exists(
+        os.path.join(logdir, telemetry.SELF_TRACE_NAME))
+
+
+# --- collector lifecycle edge cases ----------------------------------------
+
+def test_collector_start_failure_is_degradation_not_abort(logdir,
+                                                          monkeypatch):
+    """One collector failing to start costs its series, never the
+    recording — and the manifest records the failed outcome."""
+    from sofa_tpu.collectors.procmon import ProcMonCollector
+
+    def boom(self):
+        raise RuntimeError("synthetic start failure")
+
+    monkeypatch.setattr(ProcMonCollector, "start", boom)
+    rc, _cfg = _record(logdir)
+    assert rc == 0  # the profiled command still ran
+    ent = _manifest(logdir)["collectors"]["procmon"]
+    assert ent["status"] == "failed"
+    assert ent["phase"] == "start"
+    assert "synthetic start failure" in ent["error"]
+    # the OTHER collectors were unaffected
+    assert _manifest(logdir)["collectors"]["timebase"]["status"] == "stopped"
+
+
+def test_collectors_stop_in_reverse_start_order(logdir):
+    _record(logdir)
+    cols = _manifest(logdir)["collectors"]
+    started = [(name, ent) for name, ent in cols.items()
+               if "start_seq" in ent and "stop_seq" in ent]
+    assert len(started) >= 3  # timebase + procmon + xprof at minimum
+    by_start = sorted(started, key=lambda kv: kv[1]["start_seq"])
+    stop_seqs = [ent["stop_seq"] for _n, ent in by_start]
+    assert stop_seqs == sorted(stop_seqs, reverse=True), (
+        "epilogue must stop collectors in reverse start order")
+
+
+def test_kill_all_on_error_epilogue_recorded(logdir, monkeypatch):
+    """A mid-record failure kills every started collector; the manifest
+    keeps the killed status even though the epilogue's stop/flush still
+    runs afterwards (failed/killed are sticky)."""
+    import sofa_tpu.record as record_mod
+
+    def explode(child, cfg):
+        raise RuntimeError("synthetic launch failure")
+
+    monkeypatch.setattr(record_mod, "_wait_epilogue_bounded", explode)
+    with pytest.raises(RuntimeError, match="synthetic launch"):
+        _record(logdir)
+    doc = _manifest(logdir)  # written on the error path too
+    killed = [n for n, ent in doc["collectors"].items()
+              if ent["status"] == "killed"]
+    assert "timebase" in killed and "procmon" in killed
+    # the epilogue still ran (stop_seq present) without whitewashing
+    assert "stop_seq" in doc["collectors"]["timebase"]
+    assert doc["runs"]["record"]["counters"]["errors"] >= 1
+
+
+# --- sofa status ------------------------------------------------------------
+
+def test_status_cli_healthy_and_failed(logdir, monkeypatch, capsys):
+    from sofa_tpu.cli import main
+
+    rc, _cfg = _record(logdir)
+    assert main(["status", logdir]) == 0
+    out = capsys.readouterr()
+    text = out.out + out.err
+    assert "COLLECTOR" in text and "timebase" in text
+
+    # injected collector failure -> nonzero exit
+    from sofa_tpu.collectors.procmon import ProcMonCollector
+
+    def boom(self):
+        raise RuntimeError("injected failure")
+
+    monkeypatch.setattr(ProcMonCollector, "start", boom)
+    _record(logdir)
+    assert main(["status", "--logdir", logdir]) == 1
+    text = "".join(capsys.readouterr())
+    assert "failed" in text
+
+    # no manifest at all
+    assert main(["status", str(logdir) + "_nope/"]) == 2
+
+
+# --- preprocess sources -----------------------------------------------------
+
+def _small_logdir(tmp_path, name="plog"):
+    d = str(tmp_path / name) + "/"
+    os.makedirs(d)
+    with open(d + "mpstat.txt", "w") as f:
+        f.write("1700000000.0 cpu0 100 0 50 800 10 5 5 0\n"
+                "1700000000.5 cpu0 140 0 60 830 12 6 6 0\n")
+    with open(d + "sofa_time.txt", "w") as f:
+        f.write("1700000000.0\n")
+    return d
+
+
+def test_preprocess_manifest_covers_every_source(tmp_path):
+    d = _small_logdir(tmp_path)
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    doc = _manifest(d)
+    from sofa_tpu.ingest.cache import PARSER_VERSIONS
+
+    assert set(doc["sources"]) == set(PARSER_VERSIONS)
+    for name, ent in doc["sources"].items():
+        assert ent["status"] in telemetry.SOURCE_STATUSES, name
+        assert ent["cache"] in telemetry.CACHE_OUTCOMES, name
+        assert ent["wall_s"] >= 0 and ent["events"] >= 0, name
+    assert doc["sources"]["mpstat"]["status"] == "parsed"
+    assert doc["sources"]["mpstat"]["events"] > 0
+    assert doc["meta"]["pool"]["jobs"] >= 1
+    # warm re-run flips mpstat to a recorded cache hit
+    sofa_preprocess(cfg)
+    doc2 = _manifest(d)
+    assert doc2["sources"]["mpstat"]["cache"] == "hit"
+    assert doc2["sources"]["mpstat"]["status"] == "cached"
+    assert doc2["meta"]["ingest_cache"]["hits"].count("mpstat") == 1
+
+
+def test_preprocess_degraded_source_recorded(tmp_path, monkeypatch):
+    from sofa_tpu.ingest import procfs
+
+    def boom(text, time_base=0.0, **kw):
+        raise ValueError("synthetic parse failure")
+
+    monkeypatch.setattr(procfs, "parse_mpstat", boom)
+    d = _small_logdir(tmp_path)
+    sofa_preprocess(SofaConfig(logdir=d, ingest_cache=False))
+    ent = _manifest(d)["sources"]["mpstat"]
+    assert ent["status"] == "degraded"
+    assert "synthetic parse failure" in ent["error"]
+
+
+def test_analyze_folds_manifest_warnings_into_hints(tmp_path, monkeypatch):
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.ingest import procfs
+
+    def boom(text, time_base=0.0, **kw):
+        raise ValueError("synthetic parse failure")
+
+    monkeypatch.setattr(procfs, "parse_mpstat", boom)
+    d = _small_logdir(tmp_path)
+    cfg = SofaConfig(logdir=d, ingest_cache=False)
+    sofa_analyze(cfg, frames=sofa_preprocess(cfg))
+    hints = open(os.path.join(d, "hints.txt")).read()
+    assert "[self]" in hints
+    assert "mpstat" in hints
+    # analyze's own run landed in the manifest too
+    assert "analyze" in _manifest(d)["runs"]
+    assert any(s["verb"] == "analyze" and s["cat"] == "analyze"
+               for s in _manifest(d)["stages"])
+
+
+# --- self-trace + export ----------------------------------------------------
+
+def test_self_trace_is_valid_chrome_trace(logdir):
+    _record(logdir)
+    cfg = SofaConfig(logdir=logdir)
+    sofa_preprocess(cfg)
+    doc = _assert_valid_chrome_trace(
+        os.path.join(logdir, telemetry.SELF_TRACE_NAME))
+    verbs = {(e.get("args") or {}).get("verb")
+             for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"record", "preprocess"} <= verbs
+    # anchored to the capture's own time zero
+    tb = float(open(cfg.path("sofa_time.txt")).read().split()[0])
+    assert doc["otherData"]["ts_zero_unix"] == pytest.approx(tb)
+
+
+def test_perfetto_export_includes_self_trace(logdir):
+    import gzip
+
+    from sofa_tpu.export_perfetto import _SELF_PID, export_perfetto
+
+    _record(logdir, sys_mon_rate=50, command="sleep 0.2")
+    cfg = SofaConfig(logdir=logdir)
+    sofa_preprocess(cfg)
+    path = export_perfetto(cfg)
+    assert path is not None
+    with gzip.open(path, "rt") as f:
+        doc = json.load(f)
+    self_events = [e for e in doc["traceEvents"]
+                   if e.get("pid") == _SELF_PID]
+    assert any(e.get("name") == "prologue" for e in self_events)
+    assert any(e.get("ph") == "M" for e in self_events)
+
+
+# --- manifest_check tool ----------------------------------------------------
+
+def test_manifest_check_validates_and_rejects(logdir, tmp_path):
+    mc = _load_manifest_check()
+    _record(logdir)
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    assert mc.check_path(logdir) == 0
+    doc = _manifest(logdir)
+    assert mc.validate_manifest(doc) == []
+
+    # corruption is caught
+    bad = json.loads(json.dumps(doc))
+    bad["schema_version"] = 99
+    bad["collectors"]["timebase"]["status"] = "exploded"
+    del bad["runs"]["record"]["wall_s"]
+    probs = mc.validate_manifest(bad)
+    assert len(probs) >= 3
+    assert any("schema_version" in p for p in probs)
+    assert any("exploded" in p for p in probs)
+
+    # --require-healthy flags failed collectors
+    sick = json.loads(json.dumps(doc))
+    sick["collectors"]["timebase"]["status"] = "failed"
+    assert mc.validate_manifest(sick) == []
+    assert any("unhealthy" in p
+               for p in mc.validate_manifest(sick, require_healthy=True))
+
+    # missing path exit code
+    assert mc.check_path(str(tmp_path / "nothing")) == 2
+
+
+# --- printing satellites ----------------------------------------------------
+
+def test_log_level_env_filters_display_not_counters(monkeypatch, capsys):
+    from sofa_tpu.printing import print_warning
+
+    monkeypatch.setenv("SOFA_LOG_LEVEL", "error")
+    tel = telemetry.begin("record")
+    try:
+        print_warning("suppressed but counted")
+    finally:
+        telemetry.end(tel)
+    out = capsys.readouterr()
+    assert "suppressed but counted" not in out.out + out.err
+    assert tel.counters["warnings"] == 1
+    assert "suppressed but counted" in tel.warning_tail[0]
+
+    monkeypatch.setenv("SOFA_LOG_LEVEL", "warn")
+    print_warning("now visible")
+    assert "now visible" in capsys.readouterr().err
+
+
+def test_log_level_debug_shows_info_without_verbose(monkeypatch, capsys):
+    from sofa_tpu import printing
+
+    monkeypatch.setattr(printing, "verbose", False)
+    monkeypatch.delenv("SOFA_LOG_LEVEL", raising=False)
+    printing.print_info("hidden by default")
+    assert "hidden by default" not in capsys.readouterr().out
+    monkeypatch.setenv("SOFA_LOG_LEVEL", "debug")
+    printing.print_info("debug shows me")
+    assert "debug shows me" in capsys.readouterr().out
+
+
+def test_log_timestamps_env(monkeypatch, capsys):
+    import re
+
+    monkeypatch.setenv("SOFA_LOG_TIMESTAMPS", "1")
+    from sofa_tpu.printing import print_progress
+
+    print_progress("stamped")
+    out = capsys.readouterr().out
+    assert re.search(r"\d{2}:\d{2}:\d{2}\.\d{3} \[PROGRESS\] stamped", out)
+
+
+# --- acceptance e2e: pod_synth --raw harness --------------------------------
+
+def test_e2e_pod_synth_raw_manifest(tmp_path):
+    """ISSUE 2 acceptance: `sofa record` + `sofa preprocess` over the
+    pod_synth --raw collector files leaves a schema-valid manifest
+    covering every collector and ingest source, `sofa status` renders it
+    with exit 0, and the self-trace loads as a valid Chrome trace."""
+    logdir = str(tmp_path / "podlog") + "/"
+    rc, cfg = _record(logdir, command="sleep 0.2", sys_mon_rate=50)
+    assert rc == 0
+    synth = str(tmp_path / "synth") + "/"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "pod_synth.py"),
+         synth, "--raw"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    # overlay the raw collector harness files; keep record's clock files
+    for name in ("perf.script", "strace.txt", "pystacks.txt", "mpstat.txt",
+                 "cpuinfo.txt", "netstat.txt", "vmstat.txt", "tpumon.txt",
+                 "misc.txt"):
+        shutil.copy(synth + name, logdir + name)
+    sofa_preprocess(cfg)
+
+    mc = _load_manifest_check()
+    assert mc.check_path(logdir, require_healthy=True) == 0
+    doc = _manifest(logdir)
+    from sofa_tpu.ingest.cache import PARSER_VERSIONS
+
+    assert set(doc["collectors"]) == {c.name for c in build_collectors(cfg)}
+    assert set(doc["sources"]) == set(PARSER_VERSIONS)
+    # the big text parsers really parsed (not empty-degraded)
+    for src in ("cputrace", "strace", "pystacks", "mpstat", "tpumon"):
+        assert doc["sources"][src]["status"] == "parsed", src
+        assert doc["sources"][src]["events"] > 0, src
+
+    from sofa_tpu.cli import main
+
+    assert main(["status", logdir]) == 0
+    _assert_valid_chrome_trace(
+        os.path.join(logdir, telemetry.SELF_TRACE_NAME))

@@ -1,0 +1,192 @@
+#!/usr/bin/env python3
+"""Validate a sofa_tpu ``run_manifest.json`` against its schema.
+
+CI/tooling companion of sofa_tpu/telemetry.py: bench.py runs this after its
+preprocess-path evidence so every bench run also asserts the self-telemetry
+ledger is present, schema-valid, and (with --require-healthy) free of
+failed collectors.
+
+    python tools/manifest_check.py <logdir-or-manifest.json> [--require-healthy]
+
+Exit codes: 0 valid, 1 invalid (problems printed one per line), 2 missing /
+unreadable.  ``validate_manifest`` is importable for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from sofa_tpu.telemetry import (  # noqa: E402
+    CACHE_OUTCOMES,
+    COLLECTOR_STATUSES,
+    MANIFEST_NAME,
+    MANIFEST_SCHEMA,
+    MANIFEST_VERSION,
+    SOURCE_STATUSES,
+)
+
+_KNOWN_VERBS = ("record", "preprocess", "analyze")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
+    """All schema problems found (empty list == valid).
+
+    Validation tracks the versioning policy in docs/OBSERVABILITY.md: keys
+    beyond the ones checked here are ALLOWED (additive evolution does not
+    bump schema_version), so this only rejects missing/mistyped required
+    structure and out-of-vocabulary enum values.
+    """
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["manifest is not a JSON object"]
+    if doc.get("schema") != MANIFEST_SCHEMA:
+        probs.append(f"schema: expected {MANIFEST_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("schema_version") != MANIFEST_VERSION:
+        probs.append(f"schema_version: expected {MANIFEST_VERSION}, "
+                     f"got {doc.get('schema_version')!r}")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+
+    runs = doc.get("runs")
+    if not isinstance(runs, dict) or not runs:
+        probs.append("runs: missing or empty")
+        runs = {}
+    for verb, run in runs.items():
+        where = f"runs.{verb}"
+        if not isinstance(run, dict):
+            probs.append(f"{where}: not an object")
+            continue
+        if not _is_num(run.get("started_unix")):
+            probs.append(f"{where}.started_unix: missing or not a number")
+        if not _is_num(run.get("wall_s")) or run.get("wall_s", 0) < 0:
+            probs.append(f"{where}.wall_s: missing or negative")
+        rc = run.get("rc")
+        if rc is not None and not isinstance(rc, int):
+            probs.append(f"{where}.rc: not an int or null")
+        counters = run.get("counters")
+        if not isinstance(counters, dict):
+            probs.append(f"{where}.counters: missing")
+        else:
+            for key in ("warnings", "errors"):
+                v = counters.get(key, 0)
+                if not isinstance(v, int) or v < 0:
+                    probs.append(f"{where}.counters.{key}: not a "
+                                 "non-negative int")
+
+    env = doc.get("env")
+    if not isinstance(env, dict) or "sofa_tpu_version" not in env:
+        probs.append("env: missing or lacks sofa_tpu_version")
+
+    collectors = doc.get("collectors", {})
+    if not isinstance(collectors, dict):
+        probs.append("collectors: not an object")
+        collectors = {}
+    for name, ent in collectors.items():
+        where = f"collectors.{name}"
+        if not isinstance(ent, dict):
+            probs.append(f"{where}: not an object")
+            continue
+        if ent.get("status") not in COLLECTOR_STATUSES:
+            probs.append(f"{where}.status: {ent.get('status')!r} not in "
+                         f"{COLLECTOR_STATUSES}")
+        for key in ("bytes_captured", "exit_code"):
+            if key in ent and not isinstance(ent[key], int):
+                probs.append(f"{where}.{key}: not an int")
+        if "bytes_captured" in ent and ent["bytes_captured"] < 0:
+            probs.append(f"{where}.bytes_captured: negative")
+
+    sources = doc.get("sources", {})
+    if not isinstance(sources, dict):
+        probs.append("sources: not an object")
+        sources = {}
+    for name, ent in sources.items():
+        where = f"sources.{name}"
+        if not isinstance(ent, dict):
+            probs.append(f"{where}: not an object")
+            continue
+        if ent.get("status") not in SOURCE_STATUSES:
+            probs.append(f"{where}.status: {ent.get('status')!r} not in "
+                         f"{SOURCE_STATUSES}")
+        if ent.get("cache") not in CACHE_OUTCOMES:
+            probs.append(f"{where}.cache: {ent.get('cache')!r} not in "
+                         f"{CACHE_OUTCOMES}")
+        if not _is_num(ent.get("wall_s")) or ent.get("wall_s", 0) < 0:
+            probs.append(f"{where}.wall_s: missing or negative")
+        if not isinstance(ent.get("events"), int) or ent.get("events", 0) < 0:
+            probs.append(f"{where}.events: missing or negative")
+
+    stages = doc.get("stages", [])
+    if not isinstance(stages, list):
+        probs.append("stages: not a list")
+        stages = []
+    for i, s in enumerate(stages):
+        if not isinstance(s, dict) or not isinstance(s.get("name"), str) \
+                or not _is_num(s.get("t0_unix")) or not _is_num(s.get("dur_s")):
+            probs.append(f"stages[{i}]: needs name + numeric t0_unix/dur_s")
+        elif s.get("dur_s") < 0:
+            probs.append(f"stages[{i}].dur_s: negative")
+
+    if "record" in runs and not collectors:
+        probs.append("a record run is present but the collectors ledger "
+                     "is empty")
+    if "preprocess" in runs and not sources:
+        probs.append("a preprocess run is present but the sources ledger "
+                     "is empty")
+
+    if require_healthy:
+        for name, ent in collectors.items():
+            if ent.get("status") in ("failed", "killed"):
+                probs.append(f"unhealthy: collector {name} "
+                             f"{ent.get('status')}")
+        for verb, run in runs.items():
+            if isinstance(run, dict) and (run.get("counters") or {}).get(
+                    "errors"):
+                probs.append(f"unhealthy: `sofa {verb}` logged error lines")
+    return probs
+
+
+def check_path(path: str, require_healthy: bool = False) -> int:
+    """0 valid / 1 invalid / 2 missing; problems go to stderr."""
+    if os.path.isdir(path):
+        path = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        print(f"manifest_check: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"manifest_check: {path} is not JSON: {e}", file=sys.stderr)
+        return 1
+    probs = validate_manifest(doc, require_healthy=require_healthy)
+    for p in probs:
+        print(f"manifest_check: {p}", file=sys.stderr)
+    if not probs:
+        verbs = ",".join(v for v in _KNOWN_VERBS if v in doc.get("runs", {}))
+        print(f"manifest_check: OK ({path}; verbs: {verbs or '?'})")
+    return 1 if probs else 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="logdir or run_manifest.json path")
+    p.add_argument("--require-healthy", action="store_true",
+                   help="also fail on failed/killed collectors or logged "
+                        "error lines")
+    args = p.parse_args(argv)
+    return check_path(args.path, require_healthy=args.require_healthy)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
