@@ -114,6 +114,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "kills its process group (default: derived from "
                         "the in-child stop timeouts)")
 
+    g = p.add_argument_group("record: fault tolerance")
+    g.add_argument("--inject_faults",
+                   help="fault-injection spec, e.g. 'procmon:die@2s,"
+                        "tcpdump:wedge@stop,pcap:corrupt' (SOFA_FAULTS env "
+                        "equivalent; see docs/ROBUSTNESS.md)")
+    g.add_argument("--collector_restarts", type=int,
+                   help="restart budget for a collector that dies mid-run "
+                        "(default 1; 0 disables restarts)")
+    g.add_argument("--collector_stop_timeout_s", type=float,
+                   help="per-collector stop deadline in seconds — a wedged "
+                        "flush degrades that series instead of hanging "
+                        "record (default 15; 0 = unbounded)")
+    g.add_argument("--collector_harvest_timeout_s", type=float,
+                   help="per-collector harvest deadline in seconds "
+                        "(default 120; 0 = unbounded)")
+
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
     g.add_argument("--tpu_time_offset_ms", type=float,
@@ -183,6 +199,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "netstat_interface", "blkdev", "pid",
         "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
         "xprof_duration_s", "tpu_mon_rate", "epilogue_deadline_s",
+        "inject_faults", "collector_restarts", "collector_stop_timeout_s",
+        "collector_harvest_timeout_s",
         "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
         "trace_format",
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",

@@ -21,10 +21,11 @@ import os
 import shutil
 import signal
 import subprocess
+import threading
 import time
 from typing import Dict, List, Optional
 
-from sofa_tpu import telemetry
+from sofa_tpu import faults, telemetry
 from sofa_tpu.printing import print_info, print_warning
 
 
@@ -33,6 +34,39 @@ def _next_seq() -> int:
     none) — the manifest's proof that stop order reversed start order."""
     tel = telemetry.current()
     return tel.next_seq() if tel is not None else 0
+
+
+def _run_bounded(fn, timeout: "float | None", name: str, phase: str) -> bool:
+    """Run a collector epilogue step with a wall-clock deadline.
+
+    True iff ``fn`` finished (its exception, if any, propagates to the
+    caller exactly as an unbounded call would).  False once the deadline
+    passes: ``fn`` keeps running on an abandoned daemon thread that dies
+    with the process — a C call wedged without the GIL cannot be cancelled
+    from Python, so abandonment is the only escalation that always works
+    (same reasoning as the injected atexit guard, collectors/xprof.py).
+    timeout None/<=0 disables the bound (direct call).
+    """
+    if not timeout or timeout <= 0:
+        fn()
+        return True
+    box: dict = {}
+
+    def _run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            box["err"] = e
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"sofa_{name}_{phase}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        return False
+    if "err" in box:
+        raise box["err"]
+    return True
 
 
 class CollectorState(enum.Enum):
@@ -79,11 +113,50 @@ class Collector:
         ledger sums their on-disk sizes after harvest."""
         return []
 
+    # -- supervision hooks (sofa_tpu/supervisor.py) ------------------------
+    def alive(self) -> Optional[bool]:
+        """Liveness for the watchdog: True/False when this collector has a
+        watchable backing worker, None when there is nothing to watch
+        (prefix-only or one-shot collectors)."""
+        return None
+
+    def fault_kill(self) -> None:
+        """Fault-injection kill point (faults.py ``die``): make the backing
+        worker vanish the way a crash would."""
+        if hasattr(self, "kill"):
+            self.kill()
+
+    def _deadline(self, field: str, default: float) -> "float | None":
+        return getattr(self.cfg, field, default)
+
+    def _escalate_kill(self) -> None:
+        """TERM -> KILL -> abandon on the backing process after a stop
+        deadline — the `_signal_tree` discipline from record.py applied to
+        one collector (killpg falls back to a direct signal for processes
+        that are not group leaders, i.e. every collector proc)."""
+        proc = getattr(self, "proc", None)
+        if proc is None or proc.poll() is not None:
+            return
+        from sofa_tpu.record import _signal_tree  # lazy: record imports us
+
+        _signal_tree(proc, signal.SIGTERM)
+        try:
+            proc.wait(timeout=2)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        _signal_tree(proc, signal.SIGKILL)
+        try:
+            proc.wait(timeout=2)
+        except subprocess.TimeoutExpired:
+            pass  # abandoned; the manifest carries timed_out
+
     # -- instrumented lifecycle (driven by record; do not override) --------
     def run_start(self) -> None:
         t0 = time.perf_counter()
         try:
             with telemetry.maybe_span(f"{self.name}.start", cat="collector"):
+                faults.maybe_inject(self.name, "start")
                 self.start()
         except Exception as e:  # noqa: BLE001 — ledger first, caller decides
             telemetry.collector_event(
@@ -92,16 +165,35 @@ class Collector:
         telemetry.collector_event(
             self.name, "started", start_seq=_next_seq(),
             start_wall_s=round(time.perf_counter() - t0, 6))
+        faults.arm_die(self)
 
     def run_stop(self) -> None:
         t0 = time.perf_counter()
+
+        def _do_stop():
+            faults.maybe_inject(self.name, "stop")
+            self.stop()
+
+        timeout = self._deadline("collector_stop_timeout_s", 15.0)
         try:
             with telemetry.maybe_span(f"{self.name}.stop", cat="collector"):
-                self.stop()
+                finished = _run_bounded(_do_stop, timeout, self.name, "stop")
         except Exception as e:  # noqa: BLE001
             telemetry.collector_event(
                 self.name, "failed", phase="stop", error=str(e)[:300])
             raise
+        if not finished:
+            # A wedged flush degrades THIS series, never the whole record.
+            self._escalate_kill()
+            telemetry.collector_event(
+                self.name, "timed_out", phase="stop", timed_out=True,
+                stop_seq=_next_seq(),
+                stop_wall_s=round(time.perf_counter() - t0, 6))
+            print_warning(
+                f"{self.name}: stop exceeded {timeout:g}s — killed and "
+                "abandoned; its series may be partial "
+                "(--collector_stop_timeout_s)")
+            return
         fields = {"stop_seq": _next_seq(),
                   "stop_wall_s": round(time.perf_counter() - t0, 6)}
         proc = getattr(self, "proc", None)
@@ -111,10 +203,18 @@ class Collector:
 
     def run_harvest(self) -> None:
         t0 = time.perf_counter()
+
+        def _do_harvest():
+            faults.maybe_inject(self.name, "harvest")
+            self.harvest()
+            faults.maybe_truncate(self)
+
+        timeout = self._deadline("collector_harvest_timeout_s", 120.0)
         try:
             with telemetry.maybe_span(f"{self.name}.harvest",
                                       cat="collector"):
-                self.harvest()
+                finished = _run_bounded(_do_harvest, timeout, self.name,
+                                        "harvest")
         except Exception as e:  # noqa: BLE001
             telemetry.collector_event(
                 self.name, "failed", phase="harvest", error=str(e)[:300])
@@ -123,6 +223,14 @@ class Collector:
             telemetry.collector_event(
                 self.name,
                 bytes_captured=telemetry.collector_bytes(self.outputs()))
+        if not finished:
+            telemetry.collector_event(
+                self.name, "timed_out", phase="harvest", timed_out=True)
+            print_warning(
+                f"{self.name}: harvest exceeded {timeout:g}s — abandoned; "
+                "its derived series may be missing "
+                "(--collector_harvest_timeout_s)")
+            return
         telemetry.collector_event(
             self.name, harvest_wall_s=round(time.perf_counter() - t0, 6))
 
@@ -154,6 +262,11 @@ class ProcessCollector(Collector):
         self.proc = subprocess.Popen(argv, **popen_kwargs)
         self.state = CollectorState.RUNNING
 
+    def alive(self) -> Optional[bool]:
+        if self.proc is None:
+            return None
+        return self.proc.poll() is None
+
     def stop(self, sig=signal.SIGTERM, timeout: float = 5.0) -> None:
         if self.proc is None:
             return
@@ -165,7 +278,17 @@ class ProcessCollector(Collector):
                 except subprocess.TimeoutExpired:
                     print_warning(f"{self.name}: did not exit on signal; killing")
                     self.proc.kill()
-                    self.proc.wait(timeout=timeout)
+                    try:
+                        self.proc.wait(timeout=timeout)
+                    except subprocess.TimeoutExpired:
+                        # Already SIGKILLed: an unreapable zombie (wedged in
+                        # an uninterruptible syscall) must degrade to a
+                        # recorded state, not turn the epilogue into a
+                        # failure for a collector that is already dead.
+                        print_warning(
+                            f"{self.name}: not reaped after SIGKILL; "
+                            "abandoning the wait")
+                        telemetry.collector_event(self.name, unreaped=True)
         except ProcessLookupError:
             pass
         self.state = CollectorState.STOPPED

@@ -24,7 +24,9 @@ class VmstatCollector(ProcessCollector):
         return None
 
     def start(self) -> None:
-        self._out = open(self.cfg.path("vmstat.txt"), "w")
+        # Append: record cleans stale files first, so "a" only matters on a
+        # supervisor restart — which must not wipe the pre-death samples.
+        self._out = open(self.cfg.path("vmstat.txt"), "a")
         self.launch(["vmstat", "-w", "-t", "1"], stdout=self._out,
                     stderr=subprocess.DEVNULL)
 

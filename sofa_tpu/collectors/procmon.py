@@ -121,8 +121,24 @@ class ProcMonCollector(ProcessCollector):
             self.launch(argv)
             return
         print_info("procmon: python fallback sampler threads")
+        # Fresh event per (re)start: a supervisor restart after a die must
+        # not inherit the stop signal that killed the previous sampler.
+        self._stop_event = threading.Event()
         self._thread = threading.Thread(target=self._sample_loop, daemon=True)
         self._thread.start()
+
+    def alive(self):
+        if self.proc is not None:
+            return super().alive()
+        if self._thread is not None:
+            return self._thread.is_alive()
+        return None
+
+    def fault_kill(self) -> None:
+        if self.proc is not None:
+            super().fault_kill()
+        elif self._thread is not None:
+            self._stop_event.set()
 
     def _sample_loop(self) -> None:
         cfg = self.cfg

@@ -112,6 +112,20 @@ class SofaConfig:
                                      # breadcrumb appears (None = derive from
                                      # the breadcrumb's own timeouts)
 
+    # --- record: fault tolerance / chaos -----------------------------------
+    inject_faults: str = ""          # fault-injection spec (sofa_tpu/faults.py
+                                     # grammar; SOFA_FAULTS env equivalent) —
+                                     # empty = all hooks are no-ops
+    collector_restarts: int = 1      # supervisor restart budget per collector
+                                     # that dies mid-run (0 = never restart)
+    collector_stop_timeout_s: float = 15.0
+                                     # per-collector stop deadline; a wedged
+                                     # flush is TERM/KILLed + abandoned past
+                                     # it (0 = unbounded)
+    collector_harvest_timeout_s: float = 120.0
+                                     # per-collector harvest deadline
+                                     # (0 = unbounded)
+
     # --- preprocess --------------------------------------------------------
     cpu_time_offset_ms: int = 0      # manual host-clock fudge (bin/sofa:111)
     tpu_time_offset_ms: float = 0.0  # manual device/XPlane-clock fudge: the

@@ -1,0 +1,235 @@
+"""Fault injection: every degradation path, exercisable on demand.
+
+SOFA's contract is that a profiling run *always* yields a usable trace even
+when individual collectors misbehave (the reference's kill-all epilogue,
+sofa_record.py:480-523).  Those paths are exactly the ones that never run in
+a healthy dev loop — "Fake Runs, Real Fixes" (PAPERS.md) argues injected
+failures are the only way to keep them honest.  This module parses a fault
+spec and exposes the hook points the runtime threads through
+``collectors/base.py`` and the preprocess ingest fan-out:
+
+    SOFA_FAULTS='procmon:die@2s,tcpdump:wedge@stop,perf:fail@start'
+    sofa record "python train.py" --inject_faults 'xprof:truncate@harvest'
+
+Grammar (comma-joined entries)::
+
+    entry  = <target> ":" <kind> [ "@" <when> ]
+    target = collector name (procmon, tcpdump, perf, xprof, vmstat, ...)
+             or ingest source name (mpstat, nettrace, xplane, ...;
+             "pcap" aliases nettrace)
+    kind   = die      kill the collector's backing process/thread mid-run
+                      (@<delay> after start, e.g. @2s; default immediately)
+             wedge    block forever at @<phase> (stop|harvest; default stop)
+                      — exercises the bounded-epilogue deadlines
+             fail     raise at @<phase> (start|stop|harvest; default start)
+             truncate halve the collector's output files at harvest
+             corrupt  ingest: the source's parse raises CorruptRawError,
+                      driving the quarantine path
+    when   = "start" | "stop" | "harvest" | <float>"s" (die delay)
+
+Zero overhead when unset: every hook first reads the module-level plan and
+returns on ``None`` — no parsing, no lookups, no env reads on the hot path.
+The plan is installed by ``sofa record`` / ``sofa preprocess`` from
+``cfg.inject_faults`` (or the SOFA_FAULTS env) and cleared in their
+``finally``, so library users and tests never inherit a stale plan.
+
+Supervisor/restart semantics live in sofa_tpu/supervisor.py; the quarantine
+flow in sofa_tpu/preprocess.py.  See docs/ROBUSTNESS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+KINDS = ("die", "wedge", "fail", "truncate", "corrupt")
+PHASES = ("start", "stop", "harvest")
+
+# Spec targets users think of by raw-file name map onto the internal
+# ingest-task name here.
+ALIASES = {"pcap": "nettrace"}
+
+# Which phase a kind fires in when the entry names none.
+DEFAULT_PHASE = {"fail": "start", "wedge": "stop", "truncate": "harvest"}
+
+# A wedge blocks "forever" relative to any sane deadline; the sleeping
+# daemon thread is abandoned by the bounded epilogue and dies with the
+# process.
+_WEDGE_S = 3600.0
+
+_DELAY_RE = re.compile(r"^(\d+(?:\.\d+)?)s?$")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``fail`` injection — a synthetic collector failure."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    target: str
+    kind: str
+    phase: Optional[str] = None   # start|stop|harvest (fail/wedge/truncate)
+    delay_s: Optional[float] = None  # die only
+
+    def fires_at(self, phase: str) -> bool:
+        return (self.phase or DEFAULT_PHASE.get(self.kind)) == phase
+
+
+class FaultPlan:
+    """Parsed fault spec, indexed by target for O(1) hook lookups."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        self._by_target: Dict[str, List[FaultSpec]] = {}
+        for s in self.specs:
+            self._by_target.setdefault(s.target, []).append(s)
+
+    def find(self, target: str, kind: str,
+             phase: Optional[str] = None) -> Optional[FaultSpec]:
+        for s in self._by_target.get(target, ()):
+            if s.kind != kind:
+                continue
+            if phase is None or s.fires_at(phase):
+                return s
+        return None
+
+    def corrupt_for(self, source: str) -> Optional[FaultSpec]:
+        return self.find(source, "corrupt")
+
+
+def parse(text: str) -> FaultPlan:
+    """Parse a spec string; raises ValueError with the offending entry."""
+    specs: List[FaultSpec] = []
+    for entry in (e.strip() for e in text.split(",")):
+        if not entry:
+            continue
+        target, sep, rest = entry.partition(":")
+        if not sep or not target or not rest:
+            raise ValueError(
+                f"fault entry {entry!r}: expected <target>:<kind>[@<when>]")
+        kind, _, when = rest.partition("@")
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault entry {entry!r}: kind {kind!r} not in {KINDS}")
+        phase: Optional[str] = None
+        delay: Optional[float] = None
+        if when:
+            if when in PHASES:
+                phase = when
+            else:
+                m = _DELAY_RE.match(when)
+                if m is None:
+                    raise ValueError(
+                        f"fault entry {entry!r}: {when!r} is neither a "
+                        f"phase {PHASES} nor a delay like '2s'")
+                delay = float(m.group(1))
+        if kind == "die" and phase is not None:
+            raise ValueError(
+                f"fault entry {entry!r}: die takes a delay (e.g. @2s), "
+                "not a phase")
+        if kind in ("fail", "wedge", "truncate") and delay is not None:
+            raise ValueError(
+                f"fault entry {entry!r}: {kind} takes a phase "
+                f"{PHASES}, not a delay")
+        if kind == "wedge" and phase == "start":
+            raise ValueError(
+                f"fault entry {entry!r}: wedge supports the bounded "
+                "phases stop|harvest (start is unbounded by design — "
+                "use fail@start)")
+        specs.append(FaultSpec(target=ALIASES.get(target, target),
+                               kind=kind, phase=phase, delay_s=delay))
+    return FaultPlan(specs)
+
+
+# --- active-plan registry ----------------------------------------------------
+# One process-wide plan, installed per pipeline verb.  Not per-thread: the
+# hooks fire from collector worker threads and pool workers that must see
+# the verb's plan.
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def install_from(cfg=None) -> Optional[FaultPlan]:
+    """Install the plan from cfg.inject_faults, falling back to SOFA_FAULTS.
+
+    A bad spec is a usage error (curated SofaUserError), not a traceback.
+    Pair with :func:`clear` in a finally.
+    """
+    global _PLAN
+    _PLAN = None  # a failed parse must never leave a previous plan live
+    text = (getattr(cfg, "inject_faults", "")
+            or os.environ.get("SOFA_FAULTS", "") or "").strip()
+    if not text:
+        return None
+    from sofa_tpu.printing import SofaUserError, print_warning
+
+    try:
+        _PLAN = parse(text)
+    except ValueError as e:
+        raise SofaUserError(f"bad --inject_faults/SOFA_FAULTS spec: {e}") \
+            from None
+    # Loud on purpose — and print_warning rides the telemetry counters, so
+    # a chaos run's manifest self-documents that faults were active.
+    print_warning(f"fault injection ACTIVE: {text}")
+    return _PLAN
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+# --- hook points -------------------------------------------------------------
+
+def maybe_inject(name: str, phase: str) -> None:
+    """Collector lifecycle hook (run_start/run_stop/run_harvest).
+
+    ``fail`` raises FaultInjected; ``wedge`` blocks (only ever called for
+    stop/harvest, which the bounded epilogue deadlines cover)."""
+    plan = _PLAN
+    if plan is None:
+        return
+    if plan.find(name, "fail", phase) is not None:
+        raise FaultInjected(f"injected {name} failure at {phase} "
+                            "(--inject_faults)")
+    if phase != "start" and plan.find(name, "wedge", phase) is not None:
+        time.sleep(_WEDGE_S)
+
+
+def arm_die(col) -> None:
+    """After a successful start: schedule the collector's backing worker to
+    vanish the way a crash would (Collector.fault_kill)."""
+    plan = _PLAN
+    if plan is None:
+        return
+    spec = plan.find(col.name, "die")
+    if spec is None:
+        return
+    t = threading.Timer(spec.delay_s or 0.0, col.fault_kill)
+    t.daemon = True
+    t.start()
+
+
+def maybe_truncate(col) -> None:
+    """Harvest hook: halve every existing output file — a synthetic
+    torn/partial harvest for the corrupt-input paths downstream."""
+    plan = _PLAN
+    if plan is None:
+        return
+    if plan.find(col.name, "truncate", "harvest") is None:
+        return
+    for path in col.outputs():
+        try:
+            if os.path.isfile(path):
+                size = os.path.getsize(path)
+                os.truncate(path, size // 2)
+        except OSError:
+            pass

@@ -4,4 +4,29 @@ One module per source (the reference concentrates all of this in the 2106-line
 sofa_preprocess.py; see SURVEY §2.4 for the per-parser map).  Every parser is
 a pure function ``text/path -> DataFrame`` so fixtures can test it without
 running collectors.
+
+Corruption contract: a parser that can positively identify a truncated or
+corrupt raw file raises :class:`CorruptRawError` (never for a merely-empty
+or absent file — those are normal degradations).  Preprocess reacts by
+quarantining the file to ``<logdir>/_quarantine/`` and recording the source
+as ``quarantined`` in the run manifest; see docs/ROBUSTNESS.md.
 """
+
+from __future__ import annotations
+
+
+class CorruptRawError(ValueError):
+    """A raw collector file is positively corrupt (not merely absent/empty).
+
+    Carries the on-disk ``path`` so preprocess can quarantine the file.
+    args stay ``(path, reason)`` so the exception survives a process-pool
+    pickle round-trip with its attributes intact.
+    """
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(path, reason)
+        self.path = path
+        self.reason = reason
+
+    def __str__(self) -> str:
+        return f"{self.path}: {self.reason}"

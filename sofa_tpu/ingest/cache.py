@@ -129,6 +129,26 @@ class IngestCache:
         self.hits.append(source)
         return {"frames": frames, "meta": doc.get("meta") or {}}
 
+    def invalidate(self, source: str) -> None:
+        """Drop every stored entry for a source.  The quarantine contract:
+        a source whose raw input was quarantined must never be served warm
+        (preprocess calls this even when ``enabled=False`` — a bypassed
+        cache still holds files a later cached run would read)."""
+        try:
+            os.unlink(self._key_path(source))
+        except OSError:
+            pass
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(source + "__"):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
     def stats(self) -> dict:
         """Hit/miss ledger + bytes written this run, for the run manifest
         (sofa_tpu/telemetry.py) — which sources reparsed, and how much

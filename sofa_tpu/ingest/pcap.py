@@ -189,10 +189,33 @@ def write_net_addrs(intern: _AddrIntern, logdir: str) -> Optional[str]:
 
 
 def ingest_pcap(path: str, time_base: float = 0.0) -> pd.DataFrame:
+    """File-level ingest; positively-corrupt captures raise CorruptRawError
+    (the preprocess quarantine contract, sofa_tpu/ingest/__init__.py).
+
+    Corrupt means a non-empty file that cannot be a pcap: a truncated
+    global header or an unknown magic.  An empty file (tcpdump launched,
+    zero packets flushed) and a truncated *trailing packet* (capture
+    killed mid-write — every real kill-all epilogue does this) stay benign:
+    parse_pcap_bytes keeps whatever decoded.
+    """
     if not os.path.isfile(path):
         return empty_frame()
     intern = _AddrIntern()
     with open(path, "rb") as f:
-        df = parse_pcap_bytes(f.read(), time_base, intern=intern)
+        blob = f.read()
+    if blob:
+        if len(blob) < 24:
+            from sofa_tpu.ingest import CorruptRawError
+
+            raise CorruptRawError(path, "truncated pcap global header "
+                                        f"({len(blob)} bytes)")
+        magic_le = struct.unpack("<I", blob[:4])[0]
+        magic_be = struct.unpack(">I", blob[:4])[0]
+        if magic_le not in _MAGICS and magic_be not in _MAGICS:
+            from sofa_tpu.ingest import CorruptRawError
+
+            raise CorruptRawError(path, "not a pcap: bad magic "
+                                        f"0x{magic_le:08x}")
+    df = parse_pcap_bytes(blob, time_base, intern=intern)
     write_net_addrs(intern, os.path.dirname(path) or ".")
     return df

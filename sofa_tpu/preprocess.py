@@ -23,9 +23,9 @@ from typing import Dict, List, NamedTuple, Tuple
 
 import pandas as pd
 
-from sofa_tpu import pool
+from sofa_tpu import faults, pool
 from sofa_tpu.config import SofaConfig
-from sofa_tpu.ingest import procfs
+from sofa_tpu.ingest import CorruptRawError, procfs
 from sofa_tpu.ingest.cache import (CACHE_DIR_NAME, IngestCache, make_key,
                                    raw_files_present)
 from sofa_tpu.ingest.pcap import ingest_pcap
@@ -60,6 +60,10 @@ _SERIES_STYLE = {
 # Frames the xplane ingest contributes, in deterministic output order.
 _XPLANE_FRAMES = ("tputrace", "tpumodules", "hosttrace", "tpusteps",
                   "customtrace")
+
+# Corrupt raw inputs are moved here (never deleted: the bytes are evidence).
+# Listed in record.DERIVED_DIRS so `sofa clean` removes it.
+QUARANTINE_DIR_NAME = "_quarantine"
 
 
 def read_time_base(cfg: SofaConfig) -> float:
@@ -236,7 +240,9 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
             res = t.fn(*t.args, **t.kwargs)
             return res, None, time.perf_counter() - t0
         except Exception as e:  # noqa: BLE001 — per-source degradation
-            return None, str(e), time.perf_counter() - t0
+            # The exception OBJECT, not its string: the quarantine path
+            # downstream dispatches on CorruptRawError and needs .path.
+            return None, e, time.perf_counter() - t0
 
     outcomes: Dict[str, tuple] = {}
     policy = os.environ.get("SOFA_PREPROCESS_POOL", "auto")
@@ -288,7 +294,7 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
                 broken = True
                 outcomes[t.name] = run_local(t)
             except Exception as e:  # noqa: BLE001 — per-source degradation
-                outcomes[t.name] = (None, str(e), 0.0)
+                outcomes[t.name] = (None, e, 0.0)
         procpool.shutdown()
     return outcomes
 
@@ -304,8 +310,11 @@ def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
     tasks = _ingest_tasks(cfg, time_base, jobs)
     cache = IngestCache(cfg.path(CACHE_DIR_NAME), enabled=cfg.ingest_cache)
     keys = {t.name: make_key(t.name, t.raw_paths, t.params) for t in tasks}
+    plan = faults.active()
 
     def _load(t: _IngestTask) -> tuple:
+        if plan is not None and plan.corrupt_for(t.name) is not None:
+            return None, 0.0  # a warm hit must not mask an injected fault
         t0 = time.perf_counter()
         hit = cache.load(t.name, keys[t.name])
         return hit, time.perf_counter() - t0
@@ -324,9 +333,26 @@ def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
         else:
             pending.append(t)
     cache_outcome = "miss" if cache.enabled else "bypass"
-    if pending:
-        outcomes = _run_pending(pending, jobs)
+    # Fault injection (faults.py `<source>:corrupt`) synthesizes the
+    # CorruptRawError *before* dispatch: the hook must not depend on the
+    # plan crossing a process-pool boundary, and a forced corruption has
+    # nothing to parse anyway.
+    outcomes: Dict[str, tuple] = {}
+    if plan is not None and pending:
+        still = []
         for t in pending:
+            if plan.corrupt_for(t.name) is not None:
+                path = next((p for p in t.raw_paths if os.path.isfile(p)),
+                            t.raw_paths[0] if t.raw_paths else "")
+                outcomes[t.name] = (
+                    None, CorruptRawError(path, "injected corruption "
+                                                "(--inject_faults)"), 0.0)
+            else:
+                still.append(t)
+        pending = still
+    if pending or outcomes:
+        outcomes.update(_run_pending(pending, jobs) if pending else {})
+        for t in [t for t in tasks if t.name in outcomes]:
             res, err, parse_dt = outcomes[t.name]
             if err is None:
                 frames, meta = _normalize(t, res)
@@ -348,12 +374,49 @@ def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
             else:
                 results[t.name] = (
                     {fn: empty_frame() for fn in t.frame_names}, {}, err)
-                if tel is not None:
+                if isinstance(err, CorruptRawError):
+                    _quarantine_source(cfg, t.name, err, cache, tel,
+                                       cache_outcome, parse_dt)
+                elif tel is not None:
                     tel.source_event(t.name, status="degraded",
                                      cache=cache_outcome,
                                      wall_s=round(parse_dt, 6),
                                      events=0, error=str(err)[:300])
     return tasks, results, cache
+
+
+def _quarantine_source(cfg: SofaConfig, name: str, err: CorruptRawError,
+                       cache: IngestCache, tel, cache_outcome: str,
+                       parse_dt: float) -> None:
+    """Corrupt raw input -> <logdir>/_quarantine/, manifest entry, and a
+    purged cache so the poisoned parse can never be served warm."""
+    moved = None
+    src = err.path
+    if src and os.path.isfile(src):
+        qdir = cfg.path(QUARANTINE_DIR_NAME)
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            dest = os.path.join(qdir, os.path.basename(src))
+            n = 1
+            while os.path.exists(dest):
+                dest = os.path.join(qdir, f"{os.path.basename(src)}.{n}")
+                n += 1
+            os.replace(src, dest)  # same filesystem as the logdir
+            moved = dest
+        except OSError as e:
+            print_warning(f"preprocess {name}: cannot quarantine {src}: {e}")
+    cache.invalidate(name)
+    fields = {"status": "quarantined", "cache": cache_outcome,
+              "wall_s": round(parse_dt, 6), "events": 0,
+              "error": str(err)[:300]}
+    if moved is not None:
+        fields["quarantined_file"] = moved
+    if tel is not None:
+        tel.source_event(name, **fields)
+    print_warning(f"preprocess {name}: corrupt raw input "
+                  f"({err}) — quarantined to "
+                  f"{moved or cfg.path(QUARANTINE_DIR_NAME)}; the source "
+                  "is empty this run")
 
 
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
@@ -367,9 +430,11 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         )
     tel = telemetry.begin("preprocess")
     try:
+        faults.install_from(cfg)  # inside the run: the ACTIVE warning counts
         return _preprocess_body(cfg, tel)
     finally:
         telemetry.end(tel)
+        faults.clear()
 
 
 def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
@@ -392,7 +457,8 @@ def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
         tpu_meta: Dict[str, Dict[str, float]] = {}
         for t in tasks:
             task_frames, meta, err = results[t.name]
-            if err is not None:
+            if err is not None and not isinstance(err, CorruptRawError):
+                # quarantined sources already warned with the destination
                 print_warning(f"preprocess {t.name}: {err}")
             shift = tpu_off if t.name == "xplane" else offset
             for fname in t.frame_names:

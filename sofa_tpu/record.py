@@ -57,7 +57,7 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # by `sofa clean`, and _clean_stale wipes them at record
                  # start so manifests never mix across runs.
                  "run_manifest.json", "sofa_self_trace.json"]
-DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache"]
+DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine"]
 
 
 def build_collectors(cfg):
@@ -289,11 +289,19 @@ def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
 
 
 def sofa_record(command: str, cfg) -> int:
-    from sofa_tpu import telemetry
+    from sofa_tpu import faults, telemetry
 
     ensure_logdir(cfg.logdir)
     _clean_stale(cfg)
     tel = telemetry.begin("record")
+    try:
+        # Inside the telemetry run so the ACTIVE warning rides the
+        # manifest's noise counters; a bad spec aborts before any
+        # collector starts.
+        faults.install_from(cfg)
+    except Exception:
+        telemetry.end(tel)
+        raise
     collectors = build_collectors(cfg)
 
     # SIGTERM/SIGHUP (drivers, CI timeouts, ssh teardown) ride the SIGINT
@@ -313,10 +321,13 @@ def sofa_record(command: str, cfg) -> int:
         # one worth diagnosing).
         tel.write(cfg.logdir, rc=rc, cfg=cfg)
         telemetry.end(tel)
+        faults.clear()
 
 
 def _record_body(command: str, cfg, collectors, tel) -> int:
     import signal as _signal
+
+    from sofa_tpu.supervisor import CollectorSupervisor
 
     started = []
     prefix = []
@@ -324,6 +335,7 @@ def _record_body(command: str, cfg, collectors, tel) -> int:
     rc = 1
     is_docker = cfg.pid is None and _DOCKER_RUN_RE.match(command) is not None
     docker_perf = None
+    supervisor = None
     try:
         with tel.span("prologue", cat="record"):
             for col in collectors:
@@ -350,6 +362,12 @@ def _record_body(command: str, cfg, collectors, tel) -> int:
                 else:
                     prefix += col.command_prefix()
                 child_env.update(col.child_env())
+        # Watchdog over the started swarm: a collector dying mid-run is
+        # detected within seconds, manifested, and restarted with bounded
+        # retries (sofa_tpu/supervisor.py) instead of being silently
+        # discovered dead at stop.
+        supervisor = CollectorSupervisor(cfg, started)
+        supervisor.start()
 
         # The profiled child must be able to import sofa_tpu (built-in
         # workloads) from any cwd.  Appended AFTER the collector env updates
@@ -415,6 +433,8 @@ def _record_body(command: str, cfg, collectors, tel) -> int:
             _write_misc(cfg, elapsed, child.pid, rc)
     except Exception as e:  # kill-all cleanup, reference sofa_record.py:480-523
         print_error(f"record failed: {e}")
+        if supervisor is not None:
+            supervisor.stop()  # no restarts may race the kill-all
         for col in reversed(started):
             try:
                 col.run_kill()
@@ -426,6 +446,10 @@ def _record_body(command: str, cfg, collectors, tel) -> int:
         # installed (the caller's `with` exits after us): a TERM arriving
         # during a slow harvest rides the cleanup path, not the default
         # die-now handler.
+        if supervisor is not None:
+            # Idempotent; before any stop so a deliberate collector stop
+            # can never read as a death worth restarting.
+            supervisor.stop()
         with tel.span("epilogue", cat="record"):
             for col in reversed(started):
                 try:
@@ -686,6 +710,10 @@ def _record_flags(cfg) -> list:
         ("xprof_duration_s", "--xprof_duration_s"),
         ("tpu_mon_rate", "--tpu_mon_rate"),
         ("trace_format", "--trace_format"),
+        ("inject_faults", "--inject_faults"),
+        ("collector_restarts", "--collector_restarts"),
+        ("collector_stop_timeout_s", "--collector_stop_timeout_s"),
+        ("collector_harvest_timeout_s", "--collector_harvest_timeout_s"),
     ]
     for name, flag in valued:
         v = getattr(cfg, name)
@@ -703,6 +731,13 @@ def _record_flags(cfg) -> list:
         if getattr(cfg, name) and not getattr(base, name):
             flags.append(flag)
     return flags
+
+
+# Per-host epilogue bounds for cluster_record: a dead host's scp hangs on
+# TCP timeouts otherwise (the recorders themselves stay unbounded — only
+# the fetch/cleanup RPCs get deadlines).
+_CLUSTER_FETCH_TIMEOUT_S = 300
+_CLUSTER_RM_TIMEOUT_S = 30
 
 
 def cluster_record(command: str, cfg) -> int:
@@ -832,16 +867,30 @@ def _cluster_record_body(command: str, cfg, flags, child_env) -> int:
             print_warning(f"cluster: {host} record exited rc={host_rc}")
         if remote_dir is not None:
             ensure_logdir(host_logdir)
-            fetch = subprocess.run(
-                ["scp", "-q", "-r", "-o", "BatchMode=yes",
-                 f"{host}:{remote_dir.rstrip('/')}/.", host_logdir],
-            )
-            if fetch.returncode != 0:
-                print_warning(f"cluster: could not fetch logs from {host}")
-            subprocess.run(
-                ["ssh", "-o", "BatchMode=yes", host, f"rm -rf {remote_dir}"],
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            )
+            # Bounded: one dead/unreachable host must degrade ITS logs,
+            # not wedge the whole cluster epilogue on a hung scp/ssh.
+            try:
+                fetch = subprocess.run(
+                    ["scp", "-q", "-r", "-o", "BatchMode=yes",
+                     f"{host}:{remote_dir.rstrip('/')}/.", host_logdir],
+                    timeout=_CLUSTER_FETCH_TIMEOUT_S,
+                )
+                if fetch.returncode != 0:
+                    print_warning(
+                        f"cluster: could not fetch logs from {host}")
+            except (subprocess.SubprocessError, OSError) as e:
+                print_warning(f"cluster: fetching logs from {host} "
+                              f"failed: {e}")
+            try:
+                subprocess.run(
+                    ["ssh", "-o", "BatchMode=yes", host,
+                     f"rm -rf {remote_dir}"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    timeout=_CLUSTER_RM_TIMEOUT_S,
+                )
+            except (subprocess.SubprocessError, OSError):
+                print_warning(f"cluster: could not remove {remote_dir} "
+                              f"on {host} (dead host?) — leaving it")
     print_progress(f"cluster: recorded {len(launches)} hosts into "
                    f"{cfg.logdir.rstrip('/')}-<host>/")
     return rc
@@ -857,12 +906,18 @@ def sofa_clean(cfg) -> None:
     removed = 0
     for name in list(os.listdir(cfg.logdir)):
         path = cfg.path(name)
-        if name in DERIVED_FILES or (
-            name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
-        ):
-            os.unlink(path)
-            removed += 1
-        elif name in DERIVED_DIRS or name == "_inject":
-            shutil.rmtree(path)
-            removed += 1
+        # Per-entry degradation, like _clean_stale: one unreadable entry
+        # (permissions, live mount, races) must not abort the clean with
+        # the rest of the derived files still on disk.
+        try:
+            if name in DERIVED_FILES or (
+                name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
+            ):
+                os.unlink(path)
+                removed += 1
+            elif name in DERIVED_DIRS or name == "_inject":
+                shutil.rmtree(path)
+                removed += 1
+        except OSError as e:
+            print_warning(f"cannot clean {path}: {e}")
     print_progress(f"cleaned {removed} derived entries from {cfg.logdir}")

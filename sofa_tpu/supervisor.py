@@ -1,0 +1,165 @@
+"""Collector supervision during `sofa record`.
+
+Before this layer, a collector that died mid-run was silently discovered
+dead at stop time: its series simply ended, and nothing recorded when or
+why.  The supervisor is a watchdog thread that polls every *watchable*
+started collector (one that exposes liveness — a backing process or
+sampler thread, :meth:`Collector.alive`) and its output growth:
+
+  * a collector found dead before the epilogue is recorded in the run
+    manifest at detection time (``died: true``, ``deaths``, ``exit_code``)
+    and **restarted** with bounded retries and exponential backoff
+    (``--collector_restarts``, default 1; backoff 0.5s * 2^attempt).  A
+    successful restart lands ``restarts: n`` in the manifest — the series
+    has a gap, but the rest of the run is covered;
+  * once the budget is exhausted the collector's status becomes ``died``
+    (sticky — the epilogue's stop cannot whitewash it) and `sofa status`
+    exits nonzero;
+  * output files that stop growing while the process stays alive are
+    flagged once (``output_stalled: true``) — a wedged-but-alive collector
+    is a fidelity warning, not a kill (it may legitimately be buffering).
+
+The poll period (default 0.5s — "detected within seconds") is tunable via
+SOFA_SUPERVISOR_POLL_S for tests.  The exascale-diagnostics framing
+(PAPERS: "Enhancing Performance Insight at Scale") treats exactly this —
+collector fault tolerance as a first-class design axis — as what separates
+a profiler you trust at scale from one you babysit.
+
+record drives the lifecycle: start() after the prologue, stop() before the
+epilogue (and before kill-all), so a restart can never race a deliberate
+collector stop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List
+
+from sofa_tpu import telemetry
+from sofa_tpu.printing import print_warning
+
+# Polls with zero output growth (while alive) before the one-time stall
+# flag: 20 polls * 0.5s default = 10s of silence.
+_STALL_POLLS = 20
+
+_BACKOFF_BASE_S = 0.5
+
+
+def _poll_s() -> float:
+    try:
+        return max(float(os.environ.get("SOFA_SUPERVISOR_POLL_S", "0.5")),
+                   0.05)
+    except ValueError:
+        return 0.5
+
+
+class CollectorSupervisor:
+    """Watchdog over the started-collector list for one recording."""
+
+    def __init__(self, cfg, collectors: List):
+        self.cfg = cfg
+        self.collectors = collectors  # live reference: record appends to it
+        self.poll_s = _poll_s()
+        self._stop = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sofa_supervisor")
+        self._state: Dict[str, dict] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Idempotent; after return no restart can fire."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    # -- watchdog loop -----------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            for col in list(self.collectors):
+                if self._stop.is_set():
+                    return
+                try:
+                    self._check(col)
+                except Exception as e:  # noqa: BLE001 — watchdog never dies
+                    print_warning(f"supervisor: check of {col.name} "
+                                  f"failed: {e}")
+
+    def _check(self, col) -> None:
+        alive = col.alive()
+        if alive is None:
+            return  # not watchable (prefix-only / one-shot collectors)
+        st = self._state.setdefault(col.name, {
+            "deaths": 0, "restarts": 0, "retry_at": None,
+            "gave_up": False, "bytes": -1, "stall_polls": 0,
+            "stalled_flagged": False,
+        })
+        if st["gave_up"]:
+            return
+        if st["retry_at"] is not None:
+            if time.time() >= st["retry_at"]:
+                self._restart(col, st)
+            return
+        if alive:
+            self._track_growth(col, st)
+            return
+        # -- death detected ------------------------------------------------
+        st["deaths"] += 1
+        proc = getattr(col, "proc", None)
+        exit_code = proc.poll() if proc is not None else None
+        fields = {"died": True, "deaths": st["deaths"]}
+        if exit_code is not None:
+            fields["exit_code"] = int(exit_code)
+        budget = max(int(getattr(self.cfg, "collector_restarts", 1) or 0), 0)
+        if st["restarts"] >= budget:
+            # Sticky status: the epilogue's stop/flush must not whitewash a
+            # collector that ended the run dead.
+            telemetry.collector_event(col.name, "died", **fields)
+            print_warning(
+                f"{col.name}: died mid-run (exit {exit_code}) — restart "
+                f"budget ({budget}) exhausted; its series end here")
+            st["gave_up"] = True
+            return
+        telemetry.collector_event(col.name, **fields)
+        backoff = _BACKOFF_BASE_S * (2 ** st["restarts"])
+        print_warning(f"{col.name}: died mid-run (exit {exit_code}) — "
+                      f"restarting in {backoff:.1f}s")
+        st["retry_at"] = time.time() + backoff
+
+    def _restart(self, col, st: dict) -> None:
+        st["retry_at"] = None
+        try:
+            col.start()
+        except Exception as e:  # noqa: BLE001 — a failed restart = gave up
+            telemetry.collector_event(col.name, "died",
+                                      restart_error=str(e)[:300])
+            print_warning(f"{col.name}: restart failed: {e}")
+            st["gave_up"] = True
+            return
+        st["restarts"] += 1
+        st["bytes"], st["stall_polls"] = -1, 0
+        telemetry.collector_event(col.name, restarts=st["restarts"])
+        print_warning(f"{col.name}: restarted "
+                      f"(attempt {st['restarts']})")
+
+    def _track_growth(self, col, st: dict) -> None:
+        b = telemetry.collector_bytes(col.outputs())
+        if b != st["bytes"]:
+            st["bytes"], st["stall_polls"] = b, 0
+            return
+        st["stall_polls"] += 1
+        if st["stall_polls"] == _STALL_POLLS and not st["stalled_flagged"]:
+            st["stalled_flagged"] = True
+            telemetry.collector_event(col.name, output_stalled=True)
+            print_warning(
+                f"{col.name}: alive but its output has not grown for "
+                f"{_STALL_POLLS * self.poll_s:.0f}s — series may be "
+                "wedged or buffering")

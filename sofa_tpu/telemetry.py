@@ -18,11 +18,14 @@ and counters and lands two artifacts in the logdir:
                               SOFA_*/JAX_PLATFORMS vars that shape a run
     config                    SofaConfig snapshot of the writing verb
     meta                      pool sizing, ingest-cache stats, ...
-    collectors.<name>         status started/stopped/failed/skipped/killed,
-                              degraded flag+reason, exit_code,
-                              bytes_captured, start/stop seq, timings
-    sources.<name>            status parsed/cached/degraded/empty,
-                              cache hit/miss/bypass, wall_s, events, error
+    collectors.<name>         status started/stopped/failed/skipped/killed/
+                              died/timed_out, degraded flag+reason,
+                              died/deaths/restarts (supervisor), timed_out,
+                              exit_code, bytes_captured, start/stop seq,
+                              timings
+    sources.<name>            status parsed/cached/degraded/empty/
+                              quarantined, cache hit/miss/bypass, wall_s,
+                              events, error, quarantined_file
     stages                    flat span list {verb,name,cat,t0_unix,dur_s}
 
 Versioning policy: ``schema_version`` bumps on any BREAKING change (key
@@ -68,16 +71,26 @@ from sofa_tpu.printing import (  # printing imports us lazily, no cycle
 MANIFEST_NAME = "run_manifest.json"
 SELF_TRACE_NAME = "sofa_self_trace.json"
 MANIFEST_SCHEMA = "sofa_tpu/run_manifest"
-MANIFEST_VERSION = 1
+# v2: supervised-runtime vocabulary — collector statuses died/timed_out,
+# source status quarantined, and the died/deaths/restarts/timed_out/
+# output_stalled/unreaped/quarantined_file fields.  New enum VALUES break
+# strict consumers that validate the closed vocabularies below, hence the
+# bump (plain additive keys would not, per docs/OBSERVABILITY.md).
+MANIFEST_VERSION = 2
 
 COLLECTOR_STATUSES = ("probed", "started", "stopped", "failed", "skipped",
-                      "killed")
-SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty")
+                      "killed", "died", "timed_out")
+SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty", "quarantined")
 CACHE_OUTCOMES = ("hit", "miss", "bypass")
+
+# Terminal bad outcomes: sticky over the benign started/stopped that the
+# epilogue's flush still records afterwards.
+_STICKY_STATUSES = ("failed", "killed", "died", "timed_out")
 
 # Environment variables that shape a run enough to belong in the snapshot.
 _ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
-             "SOFA_NATIVE_PERFETTO", "JAX_PLATFORMS", "NO_COLOR")
+             "SOFA_NATIVE_PERFETTO", "JAX_PLATFORMS", "NO_COLOR",
+             "SOFA_FAULTS", "SOFA_SUPERVISOR_POLL_S")
 
 # Self-trace thread lanes: one per pipeline verb so the viewer shows the
 # verbs as parallel tracks of the single "sofa" process.
@@ -155,9 +168,9 @@ class Telemetry:
         """Merge a lifecycle fact into the collector health ledger.
 
         ``degraded`` is a flag, not a status (a degraded collector still
-        runs); ``failed``/``killed`` are sticky over the benign
-        started/stopped so a kill-all epilogue's flush cannot whitewash
-        the outcome."""
+        runs); ``failed``/``killed``/``died``/``timed_out`` are sticky over
+        the benign started/stopped so a kill-all epilogue's flush cannot
+        whitewash the outcome."""
         with self._lock:
             ent = self.collectors.setdefault(name, {"status": "probed"})
             if status == "degraded":
@@ -165,7 +178,7 @@ class Telemetry:
                 if "reason" in fields:
                     ent["degraded_reason"] = fields.pop("reason")
             elif status is not None:
-                sticky = ent.get("status") in ("failed", "killed")
+                sticky = ent.get("status") in _STICKY_STATUSES
                 if not (sticky and status in ("started", "stopped")):
                     ent["status"] = status
             ent.update(fields)
@@ -420,7 +433,16 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
     out: List[str] = []
     for name, ent in sorted((doc.get("collectors") or {}).items()):
         status = ent.get("status")
-        if status in ("failed", "killed"):
+        if status == "died":
+            code = ent.get("exit_code")
+            out.append(f"collector {name} died mid-run"
+                       + (f" (exit {code})" if code is not None else "")
+                       + " and was not restarted — its series end early")
+        elif status == "timed_out":
+            phase = ent.get("phase") or "stop"
+            out.append(f"collector {name} exceeded its {phase} deadline and "
+                       "was abandoned — its series may be partial")
+        elif status in ("failed", "killed"):
             detail = ent.get("error") or ent.get("phase") or ""
             out.append(f"collector {name} {status}"
                        + (f" ({detail})" if detail else "")
@@ -428,11 +450,24 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
         elif ent.get("degraded"):
             why = ent.get("degraded_reason") or "reduced fidelity"
             out.append(f"collector {name} ran degraded: {why}")
+        elif ent.get("died"):
+            n = ent.get("restarts", 0)
+            out.append(f"collector {name} died mid-run and was restarted "
+                       f"{n}x — its series have a gap")
+        if ent.get("output_stalled") and status not in ("died", "timed_out",
+                                                        "failed", "killed"):
+            out.append(f"collector {name} stopped producing output mid-run "
+                       "while still alive — series may be incomplete")
     for name, ent in sorted((doc.get("sources") or {}).items()):
         if ent.get("status") == "degraded":
             why = ent.get("error") or "parse failed"
             out.append(f"ingest source {name} degraded to an empty frame: "
                        f"{why}")
+        elif ent.get("status") == "quarantined":
+            where = ent.get("quarantined_file") or "_quarantine/"
+            out.append(f"ingest source {name} had corrupt raw input — "
+                       f"quarantined to {where}; its series are empty "
+                       "this run")
     for verb, run in sorted((doc.get("runs") or {}).items()):
         counters = run.get("counters") or {}
         if counters.get("errors"):
@@ -514,12 +549,17 @@ def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
         rows = [["COLLECTOR", "STATUS", "BYTES", "DETAIL"]]
         for name, ent in sorted(collectors.items()):
             status = str(ent.get("status", "?"))
-            if status in ("failed", "killed"):
+            if status in _STICKY_STATUSES:
                 rc = 1
             detail = (ent.get("error") or ent.get("reason")
                       or ent.get("degraded_reason") or "")
             if ent.get("degraded"):
                 status += " (degraded)"
+            if ent.get("died") and status not in ("died",):
+                status += (f" (died, restarted "
+                           f"{ent.get('restarts', 0)}x)")
+            if ent.get("timed_out") and status != "timed_out":
+                status += " (timed_out)"
             exit_code = ent.get("exit_code")
             if isinstance(exit_code, int) and exit_code not in (0, -15):
                 detail = (detail + f" exit_code={exit_code}").strip()
@@ -570,5 +610,6 @@ def sofa_status(cfg) -> int:
     lines, rc = render_status(doc, cfg.logdir)
     print("\n".join(lines))
     if rc != 0:
-        print_error("one or more collectors failed — see the table above")
+        print_error("one or more collectors failed, died, or timed out — "
+                    "see the table above")
     return rc

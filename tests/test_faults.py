@@ -1,0 +1,404 @@
+"""Fault-injection harness + supervised collector runtime (ISSUE 3).
+
+Every degradation path must be exercisable on demand: die-mid-run with
+supervisor restart, start failure, stop/harvest wedges hitting the bounded
+epilogue deadlines, truncate-at-harvest, corrupt raw input -> quarantine
+(and the cache never serving a quarantined parse warm), plus the `sofa
+status` exit-code contract over a degraded manifest.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sofa_tpu import faults, telemetry
+from sofa_tpu.collectors.base import CollectorState, ProcessCollector
+from sofa_tpu.collectors.timebase import TimebaseCollector
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.ingest import CorruptRawError
+from sofa_tpu.ingest.cache import IngestCache
+from sofa_tpu.preprocess import QUARANTINE_DIR_NAME, sofa_preprocess
+import sofa_tpu.record as record_mod
+from sofa_tpu.record import sofa_clean, sofa_record
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- spec grammar -----------------------------------------------------------
+
+def test_fault_spec_grammar():
+    plan = faults.parse(
+        "procmon:die@2s,tcpdump:wedge@stop,perf:fail@start,"
+        "xprof:truncate@harvest,pcap:corrupt")
+    assert plan.find("procmon", "die").delay_s == 2.0
+    assert plan.find("tcpdump", "wedge", "stop") is not None
+    assert plan.find("tcpdump", "wedge", "harvest") is None
+    assert plan.find("perf", "fail", "start") is not None
+    assert plan.find("xprof", "truncate", "harvest") is not None
+    # "pcap" aliases the internal nettrace source name
+    assert plan.corrupt_for("nettrace") is not None
+    # defaults: fail->start, wedge->stop
+    plan = faults.parse("a:fail,b:wedge,c:die")
+    assert plan.find("a", "fail", "start") is not None
+    assert plan.find("b", "wedge", "stop") is not None
+    assert plan.find("c", "die").delay_s is None
+
+
+@pytest.mark.parametrize("bad", [
+    "procmon",                 # no kind
+    "procmon:explode",         # unknown kind
+    "procmon:die@stop",        # die takes a delay, not a phase
+    "procmon:fail@2s",         # fail takes a phase, not a delay
+    "procmon:wedge@start",     # start is unbounded by design
+    "procmon:die@soon",        # unparseable delay
+])
+def test_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse(bad)
+
+
+def test_no_spec_means_no_plan(monkeypatch):
+    monkeypatch.delenv("SOFA_FAULTS", raising=False)
+    assert faults.install_from(SofaConfig()) is None
+    assert faults.active() is None
+    # hooks are no-ops without a plan
+    faults.maybe_inject("anything", "start")
+
+
+def test_bad_spec_is_a_usage_error(logdir, monkeypatch):
+    from sofa_tpu.printing import SofaUserError
+
+    monkeypatch.setenv("SOFA_FAULTS", "procmon:explode")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    with pytest.raises(SofaUserError, match="explode"):
+        sofa_record("true", cfg)
+    assert faults.active() is None  # cleared on the error path too
+
+
+# --- collector-level faults -------------------------------------------------
+
+class FakeProcCollector(ProcessCollector):
+    """A watchable background collector with a controllable lifetime."""
+
+    name = "fakeproc"
+
+    def start(self):
+        self.launch(["sleep", "60"], stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
+
+    def outputs(self):
+        return [self.cfg.path("fakeproc.txt")]
+
+
+@pytest.fixture
+def fake_swarm(monkeypatch):
+    monkeypatch.setattr(
+        record_mod, "build_collectors",
+        lambda cfg: [TimebaseCollector(cfg), FakeProcCollector(cfg)])
+    monkeypatch.setenv("SOFA_SUPERVISOR_POLL_S", "0.1")
+
+
+def _manifest(logdir):
+    doc = telemetry.load_manifest(logdir)
+    assert doc is not None
+    return doc
+
+
+def test_die_mid_run_is_detected_and_restarted(logdir, fake_swarm,
+                                               monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:die@0.1s")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, collector_restarts=1)
+    rc = sofa_record("sleep 1.5", cfg)
+    assert rc == 0
+    ent = _manifest(logdir)["collectors"]["fakeproc"]
+    assert ent["died"] is True
+    assert ent["deaths"] >= 1
+    assert ent["restarts"] >= 1
+    # the restart succeeded, so the epilogue stopped it normally
+    assert ent["status"] == "stopped"
+    # a restarted-but-recovered run renders healthy (exit 0) but warns
+    from sofa_tpu.cli import main
+
+    assert main(["status", logdir]) == 0
+    assert any("restarted" in w
+               for w in telemetry.manifest_warnings(_manifest(logdir)))
+
+
+def test_die_without_restart_budget_is_sticky(logdir, fake_swarm,
+                                              monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:die@0.1s")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, collector_restarts=0)
+    rc = sofa_record("sleep 0.8", cfg)
+    assert rc == 0
+    ent = _manifest(logdir)["collectors"]["fakeproc"]
+    assert ent["status"] == "died"  # epilogue stop didn't whitewash it
+    assert ent["died"] is True and "restarts" not in ent
+    assert ent["exit_code"] == -9
+    from sofa_tpu.cli import main
+
+    assert main(["status", logdir]) == 1
+
+
+def test_stop_wedge_hits_the_deadline(logdir, fake_swarm, monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:wedge@stop")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False,
+                     collector_stop_timeout_s=0.5)
+    t0 = time.time()
+    rc = sofa_record("true", cfg)
+    wall = time.time() - t0
+    assert rc == 0
+    assert wall < 10, "a wedged stop must not hang record"
+    ent = _manifest(logdir)["collectors"]["fakeproc"]
+    assert ent["status"] == "timed_out"
+    assert ent["timed_out"] is True and ent["phase"] == "stop"
+    from sofa_tpu.cli import main
+
+    assert main(["status", logdir]) == 1
+
+
+def test_harvest_wedge_hits_the_deadline(logdir, fake_swarm, monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:wedge@harvest")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False,
+                     collector_harvest_timeout_s=0.5)
+    t0 = time.time()
+    assert sofa_record("true", cfg) == 0
+    assert time.time() - t0 < 10
+    ent = _manifest(logdir)["collectors"]["fakeproc"]
+    assert ent["status"] == "timed_out"
+    assert ent["phase"] == "harvest"
+
+
+def test_start_fail_on_a_real_collector(logdir, monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "procmon:fail@start")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    rc = sofa_record("true", cfg)
+    assert rc == 0  # per-collector degradation, never an abort
+    ent = _manifest(logdir)["collectors"]["procmon"]
+    assert ent["status"] == "failed"
+    assert "injected" in ent["error"]
+    # siblings unaffected
+    assert _manifest(logdir)["collectors"]["timebase"]["status"] == "stopped"
+
+
+def test_truncate_at_harvest(logdir, fake_swarm, monkeypatch):
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:truncate@harvest")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False)
+    # _clean_stale wipes the logdir at record start, so the output file is
+    # written by the start hook (like a real collector would)
+    orig_start = FakeProcCollector.start
+
+    def start_and_write(self):
+        orig_start(self)
+        with open(self.cfg.path("fakeproc.txt"), "w") as f:
+            f.write("x" * 100)
+
+    monkeypatch.setattr(FakeProcCollector, "start", start_and_write)
+    assert sofa_record("true", cfg) == 0
+    assert os.path.getsize(cfg.path("fakeproc.txt")) == 50
+
+
+# --- corrupt raw input -> quarantine ----------------------------------------
+
+def _valid_pcap() -> bytes:
+    ip = (bytes([0x45, 0, 0, 24, 0, 0, 0, 0, 64, 6, 0, 0,
+                 10, 0, 0, 1, 10, 0, 0, 2]) + struct.pack("!HH", 1234, 80))
+    return (struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, 101)
+            + struct.pack("<IIII", 1, 0, len(ip), len(ip)) + ip)
+
+
+def _plog(tmp_path, name="plog"):
+    d = str(tmp_path / name) + "/"
+    os.makedirs(d)
+    with open(d + "sofa_time.txt", "w") as f:
+        f.write("1700000000.0\n")
+    return d
+
+
+def test_corrupt_pcap_is_quarantined(tmp_path):
+    d = _plog(tmp_path)
+    with open(d + "sofa.pcap", "wb") as f:
+        f.write(b"this is not a pcap file at all")
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)  # must not raise
+    ent = _manifest(d)["sources"]["nettrace"]
+    assert ent["status"] == "quarantined"
+    assert "bad magic" in ent["error"]
+    qfile = os.path.join(d, QUARANTINE_DIR_NAME, "sofa.pcap")
+    assert os.path.isfile(qfile)
+    assert ent["quarantined_file"] == qfile
+    assert not os.path.exists(d + "sofa.pcap")
+    # quarantine surfaces in status + the analyze [self] channel
+    from sofa_tpu.cli import main
+
+    assert main(["status", d]) == 0  # degraded ingest, not a dead collector
+    assert any("quarantined" in w
+               for w in telemetry.manifest_warnings(_manifest(d)))
+
+
+def test_truncated_pcap_header_is_corrupt(tmp_path):
+    from sofa_tpu.ingest.pcap import ingest_pcap
+
+    p = str(tmp_path / "sofa.pcap")
+    with open(p, "wb") as f:
+        f.write(b"\xd4\xc3\xb2\xa1short")
+    with pytest.raises(CorruptRawError):
+        ingest_pcap(p)
+    # absent and empty files stay benign degradations
+    assert ingest_pcap(str(tmp_path / "nope.pcap")).empty
+    open(str(tmp_path / "empty.pcap"), "wb").close()
+    assert ingest_pcap(str(tmp_path / "empty.pcap")).empty
+
+
+def test_quarantine_purges_and_never_recaches(tmp_path):
+    """A warm cache entry from the healthy run must not survive the
+    quarantine, and the quarantined parse itself is never stored."""
+    d = _plog(tmp_path)
+    with open(d + "sofa.pcap", "wb") as f:
+        f.write(_valid_pcap())
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    assert _manifest(d)["sources"]["nettrace"]["status"] == "parsed"
+    cache_dir = d + "_ingest_cache/"
+    assert any(n.startswith("nettrace") for n in os.listdir(cache_dir))
+
+    with open(d + "sofa.pcap", "wb") as f:
+        f.write(b"garbage garbage garbage garbage!")
+    sofa_preprocess(cfg)
+    assert _manifest(d)["sources"]["nettrace"]["status"] == "quarantined"
+    assert not any(n.startswith("nettrace") for n in os.listdir(cache_dir))
+
+    # warm re-run: no cached frame served for the quarantined source
+    sofa_preprocess(cfg)
+    ent = _manifest(d)["sources"]["nettrace"]
+    assert ent["cache"] != "hit"
+    assert ent["status"] == "empty"
+
+
+def test_injected_corruption_via_fault_spec(tmp_path, monkeypatch):
+    d = _plog(tmp_path)
+    with open(d + "mpstat.txt", "w") as f:
+        f.write("1700000000.0 cpu0 100 0 50 800 10 5 5 0\n")
+    monkeypatch.setenv("SOFA_FAULTS", "mpstat:corrupt")
+    sofa_preprocess(SofaConfig(logdir=d))
+    ent = _manifest(d)["sources"]["mpstat"]
+    assert ent["status"] == "quarantined"
+    assert os.path.isfile(os.path.join(d, QUARANTINE_DIR_NAME, "mpstat.txt"))
+    assert faults.active() is None  # cleared after the verb
+
+
+def test_injected_corruption_bypasses_warm_cache(tmp_path, monkeypatch):
+    """A warm cache hit must not mask an injected corruption fault."""
+    d = _plog(tmp_path)
+    with open(d + "mpstat.txt", "w") as f:
+        f.write("1700000000.0 cpu0 100 0 50 800 10 5 5 0\n")
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)  # warms the cache
+    assert _manifest(d)["sources"]["mpstat"]["status"] == "parsed"
+    monkeypatch.setenv("SOFA_FAULTS", "mpstat:corrupt")
+    sofa_preprocess(cfg)
+    assert _manifest(d)["sources"]["mpstat"]["status"] == "quarantined"
+
+
+def test_cache_invalidate_is_safe_without_entries(tmp_path):
+    cache = IngestCache(str(tmp_path / "nocache"))
+    cache.invalidate("nettrace")  # no dir, no entries: no raise
+
+
+def test_sofa_clean_removes_quarantine(tmp_path):
+    d = _plog(tmp_path)
+    with open(d + "sofa.pcap", "wb") as f:
+        f.write(b"not a pcap, quarantine me plz!!")
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    assert os.path.isdir(d + QUARANTINE_DIR_NAME)
+    sofa_clean(cfg)
+    assert not os.path.exists(d + QUARANTINE_DIR_NAME)
+
+
+# --- satellite regressions --------------------------------------------------
+
+class _StubbornProc:
+    """poll() says alive, wait() never returns — an unreapable zombie."""
+
+    returncode = None
+
+    def poll(self):
+        return None
+
+    def send_signal(self, sig):
+        pass
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        raise subprocess.TimeoutExpired("stubborn", timeout)
+
+
+def test_stop_survives_unreapable_process(logdir):
+    """collectors/base.py satellite: the post-kill() wait raising
+    TimeoutExpired must not escape stop() and fail the epilogue."""
+    col = ProcessCollector(SofaConfig(logdir=logdir))
+    col.proc = _StubbornProc()
+    col.stop(timeout=0.01)  # must not raise
+    assert col.state == CollectorState.STOPPED
+
+
+def test_sofa_clean_continues_past_oserror(tmp_path, monkeypatch):
+    d = _plog(tmp_path)
+    for name in ("poison.csv", "fine.csv"):
+        with open(d + name, "w") as f:
+            f.write("x\n")
+    real_unlink = os.unlink
+
+    def selective_unlink(path, *a, **kw):
+        if str(path).endswith("poison.csv"):
+            raise OSError("synthetic unremovable entry")
+        return real_unlink(path, *a, **kw)
+
+    monkeypatch.setattr(os, "unlink", selective_unlink)
+    sofa_clean(SofaConfig(logdir=d))  # must not raise
+    assert not os.path.exists(d + "fine.csv")  # the clean went on
+    assert os.path.exists(d + "poison.csv")
+
+
+def test_manifest_check_covers_new_vocabulary(logdir, fake_swarm,
+                                              monkeypatch):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "manifest_check", os.path.join(_ROOT, "tools", "manifest_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+
+    monkeypatch.setenv("SOFA_FAULTS", "fakeproc:die@0.1s")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False, collector_restarts=0)
+    sofa_record("sleep 0.8", cfg)
+    doc = _manifest(logdir)
+    assert mc.validate_manifest(doc) == []  # died is valid vocabulary
+    assert any("unhealthy" in p
+               for p in mc.validate_manifest(doc, require_healthy=True))
+    bad = json.loads(json.dumps(doc))
+    bad["collectors"]["fakeproc"]["restarts"] = "three"
+    assert any("restarts" in p for p in mc.validate_manifest(bad))
+
+
+# --- end-to-end chaos (slow) ------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_matrix_end_to_end(tmp_path):
+    """ISSUE 3 acceptance: the full fault matrix over a pod_synth --raw
+    harness — every run still yields a schema-valid manifest + report."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "chaos_matrix.py"),
+         str(tmp_path / "chaos")],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 SOFA_SUPERVISOR_POLL_S="0.1"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FAIL" not in r.stdout

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Chaos matrix: every fault kind against a pod_synth --raw harness.
+
+CI/tooling companion of sofa_tpu/faults.py: each cell records a short
+command under an injected fault, overlays the pod_synth --raw collector
+files, preprocesses, and asserts the run STILL yields a schema-valid
+run_manifest.json (tools/manifest_check.py) and a report.js — the
+"a profiling run always yields a usable trace" contract, exercised on
+demand instead of waiting for production to exercise it for us.
+
+    python tools/chaos_matrix.py [workdir]
+
+Prints one PASS/FAIL row per cell; exits nonzero if any cell fails.
+The slow-marked tests/test_faults.py::test_chaos_matrix_end_to_end runs
+this end to end.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import traceback
+from typing import List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sofa_tpu import telemetry  # noqa: E402
+from sofa_tpu.config import SofaConfig  # noqa: E402
+from sofa_tpu.preprocess import QUARANTINE_DIR_NAME, sofa_preprocess  # noqa: E402
+from sofa_tpu.record import sofa_record  # noqa: E402
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+# (cell name, fault spec, extra cfg overrides).  Targets are collectors that
+# exist on every machine (procmon/timebase/xprof) plus ingest sources; the
+# corrupt-file cell injects REAL corruption instead of a spec.
+MATRIX: List[Tuple[str, str, dict]] = [
+    # die cells record long enough for detect (poll 0.5s) + backoff (0.5s)
+    # + restart to land before the epilogue
+    ("die+restart", "procmon:die@0.3s",
+     {"collector_restarts": 1, "_cmd": "sleep 2.5"}),
+    ("die-no-restart", "procmon:die@0.3s",
+     {"collector_restarts": 0, "_cmd": "sleep 1.5"}),
+    ("start-fail", "procmon:fail@start", {}),
+    ("stop-wedge", "procmon:wedge@stop", {"collector_stop_timeout_s": 1.0}),
+    ("harvest-wedge", "procmon:wedge@harvest",
+     {"collector_harvest_timeout_s": 1.0}),
+    ("timebase-fail", "timebase:fail@start", {}),
+    ("xprof-truncate", "xprof:truncate@harvest", {}),
+    ("ingest-corrupt", "mpstat:corrupt", {}),
+    ("corrupt-pcap-file", "", {}),  # real on-disk corruption
+]
+
+_RAW_OVERLAY = ("perf.script", "strace.txt", "pystacks.txt", "mpstat.txt",
+                "cpuinfo.txt", "netstat.txt", "vmstat.txt", "tpumon.txt",
+                "misc.txt")
+
+
+def _load_manifest_check():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "manifest_check", os.path.join(_TOOLS, "manifest_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synth(workdir: str) -> str:
+    synth = os.path.join(workdir, "synth") + "/"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "pod_synth.py"), synth,
+         "--raw"],
+        capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        raise RuntimeError(f"pod_synth failed: {r.stderr}")
+    return synth
+
+
+def _run_cell(name: str, spec: str, overrides: dict, workdir: str,
+              synth: str, mc) -> List[str]:
+    """One chaos cell -> list of problems (empty == PASS)."""
+    logdir = os.path.join(workdir, name) + "/"
+    overrides = dict(overrides)
+    cmd = overrides.pop("_cmd", "sleep 0.8")
+    cfg = SofaConfig(logdir=logdir, enable_xprof=False,
+                     inject_faults=spec, **overrides)
+    rc = sofa_record(cmd, cfg)
+    problems: List[str] = []
+    if rc != 0:
+        problems.append(f"record rc={rc}")
+    for fname in _RAW_OVERLAY:
+        src = synth + fname
+        if os.path.isfile(src) and not os.path.isfile(cfg.path(fname)):
+            shutil.copy(src, cfg.path(fname))
+    if name == "corrupt-pcap-file":
+        with open(cfg.path("sofa.pcap"), "wb") as f:
+            f.write(b"chaos: positively not a pcap file")
+    # preprocess inherits the fault spec (ingest-corrupt cells) via cfg
+    sofa_preprocess(cfg)
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        return problems + ["no run_manifest.json"]
+    schema_probs = mc.validate_manifest(doc)
+    problems += [f"manifest: {p}" for p in schema_probs]
+    if not os.path.isfile(cfg.path("report.js")):
+        problems.append("no report.js")
+    # per-cell expectations: the injected fault actually landed in the ledger
+    cols = doc.get("collectors") or {}
+    srcs = doc.get("sources") or {}
+    if name == "die+restart" and not (
+            cols.get("procmon", {}).get("died")
+            and cols.get("procmon", {}).get("restarts", 0) >= 1):
+        problems.append("procmon died+restarts not recorded")
+    if name == "die-no-restart" and cols.get("procmon", {}).get(
+            "status") != "died":
+        problems.append("procmon died status not sticky")
+    if name == "start-fail" and cols.get("procmon", {}).get(
+            "status") != "failed":
+        problems.append("procmon failed status not recorded")
+    if name in ("stop-wedge", "harvest-wedge") and cols.get(
+            "procmon", {}).get("status") != "timed_out":
+        problems.append("procmon timed_out status not recorded")
+    if name in ("ingest-corrupt", "corrupt-pcap-file"):
+        source = "mpstat" if name == "ingest-corrupt" else "nettrace"
+        if srcs.get(source, {}).get("status") != "quarantined":
+            problems.append(f"{source} not quarantined")
+        if not os.path.isdir(cfg.path(QUARANTINE_DIR_NAME)):
+            problems.append("no _quarantine/ directory")
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
+    os.makedirs(workdir, exist_ok=True)
+    mc = _load_manifest_check()
+    synth = _synth(workdir)
+    failures = 0
+    width = max(len(n) for n, _s, _o in MATRIX)
+    for name, spec, overrides in MATRIX:
+        try:
+            problems = _run_cell(name, spec, overrides, workdir, synth, mc)
+        except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+            problems = ["crashed:\n" + traceback.format_exc()]
+        status = "PASS" if not problems else "FAIL"
+        failures += bool(problems)
+        print(f"{name.ljust(width)}  {status}  "
+              f"{spec or '(real corrupt pcap)'}")
+        for p in problems:
+            print(f"{' ' * width}    - {p}")
+    print(f"chaos matrix: {len(MATRIX) - failures}/{len(MATRIX)} cells "
+          "survived with a valid manifest + report")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,11 +98,17 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
         if ent.get("status") not in COLLECTOR_STATUSES:
             probs.append(f"{where}.status: {ent.get('status')!r} not in "
                          f"{COLLECTOR_STATUSES}")
-        for key in ("bytes_captured", "exit_code"):
+        for key in ("bytes_captured", "exit_code", "restarts", "deaths"):
             if key in ent and not isinstance(ent[key], int):
                 probs.append(f"{where}.{key}: not an int")
         if "bytes_captured" in ent and ent["bytes_captured"] < 0:
             probs.append(f"{where}.bytes_captured: negative")
+        for key in ("restarts", "deaths"):
+            if key in ent and isinstance(ent[key], int) and ent[key] < 0:
+                probs.append(f"{where}.{key}: negative")
+        for key in ("died", "timed_out", "output_stalled"):
+            if key in ent and not isinstance(ent[key], bool):
+                probs.append(f"{where}.{key}: not a bool")
 
     sources = doc.get("sources", {})
     if not isinstance(sources, dict):
@@ -123,6 +129,9 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             probs.append(f"{where}.wall_s: missing or negative")
         if not isinstance(ent.get("events"), int) or ent.get("events", 0) < 0:
             probs.append(f"{where}.events: missing or negative")
+        if "quarantined_file" in ent and \
+                not isinstance(ent["quarantined_file"], str):
+            probs.append(f"{where}.quarantined_file: not a string")
 
     stages = doc.get("stages", [])
     if not isinstance(stages, list):
@@ -144,9 +153,13 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
 
     if require_healthy:
         for name, ent in collectors.items():
-            if ent.get("status") in ("failed", "killed"):
+            if ent.get("status") in ("failed", "killed", "died",
+                                     "timed_out"):
                 probs.append(f"unhealthy: collector {name} "
                              f"{ent.get('status')}")
+        for name, ent in sources.items():
+            if ent.get("status") == "quarantined":
+                probs.append(f"unhealthy: source {name} quarantined")
         for verb, run in runs.items():
             if isinstance(run, dict) and (run.get("counters") or {}).get(
                     "errors"):
