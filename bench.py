@@ -370,6 +370,36 @@ print(json.dumps({{"cold": round(cold, 3), "warm": round(warm, 3)}}))
         shutil.rmtree(os.path.dirname(logdir), ignore_errors=True)
 
 
+def _lint_evidence() -> dict:
+    """Static-analysis gate riding the evidence extras: run sofa-lint over
+    the package and report ``lint_ok`` + the new-finding count, so a bench
+    round whose code silently broke a runtime contract (unbounded
+    subprocess, swallowed except) is visibly unhealthy even when its
+    numbers look fine.  Needs no device; opt out with SOFA_BENCH_LINT=0.
+    """
+    import subprocess
+
+    if os.environ.get("SOFA_BENCH_LINT", "1") != "1":
+        return {}
+    _state["phase"] = "sofa-lint evidence"
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "sofa_lint.py"),
+             os.path.join(root, "sofa_tpu"), "--json"],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode == 2:
+            return {"lint_error": (r.stderr.strip().splitlines()
+                                   or ["internal error"])[-1][:160]}
+        doc = json.loads(r.stdout)
+        n_new = len(doc.get("new", []))
+        _log(f"bench: sofa-lint {'OK' if not n_new else 'FAILED'} "
+             f"({n_new} new, {doc.get('baselined', 0)} baselined)")
+        return {"lint_ok": n_new == 0, "lint_new_findings": n_new}
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        return {"lint_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 class _Hung(Exception):
     pass
 
@@ -602,6 +632,7 @@ def main() -> int:
         # Report-path perf needs no chip: the preprocess wall-time metric
         # keeps this round's trajectory non-null even with a dead tunnel.
         extra.update(_preprocess_wall_evidence())
+        extra.update(_lint_evidence())
         if extra:
             # The driver reads the LAST parseable line: re-emit the same
             # error enriched with the CPU-backend evidence.
@@ -688,6 +719,7 @@ def main() -> int:
     # reads the LAST parseable line; a kill during this minute-scale
     # evidence run must still find the real result above).
     pre = _preprocess_wall_evidence()
+    pre.update(_lint_evidence())
     if pre:
         _emit(round(overhead, 3), p_value=p_value, extra={**extra, **pre})
     return 0

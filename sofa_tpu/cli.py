@@ -15,6 +15,9 @@ CLI (/root/reference/bin/sofa:328-376):
   status            render logdir/run_manifest.json (the pipeline's own
                     health ledger, sofa_tpu/telemetry.py) as a table;
                     exits nonzero on failed collectors
+  lint              AST invariant checker for sofa_tpu's own contracts
+                    (sofa_tpu/lint/, docs/STATIC_ANALYSIS.md); exits 1 on
+                    findings not grandfathered in lint_baseline.json
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -54,10 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "export", "top", "status", "clean", "setup",
+        "export", "top", "status", "lint", "clean", "setup",
     ])
     p.add_argument("usr_command", nargs="?", default="",
-                   help="command to profile (record/stat); logdir (status)")
+                   help="command to profile (record/stat); logdir (status); "
+                        "path to lint (lint)")
 
     g = p.add_argument_group("pipeline")
     g.add_argument("--logdir")
@@ -399,6 +403,11 @@ def _run(argv=None) -> int:
                 cfg.logdir = args.usr_command
                 cfg.__post_init__()
             return sofa_status(cfg)
+        if cmd == "lint":
+            from sofa_tpu.lint.cli import run_lint
+            # lint is config-free: the positional argument is a path, and
+            # the nested parser owns the exit-code contract (0/1/2).
+            return run_lint([args.usr_command] if args.usr_command else [])
         if cmd == "clean":
             from sofa_tpu.record import sofa_clean
             sofa_clean(cfg)

@@ -123,7 +123,9 @@ def _page_ici(fig, cfg) -> bool:
         return False
     try:
         mat = pd.read_csv(path, index_col=0)
-    except Exception:  # noqa: BLE001 — any unreadable matrix just skips the page
+    except Exception as e:  # noqa: BLE001 — an unreadable matrix skips the page
+        print_warning(f"export: unreadable {path} ({e}); skipping the "
+                      "ICI page")
         return False
     if mat.empty:
         return False

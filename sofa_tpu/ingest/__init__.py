@@ -10,6 +10,12 @@ corrupt raw file raises :class:`CorruptRawError` (never for a merely-empty
 or absent file — those are normal degradations).  Preprocess reacts by
 quarantining the file to ``<logdir>/_quarantine/`` and recording the source
 as ``quarantined`` in the run manifest; see docs/ROBUSTNESS.md.
+
+Tool contract: a parser that has raw bytes to read but whose external
+converter (``perf script``, the native scanners) fails or exceeds its
+deadline raises :class:`IngestToolError`.  Preprocess records the source as
+``failed`` in the manifest — raw data exists but could not be converted,
+which is a different (re-runnable) failure than corrupt or absent input.
 """
 
 from __future__ import annotations
@@ -21,6 +27,25 @@ class CorruptRawError(ValueError):
     Carries the on-disk ``path`` so preprocess can quarantine the file.
     args stay ``(path, reason)`` so the exception survives a process-pool
     pickle round-trip with its attributes intact.
+    """
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(path, reason)
+        self.path = path
+        self.reason = reason
+
+    def __str__(self) -> str:
+        return f"{self.path}: {self.reason}"
+
+
+class IngestToolError(RuntimeError):
+    """An external conversion tool failed/hung over EXISTING raw bytes.
+
+    Distinct from :class:`CorruptRawError`: the raw file may be perfectly
+    fine — the converter (``perf script``, a native scanner) is what broke,
+    so the file must NOT be quarantined; a re-run with a working tool can
+    still ingest it.  Preprocess records the source as ``failed`` in the
+    run manifest.  args stay ``(path, reason)`` for process-pool pickling.
     """
 
     def __init__(self, path: str, reason: str):

@@ -29,6 +29,8 @@ from typing import Dict, List, Optional
 
 import pandas as pd
 
+from sofa_tpu.printing import print_info, print_warning
+
 CACHE_DIR_NAME = "_ingest_cache"
 
 # Cache container format; a bump invalidates every cached source at once.
@@ -123,7 +125,9 @@ class IngestCache:
                 else:
                     self.misses.append(source)
                     return None
-        except Exception:  # noqa: BLE001 — a corrupt cache entry is a miss
+        except Exception as e:  # noqa: BLE001 — a corrupt cache entry is a miss
+            print_warning(f"ingest cache: unreadable entry for {source} "
+                          f"({e}); reparsing from raw")
             self.misses.append(source)
             return None
         self.hits.append(source)
@@ -179,7 +183,10 @@ class IngestCache:
                     if os.path.isfile(pk):
                         os.unlink(pk)  # never shadow a fresh parquet
                     stored += os.path.getsize(pq)
-                except Exception:  # noqa: BLE001 — no pyarrow: pickle fallback
+                except Exception as e:  # noqa: BLE001 — no pyarrow: pickle fallback
+                    print_info(f"ingest cache: parquet store of "
+                               f"{source}/{name} failed ({e}); "
+                               "using pickle")
                     df.to_pickle(pk + ".tmp")
                     os.replace(pk + ".tmp", pk)
                     if os.path.isfile(pq):

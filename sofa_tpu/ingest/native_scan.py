@@ -28,6 +28,19 @@ from sofa_tpu.printing import print_info, print_warning
 _MAGIC = 0x31584653  # "SFX1" little-endian
 _VERSION = 1
 
+# Deadline for one scanner invocation (SL001): a wedged scan degrades to
+# the Python ingest path instead of hanging preprocess.  Env-tunable for
+# pod-scale captures on slow disks.
+_SCAN_TIMEOUT_S = 300.0
+
+
+def _scan_timeout_s() -> float:
+    try:
+        return float(os.environ.get("SOFA_NATIVE_SCAN_TIMEOUT_S",
+                                    _SCAN_TIMEOUT_S))
+    except ValueError:
+        return _SCAN_TIMEOUT_S
+
 
 @dataclass
 class ScanLine:
@@ -129,7 +142,7 @@ def scan_file(path: str, derived_stat_names) -> Optional[List[ScanPlane]]:
     try:
         r = subprocess.run(
             [exe, path, out_path, ",".join(sorted(derived_stat_names))],
-            capture_output=True, text=True, timeout=300)
+            capture_output=True, text=True, timeout=_scan_timeout_s())
         if r.returncode != 0:
             print_warning(f"native scan failed ({r.stderr.strip()[:120]}); "
                           "using Python ingest")

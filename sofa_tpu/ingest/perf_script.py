@@ -25,8 +25,22 @@ from typing import Callable, Optional
 
 import pandas as pd
 
+from sofa_tpu.ingest import IngestToolError
 from sofa_tpu.printing import print_warning
 from sofa_tpu.trace import empty_frame, make_frame
+
+# Deadline for the perf.data -> text conversion subprocess; pod-scale
+# perf.data can legitimately take minutes, so the bound is generous and
+# env-tunable rather than hardcoded (SL001).
+_PERF_SCRIPT_TIMEOUT_S = 600.0
+
+
+def _conversion_timeout_s() -> float:
+    try:
+        return float(os.environ.get("SOFA_PERF_SCRIPT_TIMEOUT_S",
+                                    _PERF_SCRIPT_TIMEOUT_S))
+    except ValueError:
+        return _PERF_SCRIPT_TIMEOUT_S
 
 _LINE_RE = re.compile(
     r"^(?P<comm>.+?)\s+(?P<pid>\d+)(?:/(?P<tid>\d+))?\s+"
@@ -128,7 +142,13 @@ def parse_perf_script(
 
 
 def run_perf_script(perf_data: str, kallsyms: Optional[str] = None) -> str:
-    """Convert perf.data to text; returns "" when perf is unavailable."""
+    """Convert perf.data to text; returns "" when there is nothing to do.
+
+    Raises :class:`IngestToolError` when perf.data EXISTS but the
+    conversion subprocess is missing, fails, or exceeds its deadline —
+    there are raw samples on disk the run could not use, and the manifest
+    must say ``failed`` rather than quietly showing an empty cputrace.
+    """
     if not os.path.isfile(perf_data):
         return ""
     argv = [
@@ -137,14 +157,21 @@ def run_perf_script(perf_data: str, kallsyms: Optional[str] = None) -> str:
     ]
     if kallsyms and os.path.isfile(kallsyms):
         argv += ["--kallsyms", kallsyms]
+    timeout_s = _conversion_timeout_s()
     try:
-        out = subprocess.run(argv, capture_output=True, text=True, timeout=600)
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise IngestToolError(
+            perf_data, f"perf script exceeded {timeout_s:.0f}s "
+            "(SOFA_PERF_SCRIPT_TIMEOUT_S to raise)") from None
     except (subprocess.SubprocessError, OSError, FileNotFoundError) as e:
-        print_warning(f"perf script failed: {e}")
-        return ""
+        raise IngestToolError(perf_data, f"perf script failed: {e}") \
+            from None
     if out.returncode != 0:
-        print_warning(f"perf script rc={out.returncode}: {out.stderr[:200]}")
-        return ""
+        raise IngestToolError(
+            perf_data,
+            f"perf script rc={out.returncode}: {out.stderr[:200]}")
     return out.stdout
 
 

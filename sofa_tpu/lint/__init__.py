@@ -1,0 +1,38 @@
+"""sofa-lint: project-native static analysis for sofa_tpu's own contracts.
+
+PRs 1-3 established hard runtime invariants — every pipeline pool sized by
+--jobs, every collector epilogue bounded by a deadline, every parser raising
+typed errors into the quarantine path, every warning routed through the
+telemetry counters.  Nothing in pytest stops the next patch from silently
+violating them: a new ``subprocess.run`` without a timeout or a new
+``except Exception: pass`` is invisible until it wedges or swallows a
+production run.  This package turns those contracts into machine-checked
+rules, following the modular program-analysis-framework design (PASTA,
+PAPERS.md) and SOFA's own philosophy of replacing ad-hoc observation with a
+checked schema (PAPER.md §1).
+
+Layout:
+
+  core.py      single-pass AST engine: per-file visitor dispatch, import
+               alias resolution, ``# sofa-lint: disable=RULE`` suppressions,
+               project context (the trace schema, extracted statically)
+  rules.py     the project-specific rules SL001..SL008
+  baseline.py  fingerprint baseline: grandfather existing findings so only
+               NEW violations fail (``lint_baseline.json`` — shrinks over
+               PRs, never grows)
+  cli.py       exit-code contract (0 clean / 1 new findings / 2 internal
+               error), --json, --update-baseline; backs both
+               ``tools/sofa_lint.py`` and the ``sofa lint`` verb
+
+See docs/STATIC_ANALYSIS.md for each rule's rationale and the baseline
+workflow.
+"""
+
+from sofa_tpu.lint.core import (  # noqa: F401
+    Finding,
+    LintEngine,
+    ProjectContext,
+    lint_paths,
+)
+from sofa_tpu.lint.baseline import Baseline  # noqa: F401
+from sofa_tpu.lint.cli import run_lint  # noqa: F401

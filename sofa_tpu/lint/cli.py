@@ -1,0 +1,124 @@
+"""sofa-lint command line (backs ``tools/sofa_lint.py`` and ``sofa lint``).
+
+Exit-code contract (stable for CI):
+
+  0  clean — no findings outside the baseline
+  1  new findings (printed one per line as ``file:line: RULE [sev] msg``)
+  2  internal error (bad baseline file, engine crash)
+
+``--update-baseline`` regenerates ``lint_baseline.json`` from the current
+findings (expired entries drop out); ``--json`` emits the machine-readable
+report bench.py's evidence extras consume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from sofa_tpu.lint.baseline import (
+    Baseline,
+    fingerprint_findings,
+    locate_baseline,
+)
+from sofa_tpu.lint.core import lint_paths
+from sofa_tpu.lint.rules import default_rules
+
+
+def _default_paths() -> List[str]:
+    """The sofa_tpu package of THIS checkout (works from any cwd)."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sofa-lint",
+        description="AST-based checker for sofa_tpu's own runtime "
+                    "contracts (see docs/STATIC_ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the sofa_tpu "
+                        "package)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: nearest lint_baseline.json "
+                        "up from the first path)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, grandfathered or not")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current findings "
+                        "(expired entries drop out) and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--base", default=None,
+                   help="directory findings' relative paths (and baseline "
+                        "fingerprints) are anchored to (default: the "
+                        "directory containing the baseline file)")
+    return p
+
+
+def run_lint(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _run(build_parser().parse_args(argv))
+    except SystemExit:
+        raise
+    except Exception as e:  # sofa-lint: disable=SL002 — exit-code contract: internal errors become rc 2 on stderr
+        print(f"sofa-lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+
+def _run(args: argparse.Namespace) -> int:
+    paths = args.paths or _default_paths()
+    baseline_path = args.baseline or locate_baseline(paths[0])
+    base = args.base or os.path.dirname(os.path.abspath(baseline_path))
+    findings = lint_paths(paths, default_rules(), base=base)
+
+    def line_text_for(f):
+        path = f.file if os.path.isabs(f.file) else os.path.join(base, f.file)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                lines = fh.read().splitlines()
+            return lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        except OSError:
+            return ""
+
+    fingerprinted = fingerprint_findings(findings, line_text_for)
+
+    if args.update_baseline:
+        Baseline.write(baseline_path, fingerprinted)
+        print(f"sofa-lint: baseline rewritten with {len(fingerprinted)} "
+              f"entr{'y' if len(fingerprinted) == 1 else 'ies'} "
+              f"-> {baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, old = findings, []
+    else:
+        baseline = Baseline.load(baseline_path)
+        new, old = baseline.split(fingerprinted)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": len(old),
+            "total": len(findings),
+            "baseline": baseline_path if not args.no_baseline else None,
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    tail = f", {len(old)} baselined" if old else ""
+    if new:
+        print(f"sofa-lint: {len(new)} new finding(s){tail} — fix, suppress "
+              "inline with a justification, or (pre-existing only) "
+              "--update-baseline")
+        return 1
+    print(f"sofa-lint: clean ({len(findings)} finding(s) total{tail})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_lint())

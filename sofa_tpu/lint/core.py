@@ -1,0 +1,342 @@
+"""The sofa-lint engine: one AST pass per file, rule dispatch by node type.
+
+Design notes
+------------
+
+* **Single pass.**  Every rule declares the node types it cares about
+  (``node_types``); the engine walks each module AST exactly once and
+  dispatches nodes to interested rules.  Rules are stateless across files —
+  per-file scratch state lives on the :class:`FileContext`.
+* **Static only.**  The engine never imports the code it checks.  Project
+  facts the rules need (the unified trace schema) are extracted from
+  ``trace.py``'s AST, so linting works on a tree that does not even import
+  (and costs no pandas/jax startup).
+* **Suppressions.**  ``# sofa-lint: disable=SL001[,SL002]`` on the flagged
+  line silences those rules for that line; ``# sofa-lint: disable-file=SL001``
+  anywhere silences them for the whole file; ``all`` matches every rule.
+  Comments are found with :mod:`tokenize`, so a string literal that merely
+  *contains* the marker does not suppress anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+#: Rule id reserved for files the engine itself cannot parse.
+PARSE_RULE_ID = "SL000"
+
+_DISABLE_RE = re.compile(
+    r"sofa-lint:\s*(?P<scope>disable-file|disable)\s*=\s*"
+    r"(?P<rules>(?:all|SL\d+)(?:\s*,\s*(?:all|SL\d+))*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file:line."""
+
+    file: str
+    line: int
+    rule_id: str
+    message: str
+    severity: str = SEV_ERROR
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: {self.rule_id} "
+                f"[{self.severity}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"file": self.file, "line": self.line, "rule": self.rule_id,
+                "severity": self.severity, "message": self.message}
+
+
+@dataclass
+class ProjectContext:
+    """Cross-file facts rules consult (kept deliberately small)."""
+
+    #: The unified trace schema (trace.COLUMNS), extracted from the AST of
+    #: trace.py — empty set disables the schema-drift rule.
+    columns: frozenset = frozenset()
+
+    @classmethod
+    def detect(cls, files: Sequence[str]) -> "ProjectContext":
+        """Build the context from the tree being linted: find a trace.py
+        declaring BASE_COLUMNS/EXTRA_COLUMNS and read the literals out of
+        its AST.  Falls back to this package's own trace.py so linting a
+        single file still knows the schema."""
+        candidates = [f for f in files if os.path.basename(f) == "trace.py"]
+        here = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "trace.py")
+        if os.path.isfile(here):
+            candidates.append(here)
+        for cand in candidates:
+            cols = _columns_from_trace(cand)
+            if cols:
+                return cls(columns=frozenset(cols))
+        return cls()
+
+
+def _columns_from_trace(path: str) -> List[str]:
+    try:
+        with open(path, "rb") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return []
+    lists: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id in ("BASE_COLUMNS", "EXTRA_COLUMNS") and \
+                isinstance(node.value, ast.List):
+            vals = [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+            lists[tgt.id] = vals
+    return lists.get("BASE_COLUMNS", []) + lists.get("EXTRA_COLUMNS", [])
+
+
+class FileContext:
+    """Per-file state handed to rules: source, AST, parents, import map."""
+
+    def __init__(self, relpath: str, src: str, tree: ast.Module,
+                 project: ProjectContext):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.project = project
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # alias -> module ("sp" -> "subprocess"); name -> dotted origin
+        # ("run" -> "subprocess.run") for from-imports.
+        self.import_alias: Dict[str, str] = {}
+        self.from_import: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_alias[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_import[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    # -- helpers rules lean on --------------------------------------------
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_stmt(self, node: ast.AST) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        return cur
+
+    def stmt_source(self, node: ast.AST) -> str:
+        stmt = self.enclosing_stmt(node)
+        if stmt is None:
+            return self.line_text(getattr(node, "lineno", 0))
+        return ast.get_source_segment(self.src, stmt) or \
+            self.line_text(stmt.lineno)
+
+    def resolve_call(self, node: ast.Call) -> Optional[str]:
+        """Dotted origin of a call through the file's import aliases:
+        ``sp.run`` -> "subprocess.run", bare ``run`` (from-imported) ->
+        "subprocess.run", plain builtins -> their own name."""
+        return self.resolve_name(node.func)
+
+    def resolve_name(self, func: ast.expr) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return self.from_import.get(func.id,
+                                        self.import_alias.get(func.id,
+                                                              func.id))
+        if isinstance(func, ast.Attribute):
+            parts: List[str] = [func.attr]
+            cur = func.value
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                base = self.import_alias.get(cur.id,
+                                             self.from_import.get(cur.id,
+                                                                  cur.id))
+                parts.append(base)
+                return ".".join(reversed(parts))
+        return None
+
+
+class Rule:
+    """Base rule.  Subclasses set ``rule_id``/``severity``/``node_types``
+    and implement :meth:`visit`; optional :meth:`finish` runs once per file
+    after the walk (for module-level checks)."""
+
+    rule_id = ""
+    severity = SEV_ERROR
+    #: AST node classes this rule wants to see; () = finish()-only rule.
+    node_types: Tuple[Type[ast.AST], ...] = ()
+    #: relpath fragments (``/``-separated) exempting a whole file.
+    exempt_files: Tuple[str, ...] = ()
+
+    def applies(self, ctx: FileContext) -> bool:
+        return not any(_path_matches(ctx.relpath, pat)
+                       for pat in self.exempt_files)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> Iterable[Finding]:
+        return ()
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(ctx.relpath, getattr(node, "lineno", 0),
+                       self.rule_id, message, self.severity)
+
+
+def _path_matches(relpath: str, pat: str) -> bool:
+    """True when ``pat`` names this file (suffix match on /-separated
+    fragments: "collectors/base.py" matches "sofa_tpu/collectors/base.py",
+    "ingest/" matches any file under an ingest directory)."""
+    if pat.endswith("/"):
+        return f"/{pat}" in f"/{relpath}"
+    return relpath == pat or relpath.endswith("/" + pat)
+
+
+@dataclass
+class _Suppressions:
+    by_line: Dict[int, set] = field(default_factory=dict)
+    whole_file: set = field(default_factory=set)
+
+    def hides(self, f: Finding) -> bool:
+        for scope in (self.whole_file, self.by_line.get(f.line, ())):
+            if "all" in scope or f.rule_id in scope:
+                return True
+        return False
+
+
+def _scan_suppressions(src: str) -> _Suppressions:
+    sup = _Suppressions()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("scope") == "disable-file":
+            sup.whole_file |= rules
+        else:
+            sup.by_line.setdefault(tok.start[0], set()).update(rules)
+    return sup
+
+
+class LintEngine:
+    """Run a rule set over files; one AST walk per file."""
+
+    def __init__(self, rules: Sequence[Rule], project: ProjectContext):
+        self.rules = list(rules)
+        self.project = project
+        self._by_type: Dict[Type[ast.AST], List[Rule]] = {}
+        for rule in self.rules:
+            for nt in rule.node_types:
+                self._by_type.setdefault(nt, []).append(rule)
+
+    def lint_file(self, path: str, relpath: str) -> List[Finding]:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+        except OSError as e:
+            return [Finding(relpath, 0, PARSE_RULE_ID,
+                            f"cannot read file: {e}")]
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            return [Finding(relpath, e.lineno or 0, PARSE_RULE_ID,
+                            f"syntax error: {e.msg}")]
+        ctx = FileContext(relpath, src, tree, self.project)
+        active = [r for r in self.rules if r.applies(ctx)]
+        if not active:
+            return []
+        active_set = set(map(id, active))
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            for rule in self._by_type.get(type(node), ()):
+                if id(rule) in active_set:
+                    findings.extend(rule.visit(ctx, node))
+        for rule in active:
+            findings.extend(rule.finish(ctx))
+        if findings:
+            sup = _scan_suppressions(src)
+            findings = [f for f in findings if not sup.hides(f)]
+        return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/dirs into a sorted .py file list (skips caches and
+    hidden dirs; deterministic order keeps baselines reproducible)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith((".", "__pycache__")))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    seen, uniq = set(), []
+    for f in out:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
+def lint_paths(paths: Sequence[str], rules: Sequence[Rule],
+               project: Optional[ProjectContext] = None,
+               base: Optional[str] = None) -> List[Finding]:
+    """Lint files/directories; findings sorted by (file, line, rule).
+
+    ``base`` anchors the relpaths findings (and baseline fingerprints) are
+    keyed on — defaults to the current directory, matching the
+    ``python tools/sofa_lint.py sofa_tpu/`` invocation from the repo root.
+    """
+    files = iter_python_files(paths)
+    if project is None:
+        project = ProjectContext.detect(files)
+    base = os.path.abspath(base or os.getcwd())
+    engine = LintEngine(rules, project)
+    findings: List[Finding] = []
+    for f in files:
+        ab = os.path.abspath(f)
+        rel = os.path.relpath(ab, base) if ab.startswith(base + os.sep) else ab
+        findings.extend(engine.lint_file(f, rel))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return findings

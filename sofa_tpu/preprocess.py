@@ -25,7 +25,7 @@ import pandas as pd
 
 from sofa_tpu import faults, pool
 from sofa_tpu.config import SofaConfig
-from sofa_tpu.ingest import CorruptRawError, procfs
+from sofa_tpu.ingest import CorruptRawError, IngestToolError, procfs
 from sofa_tpu.ingest.cache import (CACHE_DIR_NAME, IngestCache, make_key,
                                    raw_files_present)
 from sofa_tpu.ingest.pcap import ingest_pcap
@@ -239,7 +239,7 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
         try:
             res = t.fn(*t.args, **t.kwargs)
             return res, None, time.perf_counter() - t0
-        except Exception as e:  # noqa: BLE001 — per-source degradation
+        except Exception as e:  # sofa-lint: disable=SL002 — the exception object IS the routing: dispatched downstream to quarantine/degraded manifest entries
             # The exception OBJECT, not its string: the quarantine path
             # downstream dispatches on CorruptRawError and needs .path.
             return None, e, time.perf_counter() - t0
@@ -293,7 +293,7 @@ def _run_pending(pending: List[_IngestTask], jobs: int) -> Dict[str, tuple]:
                               "reparsing remaining sources in-process")
                 broken = True
                 outcomes[t.name] = run_local(t)
-            except Exception as e:  # noqa: BLE001 — per-source degradation
+            except Exception as e:  # sofa-lint: disable=SL002 — routed downstream, same as run_local
                 outcomes[t.name] = (None, e, 0.0)
         procpool.shutdown()
     return outcomes
@@ -378,7 +378,13 @@ def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
                     _quarantine_source(cfg, t.name, err, cache, tel,
                                        cache_outcome, parse_dt)
                 elif tel is not None:
-                    tel.source_event(t.name, status="degraded",
+                    # A broken external tool over existing raw bytes is
+                    # `failed` (re-runnable); any other parse error is
+                    # `degraded`.  Neither is quarantined — the raw file
+                    # itself is not known-corrupt.
+                    status = ("failed" if isinstance(err, IngestToolError)
+                              else "degraded")
+                    tel.source_event(t.name, status=status,
                                      cache=cache_outcome,
                                      wall_s=round(parse_dt, 6),
                                      events=0, error=str(err)[:300])

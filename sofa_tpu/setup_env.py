@@ -101,9 +101,13 @@ def check(utilities: Optional[List[str]] = None,
             continue
         got = ""
         if shutil.which("getcap"):
-            out = subprocess.run(["getcap", path], capture_output=True,
-                                 text=True)
-            got = out.stdout.strip()
+            try:
+                out = subprocess.run(["getcap", path], capture_output=True,
+                                     text=True, timeout=10)
+                got = out.stdout.strip()
+            except (subprocess.SubprocessError, OSError) as e:
+                print_warning(f"setup: getcap {path} failed ({e}); "
+                              "assuming no capabilities")
         # getcap prints caps sorted by capability number, so compare the
         # individual names, not the whole comma-joined string.
         if all(c in got for c in cap.split("=")[0].split(",")):
@@ -194,9 +198,21 @@ def sofa_setup(utilities: Optional[List[str]] = None, apply: bool = False,
         for cmd in fixes:
             print(f"  {cmd}")
         return 1
-    run = runner or (lambda c: subprocess.run(c, shell=True).returncode)
+    run = runner or _run_fix
     rc = 0
     for cmd in fixes:
         print_progress(f"setup: {cmd}")
         rc = max(rc, run(cmd))
     return rc
+
+
+def _run_fix(cmd: str, timeout_s: float = 120.0) -> int:
+    """Default --apply runner.  Bounded: the fix commands are setcap/sysctl
+    one-liners — a sudo prompt or wedged PAM stack must not hang
+    `setup --apply` forever."""
+    try:
+        return subprocess.run(cmd, shell=True, timeout=timeout_s).returncode
+    except subprocess.TimeoutExpired:
+        print_warning(f"setup: fix command exceeded {timeout_s:.0f}s and "
+                      f"was killed: {cmd}")
+        return 124

@@ -105,7 +105,9 @@ class CollectorSupervisor:
         if st["gave_up"]:
             return
         if st["retry_at"] is not None:
-            if time.time() >= st["retry_at"]:
+            # Monotonic, not wall: an NTP step mid-run must not fire the
+            # restart early or push it out indefinitely (SL003).
+            if time.monotonic() >= st["retry_at"]:
                 self._restart(col, st)
             return
         if alive:
@@ -132,7 +134,7 @@ class CollectorSupervisor:
         backoff = _BACKOFF_BASE_S * (2 ** st["restarts"])
         print_warning(f"{col.name}: died mid-run (exit {exit_code}) — "
                       f"restarting in {backoff:.1f}s")
-        st["retry_at"] = time.time() + backoff
+        st["retry_at"] = time.monotonic() + backoff
 
     def _restart(self, col, st: dict) -> None:
         st["retry_at"] = None

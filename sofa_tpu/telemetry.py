@@ -76,11 +76,16 @@ MANIFEST_SCHEMA = "sofa_tpu/run_manifest"
 # output_stalled/unreaped/quarantined_file fields.  New enum VALUES break
 # strict consumers that validate the closed vocabularies below, hence the
 # bump (plain additive keys would not, per docs/OBSERVABILITY.md).
-MANIFEST_VERSION = 2
+# v3: source status ``failed`` — raw bytes exist but the external
+# conversion tool (perf script, native scanners) broke or timed out
+# (ingest.IngestToolError); distinct from quarantined (corrupt input) and
+# degraded (parse error) because a re-run with a working tool recovers it.
+MANIFEST_VERSION = 3
 
 COLLECTOR_STATUSES = ("probed", "started", "stopped", "failed", "skipped",
                       "killed", "died", "timed_out")
-SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty", "quarantined")
+SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty", "quarantined",
+                   "failed")
 CACHE_OUTCOMES = ("hit", "miss", "bypass")
 
 # Terminal bad outcomes: sticky over the benign started/stopped that the
@@ -463,6 +468,10 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
             why = ent.get("error") or "parse failed"
             out.append(f"ingest source {name} degraded to an empty frame: "
                        f"{why}")
+        elif ent.get("status") == "failed":
+            why = ent.get("error") or "conversion tool failed"
+            out.append(f"ingest source {name} failed: {why} — raw bytes "
+                       "exist; re-run preprocess once the tool works")
         elif ent.get("status") == "quarantined":
             where = ent.get("quarantined_file") or "_quarantine/"
             out.append(f"ingest source {name} had corrupt raw input — "

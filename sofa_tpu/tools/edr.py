@@ -70,11 +70,11 @@ def run_edr(argv=None) -> int:
     triggers = [parse_trigger(s) for s in args.trigger]
     fired: dict = {}
     pos = 0
-    t0 = time.time()
+    t0 = time.monotonic()  # watch deadline: immune to wall-clock steps
     print(f"sofa-edr: watching {args.log} for "
           f"{[k for k, _ in triggers]}", flush=True)
     while True:
-        if args.timeout_s and time.time() - t0 > args.timeout_s:
+        if args.timeout_s and time.monotonic() - t0 > args.timeout_s:
             print("sofa-edr: timeout reached", flush=True)
             return 0
         lines, pos = tail_lines(args.log, pos)
@@ -91,11 +91,20 @@ def run_edr(argv=None) -> int:
                 print(f"sofa-edr: trigger {keyword!r} -> recording "
                       f"{args.record_seconds:.0f}s into {logdir}", flush=True)
                 # Timed system-wide capture while the app keeps running,
-                # like the reference's per-phase timed record.
-                subprocess.run(
-                    [sys.executable, "-m", "sofa_tpu", "record",
-                     f"sleep {args.record_seconds}", "--logdir", logdir],
-                )
+                # like the reference's per-phase timed record.  Bounded:
+                # the capture is record_seconds long by construction, so a
+                # generous grace past that means record wedged (dead
+                # tunnel, stuck epilogue) and EDR must keep watching.
+                try:
+                    subprocess.run(
+                        [sys.executable, "-m", "sofa_tpu", "record",
+                         f"sleep {args.record_seconds}", "--logdir", logdir],
+                        timeout=args.record_seconds + 300,
+                    )
+                except subprocess.TimeoutExpired:
+                    print(f"sofa-edr: record of {logdir} exceeded "
+                          f"{args.record_seconds + 300:.0f}s — killed; "
+                          "resuming watch", flush=True)
         if all(phase in fired for _, phase in triggers) and not args.rearm:
             print("sofa-edr: all phases captured", flush=True)
             return 0

@@ -230,7 +230,7 @@ def empty_frame() -> pd.DataFrame:
     # Constructing 22 typed Series costs ~10ms; a pod-scale run calls this
     # dozens of times (one per absent source), so hand out copies of one
     # template instead.
-    global _EMPTY_TEMPLATE
+    global _EMPTY_TEMPLATE  # sofa-lint: disable=SL006 — idempotent memo: racing writers compute identical values
     if _EMPTY_TEMPLATE is None:
         _EMPTY_TEMPLATE = pd.DataFrame(
             {c: pd.Series(dtype=type(_DEFAULTS[c])

@@ -1,0 +1,433 @@
+"""sofa-lint: per-rule positive/negative fixtures, suppressions, baseline
+add/expire semantics, the exit-code contract, and the self-run gate.
+
+The self-run (`test_self_run_tree_is_clean`) is the tier-1 smoke test the
+ISSUE asks for: the shipped tree must lint clean against the checked-in
+baseline, and the baseline must only ever shrink.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from sofa_tpu.lint.baseline import Baseline, fingerprint_findings
+from sofa_tpu.lint.core import ProjectContext, lint_paths
+from sofa_tpu.lint.cli import run_lint
+from sofa_tpu.lint.rules import default_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_COLUMNS = ProjectContext.detect([]).columns  # the real schema
+
+
+def run_rules(tmp_path, relname, src, columns=None):
+    """Write one synthetic module and lint it; returns findings."""
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    project = ProjectContext(columns=frozenset(
+        columns if columns is not None else _COLUMNS))
+    return lint_paths([str(path)], default_rules(), project=project,
+                      base=str(tmp_path))
+
+
+def rule_ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# --- SL001 ------------------------------------------------------------------
+
+def test_sl001_flags_unbounded_subprocess(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import subprocess
+        subprocess.run(["ls"])
+    """)
+    assert rule_ids(fs) == ["SL001"]
+    assert fs[0].line == 3
+
+
+def test_sl001_ok_with_timeout_or_kwargs_or_alias(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import subprocess as sp
+        from subprocess import check_output
+        sp.run(["ls"], timeout=5)
+        check_output(["ls"], timeout=1)
+        kw = {"timeout": 2}
+        sp.call(["ls"], **kw)
+        sp.Popen(["ls"])  # async by design: bounded at wait/stop time
+    """)
+    assert fs == []
+
+
+def test_sl001_alias_and_from_import_detected(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import subprocess as sp
+        from subprocess import check_call
+        sp.check_output(["ls"])
+        check_call(["ls"])
+    """)
+    assert rule_ids(fs) == ["SL001", "SL001"]
+
+
+def test_sl001_exempt_in_collector_base(tmp_path):
+    fs = run_rules(tmp_path, "collectors/base.py", """
+        import subprocess
+        subprocess.run(["ls"])
+    """)
+    assert fs == []
+
+
+# --- SL002 ------------------------------------------------------------------
+
+def test_sl002_flags_silent_broad_except(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        try:
+            x = 1
+        except Exception:
+            pass
+        try:
+            x = 2
+        except:
+            x = 0
+    """)
+    assert rule_ids(fs) == ["SL002", "SL002"]
+
+
+def test_sl002_ok_when_routed_or_reraised(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        from sofa_tpu.printing import print_warning
+        try:
+            x = 1
+        except Exception as e:
+            print_warning(f"degraded: {e}")
+        try:
+            x = 2
+        except Exception:
+            raise
+        try:
+            x = 3
+        except (ValueError, OSError):
+            pass  # narrow except: the rule only polices broad ones
+    """)
+    assert fs == []
+
+
+# --- SL003 ------------------------------------------------------------------
+
+def test_sl003_flags_deadline_math(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import time
+        t0 = time.time()          # plain anchor: allowed
+        while time.time() - t0 < 5.0:   # comparison: flagged
+            pass
+        retry_at = time.time() + 2.0    # backoff arithmetic: flagged
+    """)
+    assert rule_ids(fs) == ["SL003", "SL003"]
+
+
+def test_sl003_allows_wall_anchors(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import time
+        stamp = time.time()
+        doc = {"t": time.time(), "pid": 1}
+        wall = round(time.time() - stamp, 6)  # no deadline words: allowed
+    """)
+    assert fs == []
+
+
+# --- SL004 ------------------------------------------------------------------
+
+def test_sl004_flags_schema_drift_in_ingest(tmp_path):
+    fs = run_rules(tmp_path, "ingest/foo.py", """
+        rows = [{"timestamp": 1.0, "duration": 0.1, "nmae": "x"}]
+    """)
+    assert rule_ids(fs) == ["SL004"]
+    assert "'nmae'" in fs[0].message
+
+
+def test_sl004_ok_outside_ingest_and_without_anchor(tmp_path):
+    good = """
+        rows = [{"timestamp": 1.0, "duration": 0.1, "name": "x"}]
+        internal = {"flops": 1, "phase": "fw", "kind": 3}  # no anchor key
+    """
+    assert run_rules(tmp_path, "ingest/foo.py", good) == []
+    drifted = 'rows = [{"timestamp": 1.0, "duration": 0.1, "nmae": "x"}]'
+    assert run_rules(tmp_path, "analysis/foo.py", drifted) == []
+
+
+# --- SL005 ------------------------------------------------------------------
+
+def test_sl005_flags_incomplete_collector(tmp_path):
+    fs = run_rules(tmp_path, "collectors/foo.py", """
+        from sofa_tpu.collectors.base import Collector
+        class FooCollector(Collector):
+            name = "foo"
+            def probe(self):
+                return None
+    """)
+    assert sorted(rule_ids(fs)) == ["SL005", "SL005"]  # outputs + hooks
+
+
+def test_sl005_ok_with_surface(tmp_path):
+    fs = run_rules(tmp_path, "collectors/foo.py", """
+        from sofa_tpu.collectors.base import ProcessCollector
+        class FooCollector(ProcessCollector):
+            name = "foo"
+            def start(self):
+                pass
+            def outputs(self):
+                return []
+        class Helper:  # not a collector: ignored
+            pass
+    """)
+    assert fs == []
+
+
+# --- SL006 ------------------------------------------------------------------
+
+def test_sl006_flags_worker_global_write(tmp_path):
+    fs = run_rules(tmp_path, "ingest/foo.py", """
+        _CACHE = None
+        def parse(text):
+            global _CACHE
+            _CACHE = text
+    """)
+    assert rule_ids(fs) == ["SL006"]
+    assert fs[0].severity == "warn"
+
+
+def test_sl006_ignores_driver_modules(tmp_path):
+    fs = run_rules(tmp_path, "faults.py", """
+        _PLAN = None
+        def install(plan):
+            global _PLAN
+            _PLAN = plan
+    """)
+    assert fs == []
+
+
+# --- SL007 ------------------------------------------------------------------
+
+def test_sl007_flags_raw_open_outside_ingest(tmp_path):
+    fs = run_rules(tmp_path, "analysis/foo.py", """
+        import os
+        def load(logdir):
+            with open(os.path.join(logdir, "perf.script")) as f:
+                return f.read()
+    """)
+    assert rule_ids(fs) == ["SL007"]
+
+
+def test_sl007_allows_ingest_and_derived_files(tmp_path):
+    raw = """
+        import os
+        def load(logdir):
+            with open(os.path.join(logdir, "perf.script")) as f:
+                return f.read()
+    """
+    assert run_rules(tmp_path, "ingest/foo.py", raw) == []
+    fs = run_rules(tmp_path, "analysis/foo.py", """
+        def load(logdir):
+            with open(logdir + "/cputrace.csv") as f:  # derived: allowed
+                return f.read()
+    """)
+    assert fs == []
+
+
+# --- SL008 ------------------------------------------------------------------
+
+def test_sl008_flags_direct_kills(tmp_path):
+    fs = run_rules(tmp_path, "collectors/foo.py", """
+        import os, signal
+        def die(proc):
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.kill()
+    """)
+    assert rule_ids(fs) == ["SL008", "SL008"]
+
+
+def test_sl008_exempt_in_signal_tree_owners(tmp_path):
+    src = """
+        import os, signal
+        def die(proc):
+            os.killpg(proc.pid, signal.SIGTERM)
+    """
+    assert run_rules(tmp_path, "record.py", src) == []
+    assert run_rules(tmp_path, "collectors/base.py", src) == []
+
+
+# --- engine: suppressions, parse errors ------------------------------------
+
+def test_inline_suppression_silences_one_line(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import subprocess
+        subprocess.run(["a"])  # sofa-lint: disable=SL001 — probe, bounded by caller
+        subprocess.run(["b"])
+    """)
+    assert [(f.rule_id, f.line) for f in fs] == [("SL001", 4)]
+
+
+def test_file_level_suppression_and_all(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        # sofa-lint: disable-file=SL001
+        import subprocess
+        subprocess.run(["a"])
+        try:
+            pass
+        except Exception:  # sofa-lint: disable=all — suppressions anchor to the reported line
+            pass
+    """)
+    assert fs == []
+
+
+def test_suppression_marker_in_string_does_not_suppress(tmp_path):
+    fs = run_rules(tmp_path, "m.py", """
+        import subprocess
+        subprocess.run(["sofa-lint: disable=SL001"])
+    """)
+    assert rule_ids(fs) == ["SL001"]
+
+
+def test_syntax_error_becomes_sl000_finding(tmp_path):
+    fs = run_rules(tmp_path, "m.py", "def broken(:\n")
+    assert rule_ids(fs) == ["SL000"]
+
+
+# --- baseline semantics -----------------------------------------------------
+
+def _lint_cli(tmp_path, *extra):
+    """run_lint over tmp_path with a tmp baseline; returns (rc, baseline)."""
+    bl = str(tmp_path / "lint_baseline.json")
+    rc = run_lint([str(tmp_path), "--baseline", bl,
+                   "--base", str(tmp_path), *extra])
+    return rc, bl
+
+
+def test_baseline_grandfathers_then_catches_new(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n")
+    rc, bl = _lint_cli(tmp_path)
+    assert rc == 1  # no baseline yet: the finding is new
+    rc, _ = _lint_cli(tmp_path, "--update-baseline")
+    assert rc == 0
+    doc = json.load(open(bl))
+    assert len(doc["entries"]) == 1
+    rc, _ = _lint_cli(tmp_path)
+    assert rc == 0  # grandfathered
+    # A NEW violation fails even though the old one stays grandfathered.
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n"
+                   "subprocess.check_call(['b'])\n")
+    rc, _ = _lint_cli(tmp_path)
+    assert rc == 1
+
+
+def test_baseline_entry_expires_when_fixed(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n"
+                   "subprocess.run(['b'])\n")
+    _lint_cli(tmp_path, "--update-baseline")
+    mod.write_text("import subprocess\nsubprocess.run(['a'], timeout=5)\n"
+                   "subprocess.run(['b'])\n")
+    rc, bl = _lint_cli(tmp_path, "--update-baseline")
+    assert rc == 0
+    doc = json.load(open(bl))
+    assert len(doc["entries"]) == 1  # the fixed site expired
+    assert "['b']" in open(str(mod)).read()
+
+
+def test_editing_a_baselined_line_resurfaces_it(tmp_path):
+    """Fingerprints key on the line's TEXT: touching a grandfathered call
+    (e.g. deleting its argument) must fail, not stay hidden."""
+    mod = tmp_path / "m.py"
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n")
+    _lint_cli(tmp_path, "--update-baseline")
+    mod.write_text("import subprocess\nsubprocess.run(['a', '-v'])\n")
+    rc, _ = _lint_cli(tmp_path)
+    assert rc == 1
+
+
+def test_line_moves_do_not_churn_baseline(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n")
+    _lint_cli(tmp_path, "--update-baseline")
+    mod.write_text("import subprocess\n\n\n# moved down\n"
+                   "subprocess.run(['a'])\n")
+    rc, _ = _lint_cli(tmp_path)
+    assert rc == 0
+
+
+def test_cli_json_and_internal_error_rc(tmp_path, capsys):
+    (tmp_path / "m.py").write_text("import subprocess\nsubprocess.run(['a'])\n")
+    rc = run_lint([str(tmp_path), "--no-baseline", "--json",
+                   "--base", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and len(doc["new"]) == 1
+    bad = tmp_path / "bad_baseline.json"
+    bad.write_text("{not json")
+    rc = run_lint([str(tmp_path), "--baseline", str(bad)])
+    assert rc == 2
+
+
+# --- the gate: self-run over the shipped tree ------------------------------
+
+def test_self_run_tree_is_clean():
+    """The shipped sofa_tpu/ must have zero non-baselined findings — this
+    is the tier-1 lint smoke the CI satellite asks for."""
+    rc = run_lint([os.path.join(REPO, "sofa_tpu"),
+                   "--baseline", os.path.join(REPO, "lint_baseline.json"),
+                   "--base", REPO])
+    assert rc == 0
+
+
+def test_self_run_baseline_only_shrinks():
+    """Every baseline entry must still correspond to a live finding:
+    stale entries mean someone fixed a site without --update-baseline
+    (fine) — but entries must never exceed the current finding count,
+    and every current finding must be grandfathered (no new debt)."""
+    base = REPO
+    findings = lint_paths([os.path.join(REPO, "sofa_tpu")], default_rules(),
+                          base=base)
+
+    def text_for(f):
+        with open(os.path.join(base, f.file), errors="replace") as fh:
+            lines = fh.read().splitlines()
+        return lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+
+    fps = fingerprint_findings(findings, text_for)
+    baseline = Baseline.load(os.path.join(REPO, "lint_baseline.json"))
+    new, old = baseline.split(fps)
+    assert new == []
+    assert len(old) <= len(baseline.entries)
+
+
+def test_exit_code_contract_subprocess():
+    """tools/sofa_lint.py exit codes through a real process: 0 clean."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sofa_lint.py"),
+         os.path.join(REPO, "sofa_tpu")],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_verb_lint():
+    from sofa_tpu.cli import main
+
+    assert main(["lint", os.path.join(REPO, "sofa_tpu")]) == 0
+
+
+def test_mutation_is_caught(tmp_path):
+    """Acceptance check: copying one shipped module and deleting a
+    timeout= yields a fresh file:line finding."""
+    src = open(os.path.join(REPO, "sofa_tpu", "ingest",
+                            "native_scan.py")).read()
+    assert "timeout=_scan_timeout_s()" in src
+    mut = tmp_path / "ingest" / "native_scan.py"
+    mut.parent.mkdir()
+    mut.write_text(src.replace(", timeout=_scan_timeout_s()", ""))
+    findings = lint_paths([str(mut)], default_rules(), base=str(tmp_path))
+    assert any(f.rule_id == "SL001" and f.line > 0 for f in findings)

@@ -1,5 +1,8 @@
 import json
 import os
+import sys
+
+import pytest
 
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.preprocess import sofa_preprocess
@@ -115,3 +118,73 @@ def test_analyze_frames_passthrough_matches_reread(logdir):
     assert set(mem) == set(disk)
     for k, v in mem.items():
         assert disk[k] == pytest.approx(v, rel=1e-6), k
+
+
+# --- broken conversion tool -> `failed` source status (IngestToolError) -----
+
+def _failed_logdir(tmp_path):
+    d = str(tmp_path / "flog") + "/"
+    os.makedirs(d)
+    with open(d + "sofa_time.txt", "w") as f:
+        f.write("1700000000.0\n")
+    # perf.data exists but no perf.script: ingest must invoke `perf script`,
+    # which this container does not have -> IngestToolError.
+    with open(d + "perf.data", "wb") as f:
+        f.write(b"PERFILE2" + b"\x00" * 64)
+    return d
+
+
+def test_broken_tool_marks_source_failed(tmp_path, monkeypatch):
+    from sofa_tpu import telemetry
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    monkeypatch.setenv("PATH", "/nonexistent")  # guarantee no perf binary
+    d = _failed_logdir(tmp_path)
+    cfg = SofaConfig(logdir=d)
+    frames = sofa_preprocess(cfg)  # must not raise: per-source degradation
+    assert frames["cputrace"].empty
+    ent = telemetry.load_manifest(d)["sources"]["cputrace"]
+    assert ent["status"] == "failed"
+    assert "perf script" in ent["error"]
+    # the file is NOT quarantined — the tool broke, not the raw bytes
+    assert os.path.isfile(d + "perf.data")
+    # failed is re-runnable: nothing poisoned lands in the ingest cache
+    assert any("failed" in w and "cputrace" in w
+               for w in telemetry.manifest_warnings(
+                   telemetry.load_manifest(d)))
+
+
+def test_failed_source_fails_require_healthy(tmp_path, monkeypatch):
+    from sofa_tpu import telemetry
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from manifest_check import validate_manifest
+
+    monkeypatch.setenv("PATH", "/nonexistent")
+    d = _failed_logdir(tmp_path)
+    sofa_preprocess(SofaConfig(logdir=d))
+    doc = telemetry.load_manifest(d)
+    assert validate_manifest(doc) == []  # `failed` is schema-valid...
+    probs = validate_manifest(doc, require_healthy=True)
+    assert any("cputrace failed" in p for p in probs)  # ...but unhealthy
+
+
+def test_perf_script_timeout_knob(tmp_path, monkeypatch):
+    from sofa_tpu.ingest import IngestToolError
+    from sofa_tpu.ingest.perf_script import run_perf_script
+
+    perf_data = str(tmp_path / "perf.data")
+    with open(perf_data, "wb") as f:
+        f.write(b"PERFILE2")
+    # a fake `perf` that hangs longer than the (tiny) deadline
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    fake = bindir / "perf"
+    fake.write_text("#!/bin/sh\nexec /bin/sleep 5\n")
+    fake.chmod(0o755)
+    monkeypatch.setenv("PATH", str(bindir))
+    monkeypatch.setenv("SOFA_PERF_SCRIPT_TIMEOUT_S", "0.2")
+    with pytest.raises(IngestToolError, match="exceeded"):
+        run_perf_script(perf_data)

@@ -158,8 +158,9 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 probs.append(f"unhealthy: collector {name} "
                              f"{ent.get('status')}")
         for name, ent in sources.items():
-            if ent.get("status") == "quarantined":
-                probs.append(f"unhealthy: source {name} quarantined")
+            if ent.get("status") in ("quarantined", "failed"):
+                probs.append(f"unhealthy: source {name} "
+                             f"{ent.get('status')}")
         for verb, run in runs.items():
             if isinstance(run, dict) and (run.get("counters") or {}).get(
                     "errors"):

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""sofa-lint entry point — AST invariant checker for sofa_tpu's contracts.
+
+    python tools/sofa_lint.py sofa_tpu/ [--json] [--update-baseline]
+
+Exit codes: 0 clean, 1 new findings, 2 internal error.  Equivalent to the
+``sofa lint`` verb; see docs/STATIC_ANALYSIS.md for the rule catalog and
+the lint_baseline.json workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sofa_tpu.lint.cli import run_lint  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_lint())
