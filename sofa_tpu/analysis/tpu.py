@@ -526,6 +526,39 @@ def memprof_profile(frames, cfg, features: Features) -> None:
         print(sites.head(10).to_string(index=False))
 
 
+def _hysteresis_roi(ev, ts, dur, high: float, low: float, up_count: int,
+                    t_first: float):
+    """(begin, end) of the utilization ROI — the reference's per-row
+    hysteresis state machine, vectorized (the iterrows loop was the last
+    per-row pass on the spotlight path; on a pod-scale tpuutil frame the
+    row-Series construction alone dominated the pass).
+
+    Semantics are bit-identical to the loop: a "high" sample increments a
+    counter that resets at each "low" (mid-band samples leave it alone);
+    the ROI begins at the first high whose run-since-last-low reaches
+    ``up_count``, and ends at the first low after that.
+    """
+    import numpy as np
+
+    hi = ev >= high
+    lo = ev < low
+    cs = np.cumsum(hi)
+    # highs since the most recent low: cs minus cs at the last low <= i
+    # (cs is nondecreasing, so "value at last low" == running max over
+    # low positions)
+    count = cs - np.maximum.accumulate(np.where(lo, cs, 0))
+    armed = np.flatnonzero(hi & (count >= up_count))
+    if armed.size == 0:
+        return None, None
+    i = int(armed[0])
+    begin = max(float(ts[i] - dur[i] * up_count), t_first)
+    after = np.flatnonzero(lo[i:])
+    if after.size == 0:
+        return begin, None
+    j = i + int(after[0])
+    return begin, float(ts[j] - dur[j])
+
+
 def spotlight_roi(frames, cfg, features: Features) -> None:
     """Set cfg.roi_begin/roi_end from TensorCore utilization.
 
@@ -552,19 +585,10 @@ def spotlight_roi(frames, cfg, features: Features) -> None:
     if util.empty:
         return
     high, low, up_count = 50.0, 10.0, 3
-    count = 0
-    begin = end = None
     t_first = float(util["timestamp"].min() - util["duration"].iloc[0])
-    for _, row in util.iterrows():
-        if row["event"] >= high:
-            count += 1
-            if count >= up_count and begin is None:
-                begin = max(row["timestamp"] - row["duration"] * up_count, t_first)
-        elif row["event"] < low:
-            if begin is not None:  # first drop after the ROI began ends it
-                end = row["timestamp"] - row["duration"]
-                break
-            count = 0
+    begin, end = _hysteresis_roi(
+        util["event"].to_numpy(float), util["timestamp"].to_numpy(float),
+        util["duration"].to_numpy(float), high, low, up_count, t_first)
     if begin is not None:
         if end is None or end <= begin:
             end = float(util["timestamp"].max())

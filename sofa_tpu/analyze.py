@@ -247,6 +247,22 @@ def _analyze_body(cfg: SofaConfig, frames, tel) -> Features:
         except Exception as e:  # noqa: BLE001 — report.js is not worth aborting for
             print_warning(f"cannot merge analysis series into report.js: {e}")
 
+    if cfg.enable_tiles:
+        # Deep-zoom LOD pyramid for the board (sofa_tpu/tiles.py).  The
+        # report path built it a moment ago in preprocess — content keys
+        # match and this is a warm no-op; a standalone `sofa analyze` over
+        # an older logdir builds it here, in parallel on the shared pool.
+        try:
+            from sofa_tpu import tiles
+            from sofa_tpu.trace import derived_write_guard
+
+            with tel.span("tiles", cat="stage"), \
+                    derived_write_guard(cfg.logdir):
+                tiles.ensure_tiles(cfg, frames, tel=tel)
+        except Exception as e:  # noqa: BLE001 — tiles are an enhancement, never fatal
+            print_warning(f"analyze: tile pyramid failed ({e}); the board "
+                          "serves the overview only")
+
     print(features.render())
     features.save(cfg.path("features.csv"))
 
@@ -299,7 +315,7 @@ def _append_report_series(cfg: SofaConfig, series) -> None:
                 "title": s.title,
                 "color": s.color,
                 "kind": s.kind,
-                "data": s.to_points(cfg.viz_downsample_to),
+                "data": s.to_columnar(cfg.viz_downsample_to),
             }
         )
     from sofa_tpu.trace import write_report_js_doc
@@ -400,10 +416,23 @@ def cluster_analyze(
                 s.title = f"[{hostname}] {s.title}"
                 merged_series.append(s)
         os.makedirs(cfg.logdir, exist_ok=True)
-        series_to_report_js(
-            merged_series, cfg.path("report.js"), cfg.viz_downsample_to,
-            {"cluster_hosts": list(host_frames), "time_base": tb0},
-        )
+        meta = {"cluster_hosts": list(host_frames), "time_base": tb0}
+        from sofa_tpu.trace import derived_write_guard
+
+        with derived_write_guard(cfg.logdir):
+            if cfg.enable_tiles:
+                try:
+                    from sofa_tpu import tiles
+
+                    meta["tiles"] = tiles.build_tiles(cfg, merged_series)
+                except Exception as e:  # noqa: BLE001 — tiles are an enhancement, never fatal
+                    print_warning(f"cluster: tile pyramid failed ({e}); "
+                                  "the merged board serves the overview "
+                                  "only")
+            series_to_report_js(
+                merged_series, cfg.path("report.js"),
+                cfg.viz_downsample_to, meta,
+            )
         stage_board(cfg)
         print_progress(
             f"cluster: merged timeline of {len(host_frames)} hosts "

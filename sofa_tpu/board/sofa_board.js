@@ -42,6 +42,114 @@ async function fetchCSV(path) {
   return parseCSV(await resp.text());
 }
 
+/* ---------- columnar series data ----------
+ * report.js and the LOD tiles ship series data as columnar arrays
+ * {x:[],y:[],d:[],names:[table],ni:[codes]} — names are interned into a
+ * string table + int codes (smaller payload + one C-encoder dumps
+ * server-side); the renderer works on point objects, so decode once at
+ * load.  Legacy per-point arrays and plain name arrays pass through. */
+function pointsFromColumnar(data) {
+  if (!data) return [];
+  if (Array.isArray(data)) return data;
+  const xs = data.x || [], ys = data.y || [], ds = data.d || [];
+  const table = data.names || null, codes = data.ni || null;
+  const plain = data.name || [];
+  const out = new Array(xs.length);
+  for (let i = 0; i < xs.length; i++) {
+    const nm = table ? (table[codes[i]] || "") : (plain[i] || "");
+    out[i] = { x: xs[i], y: ys[i], name: nm, d: ds[i] || 0 };
+  }
+  return out;
+}
+
+/* ---------- LOD tiles ----------
+ * Deep zoom fetches pre-gzipped columnar tiles from the pyramid the
+ * pipeline wrote under _tiles/ (sofa_tpu/tiles.py).  The sofa viz server
+ * negotiates Content-Encoding so the browser inflates transparently; a
+ * dumb static host hands back raw gzip bytes, which are inflated here via
+ * DecompressionStream (the magic-byte check tells the two apart). */
+async function fetchGzJSON(path) {
+  const resp = await fetch(path);
+  if (!resp.ok) throw new Error(path + ": " + resp.status);
+  const buf = new Uint8Array(await resp.arrayBuffer());
+  if (buf.length > 1 && buf[0] === 0x1f && buf[1] === 0x8b) {
+    const stream = new Blob([buf]).stream()
+      .pipeThrough(new DecompressionStream("gzip"));
+    return JSON.parse(await new Response(stream).text());
+  }
+  return JSON.parse(new TextDecoder().decode(buf));
+}
+
+/* Tiles are fixed-point integer columnar: x delta-encoded at sx
+ * resolution, y/d scaled ints, names interned (sofa_tpu/tiles.py) —
+ * integers encode and gzip far tighter than floats. */
+function pointsFromTile(t) {
+  if (!t.xd) return pointsFromColumnar(t);
+  const out = new Array(t.xd.length);
+  const table = t.names || [], codes = t.ni || [];
+  let acc = 0;
+  for (let i = 0; i < t.xd.length; i++) {
+    acc += t.xd[i];
+    out[i] = {
+      x: acc * t.sx,
+      y: (t.yv[i] || 0) * t.sy,
+      name: table[codes[i]] || "",
+      d: (t.dv[i] || 0) * t.sd,
+    };
+  }
+  return out;
+}
+
+class TileLoader {
+  constructor(manifest, base) {
+    this.manifest = manifest || { series: {} };
+    this.base = base || this.manifest.dir || "_tiles";
+    this.cache = new Map(); // url -> Promise<tile|null>; 404 = empty window
+  }
+  entry(name) { return (this.manifest.series || {})[name]; }
+  levelFor(ent, span) {
+    // deepest level whose tile windows are ~the view span (1-4 tiles
+    // visible); clamped to the pyramid's real depth
+    const domain = Math.max(ent.x1 - ent.x0, 1e-12);
+    const lvl = Math.ceil(Math.log2(Math.max(domain / Math.max(span, 1e-12), 1))) + 1;
+    return Math.max(0, Math.min(ent.levels - 1, lvl));
+  }
+  tile(ent, name, level, n) {
+    const url = this.base + "/" + (ent.path || name) + "/" + level + "/" + n + ".json.gz";
+    if (!this.cache.has(url)) {
+      this.cache.set(url, fetchGzJSON(url).catch(() => null));
+    }
+    return this.cache.get(url);
+  }
+  async range(name, x0, x1) {
+    // every tile overlapping [x0, x1] at the view-appropriate level,
+    // decoded and concatenated into renderer points (x-ordered: tiles are
+    // ordered and points within a tile are x-sorted)
+    const ent = this.entry(name);
+    if (!ent) return null;
+    const level = this.levelFor(ent, x1 - x0);
+    const domain = Math.max(ent.x1 - ent.x0, 1e-12);
+    const nt = Math.pow(2, level);
+    const clamp = (v) => Math.max(0, Math.min(nt - 1, v));
+    const lo = clamp(Math.floor(((x0 - ent.x0) / domain) * nt));
+    const hi = clamp(Math.floor(((x1 - ent.x0) / domain) * nt));
+    const jobs = [];
+    for (let n = lo; n <= hi && jobs.length < 16; n++) {
+      jobs.push(this.tile(ent, name, level, n));
+    }
+    const tiles = await Promise.all(jobs);
+    const pts = [];
+    let exact = true, count = 0;
+    for (const t of tiles) {
+      if (!t) continue; // sparse pyramid: missing tile = empty window
+      exact = exact && !!t.exact;
+      count += t.count || 0;
+      for (const p of pointsFromTile(t)) pts.push(p);
+    }
+    return { level: level, points: pts, exact: exact, count: count };
+  }
+}
+
 /* ---------- number formatting ---------- */
 function fmt(v) {
   if (!isFinite(v)) return "-";
@@ -68,10 +176,23 @@ class Timeline {
     this._bindEvents();
   }
   setSeries(series) {
-    this.series = series.map((s) => Object.assign({ visible: true }, s));
+    this.series = series.map((s) => {
+      const pts = pointsFromColumnar(s.data);
+      // overview = the report.js level-0 data; deep zoom swaps s.data for
+      // tile points and resetView restores this
+      return Object.assign({ visible: true }, s, { data: pts, overview: pts });
+    });
     this.resetView();
   }
+  setData(name, pts) {
+    for (const s of this.series) {
+      if (s.name === name) s.data = pts;
+    }
+  }
   resetView() {
+    for (const s of this.series) {
+      if (s.overview) s.data = s.overview;
+    }
     let x0 = Infinity, x1 = -Infinity, y0 = Infinity, y1 = -Infinity;
     for (const s of this.series) {
       if (!s.visible) continue;
@@ -89,6 +210,14 @@ class Timeline {
     const padX = (x1 - x0) * 0.02, padY = (y1 - y0) * 0.05;
     this.view = { x0: x0 - padX, x1: x1 + padX, y0: y0 - padY, y1: y1 + padY };
     this.draw();
+    this._emitViewChange();
+  }
+  _emitViewChange() {
+    // debounced: a zoom gesture is a burst of wheel events — fetch tiles
+    // once the view settles, not per tick
+    if (!this.opts.onViewChange) return;
+    clearTimeout(this._vcTimer);
+    this._vcTimer = setTimeout(() => this.opts.onViewChange(this.view), 150);
   }
   _y(v) { return this.opts.logY ? Math.log10(Math.max(v, 1e-12)) : v; }
   _sx(x) {
@@ -202,6 +331,7 @@ class Timeline {
       this.view.x0 = mx + (this.view.x0 - mx) * f;
       this.view.x1 = mx + (this.view.x1 - mx) * f;
       this.draw();
+      this._emitViewChange();
     });
     cv.addEventListener("mousedown", (e) => { dragging = { x: e.offsetX, v: { ...this.view } }; });
     window.addEventListener("mouseup", () => { dragging = null; });
@@ -212,6 +342,7 @@ class Timeline {
         this.view.x0 = dragging.v.x0 - dx;
         this.view.x1 = dragging.v.x1 - dx;
         this.draw();
+        this._emitViewChange();
       } else {
         this._hover(e.offsetX, e.offsetY);
       }

@@ -140,6 +140,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shift device/XPlane timestamps by this many ms when "
                         "automatic marker/timebase alignment is wrong")
     g.add_argument("--viz_downsample_to", type=int)
+    g.add_argument("--tile_levels", type=int,
+                   help="cap the LOD tile-pyramid depth (0 = auto: deepen "
+                        "until every leaf tile is exact)")
+    g.add_argument("--no_tiles", action="store_true",
+                   help="skip the timeline tile pyramid (board serves the "
+                        "downsampled overview only; deep zoom loses "
+                        "event fidelity)")
     g.add_argument("--trace_format", choices=["csv", "parquet"],
                    help="columnar parquet keeps pod-scale op traces small")
     g.add_argument("--network_filters", help="comma-joined ip filters")
@@ -206,7 +213,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "inject_faults", "collector_restarts", "collector_stop_timeout_s",
         "collector_harvest_timeout_s",
         "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
-        "trace_format",
+        "tile_levels", "trace_format",
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",
         "enable_swarms", "is_idle_threshold", "profile_region", "spotlight",
         "hint_server", "iterations_from",
@@ -216,6 +223,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
             setattr(cfg, name, passed[name])
     if was_set("no_ingest_cache"):
         cfg.ingest_cache = not passed["no_ingest_cache"]
+    if was_set("no_tiles"):
+        cfg.enable_tiles = not passed["no_tiles"]
     if was_set("disable_xprof"):
         cfg.enable_xprof = not passed["disable_xprof"]
     if was_set("disable_tpu_mon"):

@@ -135,6 +135,13 @@ class SofaConfig:
     viz_downsample_to: int = 10000   # max points per _viz series
     trace_format: str = "csv"        # csv | parquet (columnar, for big traces)
     network_filters: List[str] = field(default_factory=list)
+    # Level-of-detail timeline tiles (sofa_tpu/tiles.py): per-series
+    # min/max+density pyramid under <logdir>/_tiles/ so deep zoom regains
+    # full event fidelity.  --no_tiles skips the build (overview only);
+    # tile_levels caps pyramid depth (0 = auto until every leaf tile is
+    # exact, bounded by tiles.MAX_LEVELS).
+    enable_tiles: bool = True
+    tile_levels: int = 0
 
     # --- analyze -----------------------------------------------------------
     num_iterations: int = 20         # AISI expected iteration count

@@ -233,6 +233,24 @@ def _self_trace_events(cfg) -> List[dict]:
     return out
 
 
+def _host_threads(sel: pd.DataFrame) -> Dict[int, str]:
+    """tid -> thread-name map for one host lane — columnar (the
+    ``drop_duplicates().iterrows()`` loop this replaces built a pandas
+    Series per row; on a pod-scale hosttrace that was the whole cost of
+    the metadata pass).  Output is byte-identical to the row loop:
+    first-seen row per tid, module name when non-empty, else "tid <n>"."""
+    dd = sel.drop_duplicates("tid")
+    tids = dd["tid"].to_numpy()
+    if "module" in dd.columns:
+        mods = dd["module"].to_numpy()
+    else:
+        mods = [""] * len(dd)
+    threads: Dict[int, str] = {}
+    for tid, mod in zip(tids.tolist(), list(mods)):
+        threads[int(tid) & 0x7FFFFFFF] = str(mod) or f"tid {tid}"
+    return threads
+
+
 def _meta(events: List[dict], pid: int, name: str,
           threads: Optional[Dict[int, str]] = None) -> None:
     events.append({"name": "process_name", "ph": "M", "pid": pid,
@@ -307,10 +325,7 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
                4: "XLA Modules"})
     if not host.empty:
         for base, sel in host.groupby("deviceId"):
-            threads = {}
-            for _, row in sel.drop_duplicates("tid").iterrows():
-                threads[int(row["tid"]) & 0x7FFFFFFF] = (
-                    str(row.get("module")) or f"tid {row['tid']}")
+            threads = _host_threads(sel)
             base = max(int(base), 0)
             name = "host" if host["deviceId"].nunique() == 1 \
                 else f"host{base // 256}"

@@ -194,6 +194,19 @@ def export_static(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None
 
         frames = load_frames(cfg, only=STATIC_FRAMES)
 
+    # The logdir doubles as a static board bundle (board HTML + report.js
+    # + _tiles/ behind any dumb file host — the board inflates the
+    # pre-gzipped tiles itself when no server negotiates the encoding).
+    # Materialize missing/stale pyramids from the frames this export
+    # loaded; prune=False because this may be a narrow frame subset and
+    # sibling pyramids must survive.
+    try:
+        from sofa_tpu import tiles
+
+        tiles.ensure_tiles(cfg, frames, prune=False)
+    except Exception as e:  # noqa: BLE001 — the PDF export must not die on tiles
+        print_warning(f"export: tile pyramid refresh failed ({e})")
+
     written: List[str] = []
     os.makedirs(cfg.logdir, exist_ok=True)  # cluster export may precede it
     pdf_path = cfg.path("sofa_report.pdf")

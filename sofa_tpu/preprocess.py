@@ -481,57 +481,82 @@ def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
             frames.setdefault(key, empty_frame())
 
     # --- write frames -----------------------------------------------------
-    t0 = time.perf_counter()
-    t0_unix = time.time()
-    trace_format = cfg.trace_format
-    if trace_format == "parquet":
-        try:
-            import pyarrow  # noqa: F401 — pandas' default parquet engine
-        except ImportError:
-            print_warning("trace_format=parquet needs pyarrow (pip install "
-                          "'sofa-tpu[parquet]'); falling back to csv")
-            trace_format = "csv"
-    def _write_one(item):
-        name, df = item
-        write_frame(df, cfg.path(name), trace_format)
+    # Everything below writes derived artifacts that are NOT individually
+    # atomic (streamed CSVs, the tile pyramid lands file by file): the
+    # guard's sentinel lets a concurrently running viz server answer data
+    # requests with 503 + Retry-After instead of torn bytes.
+    from sofa_tpu.trace import derived_write_guard
+
+    with derived_write_guard(cfg.logdir):
+        t0 = time.perf_counter()
+        t0_unix = time.time()
+        trace_format = cfg.trace_format
         if trace_format == "parquet":
-            # The board's detail pages fetch <name>.csv; keep a downsampled
-            # viz copy beside the full-fidelity parquet (analyze prefers
-            # the parquet — trace.read_frame).  write_csv directly: the
-            # csv mode of write_frame would unlink the parquet just written.
-            write_csv(downsample(df, cfg.viz_downsample_to),
-                      cfg.path(f"{name}.csv"))
+            try:
+                import pyarrow  # noqa: F401 — pandas' default parquet engine
+            except ImportError:
+                print_warning("trace_format=parquet needs pyarrow "
+                              "(pip install 'sofa-tpu[parquet]'); "
+                              "falling back to csv")
+                trace_format = "csv"
 
-    to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
-    n_csv = len(to_write)
-    # Frames are independent files and the pyarrow CSV/parquet writers
-    # release the GIL, so the thread pool overlaps the pod-scale tputrace
-    # write with the fifteen small ones.
-    pool.thread_map(_write_one, to_write, jobs)
-    tel.add_span("write_frames", "stage", t0_unix,
-                 time.perf_counter() - t0, frames=n_csv, format=trace_format)
+        def _write_one(item):
+            name, df = item
+            write_frame(df, cfg.path(name), trace_format)
+            if trace_format == "parquet":
+                # The board's detail pages fetch <name>.csv; keep a
+                # downsampled viz copy beside the full-fidelity parquet
+                # (analyze prefers the parquet — trace.read_frame).
+                # write_csv directly: the csv mode of write_frame would
+                # unlink the parquet just written.
+                write_csv(downsample(df, cfg.viz_downsample_to),
+                          cfg.path(f"{name}.csv"))
 
-    # --- assemble the timeline series -> report.js ------------------------
-    with tel.span("report_js", cat="stage"):
+        to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
+        n_csv = len(to_write)
+        # Frames are independent files and the pyarrow CSV/parquet writers
+        # release the GIL, so the thread pool overlaps the pod-scale
+        # tputrace write with the fifteen small ones.
+        pool.thread_map(_write_one, to_write, jobs)
+        tel.add_span("write_frames", "stage", t0_unix,
+                     time.perf_counter() - t0,
+                     frames=n_csv, format=trace_format)
+
+        # --- timeline series -> LOD tiles + report.js ---------------------
         series = build_series(cfg, frames)
-        misc = read_misc(cfg)
-        meta = {
-            "elapsed_time": float(misc.get("elapsed_time", 0) or 0),
-            "time_base": time_base,
-            "tpu_meta": tpu_meta,
-            "logdir": cfg.logdir,
-        }
-        from sofa_tpu.trace import series_to_report_js
+        tiles_manifest = None
+        if cfg.enable_tiles:
+            from sofa_tpu import tiles
 
-        series_to_report_js(series, cfg.path("report.js"),
-                            cfg.viz_downsample_to, meta)
-        if tpu_meta:
-            # Device peak rates for the analyze-side roofline pass (analysis
-            # reads CSVs, not report.js, so the peaks get their own file).
-            import json
+            with tel.span("tiles", cat="stage"):
+                try:
+                    tiles_manifest = tiles.build_tiles(cfg, series,
+                                                       jobs=jobs, tel=tel)
+                except Exception as e:  # noqa: BLE001 — tiles are an enhancement, never fatal
+                    print_warning(f"preprocess: tile pyramid failed ({e}); "
+                                  "the board serves the overview only")
+        with tel.span("report_js", cat="stage"):
+            misc = read_misc(cfg)
+            meta = {
+                "elapsed_time": float(misc.get("elapsed_time", 0) or 0),
+                "time_base": time_base,
+                "tpu_meta": tpu_meta,
+                "logdir": cfg.logdir,
+            }
+            if tiles_manifest is not None:
+                meta["tiles"] = tiles_manifest
+            from sofa_tpu.trace import series_to_report_js
 
-            with open(cfg.path("tpu_meta.json"), "w") as f:
-                json.dump(tpu_meta, f, indent=1)
+            series_to_report_js(series, cfg.path("report.js"),
+                                cfg.viz_downsample_to, meta)
+            if tpu_meta:
+                # Device peak rates for the analyze-side roofline pass
+                # (analysis reads CSVs, not report.js, so the peaks get
+                # their own file).
+                import json
+
+                with open(cfg.path("tpu_meta.json"), "w") as f:
+                    json.dump(tpu_meta, f, indent=1)
     print_progress(
         f"preprocess wrote {n_csv} {trace_format} frames and report.js "
         f"({len(series)} series)"

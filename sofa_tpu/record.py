@@ -56,8 +56,12 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # self-telemetry artifacts (sofa_tpu/telemetry.py): removed
                  # by `sofa clean`, and _clean_stale wipes them at record
                  # start so manifests never mix across runs.
-                 "run_manifest.json", "sofa_self_trace.json"]
-DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine"]
+                 "run_manifest.json", "sofa_self_trace.json",
+                 # mid-write sentinel (trace.derived_write_guard) — a
+                 # crashed writer may leave it behind
+                 "_derived.writing"]
+DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
+                "_tiles"]
 
 
 def build_collectors(cfg):

@@ -501,7 +501,7 @@ def preprocess_summary(doc: "dict | None") -> "str | None":
     cached = sum(1 for s in sources.values() if s.get("cache") == "hit")
     parts = []
     for name, label in (("ingest", "ingest"), ("write_frames", "write"),
-                        ("report_js", "report")):
+                        ("tiles", "tiles"), ("report_js", "report")):
         if name in stages:
             parts.append(f"{label} {stages[name]['dur_s']:.2f}s")
     jobs = ((doc.get("meta") or {}).get("pool") or {}).get("jobs")

@@ -1,0 +1,450 @@
+"""Level-of-detail timeline tiles — the board's deep-zoom data path.
+
+``report.js`` carries a globally downsampled overview of every series (the
+level-0 picture: ~``--viz_downsample_to`` points no matter how large the
+trace), which makes first paint O(pixels) but means zooming IN shows *less*
+detail, not more.  This module builds the complement: a per-series
+multi-resolution tile pyramid under ``<logdir>/_tiles/`` that the board
+fetches viewport-driven on zoom, so deep zoom regains full event fidelity
+while the wire payload stays bounded per request.
+
+Layout (all files pre-gzipped columnar JSON)::
+
+    <logdir>/_tiles/<series>/<level>/<n>.json.gz   one tile
+    <logdir>/_tiles/<series>/tile_index.json       per-series content key
+
+Pyramid math: a series' time domain [x0, x1] splits into ``2**L`` equal
+windows at level ``L``; tile ``n`` at level ``L`` covers exactly tiles
+``2n`` and ``2n+1`` at level ``L+1`` (refinement invariant).  Levels deepen
+until every leaf tile holds at most ``TILE_RAW_MAX`` raw events (capped by
+``--tile_levels``); leaf tiles are ALWAYS exact — the acceptance contract
+is that a deepest-zoom request returns the raw events for its window with
+no downsampling loss.  Non-leaf tiles over the budget are decimated to a
+min/max envelope: ``TILE_BUCKETS`` equal sub-windows each keep their
+lowest- and highest-y point (so the drawn outline of the decimated tile is
+pixel-identical to the raw data's outline at that zoom), plus the
+``TILE_STRAGGLERS`` longest-duration events in the tile (the same
+straggler-preservation argument as trace.downsample), plus a per-bucket
+``density`` histogram.
+
+Tiles are content-keyed cached like the ingest cache: the per-series key
+signs the series' data arrays and the pyramid parameters, so a re-run over
+unchanged frames skips the build entirely, and any data change rebuilds
+only the series that changed.  Builds fan out across the shared ``--jobs``
+thread pool (sofa_tpu/pool.py) — json+gzip release the GIL.
+
+Empty windows get no file (sparse pyramid); the board treats a 404 as an
+empty tile.  Series small enough that the report.js overview is already
+exact (len <= --viz_downsample_to) get no pyramid at all.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import os
+import re
+import shutil
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+TILES_DIR_NAME = "_tiles"
+TILE_INDEX_NAME = "tile_index.json"
+TILES_VERSION = 1
+
+# A leaf tile holds at most this many raw events (auto level depth stops
+# here); sized so a worst-case exact tile gzips well under the 64 KiB
+# per-request budget.
+TILE_RAW_MAX = 4096
+# Decimation buckets per non-leaf tile: each bucket keeps its min/max-y
+# point, so a tile never ships more than ~2*TILE_BUCKETS + TILE_STRAGGLERS
+# points regardless of raw density.
+TILE_BUCKETS = 256
+TILE_STRAGGLERS = 64
+# Auto mode depth cap: 12 levels of exact leaves cover ~8M-point series
+# (TILE_RAW_MAX * 2**11); --tile_levels overrides.
+MAX_LEVELS = 12
+
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def series_dir_name(name: str) -> str:
+    """Filesystem-safe directory for a series name (filter keywords are
+    user input and may hold separators); collisions get a hash suffix."""
+    safe = _SAFE_NAME.sub("_", name).lstrip(".") or "series"
+    if safe != name:
+        safe += "-" + hashlib.sha1(name.encode()).hexdigest()[:8]
+    return safe
+
+
+def _scrub(values, digits: int) -> np.ndarray:
+    """Vectorized NaN/Inf -> 0.0 (bare NaN tokens are invalid JSON for the
+    board's parser) + rounding — replaces the per-value _num round-trip."""
+    a = np.asarray(values, dtype=float)
+    a = np.where(np.isfinite(a), a, 0.0)
+    return np.round(a, digits)
+
+
+def _tile_params(levels_cap: int) -> dict:
+    return {
+        "version": TILES_VERSION,
+        "raw_max": TILE_RAW_MAX,
+        "buckets": TILE_BUCKETS,
+        "stragglers": TILE_STRAGGLERS,
+        "levels_cap": int(levels_cap),
+    }
+
+
+def _series_key(df: pd.DataFrame, ycol: str, params: dict) -> str:
+    """Content key: signs the series' RAW data columns + pyramid
+    parameters.  Raw (unsorted, unscrubbed) on purpose: the pyramid is a
+    deterministic function of the raw columns, and hashing them directly
+    keeps the warm path free of the sort/scrub work it exists to skip.
+    pd.util.hash_pandas_object is deterministic across processes (fixed
+    default hash key), so --jobs 1 and --jobs 4 agree."""
+    h = hashlib.sha1()
+    h.update(repr(sorted(params.items())).encode())
+    for col in ("timestamp", ycol, "duration"):
+        h.update(np.ascontiguousarray(
+            df[col].to_numpy(dtype=float)).tobytes())
+    h.update(pd.util.hash_pandas_object(df["name"], index=False)
+             .to_numpy().tobytes())
+    return h.hexdigest()
+
+
+def _levels_for(xs: np.ndarray, cap: int) -> int:
+    """Smallest depth whose leaf tiles all hold <= TILE_RAW_MAX events
+    (xs sorted ascending), bounded by ``cap``."""
+    n = len(xs)
+    x0, x1 = float(xs[0]), float(xs[-1])
+    width = (x1 - x0) or 1e-9
+    level = 0
+    while level < cap - 1:
+        nt = 1 << level
+        edges = x0 + width * np.arange(1, nt) / nt
+        splits = np.searchsorted(xs, edges, side="left")
+        counts = np.diff(np.concatenate([[0], splits, [n]]))
+        if counts.max() <= TILE_RAW_MAX:
+            break
+        level += 1
+    return level + 1
+
+
+def _write_tile(path: str, doc: dict) -> int:
+    """Gzip a tile deterministically (mtime=0 so --jobs 1 / --jobs 4 and
+    repeated builds are byte-identical); returns compressed size.
+    Level 1: the pyramid is rebuilt on every data change but each tile
+    is fetched rarely, so build speed wins over the last few percent of
+    ratio (the <15%-of-wall budget) — the integer encoding already did
+    the compression's work."""
+    blob = gzip.compress(
+        json.dumps(doc, separators=(",", ":")).encode(), 1, mtime=0)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return len(blob)
+
+
+def _first_match_per_run(values, target_per_run, run_starts, run_of):
+    """First index in each contiguous run whose value equals the run's
+    target (the index recovery half of a vectorized per-run argmin)."""
+    eq = np.flatnonzero(values == target_per_run[run_of])
+    _uniq, first = np.unique(run_of[eq], return_index=True)
+    return eq[first]
+
+
+def _level_envelope(xs, ys, x0: float, width: float, nt: int):
+    """Per-bucket min/max-y point indices for one whole level at once.
+
+    ``xs`` is sorted and the level's global bucket grid (``nt`` tiles x
+    TILE_BUCKETS buckets, equal x-width) is monotone in x — points are
+    already grouped into contiguous per-bucket runs, so the per-bucket
+    extrema come from ``reduceat`` in O(n) with no sort at all (a lexsort
+    here was ~30% of the whole pyramid build).  Returns (bucket id per
+    occupied run, min index, max index, bucket id per point) with runs
+    ordered by bucket id.
+    """
+    nb = nt * TILE_BUCKETS
+    gb = ((xs - x0) / width * nb).astype(np.int64)
+    np.clip(gb, 0, nb - 1, out=gb)
+    starts = np.flatnonzero(
+        np.concatenate([[True], gb[1:] != gb[:-1]]))
+    run_of = np.repeat(np.arange(len(starts)),
+                       np.diff(np.concatenate([starts, [len(gb)]])))
+    min_val = np.minimum.reduceat(ys, starts)
+    max_val = np.maximum.reduceat(ys, starts)
+    min_idx = _first_match_per_run(ys, min_val, starts, run_of)
+    max_idx = _first_match_per_run(ys, max_val, starts, run_of)
+    return gb[starts], min_idx, max_idx, gb
+
+
+# Fixed-point scales for the integer tile encoding: x at 0.1 µs, y at
+# 1e-6 (the overview's rounding), d at 1 ns.  Integers encode ~3x faster
+# than shortest-repr floats through the C json encoder AND the x stream
+# delta-encodes into small ints that gzip tightly — this is what keeps the
+# pyramid build inside its share of the analyze budget.
+X_SCALE, Y_SCALE, D_SCALE = 1e-7, 1e-6, 1e-9
+
+
+def _build_pyramid(sdir: str, xs, ys, ds, names: pd.Series,
+                   levels: int) -> dict:
+    """Write every tile of one series under ``sdir``; returns stats."""
+    n = len(xs)
+    x0, x1 = float(xs[0]), float(xs[-1])
+    width = (x1 - x0) or 1e-9
+    # names intern ONCE per series: tiles (and report.js) ship a local
+    # string table + small int codes — symbol/HLO-op names repeat heavily,
+    # so this is most of the payload win over per-point strings
+    codes, uniques = pd.factorize(names, use_na_sentinel=False)
+    uniques = [str(u) for u in uniques]
+    xi = np.round(xs / X_SCALE).astype(np.int64)
+    yi = np.round(ys / Y_SCALE).astype(np.int64)
+    di = np.round(ds / D_SCALE).astype(np.int64)
+    n_tiles = 0
+    n_bytes = 0
+    per_level: List[int] = []
+    for level in range(levels):
+        nt = 1 << level
+        edges = x0 + width * np.arange(1, nt) / nt
+        splits = np.searchsorted(xs, edges, side="left")
+        bounds = np.concatenate([[0], splits, [n]])
+        counts = np.diff(bounds)
+        ldir = os.path.join(sdir, str(level))
+        os.makedirs(ldir, exist_ok=True)
+        leaf = level == levels - 1
+        env = None
+        if not leaf and counts.max() > TILE_RAW_MAX:
+            env = _level_envelope(xs, ys, x0, width, nt)
+        wrote = 0
+        for i in range(nt):
+            a, b = int(bounds[i]), int(bounds[i + 1])
+            if a == b:
+                continue  # sparse pyramid: empty windows get no file
+            tx0 = x0 + width * i / nt
+            tw = width / nt
+            exact = leaf or (b - a) <= TILE_RAW_MAX
+            doc = {
+                "level": level, "n": i,
+                "x0": round(tx0, 9), "x1": round(tx0 + tw, 9),
+                "count": b - a, "exact": bool(exact),
+            }
+            if exact:
+                keep = np.arange(a, b)
+            else:
+                run_b, run_min, run_max, gb = env
+                lo, hi = i * TILE_BUCKETS, (i + 1) * TILE_BUCKETS
+                r0, r1 = np.searchsorted(run_b, [lo, hi])
+                seg_d = ds[a:b]
+                k = min(TILE_STRAGGLERS, b - a)
+                top = a + np.argpartition(seg_d, len(seg_d) - k)[-k:]
+                keep = np.unique(np.concatenate(
+                    [run_min[r0:r1], run_max[r0:r1], top]))
+                doc["buckets"] = TILE_BUCKETS
+                doc["density"] = np.bincount(
+                    gb[a:b] - lo, minlength=TILE_BUCKETS).tolist()
+            # envelope over ALL raw points in the window, not just kept
+            doc["ymin"] = float(ys[a:b].min())
+            doc["ymax"] = float(ys[a:b].max())
+            xk = xi[keep]
+            doc["sx"], doc["sy"], doc["sd"] = X_SCALE, Y_SCALE, D_SCALE
+            doc["xd"] = np.diff(xk, prepend=0).tolist()  # delta-encoded
+            doc["yv"] = yi[keep].tolist()
+            doc["dv"] = di[keep].tolist()
+            local, inv = np.unique(codes[keep], return_inverse=True)
+            doc["names"] = [uniques[int(j)] for j in local]
+            doc["ni"] = inv.tolist()
+            n_bytes += _write_tile(
+                os.path.join(ldir, f"{i}.json.gz"), doc)
+            wrote += 1
+        per_level.append(wrote)
+        n_tiles += wrote
+    return {"levels": levels, "x0": round(x0, 9), "x1": round(x1, 9),
+            "count": int(n), "tiles": per_level,
+            "tile_count": n_tiles, "bytes": n_bytes}
+
+
+def tile_points(doc: dict) -> dict:
+    """Decode one tile back to value space: {"x", "y", "d" (np arrays),
+    "name" (list)} — the Python mirror of the board's pointsFromTile."""
+    xk = np.cumsum(np.asarray(doc["xd"], dtype=np.int64))
+    table = doc.get("names") or []
+    return {
+        "x": xk * doc["sx"],
+        "y": np.asarray(doc["yv"], dtype=np.int64) * doc["sy"],
+        "d": np.asarray(doc["dv"], dtype=np.int64) * doc["sd"],
+        "name": [table[i] for i in doc.get("ni") or []],
+    }
+
+
+def _series_arrays(s) -> tuple:
+    """(xs, ys, ds, names) sorted by timestamp, NaN-scrubbed — the exact
+    value space the board renders (tiles and overview must agree)."""
+    df = s.data
+    ycol = s.y_axis if s.y_axis in df.columns else "event"
+    xs = _scrub(df["timestamp"].to_numpy(), 7)
+    ys = _scrub(df[ycol].to_numpy(), 6)
+    ds = _scrub(df["duration"].to_numpy(), 9)
+    order = np.argsort(xs, kind="stable")
+    names = df["name"].astype(str)
+    return (xs[order], ys[order], ds[order],
+            names.iloc[order].reset_index(drop=True))
+
+
+def build_tiles(cfg, series, jobs: "int | None" = None,
+                tel=None, prune: bool = True) -> Dict[str, object]:
+    """Build (or reuse) the tile pyramid for every series that needs one.
+
+    Returns the tiles manifest embedded in report.js meta: the board reads
+    it to know which series have pyramids, their domain, and depth.
+    Content-keyed: a series whose data and parameters are unchanged since
+    the last build is skipped wholesale (warm re-runs are ~free).
+    ``prune=False`` when ``series`` is a partial view (narrow exporter
+    frames) — pruning then would delete healthy sibling pyramids.
+    """
+    from sofa_tpu import pool
+    from sofa_tpu.printing import print_progress, print_warning
+
+    jobs = jobs if jobs else pool.cfg_jobs(cfg)
+    levels_flag = int(getattr(cfg, "tile_levels", 0) or 0)
+    cap = levels_flag if levels_flag > 0 else MAX_LEVELS
+    params = _tile_params(cap)
+    root = cfg.path(TILES_DIR_NAME)
+    # the overview is already exact for small series — no pyramid needed
+    overview_max = int(getattr(cfg, "viz_downsample_to", 10000))
+    work = [s for s in series if len(s.data) > overview_max]
+
+    def build_one(s) -> "tuple | None":
+        try:
+            ycol = s.y_axis if s.y_axis in s.data.columns else "event"
+            key = _series_key(s.data, ycol, params)
+            dname = series_dir_name(s.name)
+            sdir = os.path.join(root, dname)
+            index_path = os.path.join(sdir, TILE_INDEX_NAME)
+            try:
+                with open(index_path) as f:
+                    index = json.load(f)
+            except (OSError, ValueError):
+                index = None
+            if isinstance(index, dict) and index.get("key") == key:
+                entry = dict(index.get("entry") or {})
+                entry["path"] = dname
+                return s.name, entry, True
+            # rebuild from scratch: stale levels must not shadow new ones
+            if os.path.isdir(sdir):
+                shutil.rmtree(sdir, ignore_errors=True)
+            os.makedirs(sdir, exist_ok=True)
+            xs, ys, ds, names = _series_arrays(s)
+            levels = _levels_for(xs, cap)
+            entry = _build_pyramid(sdir, xs, ys, ds, names, levels)
+            # the index is written LAST so a half-built pyramid never
+            # passes the key check on the next run
+            tmp = index_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"key": key, "params": params, "entry": entry}, f)
+            os.replace(tmp, index_path)
+            entry = dict(entry)
+            entry["path"] = dname
+            return s.name, entry, False
+        except Exception as e:  # noqa: BLE001 — per-series degradation
+            print_warning(f"tiles: cannot build pyramid for {s.name}: {e}")
+            return None
+
+    built = [r for r in pool.thread_map(build_one, work, jobs)
+             if r is not None]
+    manifest: Dict[str, object] = {
+        "dir": TILES_DIR_NAME,
+        "version": TILES_VERSION,
+        "raw_max": TILE_RAW_MAX,
+        "series": {name: entry for name, entry, _cached in built},
+    }
+    # prune pyramids of series that no longer exist (renamed filters, ...)
+    if prune:
+        keep_dirs = {series_dir_name(name) for name, _e, _c in built}
+        if os.path.isdir(root):
+            for entry in os.listdir(root):
+                if entry not in keep_dirs and \
+                        os.path.isdir(os.path.join(root, entry)):
+                    shutil.rmtree(os.path.join(root, entry),
+                                  ignore_errors=True)
+    n_cached = sum(1 for _n, _e, cached in built if cached)
+    total_tiles = sum(e.get("tile_count", 0) for _n, e, _c in built)
+    total_bytes = sum(e.get("bytes", 0) for _n, e, _c in built)
+    if tel is not None:
+        tel.set_meta(tiles={
+            "series": len(built), "cached": n_cached,
+            "tile_count": int(total_tiles), "bytes": int(total_bytes),
+            "levels_cap": cap,
+        })
+    if built:
+        print_progress(
+            f"tiles: {len(built)} series pyramids ({total_tiles} tiles, "
+            f"{total_bytes / 2**20:.1f} MiB, {n_cached} cached) -> {root}")
+    return manifest
+
+
+def ensure_tiles(cfg, frames=None, series=None, tel=None,
+                 prune: bool = True) -> "dict | None":
+    """Build/refresh the pyramid for a logdir that already has a report.js
+    (standalone ``sofa analyze`` / ``sofa export`` over an older
+    preprocess) and patch the manifest into report.js meta.  Warm no-op
+    when the content keys all match.  Returns the manifest, or None when
+    tiles are disabled or there is nothing to do."""
+    from sofa_tpu.printing import print_warning
+
+    if not getattr(cfg, "enable_tiles", True):
+        return None
+    report = cfg.path("report.js")
+    if not os.path.isfile(report):
+        return None  # no board data contract to deepen
+    if series is None:
+        if frames is None:
+            return None
+        from sofa_tpu.preprocess import build_series
+
+        series = build_series(cfg, frames)
+    manifest = build_tiles(cfg, series, tel=tel, prune=prune)
+    try:
+        patch_report_meta(report, manifest, merge=not prune)
+    except (OSError, ValueError) as e:
+        print_warning(f"tiles: cannot patch report.js manifest: {e}")
+    return manifest
+
+
+def patch_report_meta(report_path: str, manifest: dict,
+                      merge: bool = False) -> None:
+    """Rewrite report.js meta.tiles in place (atomic via the shared
+    report.js writer) without touching the series payload.  ``merge=True``
+    folds the new per-series entries into an existing manifest instead of
+    replacing it (partial rebuilds must not drop sibling pyramids)."""
+    from sofa_tpu.trace import write_report_js_doc
+
+    with open(report_path) as f:
+        text = f.read()
+    doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+    meta = doc.setdefault("meta", {})
+    if merge and isinstance(meta.get("tiles"), dict):
+        prev = dict(meta["tiles"])
+        prev_series = dict(prev.get("series") or {})
+        prev_series.update(manifest.get("series") or {})
+        manifest = dict(manifest)
+        manifest["series"] = prev_series
+    if meta.get("tiles") == manifest:
+        return  # warm path: nothing changed, don't churn mtimes/ETags
+    meta["tiles"] = manifest
+    write_report_js_doc(doc, report_path)
+
+
+def read_tile(logdir: str, series_path: str, level: int,
+              n: int) -> Optional[dict]:
+    """Load one tile (tests + tooling; the board fetches over HTTP)."""
+    path = os.path.join(logdir, TILES_DIR_NAME, series_path,
+                        str(level), f"{n}.json.gz")
+    try:
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

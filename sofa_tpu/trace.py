@@ -59,7 +59,7 @@ Extension columns (TPU build):
 from __future__ import annotations
 
 import json
-import math
+import os
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import List, Optional
@@ -427,28 +427,43 @@ class SofaSeries:
     y_axis: str = "event"    # which column supplies y values
     kind: str = "scatter"    # scatter | line | band
 
-    def to_points(self, max_points: int = 10000) -> List[dict]:
+    def to_columnar(self, max_points: int = 10000) -> dict:
+        """Downsampled series data as columnar arrays ``{"x": [...],
+        "y": [...], "d": [...], "names": [...], "ni": [...]}`` — the
+        report.js payload shape.  Columnar beats per-point dicts on both
+        wire bytes (no repeated keys, names interned into a string table
+        + small int codes — event names repeat heavily) and serialize
+        time (one numpy NaN-scrub pass plus the C JSON encoder, instead
+        of a per-value ``_num`` round-trip).  NaN/Inf coerce to 0 — bare
+        ``NaN`` tokens are invalid JSON for the board's parser."""
         df = downsample(self.data, max_points)
         if df.empty:
-            return []
+            return {"x": [], "y": [], "d": [], "names": [], "ni": []}
         ys = df[self.y_axis] if self.y_axis in df.columns else df["event"]
 
-        def _num(v: float, digits: int) -> float:
-            # NaN/Inf would serialize as bare `NaN` tokens — invalid JSON for
-            # the board's JSON.parse — so coerce to 0.
-            v = float(v)
-            return round(v, digits) if math.isfinite(v) else 0.0
+        def _scrub(values, digits: int) -> list:
+            a = np.asarray(values, dtype=float)
+            a = np.where(np.isfinite(a), a, 0.0)
+            return np.round(a, digits).tolist()
 
-        pts = [
-            {
-                "x": _num(x, 6),
-                "y": _num(y, 6),
-                "name": str(n),
-                "d": _num(d, 9),
-            }
-            for x, y, n, d in zip(df["timestamp"], ys, df["name"], df["duration"])
+        codes, uniques = pd.factorize(df["name"], use_na_sentinel=False)
+        return {
+            "x": _scrub(df["timestamp"].to_numpy(), 6),
+            "y": _scrub(ys.to_numpy(), 6),
+            "d": _scrub(df["duration"].to_numpy(), 9),
+            "names": [str(u) for u in uniques],
+            "ni": codes.tolist(),
+        }
+
+    def to_points(self, max_points: int = 10000) -> List[dict]:
+        """Row-oriented view of :meth:`to_columnar` (kept for plugins and
+        size-comparison tooling; report.js itself ships columnar)."""
+        c = self.to_columnar(max_points)
+        names = c["names"]
+        return [
+            {"x": x, "y": y, "name": names[i], "d": d}
+            for x, y, i, d in zip(c["x"], c["y"], c["ni"], c["d"])
         ]
-        return pts
 
 
 def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 10000,
@@ -457,7 +472,9 @@ def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 1
 
     Written as ``sofa_traces = [...]`` (one JSON blob), the modern analogue of
     the reference's per-series JS vars + sofa_traces array
-    (sofa_preprocess.py:343-374,2104).
+    (sofa_preprocess.py:343-374,2104).  Each series' ``data`` is columnar
+    (:meth:`SofaSeries.to_columnar`): the level-0 overview; deep zoom
+    fetches LOD tiles (sofa_tpu/tiles.py) named by ``meta.tiles``.
     """
     payload = [
         {
@@ -465,7 +482,7 @@ def series_to_report_js(series: List[SofaSeries], path: str, max_points: int = 1
             "title": s.title,
             "color": s.color,
             "kind": s.kind,
-            "data": s.to_points(max_points),
+            "data": s.to_columnar(max_points),
         }
         for s in series
     ]
@@ -477,11 +494,75 @@ def write_report_js_doc(doc: dict, path: str) -> None:
     exact shape (`sofa_traces = <json>;`), so every producer must go
     through here.  dumps, not dump: the one-shot path runs json's C
     encoder, while dump iterencodes 500k+ point dicts through Python
-    (~5x slower on a pod-scale report.js)."""
-    with open(path, "w") as f:
+    (~5x slower on a pod-scale report.js).  Written to a temp file +
+    rename: a board request racing the writer must see the old complete
+    document, never a truncated one."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         f.write("sofa_traces = ")
         f.write(json.dumps(doc))
         f.write(";\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Derived-artifact write guard — the shared mid-write degradation path.
+#
+# Frame CSVs are streamed (not atomic) and the tile pyramid lands file by
+# file, so a board request racing `sofa preprocess`/`analyze` could read a
+# torn artifact.  Writers hold the sentinel while derived data is in
+# flight; the viz server answers data requests with 503 + Retry-After
+# while it exists, and readers (read_net_addrs below) use it to explain a
+# torn parse instead of silently degrading.
+# ---------------------------------------------------------------------------
+
+WRITING_SENTINEL = "_derived.writing"
+
+
+def derived_writing(logdir: str) -> bool:
+    """True while a pipeline verb is mid-write on this logdir's derived
+    artifacts (stale sentinels from a crashed writer expire: a dead pid
+    or an unparsable sentinel does not wedge the server forever)."""
+    path = os.path.join(logdir, WRITING_SENTINEL)
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip() or "0")
+    except OSError:
+        return False
+    except ValueError:
+        return True  # sentinel exists but is torn — still mid-write
+    if pid <= 0:
+        return True
+    try:
+        os.kill(pid, 0)  # sofa-lint: disable=SL008 — signal 0 is a liveness probe, not a kill
+        return True
+    except ProcessLookupError:
+        return False  # writer died without cleanup; don't 503 forever
+    except OSError:
+        return True
+
+
+class derived_write_guard:
+    """Context manager a writer holds across non-atomic derived writes."""
+
+    def __init__(self, logdir: str):
+        self._path = os.path.join(logdir, WRITING_SENTINEL)
+
+    def __enter__(self):
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            with open(self._path, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass  # best-effort: an unwritable logdir fails later, loudly
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+        return False
 
 
 def packed_ip(ip: str) -> int:
@@ -533,17 +614,29 @@ def unpack_ip(value: int, addrs: "dict | None" = None) -> str:
 def read_net_addrs(path: str) -> dict:
     """Load a capture's interned id->literal address table (net_addrs.csv,
     written by ingest_pcap when non-IPv4 packets appear). Missing file ->
-    empty dict: every consumer degrades to unpack_ip placeholders."""
+    empty dict: every consumer degrades to unpack_ip placeholders.
+
+    Shares the mid-write degradation path with the viz server: a table
+    being (re)written by a concurrent preprocess — the sentinel the
+    write guard holds — degrades to the rows read so far with a warning,
+    never an exception or a silently half-wrong table."""
     import csv
-    import os
 
     table: dict = {}
     if not os.path.isfile(path):
         return table
-    with open(path, newline="") as f:
-        for row in csv.DictReader(f):
-            try:
-                table[int(row["id"])] = row["address"]
-            except (KeyError, ValueError):
-                continue
+    try:
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                try:
+                    table[int(row["id"])] = row["address"]
+                except (KeyError, ValueError, TypeError):
+                    continue
+    except OSError as e:
+        from sofa_tpu.printing import print_warning
+
+        why = ("a preprocess is mid-write on this logdir"
+               if derived_writing(os.path.dirname(path) or ".") else e)
+        print_warning(f"net_addrs: cannot read {path} ({why}) — "
+                      "addresses degrade to placeholders")
     return table

@@ -1,38 +1,172 @@
 """`sofa viz` — serve the board GUI over the logdir.
 
-Like the reference (sofa_viz.py:18) this is just an HTTP file server rooted
-at logdir (analyze stages the board HTML/JS there), but embedded so we can
-bind/port-retry and print the URL.
+The reference is a single-threaded file server (sofa_viz.py:18); this one
+is a production data server for the board's O(pixels) contract:
+
+  * ``ThreadingHTTPServer`` — tile bursts on zoom are many small parallel
+    requests; one slow pod-scale CSV download must not head-of-line block
+    them.
+  * ETag/If-None-Match on every file + ``Cache-Control`` — derived
+    artifacts change between runs, so revalidation is cheap 304s instead
+    of re-downloads.
+  * Accept-Encoding negotiation for the pre-gzipped LOD tiles
+    (``_tiles/<series>/<level>/<n>.json.gz``, sofa_tpu/tiles.py): gzip
+    bytes go straight to the wire when the client accepts gzip (every
+    browser does) and are decompressed server-side otherwise.  ``/tiles/…``
+    is a stable route alias for the on-disk ``_tiles/`` pyramid.
+  * 503 + Retry-After while a pipeline verb is mid-write on the logdir
+    (trace.derived_write_guard's sentinel): a board refresh racing
+    `sofa preprocess` gets an honest retry signal, never torn JSON.
 """
 
 from __future__ import annotations
 
 import errno
 import functools
+import gzip
 import http.server
+import io
 import os
+import posixpath
 import socket
-import socketserver
 
 from sofa_tpu.printing import print_error, print_progress
 
+# Requests answered 503 while the write-guard sentinel is up: the board's
+# data artifacts (report.js, frame CSVs, tiles, manifests).  Board chrome
+# (HTML/board JS/CSS) keeps serving — only data can be torn mid-write.
+_DATA_SUFFIXES = (".csv", ".parquet", ".json", ".json.gz")
 
-class _QuietHandler(http.server.SimpleHTTPRequestHandler):
+
+def _display_host(bind: str) -> str:
+    """URL host worth printing for a bind address.  Wildcard binds print
+    an address a *remote* user can reach; a failing gethostname (broken
+    resolv/containers) degrades to localhost instead of crashing before
+    the server ever serves, and IPv6 literals get their URL brackets."""
+    if bind in ("127.0.0.1", "::1"):
+        return "localhost"
+    if bind in ("", "0.0.0.0", "::"):
+        try:
+            return socket.gethostname() or "localhost"
+        except OSError:
+            return "localhost"
+    if ":" in bind:
+        return f"[{bind}]"
+    return bind
+
+
+class _BoardHandler(http.server.SimpleHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive across a zoom's tile burst
+    server_version = "sofa_tpu"
+
     def log_message(self, fmt, *args):  # noqa: A003
         pass
+
+    def translate_path(self, path):  # noqa: A003
+        # /tiles/... is the public route for the on-disk _tiles/ pyramid
+        # (the underscore path also works — a dumb static host has no
+        # rewrite, so the board fetches the literal layout).
+        clean = path.split("?", 1)[0].split("#", 1)[0]
+        if clean.startswith("/tiles/"):
+            path = "/_tiles/" + path[len("/tiles/"):]
+        return super().translate_path(path)
+
+    # -- helpers -----------------------------------------------------------
+    def _is_data(self, fs_path: str) -> bool:
+        rel = fs_path.replace(os.sep, "/")
+        return (rel.endswith(_DATA_SUFFIXES)
+                or posixpath.basename(rel) == "report.js"
+                or "/_tiles/" in rel)
+
+    def _unavailable(self):
+        self.send_response(503)
+        self.send_header("Retry-After", "1")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return None
+
+    def _not_modified(self, etag: str):
+        self.send_response(304)
+        self.send_header("ETag", etag)
+        self.end_headers()
+        return None
+
+    # -- the one serving path (GET and HEAD both run through send_head) ----
+    def send_head(self):
+        from sofa_tpu.trace import derived_writing
+
+        path = self.translate_path(self.path)
+        if os.path.isdir(path):
+            return super().send_head()  # index.html redirect / listing
+        if self._is_data(path) and derived_writing(self.directory):
+            # CSVs stream and tiles land file-by-file: while a writer
+            # holds the guard, data responses would race torn bytes.
+            return self._unavailable()
+        actual, precompressed = path, False
+        if os.path.isfile(path):
+            precompressed = path.endswith(".json.gz")
+        elif os.path.isfile(path + ".gz"):
+            # tiles fetched without the suffix negotiate transparently
+            actual, precompressed = path + ".gz", True
+        else:
+            return super().send_head()  # canonical 404
+        try:
+            st = os.stat(actual)
+        except OSError:
+            return super().send_head()
+        etag = f'"{st.st_mtime_ns:x}-{st.st_size:x}"'
+        if self.headers.get("If-None-Match") == etag:
+            return self._not_modified(etag)
+        accepts_gzip = "gzip" in (self.headers.get("Accept-Encoding") or "")
+        headers = [("ETag", etag)]
+        if "_tiles" in actual.replace(os.sep, "/").split("/"):
+            # tiles only change when a rebuild changes their content key's
+            # inputs; short max-age absorbs zoom-jitter refetches and the
+            # ETag revalidates after it
+            headers.append(("Cache-Control", "max-age=60, must-revalidate"))
+        else:
+            headers.append(("Cache-Control", "no-cache"))
+        if precompressed:
+            headers.append(("Vary", "Accept-Encoding"))
+            ctype = "application/json"
+            if accepts_gzip:
+                f = open(actual, "rb")
+                headers.append(("Content-Encoding", "gzip"))
+                length = st.st_size
+            else:
+                try:
+                    with open(actual, "rb") as raw:
+                        body = gzip.decompress(raw.read())
+                except (OSError, gzip.BadGzipFile, EOFError):
+                    return self._unavailable()  # torn tile: retry later
+                f = io.BytesIO(body)
+                length = len(body)
+        else:
+            ctype = self.guess_type(path)
+            f = open(actual, "rb")
+            length = st.st_size
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(length))
+        for key, value in headers:
+            self.send_header(key, value)
+        self.end_headers()
+        return f
 
 
 def sofa_viz(cfg, serve_forever: bool = True):
     if not os.path.isdir(cfg.logdir):
         print_error(f"logdir {cfg.logdir} does not exist")
         return None
-    handler = functools.partial(_QuietHandler, directory=cfg.logdir)
-    socketserver.TCPServer.allow_reuse_address = True
+    handler = functools.partial(_BoardHandler, directory=cfg.logdir)
+    http.server.ThreadingHTTPServer.allow_reuse_address = True
+    http.server.ThreadingHTTPServer.daemon_threads = True
     httpd = None
     last_err = None
     for port_try in range(cfg.viz_port, cfg.viz_port + 20):
         try:
-            httpd = socketserver.TCPServer((cfg.viz_bind, port_try), handler)
+            httpd = http.server.ThreadingHTTPServer(
+                (cfg.viz_bind, port_try), handler)
             break
         except OSError as e:
             last_err = e
@@ -46,19 +180,19 @@ def sofa_viz(cfg, serve_forever: bool = True):
         )
         return None
     port = httpd.server_address[1]
-    if cfg.viz_bind == "127.0.0.1":
-        host = "localhost"
-    elif cfg.viz_bind in ("", "0.0.0.0", "::"):
-        # Wildcard bind: print an address a *remote* user can reach.
-        host = socket.gethostname()
-    else:
-        host = cfg.viz_bind
+    host = _display_host(cfg.viz_bind)
     print_progress(
         f"serving {cfg.logdir} at http://{host}:{port}/ (Ctrl-C stops; "
         f"bound to {cfg.viz_bind or 'all interfaces'})"
     )
     from sofa_tpu.telemetry import MANIFEST_NAME, SELF_TRACE_NAME
+    from sofa_tpu.tiles import TILES_DIR_NAME
 
+    if os.path.isdir(os.path.join(cfg.logdir, TILES_DIR_NAME)):
+        print_progress(
+            f"LOD tiles: /{TILES_DIR_NAME}/ (pre-gzipped; served with "
+            "Accept-Encoding negotiation — deep zoom on the timeline "
+            "fetches these viewport-driven)")
     if os.path.isfile(os.path.join(cfg.logdir, SELF_TRACE_NAME)):
         print_progress(
             f"self-telemetry: /{SELF_TRACE_NAME} (Chrome-trace of sofa's "

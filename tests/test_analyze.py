@@ -362,6 +362,38 @@ def test_profile_region_manual(cfg):
     assert cfg.roi_begin == 1.5 and cfg.roi_end == 2.5
 
 
+def test_hysteresis_roi_matches_row_loop():
+    """The vectorized spotlight detector is byte-identical to the
+    reference's per-row state machine on randomized inputs."""
+    import numpy as np
+
+    def row_loop(ev, ts, dur, high, low, up_count, t_first):
+        count = 0
+        begin = end = None
+        for i in range(len(ev)):
+            if ev[i] >= high:
+                count += 1
+                if count >= up_count and begin is None:
+                    begin = max(ts[i] - dur[i] * up_count, t_first)
+            elif ev[i] < low:
+                if begin is not None:
+                    end = ts[i] - dur[i]
+                    break
+                count = 0
+        return begin, end
+
+    rng = np.random.default_rng(7)
+    for case in range(200):
+        n = int(rng.integers(1, 60))
+        ev = rng.choice([0.0, 5.0, 30.0, 60.0, 95.0], n)
+        ts = np.cumsum(rng.exponential(0.1, n))
+        dur = rng.exponential(0.05, n)
+        want = row_loop(ev, ts, dur, 50.0, 10.0, 3, float(ts[0] - dur[0]))
+        got = tpu._hysteresis_roi(ev, ts, dur, 50.0, 10.0, 3,
+                                  float(ts[0] - dur[0]))
+        assert got == want, (case, ev.tolist())
+
+
 def test_concurrency_breakdown(cfg):
     mp_rows = []
     for i in range(20):
@@ -521,8 +553,8 @@ def test_cluster_merged_timeline_aligns_skewed_clocks(tmp_path):
     doc = json.loads(
         open(cfg.path("report.js")).read()[len("sofa_traces = "):].rstrip(";\n"))
     by_name = {s["name"]: s for s in doc["series"]}
-    xa = by_name["hostA_tputrace"]["data"][0]["x"]
-    xb = by_name["hostB_tputrace"]["data"][0]["x"]
+    xa = by_name["hostA_tputrace"]["data"]["x"][0]
+    xb = by_name["hostB_tputrace"]["data"]["x"][0]
     assert xb - xa == pytest.approx(5.0)
     assert doc["meta"]["cluster_hosts"] == list(skews)
     assert os.path.isfile(cfg.path("index.html"))  # board staged for viz

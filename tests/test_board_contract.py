@@ -206,6 +206,46 @@ def sink(tmp_path_factory):
     return cfg
 
 
+def test_report_js_columnar_contract(sink):
+    """index.html's data contract: series data is columnar parallel
+    arrays with an interned name table (sofa_board.js pointsFromColumnar
+    decodes exactly this shape), and meta.tiles carries the LOD pyramid
+    manifest the TileLoader navigates."""
+    import json
+
+    text = open(sink.path("report.js")).read()
+    assert text.startswith("sofa_traces = ")
+    doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+    assert doc["series"], "sink analyze emitted no timeline series"
+    for s in doc["series"]:
+        for key in ("name", "title", "color", "kind"):
+            assert key in s
+        data = s["data"]
+        assert isinstance(data, dict), "per-point dicts are the old format"
+        assert len(data["x"]) == len(data["y"]) == len(data["d"]) \
+            == len(data["ni"])
+        assert all(0 <= i < len(data["names"]) for i in data["ni"])
+    tiles = doc["meta"]["tiles"]
+    assert tiles["dir"] == "_tiles"
+    assert isinstance(tiles["series"], dict)
+    for name, ent in tiles["series"].items():
+        # every advertised pyramid must resolve to fetchable tiles
+        assert ent["levels"] >= 1 and ent["x1"] >= ent["x0"]
+        assert os.path.isdir(sink.path("_tiles", ent["path"]))
+
+
+def test_board_js_decodes_tiles_and_columnar():
+    """Static scan: the board must route series data through the columnar
+    decoder and tiles through the fixed-point decoder — a format change
+    here without a decoder change ships a blank timeline."""
+    js = open(os.path.join(BOARD, "sofa_board.js")).read()
+    index = open(os.path.join(BOARD, "index.html")).read()
+    for needed in ("function pointsFromColumnar", "function pointsFromTile",
+                   "class TileLoader", "DecompressionStream"):
+        assert needed in js, f"sofa_board.js lost {needed}"
+    assert "TileLoader" in index and "onViewChange" in index
+
+
 def test_board_csv_contract(sink):
     """Every contracted CSV exists in the sink and carries every column
     the board JS reads — a renamed emitter column fails here."""

@@ -183,6 +183,30 @@ def test_export_perfetto_native_writer_equivalence(tmp_path, capsys,
     assert r.returncode != 0
 
 
+def test_host_threads_matches_row_loop():
+    """The columnar thread-metadata pass stays byte-identical to the
+    drop_duplicates().iterrows() loop it replaced."""
+    from sofa_tpu.export_perfetto import _host_threads
+    from sofa_tpu.trace import make_frame
+
+    sel = make_frame([
+        {"timestamp": 0.1, "tid": 11, "module": "jit_step"},
+        {"timestamp": 0.2, "tid": 11, "module": "other"},   # dup tid
+        {"timestamp": 0.3, "tid": 12, "module": ""},        # empty -> tid N
+        {"timestamp": 0.4, "tid": -5, "module": "neg"},     # mask applies
+        {"timestamp": 0.5, "tid": 2**31 + 7, "module": "wrap"},
+    ])
+
+    def row_loop(sel):
+        threads = {}
+        for _, row in sel.drop_duplicates("tid").iterrows():
+            threads[int(row["tid"]) & 0x7FFFFFFF] = (
+                str(row.get("module")) or f"tid {row['tid']}")
+        return threads
+
+    assert _host_threads(sel) == row_loop(sel)
+
+
 def test_export_perfetto_clamps_nonfinite_times(tmp_path):
     """inf/NaN/huge-finite timestamps must never reach either writer's
     float formatting: nan_to_num BEFORE the 1e6 scale would re-overflow to

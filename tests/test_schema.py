@@ -137,8 +137,28 @@ def test_report_js_contract(tmp_path):
     assert text.startswith("sofa_traces = ")
     doc = json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
     assert doc["series"][0]["name"] == "tpu_ops"
-    assert doc["series"][0]["data"][0]["x"] == 1.0
+    # columnar data contract: parallel arrays + interned name table
+    data = doc["series"][0]["data"]
+    assert data["x"] == [1.0]
+    assert data["y"] == [2.0]
+    assert data["names"][data["ni"][0]] == "fusion.1"
     assert doc["meta"]["elapsed"] == 3.0
+
+
+def test_to_points_matches_columnar():
+    """to_points stays the row-oriented view of the columnar payload."""
+    s = SofaSeries(
+        name="ops", title="ops", color="purple",
+        data=make_frame([
+            {"timestamp": 1.0, "event": 2.0, "name": "a", "duration": 0.5},
+            {"timestamp": 2.0, "event": float("nan"), "name": "b"},
+        ]),
+    )
+    pts = s.to_points()
+    assert pts == [
+        {"x": 1.0, "y": 2.0, "name": "a", "d": 0.5},
+        {"x": 2.0, "y": 0.0, "name": "b", "d": 0.0},  # NaN scrubbed to 0
+    ]
 
 
 def test_packed_ip_round_trip():

@@ -1,0 +1,392 @@
+"""LOD timeline tile pyramid (sofa_tpu/tiles.py) + viz data server tests.
+
+Pyramid invariants the board relies on:
+  * the per-tile min/max envelope contains every raw point in the window;
+  * level N+1 is a refinement of level N (same windows, split in two,
+    same total event counts);
+  * leaf tiles are exact — deepest zoom returns the raw events, lossless;
+  * the build is deterministic under --jobs 1 vs --jobs 4 and content-
+    keyed cached (a re-run over unchanged data rewrites nothing).
+
+Server contract (sofa_tpu/viz.py): ETag/If-None-Match 304s, gzip
+negotiation for the pre-compressed tiles, the /tiles/ route, 503 +
+Retry-After while a writer holds the derived-write sentinel, and the
+port-retry loop.
+"""
+
+import gzip
+import http.client
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from sofa_tpu import tiles
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.trace import SofaSeries, make_frame
+
+N_POINTS = 30000
+
+
+def _series(n=N_POINTS, seed=0, name="tputrace"):
+    rng = np.random.default_rng(seed)
+    df = make_frame({
+        "timestamp": np.sort(rng.uniform(0.0, 10.0, n)),
+        "event": rng.normal(5.0, 2.0, n),
+        "duration": rng.exponential(1e-4, n),
+        "name": [f"op.{i % 50}" for i in range(n)],
+    })
+    return SofaSeries(name, "TPU HLO ops", "darkorchid", df)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tiles")) + "/"
+    cfg = SofaConfig(logdir=d)
+    s = _series()
+    manifest = tiles.build_tiles(cfg, [s])
+    return cfg, s, manifest
+
+
+def _all_tiles(cfg, ent):
+    for level in range(ent["levels"]):
+        for i in range(1 << level):
+            t = tiles.read_tile(cfg.logdir, ent["path"], level, i)
+            if t is not None:
+                yield level, i, t
+
+
+def _sorted_raw(s):
+    df = s.data
+    order = np.argsort(df["timestamp"].to_numpy(), kind="stable")
+    return (df["timestamp"].to_numpy()[order],
+            df["event"].to_numpy()[order],
+            df["name"].astype(str).to_numpy()[order])
+
+
+def test_envelope_contains_every_raw_point(built):
+    cfg, s, manifest = built
+    ent = manifest["series"]["tputrace"]
+    xs, ys, _ = _sorted_raw(s)
+    n_checked = 0
+    for _level, _i, t in _all_tiles(cfg, ent):
+        a, b = np.searchsorted(xs, [t["x0"], t["x1"]], side="left")
+        seg = ys[a:a + t["count"]]
+        assert len(seg) == t["count"]
+        # tile values are rounded at 1e-6 before the envelope is taken
+        assert t["ymin"] <= seg.min() + 1e-5
+        assert t["ymax"] >= seg.max() - 1e-5
+        n_checked += 1
+    assert n_checked == ent["tile_count"]
+
+
+def test_decimated_tile_keeps_per_bucket_extrema(built):
+    """The kept points of a decimated tile trace the same outline as the
+    raw data: every occupied bucket's true min and max y survive."""
+    cfg, s, manifest = built
+    ent = manifest["series"]["tputrace"]
+    t = tiles.read_tile(cfg.logdir, ent["path"], 0, 0)
+    assert not t["exact"] and t["buckets"] > 0
+    xs, ys, _ = _sorted_raw(s)
+    pts = tiles.tile_points(t)
+    width = t["x1"] - t["x0"]
+    raw_b = np.clip(((xs - t["x0"]) / width * t["buckets"]).astype(int),
+                    0, t["buckets"] - 1)
+    kept_b = np.clip(((pts["x"] - t["x0"]) / width * t["buckets"])
+                     .astype(int), 0, t["buckets"] - 1)
+    assert sum(t["density"]) == t["count"] == len(xs)
+    for b in range(t["buckets"]):
+        raw = ys[raw_b == b]
+        if raw.size == 0:
+            assert t["density"][b] == 0
+            continue
+        kept = pts["y"][kept_b == b]
+        assert t["density"][b] == raw.size
+        assert kept.size, f"bucket {b} lost all its points"
+        assert kept.min() == pytest.approx(raw.min(), abs=1e-5)
+        assert kept.max() == pytest.approx(raw.max(), abs=1e-5)
+
+
+def test_levels_refine(built):
+    """Tile (L, i) covers exactly tiles (L+1, 2i) and (L+1, 2i+1): same
+    window, same total event count; leaf level partitions the series."""
+    cfg, s, manifest = built
+    ent = manifest["series"]["tputrace"]
+    for level in range(ent["levels"] - 1):
+        for i in range(1 << level):
+            t = tiles.read_tile(cfg.logdir, ent["path"], level, i)
+            if t is None:
+                continue
+            kids = [tiles.read_tile(cfg.logdir, ent["path"], level + 1, k)
+                    for k in (2 * i, 2 * i + 1)]
+            assert t["count"] == sum(k["count"] for k in kids if k)
+            present = [k for k in kids if k]
+            assert present[0]["x0"] == pytest.approx(t["x0"]) \
+                or kids[0] is None
+            assert present[-1]["x1"] == pytest.approx(t["x1"]) \
+                or kids[1] is None
+    leaf = ent["levels"] - 1
+    total = sum(t["count"] for lv, _i, t in _all_tiles(cfg, ent)
+                if lv == leaf)
+    assert total == ent["count"] == N_POINTS
+
+
+def test_deepest_zoom_is_exact(built):
+    """Leaf tiles carry the raw events for their window — x, y, duration
+    and names round-trip with no downsampling loss."""
+    cfg, s, manifest = built
+    ent = manifest["series"]["tputrace"]
+    xs, ys, names = _sorted_raw(s)
+    leaf = ent["levels"] - 1
+    got_x, got_y, got_names = [], [], []
+    for _lv, _i, t in ((lv, i, t) for lv, i, t in _all_tiles(cfg, ent)
+                       if lv == leaf):
+        assert t["exact"]
+        pts = tiles.tile_points(t)
+        got_x.extend(pts["x"])
+        got_y.extend(pts["y"])
+        got_names.extend(pts["name"])
+    assert len(got_x) == len(xs)
+    np.testing.assert_allclose(got_x, xs, atol=1e-6)
+    np.testing.assert_allclose(got_y, ys, atol=1e-5)
+    assert got_names == list(names)
+
+
+def test_build_deterministic_jobs_1_vs_4(tmp_path):
+    """--jobs must not leak into tile bytes: identical trees, bit for bit
+    (gzip mtime pinned, stable decimation, deterministic interning)."""
+    trees = {}
+    for jobs in (1, 4):
+        d = str(tmp_path / f"j{jobs}") + "/"
+        cfg = SofaConfig(logdir=d, jobs=jobs)
+        tiles.build_tiles(cfg, [_series(), _series(7000 + 8000, seed=3,
+                                                   name="cputrace")],
+                          jobs=jobs)
+        tree = {}
+        root = cfg.path(tiles.TILES_DIR_NAME)
+        for base, _dirs, files in os.walk(root):
+            for f in files:
+                p = os.path.join(base, f)
+                with open(p, "rb") as fh:
+                    tree[os.path.relpath(p, root)] = fh.read()
+        trees[jobs] = tree
+    assert set(trees[1]) == set(trees[4])
+    diff = [k for k in trees[1] if trees[1][k] != trees[4][k]]
+    assert not diff, f"jobs-dependent tile bytes: {diff}"
+
+
+def test_warm_rebuild_is_content_keyed_noop(built):
+    cfg, s, manifest = built
+    ent = manifest["series"]["tputrace"]
+    tile0 = os.path.join(cfg.path(tiles.TILES_DIR_NAME), ent["path"],
+                         "0", "0.json.gz")
+    before = os.stat(tile0).st_mtime_ns
+    manifest2 = tiles.build_tiles(cfg, [s])
+    assert manifest2 == manifest
+    assert os.stat(tile0).st_mtime_ns == before, "warm build rewrote tiles"
+    # data change -> key miss -> rebuild
+    s2 = _series(seed=9)
+    manifest3 = tiles.build_tiles(cfg, [s2])
+    assert os.stat(tile0).st_mtime_ns != before
+
+
+def test_small_series_has_no_pyramid(tmp_path):
+    d = str(tmp_path / "small") + "/"
+    cfg = SofaConfig(logdir=d)
+    manifest = tiles.build_tiles(cfg, [_series(n=500)])
+    assert manifest["series"] == {}  # the overview is already exact
+
+
+def test_tile_levels_cap_keeps_leaves_exact(tmp_path):
+    d = str(tmp_path / "cap") + "/"
+    cfg = SofaConfig(logdir=d, tile_levels=2)
+    manifest = tiles.build_tiles(cfg, [_series()])
+    ent = manifest["series"]["tputrace"]
+    assert ent["levels"] == 2
+    leaf_counts = 0
+    for i in range(2):
+        t = tiles.read_tile(d, ent["path"], 1, i)
+        assert t["exact"], "capped pyramids must still bottom out exact"
+        leaf_counts += t["count"]
+    assert leaf_counts == N_POINTS
+
+
+def test_series_dir_name_sanitizes_user_keywords():
+    assert os.sep not in tiles.series_dir_name("tpu_a/b")
+    assert tiles.series_dir_name("tpu_a/b") != tiles.series_dir_name("tpu_a_b")
+    assert not tiles.series_dir_name("../evil").startswith(".")
+    assert tiles.series_dir_name("cputrace") == "cputrace"
+
+
+def test_derived_writing_sentinel(tmp_path):
+    from sofa_tpu.trace import derived_write_guard, derived_writing
+
+    d = str(tmp_path)
+    assert not derived_writing(d)
+    with derived_write_guard(d):
+        assert derived_writing(d)
+    assert not derived_writing(d)
+    # a sentinel left by a dead writer must not wedge the server forever
+    with open(os.path.join(d, "_derived.writing"), "w") as f:
+        f.write("999999999")
+    assert not derived_writing(d)
+    # a torn sentinel (no pid yet) still reads as mid-write
+    with open(os.path.join(d, "_derived.writing"), "w") as f:
+        f.write("")
+    assert derived_writing(d)
+
+
+# --------------------------------------------------------------------------
+# viz server
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    from sofa_tpu.preprocess import build_series
+    from sofa_tpu.trace import series_to_report_js
+    from sofa_tpu.viz import sofa_viz
+
+    d = str(tmp_path_factory.mktemp("served")) + "/"
+    cfg = SofaConfig(logdir=d, viz_port=8941)
+    s = _series()
+    manifest = tiles.build_tiles(cfg, [s])
+    series_to_report_js([s], cfg.path("report.js"),
+                        cfg.viz_downsample_to, {"tiles": manifest})
+    with open(cfg.path("index.html"), "w") as f:
+        f.write("<html>board</html>")
+    httpd = sofa_viz(cfg, serve_forever=False)
+    assert httpd is not None
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield cfg, httpd, manifest
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _get(httpd, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      httpd.server_address[1], timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_server_etag_304(served):
+    cfg, httpd, _ = served
+    status, headers, body = _get(httpd, "/report.js")
+    assert status == 200 and body.startswith(b"sofa_traces = ")
+    assert headers.get("Cache-Control") == "no-cache"
+    etag = headers["ETag"]
+    status2, headers2, body2 = _get(httpd, "/report.js",
+                                    {"If-None-Match": etag})
+    assert status2 == 304 and body2 == b""
+    assert headers2["ETag"] == etag
+
+
+def test_server_tile_gzip_negotiation(served):
+    cfg, httpd, manifest = served
+    ent = manifest["series"]["tputrace"]
+    url = f"/tiles/{ent['path']}/0/0.json.gz"
+    status, headers, gz_body = _get(httpd, url,
+                                    {"Accept-Encoding": "gzip"})
+    assert status == 200
+    assert headers.get("Content-Encoding") == "gzip"
+    assert headers.get("Content-Type") == "application/json"
+    assert "max-age" in headers.get("Cache-Control", "")
+    doc = json.loads(gzip.decompress(gz_body))
+    assert doc["count"] == N_POINTS
+    # a client without gzip gets the decompressed bytes, same document
+    status2, headers2, plain = _get(httpd, url)
+    assert status2 == 200 and headers2.get("Content-Encoding") is None
+    assert plain == gzip.decompress(gz_body)
+    # the suffixless spelling negotiates the precompressed sibling
+    status3, headers3, body3 = _get(
+        httpd, f"/_tiles/{ent['path']}/0/0.json",
+        {"Accept-Encoding": "gzip"})
+    assert status3 == 200 and headers3.get("Content-Encoding") == "gzip"
+    assert body3 == gz_body
+
+
+def test_server_sparse_tile_404(served):
+    cfg, httpd, manifest = served
+    ent = manifest["series"]["tputrace"]
+    status, _h, _b = _get(httpd, f"/tiles/{ent['path']}/0/999.json.gz")
+    assert status == 404
+
+
+def test_server_503_while_mid_write(served):
+    from sofa_tpu.trace import derived_write_guard
+
+    cfg, httpd, manifest = served
+    ent = manifest["series"]["tputrace"]
+    with derived_write_guard(cfg.logdir):
+        for path in ("/report.js",
+                     f"/tiles/{ent['path']}/0/0.json.gz"):
+            status, headers, _b = _get(httpd, path)
+            assert status == 503, path
+            assert headers.get("Retry-After") == "1"
+        # board chrome keeps serving: only data can be torn mid-write
+        status, _h, body = _get(httpd, "/index.html")
+        assert status == 200 and b"board" in body
+    status, _h, _b = _get(httpd, "/report.js")
+    assert status == 200
+
+
+def test_server_port_retry(served):
+    from sofa_tpu.viz import sofa_viz
+
+    cfg, httpd, _ = served
+    second = sofa_viz(cfg, serve_forever=False)
+    assert second is not None
+    try:
+        assert second.server_address[1] != httpd.server_address[1]
+    finally:
+        second.server_close()
+
+
+def test_preprocess_report_carries_tiles_manifest(tmp_path):
+    """End to end: preprocess over raw files emits columnar report.js
+    whose meta.tiles names every pyramid series, the manifest records the
+    tiles stage, and `sofa clean` removes the pyramid."""
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.record import sofa_clean
+    from sofa_tpu.telemetry import load_manifest
+
+    d = str(tmp_path / "log") + "/"
+    os.makedirs(d)
+    with open(d + "sofa_time.txt", "w") as f:
+        f.write("1700000000.0\n")
+    n = 25000
+    with open(d + "pystacks.txt", "w") as f:
+        f.write("".join(
+            f"{1700000000.0 + i * 2.5 / n:.6f} {1 + i % 8} "
+            f"main;train;step_{i % 50}\n" for i in range(n)))
+    cfg = SofaConfig(logdir=d)
+    sofa_preprocess(cfg)
+    doc = json.loads(open(d + "report.js").read()
+                     [len("sofa_traces = "):].rstrip(";\n"))
+    tm = doc["meta"]["tiles"]
+    assert "pystacks" in tm["series"]
+    assert os.path.isdir(d + "_tiles/pystacks")
+    man = load_manifest(d)
+    assert any(s["name"] == "tiles" and s["verb"] == "preprocess"
+               for s in man["stages"])
+    meta = man["meta"]["tiles"]
+    assert meta["series"] == 1 and meta["tile_count"] >= 1
+    # --no_tiles skips the build
+    d2 = str(tmp_path / "log2") + "/"
+    os.makedirs(d2)
+    with open(d2 + "pystacks.txt", "w") as f:
+        f.write(open(d + "pystacks.txt").read())
+    sofa_preprocess(SofaConfig(logdir=d2, enable_tiles=False))
+    doc2 = json.loads(open(d2 + "report.js").read()
+                      [len("sofa_traces = "):].rstrip(";\n"))
+    assert "tiles" not in doc2["meta"]
+    assert not os.path.isdir(d2 + "_tiles")
+    # sofa clean removes the pyramid
+    sofa_clean(cfg)
+    assert not os.path.isdir(d + "_tiles")

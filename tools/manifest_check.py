@@ -133,6 +133,23 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 not isinstance(ent["quarantined_file"], str):
             probs.append(f"{where}.quarantined_file: not a string")
 
+    # meta.tiles (additive, written when the LOD tile pyramid builds —
+    # sofa_tpu/tiles.py): counts and bytes must be sane when present.
+    tiles = (doc.get("meta") or {}).get("tiles")
+    if tiles is not None:
+        if not isinstance(tiles, dict):
+            probs.append("meta.tiles: not an object")
+        else:
+            for key in ("series", "cached", "tile_count", "bytes"):
+                v = tiles.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.tiles.{key}: missing or not a "
+                                 "non-negative int")
+            if isinstance(tiles.get("cached"), int) and \
+                    isinstance(tiles.get("series"), int) and \
+                    tiles["cached"] > tiles["series"]:
+                probs.append("meta.tiles: cached exceeds series")
+
     stages = doc.get("stages", [])
     if not isinstance(stages, list):
         probs.append("stages: not a list")

@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""Viz-path benchmark: report.js payload, tile-pyramid build, board load.
+
+The evidence harness behind the O(pixels) viz contract (docs/ANALYSIS.md
+"Timeline tiles & viz serving"): generates the pod_synth ``--raw`` logdir,
+runs a cold + warm ``sofa preprocess``, and measures
+
+  * ``report_js_bytes``          the columnar overview payload on disk
+  * ``report_js_legacy_bytes``   the same series re-serialized the old way
+                                 (per-point dicts) — the shrink factor
+  * ``tile_build_wall_time_s``   the tiles stage from run_manifest.json
+  * ``tile_warm_wall_time_s``    same stage on the warm (content-keyed
+                                 cached) re-run — should be ~free
+  * ``tile_count`` / ``tile_bytes``  pyramid volume
+  * ``cold_board_load_bytes``    bytes a browser fetches before first
+                                 paint (board chrome + report.js)
+  * ``deepest_tile_gz_bytes``    a deepest-level exact tile served gzipped
+                                 over the real viz server (the <= 64 KiB
+                                 deep-zoom response contract)
+
+    python tools/viz_bench.py [workdir]
+
+bench.py folds report_js_bytes / tile_build_wall_time_s into its secondary
+evidence on both the success and dead-tunnel paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _board_bytes(logdir: str) -> int:
+    """Bytes fetched before first paint: index.html + board JS/CSS +
+    report.js (detail pages and tiles load lazily)."""
+    total = 0
+    for name in ("index.html", "sofa_board.js", "style.css", "report.js"):
+        try:
+            total += os.path.getsize(os.path.join(logdir, name))
+        except OSError:
+            pass
+    return total
+
+
+def _legacy_report_bytes(logdir: str) -> int:
+    """Size report.js would have in the pre-tile monolithic format
+    (per-point dicts) — same series, same downsampling, old encoding."""
+    with open(os.path.join(logdir, "report.js")) as f:
+        doc = json.loads(f.read()[len("sofa_traces = "):].rstrip(";\n"))
+    legacy_series = []
+    for s in doc.get("series", []):
+        data = s["data"]
+        table = data["names"]
+        pts = [{"x": x, "y": y, "name": table[i], "d": d}
+               for x, y, i, d in zip(data["x"], data["y"],
+                                     data["ni"], data["d"])]
+        legacy_series.append({**s, "data": pts})
+    meta = {k: v for k, v in (doc.get("meta") or {}).items() if k != "tiles"}
+    return len("sofa_traces = ;\n") + len(json.dumps(
+        {"series": legacy_series, "meta": meta}))
+
+
+def _tiles_stage(logdir: str) -> dict:
+    from sofa_tpu.telemetry import load_manifest
+
+    doc = load_manifest(logdir) or {}
+    stage = next((s for s in doc.get("stages", [])
+                  if s.get("verb") == "preprocess"
+                  and s.get("name") == "tiles"), {})
+    return {"dur_s": stage.get("dur_s"),
+            "tiles": (doc.get("meta") or {}).get("tiles") or {}}
+
+
+def _deepest_tile_over_http(cfg) -> "tuple[int, bool]":
+    """(gzipped response bytes, exact?) for a deepest-level tile of the
+    largest series, fetched from the real viz server with gzip accepted."""
+    import gzip
+    import http.client
+    import threading
+
+    from sofa_tpu.viz import sofa_viz
+
+    with open(cfg.path("report.js")) as f:
+        doc = json.loads(f.read()[len("sofa_traces = "):].rstrip(";\n"))
+    tiles_meta = (doc.get("meta") or {}).get("tiles") or {}
+    series = tiles_meta.get("series") or {}
+    if not series:
+        return 0, False
+    name, ent = max(series.items(), key=lambda kv: kv[1].get("count", 0))
+    level = ent["levels"] - 1
+    httpd = sofa_viz(cfg, serve_forever=False)
+    if httpd is None:
+        return 0, False
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        port = httpd.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        # the first non-empty tile at the deepest level
+        for n in range(1 << level):
+            conn.request("GET", f"/tiles/{ent['path']}/{level}/{n}.json.gz",
+                         headers={"Accept-Encoding": "gzip"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status == 200:
+                tile = json.loads(gzip.decompress(body))
+                return len(body), bool(tile.get("exact"))
+        return 0, False
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def run(workdir: "str | None" = None) -> dict:
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cleanup = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="sofa_vizbench_")
+    logdir = os.path.join(workdir, "podlog", "")
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "pod_synth.py"),
+             logdir, "--raw"],
+            check=True, timeout=300, capture_output=True)
+        cfg = SofaConfig(logdir=logdir)
+        t0 = time.perf_counter()
+        sofa_preprocess(cfg)
+        cold = time.perf_counter() - t0
+        cold_stage = _tiles_stage(logdir)
+        out = {
+            "preprocess_wall_time_s": round(cold, 3),
+            "report_js_bytes": os.path.getsize(cfg.path("report.js")),
+            "report_js_legacy_bytes": _legacy_report_bytes(logdir),
+            "tile_build_wall_time_s": cold_stage["dur_s"],
+            "tile_count": cold_stage["tiles"].get("tile_count"),
+            "tile_bytes": cold_stage["tiles"].get("bytes"),
+        }
+        t0 = time.perf_counter()
+        sofa_preprocess(cfg)
+        out["preprocess_warm_wall_time_s"] = round(
+            time.perf_counter() - t0, 3)
+        warm_stage = _tiles_stage(logdir)
+        out["tile_warm_wall_time_s"] = warm_stage["dur_s"]
+        out["tile_warm_cached"] = warm_stage["tiles"].get("cached")
+        from sofa_tpu.analyze import stage_board
+
+        stage_board(cfg)
+        out["cold_board_load_bytes"] = _board_bytes(logdir)
+        gz_bytes, exact = _deepest_tile_over_http(cfg)
+        out["deepest_tile_gz_bytes"] = gz_bytes
+        out["deepest_tile_exact"] = exact
+        return out
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    out = run(args[0] if args else None)
+    shrink = (out["report_js_legacy_bytes"] / out["report_js_bytes"]
+              if out.get("report_js_bytes") else 0.0)
+    print(f"report.js (columnar)     {out['report_js_bytes']:>12,} B")
+    print(f"report.js (legacy dicts) {out['report_js_legacy_bytes']:>12,} B"
+          f"  ({shrink:.2f}x shrink)")
+    print(f"cold board load          {out['cold_board_load_bytes']:>12,} B")
+    print(f"tile pyramid             {out['tile_count']} tiles, "
+          f"{(out['tile_bytes'] or 0):,} B")
+    print(f"tile build (cold)        {out['tile_build_wall_time_s']}s of "
+          f"{out['preprocess_wall_time_s']}s preprocess")
+    print(f"tile build (warm)        {out['tile_warm_wall_time_s']}s "
+          f"({out['tile_warm_cached']} series cached)")
+    print(f"deepest tile over HTTP   {out['deepest_tile_gz_bytes']:,} B "
+          f"gzipped, exact={out['deepest_tile_exact']}")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
