@@ -343,6 +343,27 @@ try:
         out["tile_build_wall_time_s"] = stage.get("dur_s")
 except Exception as e:
     out["viz_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# durability evidence (sofa_tpu/durability.py): fsck over the healthy
+# logdir, then drop the preprocess commit marker (a crash one instruction
+# before the commit) and time `sofa resume` — the number proves committed
+# work is served warm from the content-keyed caches on replay.
+try:
+    from sofa_tpu import durability
+    out["fsck_ok"] = durability.sofa_fsck(cfg) == 0
+    jpath = cfg.path(durability.JOURNAL_NAME)
+    with open(jpath) as f:
+        lines = [ln for ln in f.read().splitlines()
+                 if '"commit"' not in ln or '"preprocess"' not in ln]
+    with open(jpath, "w") as f:
+        f.write("\\n".join(lines) + "\\n")
+    t0 = time.perf_counter()
+    rc = durability.sofa_resume(cfg)
+    if rc == 0:
+        out["resume_wall_time_s"] = round(time.perf_counter() - t0, 3)
+    else:
+        out["durability_evidence_error"] = f"resume rc={{rc}}"
+except Exception as e:
+    out["durability_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 print(json.dumps(out))
 """.format(root=root, logdir=logdir)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -363,15 +384,21 @@ print(json.dumps(out))
         out = {"preprocess_wall_time_s": doc["cold"],
                "preprocess_warm_wall_time_s": doc["warm"]}
         # Viz-path secondary evidence (tools/viz_bench.py measures the
-        # full picture; these two ride every bench round): report.js
-        # payload bytes + LOD tile-pyramid build wall time.
+        # full picture; these ride every bench round): report.js payload
+        # bytes + LOD tile-pyramid build wall time, plus the durability
+        # pair — fsck over the healthy logdir and the crash-replay
+        # `sofa resume` wall time (sofa_tpu/durability.py).
         for key in ("report_js_bytes", "tile_build_wall_time_s",
-                    "viz_evidence_error"):
+                    "viz_evidence_error", "fsck_ok", "resume_wall_time_s",
+                    "durability_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
             _log(f"bench: report.js {out['report_js_bytes']} B, "
                  f"tile build {out.get('tile_build_wall_time_s')}s")
+        if "fsck_ok" in out:
+            _log(f"bench: fsck_ok={out['fsck_ok']}, resume wall "
+                 f"{out.get('resume_wall_time_s')}s (crash-replay)")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.

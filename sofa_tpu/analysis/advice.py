@@ -66,7 +66,9 @@ def mesh_advice(frames, cfg, features: Features) -> None:
         )
     hints_dir = cfg.path("sofa_hints")
     os.makedirs(hints_dir, exist_ok=True)
-    with open(os.path.join(hints_dir, "mesh_advice.txt"), "w") as f:
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(os.path.join(hints_dir, "mesh_advice.txt")) as f:
         f.write("\n".join(lines) + "\n")
     features.add_info("mesh_advice", f"{hints_dir}/mesh_advice.txt")
 
@@ -236,5 +238,7 @@ def hint_report(features: Features, cfg) -> None:
     for h in hints:
         print_hint(h)
     if hints:
-        with open(cfg.path("hints.txt"), "w") as f:
+        from sofa_tpu.durability import atomic_write
+
+        with atomic_write(cfg.path("hints.txt")) as f:
             f.write("\n".join(hints) + "\n")

@@ -189,9 +189,13 @@ def load_cluster_frames(cfg: SofaConfig,
 
 
 def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None) -> Features:
-    from sofa_tpu import telemetry
+    from sofa_tpu import durability, telemetry
+    from sofa_tpu.trace import reap_stale_sentinel
 
+    reap_stale_sentinel(cfg.logdir)
     tel = telemetry.begin("analyze")
+    journal = durability.Journal(cfg.logdir)
+    journal.begin("analyze", key=durability.logdir_raw_key(cfg.logdir))
     ok = False
     try:
         features = _analyze_body(cfg, frames, tel)
@@ -199,6 +203,12 @@ def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None)
         return features
     finally:
         tel.write(cfg.logdir, rc=0 if ok else 1, cfg=cfg)
+        if ok:
+            # analyze rewrote report.js (merged series) and added its own
+            # artifacts: refresh the integrity ledger, then commit.
+            durability.write_digests(cfg.logdir)
+            journal.commit("analyze",
+                           key=durability.logdir_raw_key(cfg.logdir))
         telemetry.end(tel)
 
 

@@ -18,6 +18,13 @@ CLI (/root/reference/bin/sofa:328-376):
   lint              AST invariant checker for sofa_tpu's own contracts
                     (sofa_tpu/lint/, docs/STATIC_ANALYSIS.md); exits 1 on
                     findings not grandfathered in lint_baseline.json
+  resume            replay the crash journal's uncommitted suffix after a
+                    killed verb (sofa_tpu/durability.py): committed work
+                    is served from the content-keyed caches, the rest
+                    re-runs
+  fsck              verify the logdir's sha256 integrity ledger; --repair
+                    invalidates poisoned cache/tile entries and re-derives
+                    (exit 0 healthy / 1 damage / 2 no ledger)
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -57,11 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "export", "top", "status", "lint", "clean", "setup",
+        "export", "top", "status", "lint", "clean", "setup", "resume",
+        "fsck",
     ])
     p.add_argument("usr_command", nargs="?", default="",
-                   help="command to profile (record/stat); logdir (status); "
-                        "path to lint (lint)")
+                   help="command to profile (record/stat); logdir "
+                        "(status/resume/fsck); path to lint (lint)")
 
     g = p.add_argument_group("pipeline")
     g.add_argument("--logdir")
@@ -133,6 +141,16 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--collector_harvest_timeout_s", type=float,
                    help="per-collector harvest deadline in seconds "
                         "(default 120; 0 = unbounded)")
+    g.add_argument("--disk_budget", type=float, dest="disk_budget_mb",
+                   help="total raw-output disk budget in MB across all "
+                        "collectors: the supervisor rotates oldest output "
+                        "files (or truncates the worst offender, manifest "
+                        "status truncated_by_budget) instead of letting an "
+                        "unbounded collector fill the disk (0 = unlimited)")
+    g.add_argument("--collector_disk_budget", type=float,
+                   dest="collector_disk_budget_mb",
+                   help="per-collector raw-output disk budget in MB "
+                        "(0 = unlimited)")
 
     g = p.add_argument_group("preprocess")
     g.add_argument("--cpu_time_offset_ms", type=int)
@@ -169,6 +187,11 @@ def build_parser() -> argparse.ArgumentParser:
     g = p.add_argument_group("diff")
     g.add_argument("--base_logdir")
     g.add_argument("--match_logdir")
+
+    g = p.add_argument_group("fsck")
+    g.add_argument("--repair", action="store_true", default=False,
+                   help="fsck: invalidate the poisoned cache/tile entries, "
+                        "sweep orphans, and re-derive damaged artifacts")
 
     g = p.add_argument_group("viz")
     g.add_argument("--viz_port", type=int)
@@ -211,7 +234,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "xprof_host_tracer_level", "xprof_python_tracer", "xprof_delay_s",
         "xprof_duration_s", "tpu_mon_rate", "epilogue_deadline_s",
         "inject_faults", "collector_restarts", "collector_stop_timeout_s",
-        "collector_harvest_timeout_s",
+        "collector_harvest_timeout_s", "disk_budget_mb",
+        "collector_disk_budget_mb",
         "cpu_time_offset_ms", "tpu_time_offset_ms", "viz_downsample_to",
         "tile_levels", "trace_format",
         "num_iterations", "num_swarms", "enable_aisi", "enable_hsg",
@@ -404,14 +428,22 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
-        if cmd == "status":
-            from sofa_tpu.telemetry import sofa_status
+        if cmd in ("status", "resume", "fsck"):
             if args.usr_command and "logdir" not in vars(args):
                 # `sofa status sofalog/` reads more naturally than
-                # --logdir for a read-only verb; an explicit flag wins.
+                # --logdir for a logdir-only verb; an explicit flag wins.
                 cfg.logdir = args.usr_command
                 cfg.__post_init__()
-            return sofa_status(cfg)
+            if cmd == "status":
+                from sofa_tpu.telemetry import sofa_status
+                return sofa_status(cfg)
+            if cmd == "resume":
+                from sofa_tpu.durability import sofa_resume
+                print_main_progress("SOFA resume")
+                return sofa_resume(cfg)
+            from sofa_tpu.durability import sofa_fsck
+            print_main_progress("SOFA fsck")
+            return sofa_fsck(cfg, repair=args.repair)
         if cmd == "lint":
             from sofa_tpu.lint.cli import run_lint
             # lint is config-free: the positional argument is a path, and

@@ -125,6 +125,14 @@ class SofaConfig:
     collector_harvest_timeout_s: float = 120.0
                                      # per-collector harvest deadline
                                      # (0 = unbounded)
+    disk_budget_mb: float = 0.0      # --disk_budget: total raw-output cap
+                                     # in MB across all watched collectors;
+                                     # the supervisor rotates oldest files /
+                                     # truncates the worst offender instead
+                                     # of letting record ENOSPC (0 = off)
+    collector_disk_budget_mb: float = 0.0
+                                     # --collector_disk_budget: per-collector
+                                     # raw-output cap in MB (0 = off)
 
     # --- preprocess --------------------------------------------------------
     cpu_time_offset_ms: int = 0      # manual host-clock fudge (bin/sofa:111)

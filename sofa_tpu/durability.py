@@ -1,0 +1,644 @@
+"""The logdir durability layer: crash journal, digests, `resume`, `fsck`.
+
+PR 3 made the pipeline survive *collector* failures; this module makes it
+survive the death of **sofa itself** and of its storage.  Three pieces:
+
+**Run journal** (``<logdir>/_journal.jsonl``) — an append-only, fsync'd
+ledger in which every pipeline verb logs a ``begin`` marker when it starts
+and a ``commit`` marker when ALL of its artifacts (including digests) are
+on disk.  Appends are one JSON line each, flushed and fsync'd before the
+verb proceeds, so a SIGKILL at any instant leaves at worst one torn final
+line — which the reader ignores.  When the journal grows past
+``JOURNAL_COMPACT_LINES`` entries it is checkpointed: the latest begin +
+commit per stage are rewritten through the same tmp+rename path as every
+other derived artifact.  ``sofa resume`` replays exactly the uncommitted
+suffix: a stage that begun but never committed (or whose committed content
+key no longer matches the raw files) re-runs, and everything the
+content-keyed ingest cache (ingest/cache.py) and tile index (tiles.py)
+already hold is reused — committed work is never redone.
+
+**Digests** (``<logdir>/_digests.json`` + the ``digests`` key of
+run_manifest.json) — a sha256 ledger over every raw and derived artifact,
+refreshed at the end of each verb.  ``sofa fsck`` verifies it and
+classifies damage:
+
+  ``missing``   digested file no longer on disk
+  ``corrupt``   bytes changed with size+mtime intact (silent rot), or any
+                derived artifact whose content stopped matching the ledger
+                (the pipeline always refreshes digests after writing, so an
+                unexplained derived change IS damage)
+  ``stale``     a raw file modified after the ledger was written — the
+                derived artifacts no longer describe it
+  ``orphaned``  ``*.tmp`` leftovers of interrupted tmp+rename writes, and
+                tile files no digest ledger covers
+
+``sofa fsck --repair`` invalidates exactly the poisoned state (the damaged
+raw file's ingest-cache entry, the damaged tile series' pyramid), sweeps
+orphans, re-derives, and re-records digests.
+
+**Atomic writes** — :func:`atomic_write` / :func:`atomic_replace` are THE
+way derived artifacts reach disk (write ``<path>.tmp``, flush, optionally
+fsync, ``os.replace``): a reader — or a crash — can never observe a torn
+derived file.  sofa-lint rule SL009 enforces this for every derived-file
+producer.
+
+Exit codes: ``sofa fsck`` 0 healthy / 1 damage found (typed verdicts
+printed) / 2 no digest ledger to check against; ``sofa resume`` 0 replayed
+(or nothing to do) / nonzero when the replayed verbs fail.
+See docs/ROBUSTNESS.md "Durability".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+JOURNAL_NAME = "_journal.jsonl"
+DIGESTS_NAME = "_digests.json"
+DIGESTS_SCHEMA = "sofa_tpu/digests"
+DIGESTS_VERSION = 1
+
+# Journal entries past this count trigger a tmp+rename checkpoint that
+# keeps only the newest begin/commit per stage.
+JOURNAL_COMPACT_LINES = 512
+
+_HASH_CHUNK = 1 << 20
+
+# fsck verdict vocabulary, in rendering order.
+FSCK_VERDICTS = ("missing", "corrupt", "stale", "orphaned")
+
+
+# ---------------------------------------------------------------------------
+# Atomic write helpers — the SL009 contract.
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "w", fsync: bool = False,
+                 **open_kw):
+    """Open ``<path>.tmp`` for writing and rename it over ``path`` on a
+    clean exit; on any exception the tmp file is removed and ``path`` is
+    untouched.  ``fsync=True`` additionally fsyncs before the rename
+    (checkpoint files whose loss changes recovery behavior want it; bulk
+    artifacts like tiles do not — their commit point is an index written
+    through here WITH fsync)."""
+    tmp = path + ".tmp"
+    f = open(tmp, mode, **open_kw)
+    try:
+        yield f
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            f.close()
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise
+
+
+@contextlib.contextmanager
+def atomic_replace(path: str):
+    """Yield a ``<path>.tmp`` pathname for writers that need their own
+    opener (gzip streams, pandas ``to_*``); renames over ``path`` on a
+    clean exit, removes the tmp on failure."""
+    tmp = path + ".tmp"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# ---------------------------------------------------------------------------
+# The run journal.
+# ---------------------------------------------------------------------------
+
+class Journal:
+    """Append-only begin/commit ledger for one logdir.
+
+    Best-effort by contract, like telemetry: an unwritable logdir degrades
+    to a warning (once) — the journal must never be able to fail the
+    pipeline it protects."""
+
+    def __init__(self, logdir: str):
+        self.path = os.path.join(logdir, JOURNAL_NAME)
+        self._warned = False
+
+    def begin(self, stage: str, **fields) -> None:
+        self._append({"ev": "begin", "stage": stage, **fields})
+
+    def commit(self, stage: str, **fields) -> None:
+        self._append({"ev": "commit", "stage": stage, **fields})
+
+    def _append(self, entry: dict) -> None:
+        entry = {**entry, "t": round(time.time(), 3), "pid": os.getpid()}
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._maybe_compact()
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                from sofa_tpu.printing import print_warning
+
+                print_warning(f"journal: cannot write {self.path}: {e} — "
+                              "`sofa resume` will not know about this run")
+
+    def _maybe_compact(self) -> None:
+        """tmp+rename checkpoint once the journal outgrows the cap: keep
+        the newest begin + newest commit per stage (all `sofa resume`
+        consults), drop the history."""
+        entries = read_journal(os.path.dirname(self.path) or ".")
+        if len(entries) <= JOURNAL_COMPACT_LINES:
+            return
+        keep: Dict[tuple, dict] = {}
+        for e in entries:
+            keep[(e.get("stage"), e.get("ev"))] = e
+        kept = sorted(keep.values(), key=lambda e: e.get("t", 0))
+        with atomic_write(self.path, fsync=True) as f:
+            for e in kept:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+
+
+def read_journal(logdir: str) -> List[dict]:
+    """Parse the journal; a torn final line (the crash case fsync'd
+    appends are designed around) — or any unparsable line — is skipped."""
+    path = os.path.join(logdir, JOURNAL_NAME)
+    entries: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a mid-append crash
+                if isinstance(e, dict):
+                    entries.append(e)
+    except OSError:
+        return []
+    return entries
+
+
+def journal_state(entries: List[dict]) -> Dict[str, dict]:
+    """{stage: {"committed": bool, "key": ..., "begin_t": ..., ...}} from
+    the latest begin/commit per stage.  A begin newer than the last commit
+    reopens the stage (re-runs journal forward, they never rewind)."""
+    state: Dict[str, dict] = {}
+    for e in entries:
+        stage = e.get("stage")
+        if not isinstance(stage, str):
+            continue
+        st = state.setdefault(stage, {"committed": False, "key": None})
+        if e.get("ev") == "begin":
+            st["committed"] = False
+            st["begin_key"] = e.get("key")
+            st["begin_t"] = e.get("t")
+        elif e.get("ev") == "commit":
+            st["committed"] = True
+            st["key"] = e.get("key")
+            st["rc"] = e.get("rc")
+    return state
+
+
+def logdir_raw_key(logdir: str) -> str:
+    """Content key over the raw collector files — (name, size, mtime_ns)
+    like the ingest cache's per-source keys, aggregated over the logdir.
+    A committed preprocess whose key no longer matches has stale outputs
+    and must replay."""
+    from sofa_tpu.record import RAW_FILES
+
+    sigs: List[tuple] = []
+    for name in RAW_FILES:
+        try:
+            st = os.stat(os.path.join(logdir, name))
+            sigs.append((name, st.st_size, st.st_mtime_ns))
+        except OSError:
+            continue
+    xprof = os.path.join(logdir, "xprof")
+    for root, _dirs, files in os.walk(xprof):
+        for name in sorted(files):
+            p = os.path.join(root, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            sigs.append((os.path.relpath(p, logdir), st.st_size,
+                         st.st_mtime_ns))
+    h = hashlib.sha1()
+    for sig in sorted(sigs):
+        h.update(repr(sig).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Digests.
+# ---------------------------------------------------------------------------
+
+# Never digested: the ledgers themselves (they change on every write,
+# including fsck's own), the journal, live sentinels, and scratch dirs.
+_DIGEST_SKIP_FILES = frozenset({
+    DIGESTS_NAME, JOURNAL_NAME, "run_manifest.json", "sofa_self_trace.json",
+    "_derived.writing", "docker.cid",
+})
+_DIGEST_SKIP_DIRS = frozenset({
+    "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
+})
+
+
+def _sha256(path: str) -> Optional[str]:
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(_HASH_CHUNK)
+                if not chunk:
+                    break
+                h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+def _digest_targets(logdir: str) -> List[str]:
+    """Relative paths of every artifact the integrity ledger covers."""
+    out: List[str] = []
+    for root, dirs, files in os.walk(logdir):
+        rel_root = os.path.relpath(root, logdir)
+        parts = [] if rel_root == "." else rel_root.split(os.sep)
+        if parts and parts[0] in _DIGEST_SKIP_DIRS:
+            dirs[:] = []
+            continue
+        dirs[:] = sorted(d for d in dirs if d not in _DIGEST_SKIP_DIRS)
+        for name in sorted(files):
+            if name in _DIGEST_SKIP_FILES or name.endswith(".tmp"):
+                continue
+            out.append("/".join(parts + [name]) if parts else name)
+    return out
+
+
+def _file_kind(rel: str) -> str:
+    from sofa_tpu.record import RAW_FILES
+
+    if rel in RAW_FILES or rel.startswith("xprof/"):
+        return "raw"
+    return "derived"
+
+
+def compute_digests(logdir: str) -> dict:
+    files: Dict[str, dict] = {}
+    for rel in _digest_targets(logdir):
+        path = os.path.join(logdir, rel)
+        digest = _sha256(path)
+        if digest is None:
+            continue  # vanished mid-scan: next write_digests catches it
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        files[rel] = {
+            "sha256": digest,
+            "bytes": int(st.st_size),
+            "mtime_ns": int(st.st_mtime_ns),
+            "kind": _file_kind(rel),
+        }
+    return {
+        "schema": DIGESTS_SCHEMA,
+        "version": DIGESTS_VERSION,
+        "algo": "sha256",
+        "generated_unix": round(time.time(), 3),
+        "files": files,
+    }
+
+
+def write_digests(logdir: str) -> Optional[dict]:
+    """Refresh the integrity ledger: the ``_digests.json`` sidecar
+    (fsync'd — fsck must work even when the manifest is itself the damaged
+    artifact) plus the manifest's ``digests`` key.  Best-effort, like every
+    telemetry write.  ``SOFA_DIGESTS=0`` opts out."""
+    if os.environ.get("SOFA_DIGESTS", "1") == "0":
+        return None
+    try:
+        doc = compute_digests(logdir)
+        with atomic_write(os.path.join(logdir, DIGESTS_NAME),
+                          fsync=True) as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        attach_digests(logdir, doc)
+        return doc
+    except OSError as e:
+        from sofa_tpu.printing import print_warning
+
+        print_warning(f"digests: cannot write integrity ledger for "
+                      f"{logdir}: {e}")
+        return None
+
+
+def attach_digests(logdir: str, doc: dict) -> None:
+    """Fold a digest ledger into run_manifest.json's ``digests`` key (the
+    sidecar stays the fsync'd authoritative copy)."""
+    _patch_manifest(logdir, digests={
+        "algo": doc["algo"],
+        "generated_unix": doc["generated_unix"],
+        "files": doc["files"],
+    })
+
+
+def load_digests(logdir: str) -> Optional[dict]:
+    """The sidecar, else the manifest's copy, else None."""
+    try:
+        with open(os.path.join(logdir, DIGESTS_NAME)) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and isinstance(doc.get("files"), dict):
+            return doc
+    except (OSError, ValueError):
+        pass
+    from sofa_tpu.telemetry import load_manifest
+
+    manifest = load_manifest(logdir)
+    if manifest and isinstance(manifest.get("digests"), dict) and \
+            isinstance(manifest["digests"].get("files"), dict):
+        return manifest["digests"]
+    return None
+
+
+def _patch_manifest(logdir: str, **top_level) -> None:
+    """Merge keys into run_manifest.json without disturbing the verbs'
+    sections (telemetry owns those); silently a no-op when no manifest
+    exists yet — record writes the first one."""
+    from sofa_tpu import telemetry
+
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        return
+    meta_patch = top_level.pop("meta", None)
+    doc.update(top_level)
+    if meta_patch:
+        doc.setdefault("meta", {}).update(meta_patch)
+    with atomic_write(os.path.join(logdir, telemetry.MANIFEST_NAME)) as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# fsck.
+# ---------------------------------------------------------------------------
+
+# Raw artifact -> the ingest source whose cache entry it poisons (repair
+# invalidates exactly that entry; preprocess._ingest_tasks is the runtime
+# twin of this table).
+_RAW_TO_SOURCE = {
+    "mpstat.txt": "mpstat", "diskstat.txt": "diskstat",
+    "netstat.txt": "netbandwidth", "cpuinfo.txt": "cpuinfo",
+    "vmstat.txt": "vmstat", "perf.data": "cputrace",
+    "perf.script": "cputrace", "kallsyms": "cputrace",
+    "timebase.txt": "cputrace", "strace.txt": "strace",
+    "pystacks.txt": "pystacks", "sofa.pcap": "nettrace",
+    "tpumon.txt": "tpumon", "blktrace.txt": "blktrace",
+}
+
+
+def fsck_scan(logdir: str, digests: "dict | None" = None) -> Optional[dict]:
+    """Verify the integrity ledger.  Returns ``{"checked": n, "ok": [...],
+    "missing": [...], "corrupt": [...], "stale": [...], "orphaned": [...]}``
+    or None when there is no ledger to check against."""
+    if digests is None:
+        digests = load_digests(logdir)
+    if digests is None:
+        return None
+    files = digests.get("files") or {}
+    report: Dict[str, list] = {v: [] for v in FSCK_VERDICTS}
+    report["ok"] = []
+    for rel, ent in sorted(files.items()):
+        path = os.path.join(logdir, rel)
+        if not os.path.isfile(path):
+            report["missing"].append(rel)
+            continue
+        digest = _sha256(path)
+        if digest == ent.get("sha256"):
+            report["ok"].append(rel)
+            continue
+        try:
+            st = os.stat(path)
+            unchanged_meta = (int(st.st_size) == ent.get("bytes")
+                              and int(st.st_mtime_ns) == ent.get("mtime_ns"))
+        except OSError:
+            report["missing"].append(rel)
+            continue
+        if ent.get("kind") == "raw" and not unchanged_meta:
+            # raw file legitimately rewritten after the ledger: the
+            # *derived* artifacts are what went stale
+            report["stale"].append(rel)
+        else:
+            # derived artifacts are only ever rewritten through the
+            # pipeline, which refreshes digests — an unexplained change
+            # is damage; raw bytes changing under an unchanged stat are
+            # silent rot either way
+            report["corrupt"].append(rel)
+    # Orphans: interrupted tmp+rename leftovers + tile files outside the
+    # ledger (a half-built pyramid whose index never landed).
+    for root, dirs, names in os.walk(logdir):
+        rel_root = os.path.relpath(root, logdir)
+        parts = [] if rel_root == "." else rel_root.split(os.sep)
+        if parts and parts[0] in ("_inject", "board", "__pycache__"):
+            dirs[:] = []
+            continue
+        for name in names:
+            rel = "/".join(parts + [name]) if parts else name
+            if name.endswith(".tmp"):
+                report["orphaned"].append(rel)
+            elif parts and parts[0] == "_tiles" and rel not in files:
+                report["orphaned"].append(rel)
+    report["checked"] = len(files)
+    return report
+
+
+def fsck_problem_counts(report: dict) -> Dict[str, int]:
+    return {v: len(report.get(v) or []) for v in FSCK_VERDICTS}
+
+
+def _fsck_repair(cfg, report: dict) -> None:
+    """Invalidate exactly the poisoned state, sweep orphans, re-derive."""
+    import shutil
+
+    from sofa_tpu.ingest.cache import CACHE_DIR_NAME, IngestCache
+    from sofa_tpu.printing import print_progress, print_warning
+    from sofa_tpu.tiles import TILES_DIR_NAME
+
+    logdir = cfg.logdir
+    damaged = (report.get("missing") or []) + (report.get("corrupt") or []) \
+        + (report.get("stale") or [])
+    cache = IngestCache(cfg.path(CACHE_DIR_NAME))
+    raw_damage: List[str] = []
+    tile_series: set = set()
+    for rel in damaged:
+        if rel.startswith("_tiles/"):
+            tile_series.add(rel.split("/")[1])
+            continue
+        src = _RAW_TO_SOURCE.get(rel) or (
+            "xplane" if rel.startswith("xprof/") else None)
+        if src is not None:
+            raw_damage.append(rel)
+            cache.invalidate(src)
+    for series in sorted(tile_series):
+        shutil.rmtree(os.path.join(logdir, TILES_DIR_NAME, series),
+                      ignore_errors=True)
+    for rel in report.get("orphaned") or []:
+        try:
+            os.unlink(os.path.join(logdir, rel))
+        except OSError:
+            pass
+    if raw_damage:
+        print_warning(
+            "fsck: raw artifact damage is not repairable (the bytes are "
+            "the evidence): " + ", ".join(sorted(raw_damage)[:8])
+            + " — their cache entries are invalidated and derived "
+            "artifacts re-derive from what remains")
+    # Re-derive.  preprocess rebuilds frames/report.js/tiles (warm where
+    # the cache/tile keys survived); analyze re-runs only if it had run.
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.telemetry import load_manifest
+
+    frames = sofa_preprocess(cfg)
+    manifest = load_manifest(logdir) or {}
+    if "analyze" in (manifest.get("runs") or {}):
+        from sofa_tpu.analyze import sofa_analyze
+
+        sofa_analyze(cfg, frames=frames)
+    print_progress("fsck: re-derived artifacts and refreshed the "
+                   "integrity ledger")
+
+
+def sofa_fsck(cfg, repair: bool = False) -> int:
+    """``sofa fsck [logdir] [--repair]`` — verify artifact integrity.
+
+    Exit 0 healthy, 1 damage found (typed verdicts printed; with
+    ``--repair`` the poisoned cache/tile entries are invalidated and the
+    artifacts re-derived, then rc reflects the post-repair scan), 2 when
+    there is no digest ledger to check against."""
+    from sofa_tpu.printing import (print_error, print_progress,
+                                   print_warning)
+    from sofa_tpu.trace import reap_stale_sentinel
+
+    if not os.path.isdir(cfg.logdir):
+        print_error(f"logdir {cfg.logdir} does not exist")
+        return 2
+    reap_stale_sentinel(cfg.logdir)
+    report = fsck_scan(cfg.logdir)
+    if report is None:
+        print_error(
+            f"no integrity ledger in {cfg.logdir} — run `sofa preprocess` "
+            "(or `sofa record`) once to create one")
+        return 2
+    counts = fsck_problem_counts(report)
+    n_bad = sum(counts.values())
+    for verdict in FSCK_VERDICTS:
+        for rel in sorted(report.get(verdict) or []):
+            print(f"  {verdict:<9} {rel}")
+    if n_bad and repair:
+        _fsck_repair(cfg, report)
+        report = fsck_scan(cfg.logdir)
+        counts = fsck_problem_counts(report or {})
+        n_bad = sum(counts.values())
+        if report is None:
+            n_bad = 1
+    summary = ", ".join(f"{counts[v]} {v}" for v in FSCK_VERDICTS
+                        if counts.get(v))
+    _patch_manifest(cfg.logdir, meta={"fsck": {
+        "checked_unix": round(time.time(), 3),
+        "ok": n_bad == 0,
+        "checked": int((report or {}).get("checked", 0)),
+        "problems": counts,
+        "repaired": bool(repair),
+    }})
+    if n_bad:
+        print_warning(
+            f"fsck: {(report or {}).get('checked', 0)} artifact(s) "
+            f"checked — {summary}"
+            + ("" if repair else "; `sofa fsck --repair` re-derives"))
+        return 1
+    print_progress(f"fsck: {report.get('checked', 0)} artifact(s) "
+                   f"verified, all healthy")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# resume.
+# ---------------------------------------------------------------------------
+
+def sofa_resume(cfg) -> int:
+    """``sofa resume <logdir>`` — replay the journal's uncommitted suffix.
+
+    Stale ``_derived.writing`` sentinels from the dead writer are reaped
+    first; then any stage that begun without committing (or whose
+    committed content key no longer matches the raw files) re-runs.  The
+    content-keyed ingest cache and tile index make the replay warm:
+    committed work is never redone."""
+    from sofa_tpu.printing import (SofaUserError, print_progress,
+                                   print_warning)
+    from sofa_tpu.trace import reap_stale_sentinel
+
+    if not os.path.isdir(cfg.logdir):
+        raise SofaUserError(
+            f"logdir {cfg.logdir} does not exist — nothing to resume")
+    reap_stale_sentinel(cfg.logdir)
+    entries = read_journal(cfg.logdir)
+    if not entries:
+        raise SofaUserError(
+            f"no {JOURNAL_NAME} in {cfg.logdir} — this logdir predates the "
+            "run journal (or never ran a pipeline verb); use `sofa report` "
+            "instead")
+    state = journal_state(entries)
+    cur_key = logdir_raw_key(cfg.logdir)
+
+    rec = state.get("record")
+    if rec is not None and not rec["committed"]:
+        print_warning(
+            "resume: the recording itself was interrupted — its raw files "
+            "are whatever landed before the crash; resuming preprocess/"
+            "analyze over them (series may end early)")
+
+    pre = state.get("preprocess")
+    need_pre = pre is not None and (
+        not pre["committed"] or pre.get("key") != cur_key)
+    if pre is not None and pre["committed"] and pre.get("key") != cur_key:
+        print_warning("resume: raw files changed since the last committed "
+                      "preprocess — replaying it")
+    an = state.get("analyze")
+    need_an = an is not None and (not an["committed"] or need_pre)
+
+    if not (need_pre or need_an):
+        print_progress("resume: every journaled stage is committed and "
+                       "matches the raw files — nothing to replay")
+        return 0
+
+    frames = None
+    if need_pre:
+        from sofa_tpu.preprocess import sofa_preprocess
+
+        print_progress("resume: replaying preprocess (uncommitted in the "
+                       "journal; cached ingest/tile work is reused)")
+        frames = sofa_preprocess(cfg)
+    if need_an:
+        from sofa_tpu.analyze import sofa_analyze
+
+        print_progress("resume: replaying analyze")
+        sofa_analyze(cfg, frames=frames)
+    print_progress("resume: journal replay complete")
+    return 0

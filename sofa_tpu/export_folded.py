@@ -87,7 +87,9 @@ def _fold_memprof(cfg) -> Counter:
 def _write(counts: Counter, path: str) -> bool:
     if not counts:
         return False
-    with open(path, "w") as f:
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(path) as f:
         for stack, n in counts.most_common():
             f.write(f"{stack} {n}\n")
     return True

@@ -355,7 +355,12 @@ def export_perfetto(cfg, frames: Optional[Dict[str, pd.DataFrame]] = None,
 
     # Pure-Python fallback: streamed write, gzip level 5, batched ~64k
     # strings per f.write (per-event writes were ~15% of the export).
-    with gzip.open(path, "wt", encoding="utf-8", compresslevel=5) as f:
+    # The stream targets a tmp name; atomic_replace renames on success.
+    from sofa_tpu.durability import atomic_replace
+
+    with atomic_replace(path) as tmp_path, \
+            gzip.open(tmp_path, "wt", encoding="utf-8",  # sofa-lint: disable=SL009 — streamed gzip body inside atomic_replace; the rename IS the atomic step
+                      compresslevel=5) as f:
         f.write('{"traceEvents":[')
         batch: List[str] = []
         wrote_any = False

@@ -194,11 +194,11 @@ class IngestCache:
                     stored += os.path.getsize(pk)
             self.stored_bytes[source] = stored
             doc = {"key": key, "frames": sorted(frames), "meta": meta or {}}
-            tmp = self._key_path(source) + ".tmp"
             # Key json LAST — a crash mid-store leaves a stale key that
             # simply mismatches, never a key pointing at missing frames.
-            with open(tmp, "w") as f:
+            from sofa_tpu.durability import atomic_write
+
+            with atomic_write(self._key_path(source), fsync=True) as f:
                 json.dump(doc, f)
-            os.replace(tmp, self._key_path(source))
         except OSError:
             pass

@@ -181,7 +181,12 @@ def write_net_addrs(intern: _AddrIntern, logdir: str) -> Optional[str]:
     if not intern.ids:
         return None
     out = os.path.join(logdir, "net_addrs.csv")
-    with open(out, "w") as f:
+    # Atomic (durability.atomic_write): read_net_addrs degrades gracefully
+    # mid-preprocess, but a crash must never leave a half-written table
+    # that LOOKS complete.
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(out) as f:
         f.write("id,address\n")
         for literal, aid in sorted(intern.ids.items(), key=lambda kv: kv[1]):
             f.write(f"{aid},{literal}\n")

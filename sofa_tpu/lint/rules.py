@@ -366,6 +366,73 @@ class DirectKill(Rule):
                 "instead of an unescalated SIGKILL")
 
 
+# ---------------------------------------------------------------------------
+# SL009 — derived-artifact writes are atomic (PR 6's durability contract).
+# ---------------------------------------------------------------------------
+
+# Modules that produce derived logdir artifacts: every open()-for-write in
+# them must route through durability.atomic_write/atomic_replace (tmp +
+# fsync-optional + rename), so a crash — or a board request racing the
+# writer — can never observe a torn derived file.  Producers of RAW files
+# (collectors/, record.py, api.py) are out of scope: raw streams are
+# append-by-nature and their integrity is fsck's digest ledger's problem.
+_DERIVED_WRITER_FILES = (
+    "trace.py", "telemetry.py", "tiles.py", "preprocess.py", "analyze.py",
+    "ingest/cache.py", "ingest/pcap.py", "export_folded.py",
+    "export_perfetto.py", "export_static.py", "analysis/", "ml/",
+    "durability.py",
+)
+
+_OPEN_FNS = frozenset({"open", "io.open", "gzip.open", "bz2.open",
+                       "lzma.open"})
+_WRITE_MODES = ("w", "a", "x")
+
+
+class NonAtomicDerivedWrite(Rule):
+    """A derived artifact written with a bare ``open(..., 'w')`` can be
+    observed torn — by the viz server, by a concurrent verb, or by the
+    next run after a crash.  Route it through durability.atomic_write
+    (or atomic_replace for writers that need their own opener); the
+    helper's tmp+rename is what `sofa resume`'s replay correctness and
+    fsck's corrupt/orphaned verdicts are built on."""
+
+    rule_id = "SL009"
+    severity = SEV_ERROR
+    node_types = (ast.Call,)
+    # durability.py IS the helper: its internal tmp write cannot route
+    # through itself.
+    exempt_files = ("durability.py",)
+
+    def applies(self, ctx: FileContext) -> bool:
+        return any(
+            (p.endswith("/") and f"/{p}" in f"/{ctx.relpath}")
+            or ctx.relpath == p or ctx.relpath.endswith("/" + p)
+            for p in _DERIVED_WRITER_FILES) and super().applies(ctx)
+
+    def _mode_of(self, node: ast.Call) -> "str | None":
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            return node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return None
+
+    def visit(self, ctx: FileContext, node: ast.Call) -> Iterable[Finding]:
+        if ctx.resolve_call(node) not in _OPEN_FNS:
+            return
+        mode = self._mode_of(node)
+        if mode is None or not any(m in mode for m in _WRITE_MODES):
+            return
+        yield self.finding(
+            ctx, node,
+            f"derived artifact opened with mode {mode!r} outside the "
+            "atomic write helper — a crash or concurrent reader sees a "
+            "torn file; use durability.atomic_write (atomic_replace for "
+            "stream writers)")
+
+
 ALL_RULES = (
     BoundedSubprocess,
     SilentBroadExcept,
@@ -375,6 +442,7 @@ ALL_RULES = (
     WorkerGlobalWrite,
     RawArtifactBypass,
     DirectKill,
+    NonAtomicDerivedWrite,
 )
 
 

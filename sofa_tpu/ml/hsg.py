@@ -88,7 +88,9 @@ def sofa_hsg(frames, cfg, features: Features) -> Optional[pd.DataFrame]:
     # auto_caption.csv is the diff input (reference sofa_ml.py:289-309).
     clustered.to_csv(cfg.path("auto_caption.csv"), index=False)
     report.to_csv(cfg.path("swarms_report.csv"), index=False)
-    with open(cfg.path("swarms_report.txt"), "w") as f:
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(cfg.path("swarms_report.txt")) as f:
         f.write(report.drop(columns=["function_names"]).to_string(index=False) + "\n")
     features.add("hsg_swarms", len(report))
     print_progress(f"hsg: {len(report)} swarms over {len(clustered)} {source} samples")

@@ -426,7 +426,8 @@ def _quarantine_source(cfg: SofaConfig, name: str, err: CorruptRawError,
 
 
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
-    from sofa_tpu import telemetry
+    from sofa_tpu import durability, telemetry
+    from sofa_tpu.trace import reap_stale_sentinel
 
     if not os.path.isdir(cfg.logdir):
         from sofa_tpu.printing import SofaUserError
@@ -434,10 +435,21 @@ def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
         raise SofaUserError(
             f"logdir {cfg.logdir} does not exist — run `sofa record` first"
         )
+    # A writer that died holding the guard must not 503 this logdir's
+    # board (or confuse read_net_addrs) for the rest of time.
+    reap_stale_sentinel(cfg.logdir)
     tel = telemetry.begin("preprocess")
+    journal = durability.Journal(cfg.logdir)
+    journal.begin("preprocess", key=durability.logdir_raw_key(cfg.logdir))
     try:
         faults.install_from(cfg)  # inside the run: the ACTIVE warning counts
-        return _preprocess_body(cfg, tel)
+        frames = _preprocess_body(cfg, tel)
+        # Commit only after every artifact (including the refreshed digest
+        # ledger inside the body) is on disk: `sofa resume` replays
+        # anything short of this line.
+        journal.commit("preprocess",
+                       key=durability.logdir_raw_key(cfg.logdir))
+        return frames
     finally:
         telemetry.end(tel)
         faults.clear()
@@ -555,17 +567,30 @@ def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
                 # their own file).
                 import json
 
-                with open(cfg.path("tpu_meta.json"), "w") as f:
+                from sofa_tpu.durability import atomic_write
+
+                with atomic_write(cfg.path("tpu_meta.json")) as f:
                     json.dump(tpu_meta, f, indent=1)
     print_progress(
         f"preprocess wrote {n_csv} {trace_format} frames and report.js "
         f"({len(series)} series)"
     )
+    # Integrity ledger AFTER the guard released (it hashes final bytes).
+    from sofa_tpu import durability
+
+    with tel.span("digests", cat="stage"):
+        digest_doc = durability.write_digests(cfg.logdir)
     tel.set_meta(ingest_cache=cache.stats())
     # Structured timings land in the manifest; the human-readable summary
     # is derived by reading the manifest BACK — one source of truth for
     # what the run did (replaces PR 1's free-form timing print).
     manifest = tel.write(cfg.logdir, rc=0, cfg=cfg)
+    if digest_doc is not None and (manifest is None
+                                   or "digests" not in manifest):
+        # First manifest of this logdir was just created by the write
+        # above — fold the digest ledger in now (re-runs hit the patch
+        # inside write_digests instead).
+        durability.attach_digests(cfg.logdir, digest_doc)
     summary = telemetry.preprocess_summary(
         manifest if manifest is not None
         else telemetry.load_manifest(cfg.logdir))

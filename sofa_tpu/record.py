@@ -59,7 +59,10 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  "run_manifest.json", "sofa_self_trace.json",
                  # mid-write sentinel (trace.derived_write_guard) — a
                  # crashed writer may leave it behind
-                 "_derived.writing"]
+                 "_derived.writing",
+                 # durability layer (sofa_tpu/durability.py): crash journal
+                 # + sha256 integrity ledger sidecar
+                 "_journal.jsonl", "_digests.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles"]
 
@@ -293,11 +296,16 @@ def wrap_docker_command(command: str, cfg, child_env: dict) -> str:
 
 
 def sofa_record(command: str, cfg) -> int:
-    from sofa_tpu import faults, telemetry
+    from sofa_tpu import durability, faults, telemetry
 
     ensure_logdir(cfg.logdir)
     _clean_stale(cfg)
     tel = telemetry.begin("record")
+    # Fresh journal for a fresh recording (_clean_stale wiped the old one):
+    # a crash anywhere past this line leaves a begun-uncommitted record
+    # marker that `sofa resume` reports honestly.
+    journal = durability.Journal(cfg.logdir)
+    journal.begin("record")
     try:
         # Inside the telemetry run so the ACTIVE warning rides the
         # manifest's noise counters; a bad spec aborts before any
@@ -324,6 +332,13 @@ def sofa_record(command: str, cfg) -> int:
         # still leave the health ledger behind (that run is exactly the
         # one worth diagnosing).
         tel.write(cfg.logdir, rc=rc, cfg=cfg)
+        if rc is not None:
+            # The epilogue ran to completion: digest the raw harvest and
+            # commit.  An aborted record (exception path) stays
+            # uncommitted — `sofa resume` will flag it.
+            durability.write_digests(cfg.logdir)
+            journal.commit("record", rc=rc,
+                           key=durability.logdir_raw_key(cfg.logdir))
         telemetry.end(tel)
         faults.clear()
 
@@ -454,6 +469,9 @@ def _record_body(command: str, cfg, collectors, tel) -> int:
             # Idempotent; before any stop so a deliberate collector stop
             # can never read as a death worth restarting.
             supervisor.stop()
+            budget = supervisor.budget_summary()
+            if budget is not None:
+                tel.set_meta(disk_budget=budget)
         with tel.span("epilogue", cat="record"):
             for col in reversed(started):
                 try:
@@ -718,6 +736,8 @@ def _record_flags(cfg) -> list:
         ("collector_restarts", "--collector_restarts"),
         ("collector_stop_timeout_s", "--collector_stop_timeout_s"),
         ("collector_harvest_timeout_s", "--collector_harvest_timeout_s"),
+        ("disk_budget_mb", "--disk_budget"),
+        ("collector_disk_budget_mb", "--collector_disk_budget"),
     ]
     for name, flag in valued:
         v = getattr(cfg, name)
@@ -901,7 +921,11 @@ def _cluster_record_body(command: str, cfg, flags, child_env) -> int:
 
 
 def sofa_clean(cfg) -> None:
-    """Remove derived files, keep raw collector output (sofa_record.py:138-147)."""
+    """Remove derived files, keep raw collector output (sofa_record.py:138-147).
+
+    Also sweeps orphaned ``*.tmp`` files ANYWHERE under the logdir — the
+    leftovers of interrupted tmp+rename writes (durability.atomic_write):
+    they are committed to nothing and shadow nothing, pure disk waste."""
     import shutil
 
     if not os.path.isdir(cfg.logdir):
@@ -924,4 +948,14 @@ def sofa_clean(cfg) -> None:
                 removed += 1
         except OSError as e:
             print_warning(f"cannot clean {path}: {e}")
+    for root, _dirs, files in os.walk(cfg.logdir):
+        for name in files:
+            if not name.endswith(".tmp"):
+                continue
+            try:
+                os.unlink(os.path.join(root, name))
+                removed += 1
+            except OSError as e:
+                print_warning(f"cannot clean {os.path.join(root, name)}: "
+                              f"{e}")
     print_progress(f"cleaned {removed} derived entries from {cfg.logdir}")

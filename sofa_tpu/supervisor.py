@@ -17,7 +17,16 @@ sampler thread, :meth:`Collector.alive`) and its output growth:
     exits nonzero;
   * output files that stop growing while the process stays alive are
     flagged once (``output_stalled: true``) — a wedged-but-alive collector
-    is a fidelity warning, not a kill (it may legitimately be buffering).
+    is a fidelity warning, not a kill (it may legitimately be buffering);
+  * **disk budgets** (``--disk_budget`` across all watched collectors,
+    ``--collector_disk_budget`` per collector, both in MB): raw outputs
+    are size-polled every tick, and a breach is enforced oldest-first —
+    a collector with several output files loses its oldest files
+    (``rotated_files`` in the manifest) before its newest, and one that
+    cannot get under its cap (a single ever-growing file) is stopped and
+    marked ``truncated_by_budget`` (sticky; schema v4).  Either way the
+    recording itself keeps running: an unbounded collector can no longer
+    ENOSPC-crash `sofa record`.
 
 The poll period (default 0.5s — "detected within seconds") is tunable via
 SOFA_SUPERVISOR_POLL_S for tests.  The exascale-diagnostics framing
@@ -67,6 +76,11 @@ class CollectorSupervisor:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sofa_supervisor")
         self._state: Dict[str, dict] = {}
+        per_mb = float(getattr(cfg, "collector_disk_budget_mb", 0) or 0)
+        total_mb = float(getattr(cfg, "disk_budget_mb", 0) or 0)
+        self._per_cap = int(per_mb * 2 ** 20)
+        self._total_cap = int(total_mb * 2 ** 20)
+        self._truncated: List[str] = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -92,6 +106,12 @@ class CollectorSupervisor:
                 except Exception as e:  # noqa: BLE001 — watchdog never dies
                     print_warning(f"supervisor: check of {col.name} "
                                   f"failed: {e}")
+            if self._total_cap and not self._stop.is_set():
+                try:
+                    self._enforce_total_budget()
+                except Exception as e:  # noqa: BLE001 — watchdog never dies
+                    print_warning(f"supervisor: disk-budget check "
+                                  f"failed: {e}")
 
     def _check(self, col) -> None:
         alive = col.alive()
@@ -100,7 +120,7 @@ class CollectorSupervisor:
         st = self._state.setdefault(col.name, {
             "deaths": 0, "restarts": 0, "retry_at": None,
             "gave_up": False, "bytes": -1, "stall_polls": 0,
-            "stalled_flagged": False,
+            "stalled_flagged": False, "rotated": 0,
         })
         if st["gave_up"]:
             return
@@ -111,7 +131,10 @@ class CollectorSupervisor:
                 self._restart(col, st)
             return
         if alive:
-            self._track_growth(col, st)
+            b = self._track_growth(col, st)
+            if self._per_cap and b > self._per_cap:
+                self._enforce_budget(col, st, b, self._per_cap,
+                                     "its --collector_disk_budget")
             return
         # -- death detected ------------------------------------------------
         st["deaths"] += 1
@@ -152,11 +175,11 @@ class CollectorSupervisor:
         print_warning(f"{col.name}: restarted "
                       f"(attempt {st['restarts']})")
 
-    def _track_growth(self, col, st: dict) -> None:
+    def _track_growth(self, col, st: dict) -> int:
         b = telemetry.collector_bytes(col.outputs())
         if b != st["bytes"]:
             st["bytes"], st["stall_polls"] = b, 0
-            return
+            return b
         st["stall_polls"] += 1
         if st["stall_polls"] == _STALL_POLLS and not st["stalled_flagged"]:
             st["stalled_flagged"] = True
@@ -165,3 +188,97 @@ class CollectorSupervisor:
                 f"{col.name}: alive but its output has not grown for "
                 f"{_STALL_POLLS * self.poll_s:.0f}s — series may be "
                 "wedged or buffering")
+        return b
+
+    # -- disk budgets (sofa_tpu/durability.py's record-side half) ----------
+    def _enforce_total_budget(self) -> None:
+        """--disk_budget across every watched collector: on breach, the
+        biggest producer pays first (its own files oldest-first)."""
+        tracked = [(st["bytes"], name) for name, st in self._state.items()
+                   if st["bytes"] > 0 and not st["gave_up"]]
+        total = sum(b for b, _n in tracked)
+        if total <= self._total_cap:
+            return
+        by_name = {c.name: c for c in list(self.collectors)}
+        for b, name in sorted(tracked, reverse=True):
+            col = by_name.get(name)
+            if col is None:
+                continue
+            over = total - self._total_cap
+            st = self._state[name]
+            freed = self._enforce_budget(col, st, b, b - over,
+                                         "the run's --disk_budget")
+            total -= freed
+            if total <= self._total_cap:
+                return
+
+    def _enforce_budget(self, col, st: dict, used: int, cap: int,
+                        why: str) -> int:
+        """Bring one collector under ``cap`` bytes.  Oldest output files
+        are rotated away first (the newest is never touched — it is being
+        appended); a collector that still cannot fit (one ever-growing
+        file) is stopped and marked ``truncated_by_budget``.  Returns the
+        bytes freed (kills count their whole future growth as 0 — the
+        ledger keeps what was captured)."""
+        files = []
+        for p in col.outputs():
+            if os.path.isdir(p):
+                for root, _dirs, names in os.walk(p):
+                    for name in names:
+                        files.append(os.path.join(root, name))
+            elif os.path.isfile(p):
+                files.append(p)
+        sigs = []
+        for p in files:
+            try:
+                fst = os.stat(p)
+            except OSError:
+                continue
+            sigs.append((fst.st_mtime_ns, fst.st_size, p))
+        sigs.sort()
+        freed = 0
+        for _mt, size, path in sigs[:-1]:  # newest survives: still written
+            if used - freed <= cap:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            freed += size
+            st["rotated"] += 1
+        if freed:
+            st["bytes"] = max(st["bytes"] - freed, 0)
+            telemetry.collector_event(col.name, rotated_files=st["rotated"],
+                                      budget_bytes=cap)
+            print_warning(
+                f"{col.name}: over {why} — rotated "
+                f"{st['rotated']} oldest output file(s) "
+                f"({freed / 2**20:.1f} MB freed)")
+        if used - freed > cap:
+            st["gave_up"] = True
+            self._truncated.append(col.name)
+            telemetry.collector_event(col.name, "truncated_by_budget",
+                                      budget_bytes=cap,
+                                      bytes_captured=int(used - freed))
+            print_warning(
+                f"{col.name}: still over {why} after rotation — stopping "
+                "it; its series are truncated at this point "
+                "(truncated_by_budget)")
+            try:
+                col.run_kill()
+            except Exception as e:  # noqa: BLE001 — enforcement best-effort
+                print_warning(f"{col.name}: budget stop failed: {e}")
+        return freed
+
+    def budget_summary(self) -> "dict | None":
+        """meta.disk_budget for the run manifest; None when no budget is
+        configured (the section only appears when the feature is on)."""
+        if not (self._per_cap or self._total_cap):
+            return None
+        return {
+            "budget_mb": self._total_cap // 2 ** 20 or None,
+            "collector_budget_mb": self._per_cap // 2 ** 20 or None,
+            "rotated_files": sum(st.get("rotated", 0)
+                                 for st in self._state.values()),
+            "truncated": sorted(set(self._truncated)),
+        }

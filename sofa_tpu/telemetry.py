@@ -27,6 +27,10 @@ and counters and lands two artifacts in the logdir:
                               quarantined, cache hit/miss/bypass, wall_s,
                               events, error, quarantined_file
     stages                    flat span list {verb,name,cat,t0_unix,dur_s}
+    digests                   sha256 integrity ledger over raw + derived
+                              artifacts (sofa_tpu/durability.py; the
+                              ``_digests.json`` sidecar is the fsync'd
+                              authoritative copy `sofa fsck` verifies)
 
 Versioning policy: ``schema_version`` bumps on any BREAKING change (key
 renamed/removed, meaning changed); purely additive keys do not bump it.
@@ -80,17 +84,23 @@ MANIFEST_SCHEMA = "sofa_tpu/run_manifest"
 # conversion tool (perf script, native scanners) broke or timed out
 # (ingest.IngestToolError); distinct from quarantined (corrupt input) and
 # degraded (parse error) because a re-run with a working tool recovers it.
-MANIFEST_VERSION = 3
+# v4: durability vocabulary — collector status ``truncated_by_budget``
+# (the supervisor's disk-budget enforcement stopped it; another new enum
+# VALUE, hence the bump) plus the additive ``digests`` integrity ledger,
+# ``rotated_files``/``budget_bytes`` collector fields, and the
+# ``meta.disk_budget``/``meta.fsck`` sections (sofa_tpu/durability.py).
+MANIFEST_VERSION = 4
 
 COLLECTOR_STATUSES = ("probed", "started", "stopped", "failed", "skipped",
-                      "killed", "died", "timed_out")
+                      "killed", "died", "timed_out", "truncated_by_budget")
 SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty", "quarantined",
                    "failed")
 CACHE_OUTCOMES = ("hit", "miss", "bypass")
 
 # Terminal bad outcomes: sticky over the benign started/stopped that the
 # epilogue's flush still records afterwards.
-_STICKY_STATUSES = ("failed", "killed", "died", "timed_out")
+_STICKY_STATUSES = ("failed", "killed", "died", "timed_out",
+                    "truncated_by_budget")
 
 # Environment variables that shape a run enough to belong in the snapshot.
 _ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
@@ -234,11 +244,10 @@ class Telemetry:
                 stages = [s for s in doc.get("stages", [])
                           if s.get("verb") != self.verb]
                 doc["stages"] = stages + list(self.spans)
-            path = os.path.join(logdir, MANIFEST_NAME)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+            from sofa_tpu.durability import atomic_write
+
+            with atomic_write(os.path.join(logdir, MANIFEST_NAME)) as f:
                 json.dump(doc, f, indent=1, sort_keys=True)
-            os.replace(tmp, path)
             self._write_self_trace(logdir)
             return doc
         except (OSError, TypeError, ValueError) as e:
@@ -292,10 +301,10 @@ class Telemetry:
             "otherData": {**other, "ts_zero_unix": round(float(zero), 6),
                           "producer": "sofa_tpu self-telemetry"},
         }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        from sofa_tpu.durability import atomic_write
+
+        with atomic_write(path) as f:
             json.dump(doc, f)
-        os.replace(tmp, path)
 
 
 # --- run registry -----------------------------------------------------------
@@ -447,6 +456,11 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
             phase = ent.get("phase") or "stop"
             out.append(f"collector {name} exceeded its {phase} deadline and "
                        "was abandoned — its series may be partial")
+        elif status == "truncated_by_budget":
+            out.append(f"collector {name} hit the disk budget and was "
+                       "stopped — its series are truncated (raise "
+                       "--disk_budget / --collector_disk_budget to keep "
+                       "more)")
         elif status in ("failed", "killed"):
             detail = ent.get("error") or ent.get("phase") or ""
             out.append(f"collector {name} {status}"
@@ -463,6 +477,10 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
                                                         "failed", "killed"):
             out.append(f"collector {name} stopped producing output mid-run "
                        "while still alive — series may be incomplete")
+        if ent.get("rotated_files") and status != "truncated_by_budget":
+            out.append(f"collector {name} had {ent['rotated_files']} "
+                       "output file(s) rotated away by the disk budget — "
+                       "its oldest data is gone")
     for name, ent in sorted((doc.get("sources") or {}).items()):
         if ent.get("status") == "degraded":
             why = ent.get("error") or "parse failed"
@@ -477,6 +495,14 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
             out.append(f"ingest source {name} had corrupt raw input — "
                        f"quarantined to {where}; its series are empty "
                        "this run")
+    fsck = (doc.get("meta") or {}).get("fsck")
+    if isinstance(fsck, dict) and fsck.get("ok") is False:
+        problems = fsck.get("problems") or {}
+        detail = ", ".join(f"{v} {k}" for k, v in sorted(problems.items())
+                           if isinstance(v, int) and v)
+        out.append("the last `sofa fsck` found damaged artifacts"
+                   + (f" ({detail})" if detail else "")
+                   + " — run `sofa fsck --repair`")
     for verb, run in sorted((doc.get("runs") or {}).items()):
         counters = run.get("counters") or {}
         if counters.get("errors"):
@@ -552,6 +578,29 @@ def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
     for verb in sorted(set(runs) - {"record", "preprocess", "analyze"}):
         lines.append(f"  {verb}: wall {runs[verb].get('wall_s', 0):.2f}s")
 
+    digests = doc.get("digests")
+    if isinstance(digests, dict) and isinstance(digests.get("files"), dict):
+        line = (f"  integrity: {len(digests['files'])} artifact(s) "
+                f"digested ({digests.get('algo', 'sha256')}; "
+                "`sofa fsck` verifies)")
+        fsck = (doc.get("meta") or {}).get("fsck")
+        if isinstance(fsck, dict):
+            if fsck.get("ok"):
+                line += " — last fsck: healthy"
+            else:
+                probs = fsck.get("problems") or {}
+                n = sum(v for v in probs.values() if isinstance(v, int))
+                line += f" — last fsck: {n} problem(s)"
+        lines.append(line)
+    budget = (doc.get("meta") or {}).get("disk_budget")
+    if isinstance(budget, dict):
+        lines.append(
+            f"  disk budget: {budget.get('budget_mb') or 'off'} MB total / "
+            f"{budget.get('collector_budget_mb') or 'off'} MB per "
+            f"collector — {budget.get('rotated_files', 0)} file(s) "
+            f"rotated, {len(budget.get('truncated') or [])} collector(s) "
+            "truncated")
+
     collectors = doc.get("collectors") or {}
     if collectors:
         lines.append("")
@@ -619,6 +668,6 @@ def sofa_status(cfg) -> int:
     lines, rc = render_status(doc, cfg.logdir)
     print("\n".join(lines))
     if rc != 0:
-        print_error("one or more collectors failed, died, or timed out — "
-                    "see the table above")
+        print_error("one or more collectors failed, died, timed out, or "
+                    "hit the disk budget — see the table above")
     return rc

@@ -142,10 +142,10 @@ def _write_tile(path: str, doc: dict) -> int:
     the compression's work."""
     blob = gzip.compress(
         json.dumps(doc, separators=(",", ":")).encode(), 1, mtime=0)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(path, "wb") as f:
         f.write(blob)
-    os.replace(tmp, path)
     return len(blob)
 
 
@@ -340,12 +340,13 @@ def build_tiles(cfg, series, jobs: "int | None" = None,
             xs, ys, ds, names = _series_arrays(s)
             levels = _levels_for(xs, cap)
             entry = _build_pyramid(sdir, xs, ys, ds, names, levels)
-            # the index is written LAST so a half-built pyramid never
-            # passes the key check on the next run
-            tmp = index_path + ".tmp"
-            with open(tmp, "w") as f:
+            # the index is written LAST (and fsync'd — it is the pyramid's
+            # commit point) so a half-built pyramid never passes the key
+            # check on the next run
+            from sofa_tpu.durability import atomic_write
+
+            with atomic_write(index_path, fsync=True) as f:
                 json.dump({"key": key, "params": params, "entry": entry}, f)
-            os.replace(tmp, index_path)
             entry = dict(entry)
             entry["path"] = dname
             return s.name, entry, False

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import List, Optional
@@ -494,15 +495,15 @@ def write_report_js_doc(doc: dict, path: str) -> None:
     exact shape (`sofa_traces = <json>;`), so every producer must go
     through here.  dumps, not dump: the one-shot path runs json's C
     encoder, while dump iterencodes 500k+ point dicts through Python
-    (~5x slower on a pod-scale report.js).  Written to a temp file +
-    rename: a board request racing the writer must see the old complete
-    document, never a truncated one."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
+    (~5x slower on a pod-scale report.js).  Atomic (durability.
+    atomic_write): a board request racing the writer must see the old
+    complete document, never a truncated one."""
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(path) as f:
         f.write("sofa_traces = ")
         f.write(json.dumps(doc))
         f.write(";\n")
-    os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
@@ -519,18 +520,39 @@ def write_report_js_doc(doc: dict, path: str) -> None:
 WRITING_SENTINEL = "_derived.writing"
 
 
+def _sentinel_stale_s() -> float:
+    """Age past which a sentinel is presumed abandoned regardless of what
+    it says: the backstop against a torn sentinel, a pid recycled onto an
+    unrelated process, or an EPERM liveness probe — none of which may 503
+    the board forever (the pre-PR-6 bug).  Generous by default: a healthy
+    writer holds the guard for seconds, not half an hour."""
+    try:
+        return max(float(os.environ.get("SOFA_SENTINEL_STALE_S", "1800")),
+                   1.0)
+    except ValueError:
+        return 1800.0
+
+
 def derived_writing(logdir: str) -> bool:
     """True while a pipeline verb is mid-write on this logdir's derived
-    artifacts (stale sentinels from a crashed writer expire: a dead pid
-    or an unparsable sentinel does not wedge the server forever)."""
+    artifacts.  The sentinel carries the writer's pid (content) and its
+    write time (mtime): it is ignored when the writer is dead, and — the
+    backstop for torn/unreadable/recycled-pid sentinels — when it is older
+    than SOFA_SENTINEL_STALE_S."""
     path = os.path.join(logdir, WRITING_SENTINEL)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    if time.time() - st.st_mtime > _sentinel_stale_s():  # sofa-lint: disable=SL003 — compared against a file mtime, which IS wall clock; monotonic has no common epoch with it
+        return False  # abandoned by any reading; don't 503 forever
     try:
         with open(path) as f:
             pid = int(f.read().strip() or "0")
     except OSError:
         return False
     except ValueError:
-        return True  # sentinel exists but is torn — still mid-write
+        return True  # torn but fresh — plausibly still mid-write
     if pid <= 0:
         return True
     try:
@@ -542,6 +564,25 @@ def derived_writing(logdir: str) -> bool:
         return True
 
 
+def reap_stale_sentinel(logdir: str) -> bool:
+    """Remove a leftover sentinel whose writer is dead or timed out (every
+    pipeline verb and the viz server call this at startup — a crashed
+    writer must not wedge the next run's readers).  Returns whether a
+    stale sentinel was removed."""
+    path = os.path.join(logdir, WRITING_SENTINEL)
+    if not os.path.exists(path) or derived_writing(logdir):
+        return False
+    try:
+        os.unlink(path)
+    except OSError:
+        return False
+    from sofa_tpu.printing import print_info
+
+    print_info(f"reaped stale {WRITING_SENTINEL} sentinel (its writer is "
+               "gone) — the logdir is readable again")
+    return True
+
+
 class derived_write_guard:
     """Context manager a writer holds across non-atomic derived writes."""
 
@@ -551,7 +592,7 @@ class derived_write_guard:
     def __enter__(self):
         try:
             os.makedirs(os.path.dirname(self._path), exist_ok=True)
-            with open(self._path, "w") as f:
+            with open(self._path, "w") as f:  # sofa-lint: disable=SL009 — the sentinel IS the mid-write signal; an atomic rename would defeat its purpose
                 f.write(str(os.getpid()))
         except OSError:
             pass  # best-effort: an unwritable logdir fails later, loudly

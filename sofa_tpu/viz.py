@@ -158,6 +158,12 @@ def sofa_viz(cfg, serve_forever: bool = True):
     if not os.path.isdir(cfg.logdir):
         print_error(f"logdir {cfg.logdir} does not exist")
         return None
+    # A verb that died holding the write guard must not 503 every data
+    # request from now on: reap its sentinel before serving (live torn
+    # sentinels also expire by mtime — trace.derived_writing).
+    from sofa_tpu.trace import reap_stale_sentinel
+
+    reap_stale_sentinel(cfg.logdir)
     handler = functools.partial(_BoardHandler, directory=cfg.logdir)
     http.server.ThreadingHTTPServer.allow_reuse_address = True
     http.server.ThreadingHTTPServer.daemon_threads = True

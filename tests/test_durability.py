@@ -1,0 +1,483 @@
+"""Durability layer (ISSUE 6): crash journal + `sofa resume`, disk
+budgets, integrity digests + `sofa fsck`, stale-sentinel reaping, atomic
+writes, and the `sofa clean` tmp sweep.
+
+The end-to-end SIGKILL proof (kill sofa mid-preprocess / mid-tile-build,
+resume to a byte-identical report.js) lives in tools/chaos_matrix.py's
+kill cells; here are the fast unit halves of every mechanism.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sofa_tpu import durability, telemetry, trace
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.durability import (
+    Journal,
+    atomic_write,
+    fsck_scan,
+    journal_state,
+    logdir_raw_key,
+    read_journal,
+    sofa_fsck,
+    sofa_resume,
+    write_digests,
+)
+from sofa_tpu.preprocess import sofa_preprocess
+from sofa_tpu.printing import SofaUserError
+from sofa_tpu.record import sofa_clean
+from sofa_tpu.supervisor import CollectorSupervisor
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_logdir(tmp_path) -> SofaConfig:
+    """The smallest logdir preprocess accepts: a time base + misc; every
+    absent source degrades to an empty frame."""
+    ld = str(tmp_path / "log") + "/"
+    os.makedirs(ld, exist_ok=True)
+    with open(ld + "sofa_time.txt", "w") as f:
+        f.write("1000.0\n")
+    with open(ld + "misc.txt", "w") as f:
+        f.write("elapsed_time 1.5\ncores 2\npid 1\nrc 0\n")
+    with open(ld + "mpstat.txt", "w") as f:
+        f.write("")
+    return SofaConfig(logdir=ld)
+
+
+# --- atomic writes ----------------------------------------------------------
+
+def test_atomic_write_lands_and_cleans_tmp(tmp_path):
+    path = str(tmp_path / "out.json")
+    with atomic_write(path, fsync=True) as f:
+        f.write('{"ok": true}')
+    assert json.load(open(path)) == {"ok": True}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_atomic_write_failure_leaves_target_untouched(tmp_path):
+    path = str(tmp_path / "out.txt")
+    with open(path, "w") as f:
+        f.write("old")
+    with pytest.raises(RuntimeError):
+        with atomic_write(path) as f:
+            f.write("half-writ")
+            raise RuntimeError("boom")
+    assert open(path).read() == "old"
+    assert not os.path.exists(path + ".tmp")
+
+
+# --- journal ----------------------------------------------------------------
+
+def test_journal_begin_commit_roundtrip(tmp_path):
+    ld = str(tmp_path)
+    j = Journal(ld)
+    j.begin("preprocess", key="k1")
+    j.commit("preprocess", key="k1")
+    j.begin("analyze", key="k1")
+    state = journal_state(read_journal(ld))
+    assert state["preprocess"]["committed"] is True
+    assert state["preprocess"]["key"] == "k1"
+    assert state["analyze"]["committed"] is False
+
+
+def test_journal_reopened_stage_uncommits(tmp_path):
+    ld = str(tmp_path)
+    j = Journal(ld)
+    j.begin("preprocess", key="k1")
+    j.commit("preprocess", key="k1")
+    j.begin("preprocess", key="k2")  # a new run started and crashed
+    state = journal_state(read_journal(ld))
+    assert state["preprocess"]["committed"] is False
+
+
+def test_journal_torn_tail_is_ignored(tmp_path):
+    ld = str(tmp_path)
+    j = Journal(ld)
+    j.begin("preprocess", key="k1")
+    with open(j.path, "a") as f:
+        f.write('{"ev": "commit", "stage": "prepro')  # SIGKILL mid-append
+    state = journal_state(read_journal(ld))
+    assert state["preprocess"]["committed"] is False
+
+
+def test_journal_compaction_preserves_state(tmp_path, monkeypatch):
+    monkeypatch.setattr(durability, "JOURNAL_COMPACT_LINES", 8)
+    ld = str(tmp_path)
+    j = Journal(ld)
+    for i in range(10):
+        j.begin("preprocess", key=f"k{i}")
+        j.commit("preprocess", key=f"k{i}")
+    j.begin("analyze", key="a")
+    entries = read_journal(ld)
+    assert len(entries) <= 8  # checkpointed, not unbounded
+    state = journal_state(entries)
+    assert state["preprocess"]["committed"] is True
+    assert state["preprocess"]["key"] == "k9"
+    assert state["analyze"]["committed"] is False
+
+
+# --- stale sentinel ---------------------------------------------------------
+
+def test_torn_sentinel_expires_by_mtime(tmp_path):
+    ld = str(tmp_path)
+    path = os.path.join(ld, trace.WRITING_SENTINEL)
+    with open(path, "w") as f:
+        f.write("not-a-pid")
+    assert trace.derived_writing(ld) is True  # fresh: plausibly mid-write
+    old = time.time() - 7200
+    os.utime(path, (old, old))
+    assert trace.derived_writing(ld) is False  # timed out: never 503 forever
+
+
+def test_reap_stale_sentinel(tmp_path):
+    ld = str(tmp_path)
+    path = os.path.join(ld, trace.WRITING_SENTINEL)
+    # dead-pid sentinel -> reaped
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    with open(path, "w") as f:
+        f.write(str(child.pid))
+    assert trace.reap_stale_sentinel(ld) is True
+    assert not os.path.exists(path)
+    # live-writer sentinel -> kept
+    with open(path, "w") as f:
+        f.write(str(os.getpid()))
+    assert trace.reap_stale_sentinel(ld) is False
+    assert os.path.exists(path)
+
+
+# --- preprocess integration -------------------------------------------------
+
+def test_preprocess_journals_commits_and_digests(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    state = journal_state(read_journal(cfg.logdir))
+    assert state["preprocess"]["committed"] is True
+    assert state["preprocess"]["key"] == logdir_raw_key(cfg.logdir)
+    sidecar = json.load(open(cfg.path(durability.DIGESTS_NAME)))
+    assert "report.js" in sidecar["files"]
+    assert sidecar["files"]["report.js"]["kind"] == "derived"
+    assert sidecar["files"]["misc.txt"]["kind"] == "raw"
+    manifest = telemetry.load_manifest(cfg.logdir)
+    assert manifest["digests"]["files"].keys() == sidecar["files"].keys()
+    assert sofa_fsck(cfg) == 0
+
+
+def test_fsck_verdicts_and_exit_codes(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    assert sofa_fsck(cfg) == 2  # no ledger yet
+    sofa_preprocess(cfg)
+    # corrupt a derived artifact
+    with open(cfg.path("report.js"), "a") as f:
+        f.write("GARBAGE")
+    # modify a raw file (new mtime -> derived artifacts are stale)
+    time.sleep(0.01)
+    with open(cfg.path("mpstat.txt"), "w") as f:
+        f.write("changed\n")
+    # delete a derived artifact + plant a tmp orphan
+    os.unlink(cfg.path("tputrace.csv"))
+    with open(cfg.path("leftover.csv.tmp"), "w") as f:
+        f.write("x")
+    report = fsck_scan(cfg.logdir)
+    assert "report.js" in report["corrupt"]
+    assert "mpstat.txt" in report["stale"]
+    assert "tputrace.csv" in report["missing"]
+    assert "leftover.csv.tmp" in report["orphaned"]
+    assert sofa_fsck(cfg) == 1
+    # the verdict lands in the manifest -> [self] hints pick it up
+    manifest = telemetry.load_manifest(cfg.logdir)
+    assert manifest["meta"]["fsck"]["ok"] is False
+    assert any("fsck" in w
+               for w in telemetry.manifest_warnings(manifest))
+
+
+def test_fsck_repair_restores_health(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    with open(cfg.path("report.js"), "a") as f:
+        f.write("GARBAGE")
+    with open(cfg.path("orphan.tmp"), "w") as f:
+        f.write("x")
+    assert sofa_fsck(cfg, repair=True) == 0
+    assert not os.path.exists(cfg.path("orphan.tmp"))
+    # report.js is valid board payload again
+    text = open(cfg.path("report.js")).read()
+    assert text.startswith("sofa_traces = ")
+    json.loads(text[len("sofa_traces = "):].rstrip(";\n"))
+    assert sofa_fsck(cfg) == 0
+    manifest = telemetry.load_manifest(cfg.logdir)
+    assert manifest["meta"]["fsck"]["ok"] is True
+
+
+def test_fsck_corrupt_raw_invalidates_cache(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    with open(cfg.path("mpstat.txt"), "w") as f:
+        f.write("dummy raw\n")
+    sofa_preprocess(cfg)
+    cache_dir = cfg.path("_ingest_cache")
+    assert any(n.startswith("mpstat") for n in os.listdir(cache_dir))
+    # same-size in-place corruption with the recorded mtime restored:
+    # the "silent bit rot" shape -> corrupt, and repair must purge the
+    # poisoned cache entry before re-deriving
+    st = os.stat(cfg.path("mpstat.txt"))
+    with open(cfg.path("mpstat.txt"), "r+") as f:
+        f.write("yummy")
+    os.utime(cfg.path("mpstat.txt"), ns=(st.st_atime_ns, st.st_mtime_ns))
+    report = fsck_scan(cfg.logdir)
+    assert "mpstat.txt" in report["corrupt"]
+    assert sofa_fsck(cfg, repair=True) == 0
+
+
+# --- resume -----------------------------------------------------------------
+
+def test_resume_requires_a_journal(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    with pytest.raises(SofaUserError):
+        sofa_resume(cfg)
+
+
+def test_resume_noop_when_committed(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    before = os.stat(cfg.path("report.js")).st_mtime_ns
+    assert sofa_resume(cfg) == 0
+    assert os.stat(cfg.path("report.js")).st_mtime_ns == before
+
+
+def test_resume_replays_uncommitted_preprocess(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    want = open(cfg.path("report.js"), "rb").read()
+    # drop the commit marker: the crash-one-instruction-before-commit shape
+    jpath = cfg.path(durability.JOURNAL_NAME)
+    lines = [ln for ln in open(jpath).read().splitlines()
+             if not ('"commit"' in ln and '"preprocess"' in ln)]
+    with open(jpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    # leave a stale sentinel behind like a real crash would
+    with open(cfg.path(trace.WRITING_SENTINEL), "w") as f:
+        f.write("99999999")
+    assert sofa_resume(cfg) == 0
+    assert not os.path.exists(cfg.path(trace.WRITING_SENTINEL))
+    assert open(cfg.path("report.js"), "rb").read() == want
+    assert journal_state(read_journal(cfg.logdir))["preprocess"][
+        "committed"] is True
+
+
+def test_resume_detects_changed_raw_files(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    before = os.stat(cfg.path("report.js")).st_mtime_ns
+    time.sleep(0.01)
+    with open(cfg.path("mpstat.txt"), "w") as f:
+        f.write("new raw content\n")
+    assert sofa_resume(cfg) == 0  # committed key no longer matches -> replay
+    assert os.stat(cfg.path("report.js")).st_mtime_ns != before
+
+
+# --- disk budgets -----------------------------------------------------------
+
+class _FakeCollector:
+    """alive() collector whose outputs are plain files we control."""
+
+    name = "fake"
+
+    def __init__(self, outdir):
+        self.outdir = outdir
+        self.killed = False
+
+    def alive(self):
+        return True
+
+    def outputs(self):
+        return [self.outdir]
+
+    def run_kill(self):
+        self.killed = True
+
+
+def _write_output(outdir, name, nbytes, age_s):
+    path = os.path.join(outdir, name)
+    with open(path, "wb") as f:
+        f.write(b"x" * nbytes)
+    old = time.time() - age_s
+    os.utime(path, (old, old))
+    return path
+
+
+def test_budget_rotates_oldest_files_first(tmp_path):
+    outdir = str(tmp_path / "out")
+    os.makedirs(outdir)
+    oldest = _write_output(outdir, "seg0.txt", 600 * 1024, 30)
+    middle = _write_output(outdir, "seg1.txt", 600 * 1024, 20)
+    newest = _write_output(outdir, "seg2.txt", 300 * 1024, 1)
+    col = _FakeCollector(outdir)
+    cfg = SofaConfig(logdir=str(tmp_path) + "/",
+                     collector_disk_budget_mb=1.0)
+    tel = telemetry.begin("record")
+    try:
+        sup = CollectorSupervisor(cfg, [col])
+        sup._check(col)
+        assert not os.path.exists(oldest)   # rotated away
+        assert os.path.exists(middle)       # under budget after one unlink
+        assert os.path.exists(newest)       # newest never touched
+        assert col.killed is False
+        assert tel.collectors["fake"]["rotated_files"] == 1
+        summary = sup.budget_summary()
+        assert summary["rotated_files"] == 1
+        assert summary["truncated"] == []
+    finally:
+        telemetry.end(tel)
+
+
+def test_budget_degrades_single_growing_file(tmp_path):
+    outdir = str(tmp_path / "out")
+    os.makedirs(outdir)
+    only = _write_output(outdir, "big.pcap", 2 * 1024 * 1024, 5)
+    col = _FakeCollector(outdir)
+    cfg = SofaConfig(logdir=str(tmp_path) + "/",
+                     collector_disk_budget_mb=1.0)
+    tel = telemetry.begin("record")
+    try:
+        sup = CollectorSupervisor(cfg, [col])
+        sup._check(col)
+        assert os.path.exists(only)  # captured bytes are kept
+        assert col.killed is True    # but the producer is stopped
+        ent = tel.collectors["fake"]
+        assert ent["status"] == "truncated_by_budget"
+        # sticky: the epilogue's stop cannot whitewash it
+        tel.collector_event("fake", "stopped")
+        assert tel.collectors["fake"]["status"] == "truncated_by_budget"
+        assert "fake" in sup.budget_summary()["truncated"]
+        # the supervisor stops watching it: no died/restart bookkeeping
+        sup._check(col)
+        assert "died" not in tel.collectors["fake"]
+    finally:
+        telemetry.end(tel)
+
+
+def test_total_budget_enforced_across_collectors(tmp_path):
+    out_a, out_b = str(tmp_path / "a"), str(tmp_path / "b")
+    os.makedirs(out_a)
+    os.makedirs(out_b)
+    _write_output(out_a, "a0.txt", 200 * 1024, 30)
+    _write_output(out_a, "a1.txt", 200 * 1024, 1)
+    big_old = _write_output(out_b, "b0.txt", 900 * 1024, 30)
+    _write_output(out_b, "b1.txt", 200 * 1024, 1)
+    col_a, col_b = _FakeCollector(out_a), _FakeCollector(out_b)
+    col_a.name, col_b.name = "small", "large"
+    cfg = SofaConfig(logdir=str(tmp_path) + "/", disk_budget_mb=1.0)
+    tel = telemetry.begin("record")
+    try:
+        sup = CollectorSupervisor(cfg, [col_a, col_b])
+        sup._check(col_a)
+        sup._check(col_b)
+        sup._enforce_total_budget()
+        # the biggest producer pays, oldest file first
+        assert not os.path.exists(big_old)
+        assert os.path.exists(os.path.join(out_a, "a0.txt"))
+        assert sup.budget_summary()["rotated_files"] == 1
+    finally:
+        telemetry.end(tel)
+
+
+def test_manifest_check_validates_budget_and_digests(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "manifest_check", os.path.join(_ROOT, "tools", "manifest_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+    doc = telemetry.load_manifest(cfg.logdir)
+    assert mc.validate_manifest(doc) == []
+    bad = json.loads(json.dumps(doc))
+    bad["digests"]["files"]["report.js"]["sha256"] = "short"
+    bad["collectors"] = {"x": {"status": "truncated_by_budget",
+                               "rotated_files": -1}}
+    bad["meta"]["disk_budget"] = {"rotated_files": "nope",
+                                  "truncated": [1]}
+    probs = mc.validate_manifest(bad)
+    assert any("sha256" in p for p in probs)
+    assert any("rotated_files" in p and "collectors" in p for p in probs)
+    assert any("disk_budget.rotated_files" in p for p in probs)
+    assert any("truncated" in p for p in probs)
+    # truncated_by_budget is a healthy-schema but unhealthy-run status
+    assert not any("collectors.x.status" in p for p in probs)
+    assert any("unhealthy" in p
+               for p in mc.validate_manifest(bad, require_healthy=True))
+
+
+# --- clean ------------------------------------------------------------------
+
+def test_clean_removes_journal_digests_and_tmp_orphans(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    os.makedirs(cfg.path("_tiles/deep"), exist_ok=True)
+    with open(cfg.path("_tiles/deep/0.json.gz.tmp"), "wb") as f:
+        f.write(b"x")
+    with open(cfg.path("stray.tmp"), "w") as f:
+        f.write("x")
+    assert os.path.isfile(cfg.path(durability.JOURNAL_NAME))
+    assert os.path.isfile(cfg.path(durability.DIGESTS_NAME))
+    sofa_clean(cfg)
+    assert not os.path.exists(cfg.path(durability.JOURNAL_NAME))
+    assert not os.path.exists(cfg.path(durability.DIGESTS_NAME))
+    assert not os.path.exists(cfg.path("stray.tmp"))
+    assert not os.path.exists(cfg.path("_tiles"))
+    assert os.path.isfile(cfg.path("sofa_time.txt"))  # raw stays
+
+
+# --- CLI surface ------------------------------------------------------------
+
+def test_cli_fsck_and_resume_verbs(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    sofa_preprocess(cfg)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_ROOT + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "fsck", cfg.logdir],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "resume", cfg.logdir],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    with open(cfg.path("report.js"), "a") as f:
+        f.write("GARBAGE")
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "fsck", cfg.logdir],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 1
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "fsck", cfg.logdir, "--repair"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+
+
+# --- the SIGKILL acceptance proof (slow: full chaos harness) ----------------
+
+@pytest.mark.slow
+def test_kill_sofa_cells_end_to_end(tmp_path):
+    """SIGKILL mid-preprocess and mid-tile-build; `sofa resume` must
+    converge to a byte-identical report.js (tools/chaos_matrix.py)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_matrix", os.path.join(_ROOT, "tools", "chaos_matrix.py"))
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+    mc = cm._load_manifest_check()
+    synth = cm._synth(str(tmp_path))
+    for name, point in cm.KILL_CELLS:
+        problems = cm._run_kill_cell(name, point, str(tmp_path), synth, mc)
+        assert problems == [], f"{name}: {problems}"

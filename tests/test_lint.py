@@ -260,6 +260,38 @@ def test_sl008_exempt_in_signal_tree_owners(tmp_path):
     assert run_rules(tmp_path, "collectors/base.py", src) == []
 
 
+# --- SL009 ------------------------------------------------------------------
+
+def test_sl009_flags_bare_derived_writes(tmp_path):
+    fs = run_rules(tmp_path, "tiles.py", """
+        import gzip
+        def write(path, blob, doc):
+            with open(path, "w") as f:
+                f.write(doc)
+            with gzip.open(path + ".gz", mode="wb") as f:
+                f.write(blob)
+    """)
+    assert rule_ids(fs) == ["SL009", "SL009"]
+
+
+def test_sl009_allows_reads_helper_and_out_of_scope(tmp_path):
+    # reads never trip it, and the helper module itself is exempt
+    src_read = """
+        def load(path):
+            with open(path) as f:
+                return f.read()
+    """
+    assert run_rules(tmp_path, "tiles.py", src_read) == []
+    src_write = """
+        def write(path, doc):
+            with open(path, "w") as f:
+                f.write(doc)
+    """
+    assert run_rules(tmp_path, "durability.py", src_write) == []
+    # raw-file producers (collectors/record) are out of scope by design
+    assert run_rules(tmp_path, "collectors/foo.py", src_write) == []
+
+
 # --- engine: suppressions, parse errors ------------------------------------
 
 def test_inline_suppression_silences_one_line(tmp_path):

@@ -8,6 +8,13 @@ run_manifest.json (tools/manifest_check.py) and a report.js — the
 "a profiling run always yields a usable trace" contract, exercised on
 demand instead of waiting for production to exercise it for us.
 
+On top of the collector-fault matrix, the **kill-sofa-itself cells**
+(sofa_tpu/durability.py's acceptance proof) SIGKILL the preprocess
+process at a random point — once mid frame-write, once mid tile build —
+and assert that `sofa resume` completes the run with a ``report.js``
+byte-identical to an uninterrupted run on the same logdir, a
+schema-valid manifest, and `sofa fsck` exit 0.
+
     python tools/chaos_matrix.py [workdir]
 
 Prints one PASS/FAIL row per cell; exits nonzero if any cell fails.
@@ -56,6 +63,37 @@ MATRIX: List[Tuple[str, str, dict]] = [
 _RAW_OVERLAY = ("perf.script", "strace.txt", "pystacks.txt", "mpstat.txt",
                 "cpuinfo.txt", "netstat.txt", "vmstat.txt", "tpumon.txt",
                 "misc.txt")
+
+# Kill-sofa-itself cells: (name, crash point).  The crash point patches a
+# hot write path in the child so os.kill(SIGKILL) fires mid-derived-write
+# after a random number of writes — no cleanup handler of any kind runs,
+# exactly like the OOM-killer / a node preemption.
+KILL_CELLS = [
+    ("kill-mid-preprocess", "frames"),
+    ("kill-mid-tiles", "tiles"),
+]
+
+_KILL_SNIPPET = """
+import os, signal, sys
+sys.path.insert(0, sys.argv[4])
+logdir, point, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+from sofa_tpu import tiles, trace
+count = [0]
+def arm(orig):
+    def hook(*a, **kw):
+        count[0] += 1
+        if count[0] >= n:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return orig(*a, **kw)
+    return hook
+if point == "tiles":
+    tiles._write_tile = arm(tiles._write_tile)
+else:
+    trace.write_csv = arm(trace.write_csv)
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import sofa_preprocess
+sofa_preprocess(SofaConfig(logdir=logdir))
+"""
 
 
 def _load_manifest_check():
@@ -132,6 +170,67 @@ def _run_cell(name: str, spec: str, overrides: dict, workdir: str,
     return problems
 
 
+def _run_kill_cell(name: str, point: str, workdir: str, synth: str,
+                   mc) -> List[str]:
+    """SIGKILL sofa mid-preprocess, then prove `sofa resume` restores the
+    run bit-for-bit.  Control and resumed runs share ONE logdir path (the
+    report.js meta embeds it), separated by `sofa clean`."""
+    import random
+
+    from sofa_tpu.durability import sofa_fsck, sofa_resume
+    from sofa_tpu.record import sofa_clean
+    from sofa_tpu.trace import WRITING_SENTINEL
+
+    logdir = os.path.join(workdir, name) + "/"
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.copytree(synth, logdir)  # copy2: raw mtimes survive (cache keys)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+
+    # 1. uninterrupted control run -> the byte-identity target
+    sofa_preprocess(cfg)
+    with open(cfg.path("report.js"), "rb") as f:
+        want = f.read()
+    sofa_clean(cfg)  # back to raw-only: derived, caches, journal all gone
+
+    # 2. the crashing run: SIGKILL at a random point in the derived writes
+    n = random.randint(1, 6)
+    root = os.path.dirname(_TOOLS)
+    r = subprocess.run(
+        [sys.executable, "-c", _KILL_SNIPPET, logdir, point, str(n), root],
+        capture_output=True, text=True, timeout=600)
+    if r.returncode != -9:
+        return problems + [f"crash child exited rc={r.returncode} "
+                           f"(expected SIGKILL -9; kill after write #{n}); "
+                           f"stderr tail: {r.stderr.strip()[-200:]}"]
+    if not os.path.exists(cfg.path(WRITING_SENTINEL)):
+        # both crash points sit inside derived_write_guard: the kill must
+        # leave the sentinel behind, and resume must reap it
+        problems.append("no mid-write sentinel left by the killed run")
+
+    # 3. resume must complete and converge to the control bytes
+    rc = sofa_resume(cfg)
+    if rc != 0:
+        problems.append(f"sofa resume rc={rc}")
+    try:
+        with open(cfg.path("report.js"), "rb") as f:
+            got = f.read()
+        if got != want:
+            problems.append(
+                f"report.js after resume differs from the uninterrupted "
+                f"run ({len(got)} vs {len(want)} bytes)")
+    except OSError as e:
+        problems.append(f"no report.js after resume: {e}")
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        problems.append("no run_manifest.json after resume")
+    else:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    if sofa_fsck(cfg) != 0:
+        problems.append("sofa fsck nonzero on the resumed logdir")
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -139,7 +238,9 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    width = max(len(n) for n, _s, _o in MATRIX)
+    n_cells = len(MATRIX) + len(KILL_CELLS)
+    width = max(len(n) for n, _s in
+                [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS)
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -151,7 +252,18 @@ def main(argv=None) -> int:
               f"{spec or '(real corrupt pcap)'}")
         for p in problems:
             print(f"{' ' * width}    - {p}")
-    print(f"chaos matrix: {len(MATRIX) - failures}/{len(MATRIX)} cells "
+    for name, point in KILL_CELLS:
+        try:
+            problems = _run_kill_cell(name, point, workdir, synth, mc)
+        except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+            problems = ["crashed:\n" + traceback.format_exc()]
+        status = "PASS" if not problems else "FAIL"
+        failures += bool(problems)
+        print(f"{name.ljust(width)}  {status}  (SIGKILL during {point}, "
+              "then sofa resume)")
+        for p in problems:
+            print(f"{' ' * width}    - {p}")
+    print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "
           "survived with a valid manifest + report")
     return 1 if failures else 0
 

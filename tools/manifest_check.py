@@ -98,12 +98,13 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
         if ent.get("status") not in COLLECTOR_STATUSES:
             probs.append(f"{where}.status: {ent.get('status')!r} not in "
                          f"{COLLECTOR_STATUSES}")
-        for key in ("bytes_captured", "exit_code", "restarts", "deaths"):
+        for key in ("bytes_captured", "exit_code", "restarts", "deaths",
+                    "rotated_files", "budget_bytes"):
             if key in ent and not isinstance(ent[key], int):
                 probs.append(f"{where}.{key}: not an int")
         if "bytes_captured" in ent and ent["bytes_captured"] < 0:
             probs.append(f"{where}.bytes_captured: negative")
-        for key in ("restarts", "deaths"):
+        for key in ("restarts", "deaths", "rotated_files", "budget_bytes"):
             if key in ent and isinstance(ent[key], int) and ent[key] < 0:
                 probs.append(f"{where}.{key}: negative")
         for key in ("died", "timed_out", "output_stalled"):
@@ -150,6 +151,63 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                     tiles["cached"] > tiles["series"]:
                 probs.append("meta.tiles: cached exceeds series")
 
+    # digests (additive in v4 — sofa_tpu/durability.py): the sha256
+    # integrity ledger `sofa fsck` verifies.
+    digests = doc.get("digests")
+    if digests is not None:
+        if not isinstance(digests, dict) or \
+                not isinstance(digests.get("files"), dict):
+            probs.append("digests: not an object with a files map")
+        else:
+            if not isinstance(digests.get("algo"), str):
+                probs.append("digests.algo: missing or not a string")
+            for rel, ent in digests["files"].items():
+                where = f"digests.files[{rel!r}]"
+                if not isinstance(ent, dict):
+                    probs.append(f"{where}: not an object")
+                    continue
+                sha = ent.get("sha256")
+                if not (isinstance(sha, str) and len(sha) == 64):
+                    probs.append(f"{where}.sha256: not a 64-hex digest")
+                for key in ("bytes", "mtime_ns"):
+                    v = ent.get(key)
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or v < 0:
+                        probs.append(f"{where}.{key}: missing or not a "
+                                     "non-negative int")
+                if ent.get("kind") not in ("raw", "derived"):
+                    probs.append(f"{where}.kind: {ent.get('kind')!r} not "
+                                 "raw/derived")
+
+    # meta.disk_budget (written when --disk_budget/--collector_disk_budget
+    # is on) and meta.fsck (written by `sofa fsck`).
+    budget = (doc.get("meta") or {}).get("disk_budget")
+    if budget is not None:
+        if not isinstance(budget, dict):
+            probs.append("meta.disk_budget: not an object")
+        else:
+            for key in ("budget_mb", "collector_budget_mb"):
+                v = budget.get(key)
+                if v is not None and (not _is_num(v) or v < 0):
+                    probs.append(f"meta.disk_budget.{key}: not a "
+                                 "non-negative number or null")
+            v = budget.get("rotated_files")
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                probs.append("meta.disk_budget.rotated_files: missing or "
+                             "not a non-negative int")
+            t = budget.get("truncated")
+            if not isinstance(t, list) or \
+                    any(not isinstance(n, str) for n in t):
+                probs.append("meta.disk_budget.truncated: not a list of "
+                             "collector names")
+    fsck = (doc.get("meta") or {}).get("fsck")
+    if fsck is not None:
+        if not isinstance(fsck, dict) or \
+                not isinstance(fsck.get("ok"), bool):
+            probs.append("meta.fsck: not an object with a bool ok")
+        elif not isinstance(fsck.get("problems"), dict):
+            probs.append("meta.fsck.problems: missing verdict counts")
+
     stages = doc.get("stages", [])
     if not isinstance(stages, list):
         probs.append("stages: not a list")
@@ -171,9 +229,12 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
     if require_healthy:
         for name, ent in collectors.items():
             if ent.get("status") in ("failed", "killed", "died",
-                                     "timed_out"):
+                                     "timed_out", "truncated_by_budget"):
                 probs.append(f"unhealthy: collector {name} "
                              f"{ent.get('status')}")
+        if isinstance(fsck, dict) and fsck.get("ok") is False:
+            probs.append("unhealthy: the last `sofa fsck` found damaged "
+                         "artifacts")
         for name, ent in sources.items():
             if ent.get("status") in ("quarantined", "failed"):
                 probs.append(f"unhealthy: source {name} "
