@@ -1,0 +1,64 @@
+"""Fleet trace archive: a content-addressed multi-run store.
+
+A profiler serving a fleet is really a *trace database*: one logdir
+answers "what happened in this run", but fleet operation needs "did this
+run regress against the last hundred".  This package is that database,
+composed from the ingredients earlier PRs built — the sha256 digest
+ledger (durability.py) is the dedup index, the content-keyed tile
+pyramid (tiles.py) makes run-to-run timeline diffs byte-comparable, and
+the journal/fsync discipline makes every write crash-safe.
+
+Layout of an archive root (``--archive_root`` / ``SOFA_ARCHIVE_ROOT``,
+default ``./sofa_archive/``)::
+
+    sofa_archive.json            marker: schema + version (is_archive_root)
+    catalog.jsonl                append-only event ledger (fsync'd lines:
+                                 ingest / bench / gc; torn tail tolerated)
+    objects/<aa>/<sha256>        deduped content blobs (frames, tiles,
+                                 manifests, raw artifacts) — one copy no
+                                 matter how many runs share the bytes
+    runs/<run_id>.json           per-run manifest: rel path -> sha256 map,
+                                 feature vector, provenance
+
+``run_id`` is the sha256 of the run's (path, sha256) content map — a true
+content address: re-ingesting an unchanged logdir yields the same id and
+grows the store by only a catalog entry.
+
+Verbs: ``sofa archive <logdir>`` ingests (plus ``ls`` / ``show <run>`` /
+``gc --keep N --keep_days D``); ``sofa regress <run> [<baseline>]``
+(archive/verdict.py) is the typed regression engine over the catalog;
+``sofa fsck <archive_root>`` verifies store integrity.  See
+docs/ARCHIVE.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+ARCHIVE_MARKER_NAME = "sofa_archive.json"
+CATALOG_NAME = "catalog.jsonl"
+OBJECTS_DIR_NAME = "objects"
+RUNS_DIR_NAME = "runs"
+QUARANTINE_DIR_NAME = "_quarantine"
+VERDICT_NAME = "regress_verdict.json"
+
+ARCHIVE_SCHEMA = "sofa_tpu/archive"
+# Bumps on any BREAKING layout/meaning change, like the run manifest's
+# policy (docs/OBSERVABILITY.md): additive keys do not bump it.
+ARCHIVE_VERSION = 1
+
+DEFAULT_ROOT = "sofa_archive"
+
+
+def resolve_root(cfg=None) -> str:
+    """The archive root for this invocation: ``--archive_root``, else the
+    ``SOFA_ARCHIVE_ROOT`` env var, else ``./sofa_archive``."""
+    root = getattr(cfg, "archive_root", "") if cfg is not None else ""
+    return root or os.environ.get("SOFA_ARCHIVE_ROOT", "") or DEFAULT_ROOT
+
+
+def is_archive_root(path: str) -> bool:
+    """Whether ``path`` is an archive root (its marker file exists).  The
+    guard `sofa clean` and `sofa fsck` dispatch on: an archive nested
+    under a logdir must never be swept as derived output."""
+    return os.path.isfile(os.path.join(path, ARCHIVE_MARKER_NAME))

@@ -1,0 +1,93 @@
+"""The archive's append-only run catalog (``catalog.jsonl``).
+
+One JSON line per event, fsync'd through ``durability.fsync_append`` (the
+run journal's discipline): a crash mid-append leaves at worst one torn
+final line, which :func:`read_catalog` skips.  Event vocabulary::
+
+    {"ev": "ingest", "run": <run_id>, "t": ..., "logdir": ..., "files": N,
+     "new_objects": M, "bytes_added": B, "label": ...}
+    {"ev": "bench",  "metric": ..., "value": ..., "t": ..., "round": ...,
+     "extra": {...}}          # bench.py's evidence trajectory
+    {"ev": "gc",     "t": ..., "dropped_runs": N, "swept_objects": M,
+     "freed_bytes": B}
+
+The catalog is the archive's source of truth for run ORDER (rolling
+baselines read it newest-last); the per-run content lives in
+``runs/<run_id>.json``.  Re-ingesting a run appends a fresh ingest event
+for the same id — readers dedup by id keeping the newest, so the file
+stays append-only (`sofa archive gc` is the only compaction path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from sofa_tpu.archive import CATALOG_NAME
+
+
+def catalog_path(root: str) -> str:
+    return os.path.join(root, CATALOG_NAME)
+
+
+def append_event(root: str, ev: str, **fields) -> dict:
+    """Durably append one event line; returns the entry written."""
+    from sofa_tpu.durability import fsync_append
+
+    entry = {"ev": ev, "t": round(time.time(), 3), **fields}
+    fsync_append(catalog_path(root),
+                 json.dumps(entry, separators=(",", ":")) + "\n")
+    return entry
+
+
+def read_catalog(root: str) -> List[dict]:
+    """Every parseable event, file order (oldest first).  A torn final
+    line — the crash case the fsync'd appends are designed around — or
+    any unparsable line is skipped, like the run journal's reader."""
+    entries: List[dict] = []
+    try:
+        with open(catalog_path(root)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a mid-append crash
+                if isinstance(e, dict):
+                    entries.append(e)
+    except OSError:
+        return []
+    return entries
+
+
+def ingest_entries(entries: List[dict]) -> List[dict]:
+    """Ingest events deduped by run id (newest wins), ordered oldest
+    first — the run sequence rolling baselines walk."""
+    latest: Dict[str, dict] = {}
+    for e in entries:
+        run = e.get("run")
+        if e.get("ev") == "ingest" and isinstance(run, str):
+            latest[run] = e
+    return sorted(latest.values(), key=lambda e: e.get("t", 0))
+
+
+def bench_entries(entries: List[dict],
+                  metric: Optional[str] = None) -> List[dict]:
+    """Bench evidence events, oldest first, optionally for one metric."""
+    out = [e for e in entries if e.get("ev") == "bench"
+           and (metric is None or e.get("metric") == metric)]
+    return sorted(out, key=lambda e: e.get("t", 0))
+
+
+def rewrite(root: str, entries: List[dict]) -> None:
+    """Atomically replace the catalog (gc's compaction path — the ONLY
+    writer that is not an append)."""
+    from sofa_tpu.durability import atomic_write
+
+    with atomic_write(catalog_path(root), fsync=True) as f:
+        for e in entries:
+            f.write(json.dumps(e, separators=(",", ":")) + "\n")

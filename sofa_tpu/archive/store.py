@@ -1,0 +1,736 @@
+"""The content-addressed object store + run manifests + `sofa archive`.
+
+Ingest walks the logdir's sha256 digest ledger (durability.py — computed
+on the spot when the logdir predates it), streams each artifact into
+``objects/<aa>/<sha256>`` exactly once, and lands a per-run manifest in
+``runs/<run_id>.json`` plus one fsync'd catalog line.  Every byte-level
+dedup falls out of the pipeline's existing determinism: tiles are
+gzip'd with ``mtime=0``, frames are written by a deterministic columnar
+writer, so two runs over unchanged inputs share every object and the
+second ingest costs one catalog entry.
+
+Crash safety mirrors the logdir pipeline: objects and run docs land via
+``durability.atomic_write`` (deterministic ``.tmp`` names, so a replay
+overwrites a crash's leftovers), the catalog line is the commit point,
+and the ingest is journaled in the LOGDIR's run journal (`sofa resume`
+replays an uncommitted ``archive`` stage).  ``archive_fsck`` verifies
+the store: every object re-hashes to its name, every run doc's
+references exist, uncataloged run docs (crash between run-doc write and
+catalog append) are re-adopted by ``--repair``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+from sofa_tpu.archive import (
+    ARCHIVE_MARKER_NAME,
+    ARCHIVE_SCHEMA,
+    ARCHIVE_VERSION,
+    OBJECTS_DIR_NAME,
+    QUARANTINE_DIR_NAME,
+    RUNS_DIR_NAME,
+    catalog,
+)
+from sofa_tpu.printing import (
+    print_error,
+    print_progress,
+    print_title,
+    print_warning,
+)
+
+RUN_SCHEMA = "sofa_tpu/archive_run"
+RUN_VERSION = 1
+
+_HASH_CHUNK = 1 << 20
+
+# fsck verdict vocabulary for the store, in rendering order.  ``corrupt``
+# (object bytes no longer hash to its name), ``missing`` (a run doc
+# references an absent object), ``orphaned`` (``*.tmp`` leftovers of an
+# interrupted write), ``uncataloged`` (a run doc the catalog never
+# committed — recoverable: --repair re-appends its ingest line).
+# ``unreferenced`` objects (no surviving run points at them) are reported
+# but are NOT damage: they are what `sofa archive gc` exists to sweep.
+ARCHIVE_FSCK_VERDICTS = ("corrupt", "missing", "orphaned", "uncataloged")
+
+
+class ArchiveStore:
+    """One archive root.  ``create=True`` initializes the marker/dirs."""
+
+    def __init__(self, root: str, create: bool = False):
+        self.root = root
+        self.marker_path = os.path.join(root, ARCHIVE_MARKER_NAME)
+        if create and not os.path.isfile(self.marker_path):
+            self._init_root()
+
+    def _init_root(self) -> None:
+        from sofa_tpu.durability import atomic_write
+
+        os.makedirs(os.path.join(self.root, OBJECTS_DIR_NAME), exist_ok=True)
+        os.makedirs(os.path.join(self.root, RUNS_DIR_NAME), exist_ok=True)
+        with atomic_write(self.marker_path, fsync=True) as f:
+            json.dump({"schema": ARCHIVE_SCHEMA, "version": ARCHIVE_VERSION,
+                       "created_unix": round(time.time(), 3)}, f)
+
+    @property
+    def exists(self) -> bool:
+        return os.path.isfile(self.marker_path)
+
+    # -- objects -----------------------------------------------------------
+    def object_path(self, sha: str) -> str:
+        return os.path.join(self.root, OBJECTS_DIR_NAME, sha[:2], sha)
+
+    def has_object(self, sha: str) -> bool:
+        return os.path.isfile(self.object_path(sha))
+
+    def put_file(self, src: str,
+                 expected_sha: Optional[str] = None) -> Tuple[str, int]:
+        """Store ``src``'s bytes; returns (sha256, bytes_added).
+
+        Dedup fast path: when the caller's digest-ledger sha is trusted
+        and the object already exists, nothing is read at all.  Otherwise
+        the bytes are hashed while staging into a deterministic ``.tmp``
+        beside the object (a crashed ingest's leftover is simply
+        overwritten by the replay), then renamed in."""
+        if expected_sha and self.has_object(expected_sha):
+            return expected_sha, 0
+        h = hashlib.sha256()
+        stage = self.object_path(expected_sha or "xx/staging") + ".tmp"
+        os.makedirs(os.path.dirname(stage), exist_ok=True)
+        size = 0
+        with open(src, "rb") as fin, open(stage, "wb") as fout:  # sofa-lint: disable=SL009 — staged under a deterministic .tmp name and renamed below; atomic_write cannot target a path unknown until the stream is hashed
+            while True:
+                chunk = fin.read(_HASH_CHUNK)
+                if not chunk:
+                    break
+                h.update(chunk)
+                fout.write(chunk)
+                size += len(chunk)
+            fout.flush()
+            os.fsync(fout.fileno())
+        sha = h.hexdigest()
+        dest = self.object_path(sha)
+        if os.path.isfile(dest):
+            os.unlink(stage)
+            return sha, 0
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        os.replace(stage, dest)
+        return sha, size
+
+    def put_bytes(self, blob: bytes) -> Tuple[str, int]:
+        """Store an in-memory blob; returns (sha256, bytes_added)."""
+        sha = hashlib.sha256(blob).hexdigest()
+        dest = self.object_path(sha)
+        if os.path.isfile(dest):
+            return sha, 0
+        from sofa_tpu.durability import atomic_write
+
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with atomic_write(dest, "wb") as f:
+            f.write(blob)
+        return sha, len(blob)
+
+    def read_object(self, sha: str) -> Optional[bytes]:
+        try:
+            with open(self.object_path(sha), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # -- run docs ----------------------------------------------------------
+    def run_doc_path(self, run_id: str) -> str:
+        return os.path.join(self.root, RUNS_DIR_NAME, f"{run_id}.json")
+
+    def load_run(self, run_id: str) -> Optional[dict]:
+        try:
+            with open(self.run_doc_path(run_id)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def run_ids(self) -> List[str]:
+        try:
+            names = os.listdir(os.path.join(self.root, RUNS_DIR_NAME))
+        except OSError:
+            return []
+        return sorted(n[:-5] for n in names
+                      if n.endswith(".json") and len(n) == 69)
+
+    def resolve_run_id(self, prefix: str) -> Optional[str]:
+        """Full run id from a unique prefix (>= 6 chars), else None."""
+        if len(prefix) < 6:
+            return None
+        hits = [r for r in self.run_ids() if r.startswith(prefix)]
+        return hits[0] if len(hits) == 1 else None
+
+    def extract(self, run_id: str, dest: str) -> int:
+        """Materialize an archived run's files under ``dest`` (tooling /
+        tests); returns the file count."""
+        doc = self.load_run(run_id)
+        if doc is None:
+            raise FileNotFoundError(f"no archived run {run_id}")
+        n = 0
+        for rel, ent in sorted((doc.get("files") or {}).items()):
+            blob = self.read_object(ent.get("sha256", ""))
+            if blob is None:
+                print_warning(f"archive: object for {rel} is missing — "
+                              "skipped in extract (run `sofa fsck` on the "
+                              "archive root)")
+                continue
+            path = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            from sofa_tpu.durability import atomic_write
+
+            with atomic_write(path, "wb") as f:
+                f.write(blob)
+            n += 1
+        return n
+
+
+def run_content_id(files: Dict[str, dict]) -> str:
+    """The run id: sha256 over the sorted (rel, sha256) content map — a
+    content address, so an unchanged logdir re-ingests to the same id."""
+    h = hashlib.sha256()
+    for rel in sorted(files):
+        h.update(f"{rel}\0{files[rel]['sha256']}\n".encode())
+    return h.hexdigest()
+
+
+def _read_features_csv(path: str) -> Dict[str, float]:
+    """features.csv (name,value) -> dict; latest value wins, like
+    Features.get.  Missing/unparsable file -> {}."""
+    import csv
+
+    out: Dict[str, float] = {}
+    try:
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                try:
+                    out[str(row["name"])] = float(row["value"])
+                except (KeyError, ValueError, TypeError):
+                    continue
+    except OSError:
+        return {}
+    return out
+
+
+def ingest_run(cfg, root: str, label: str = "",
+               tel=None) -> dict:
+    """Ingest ``cfg.logdir`` into the archive at ``root``.
+
+    Returns the catalog summary ``{"run", "files", "new_objects",
+    "bytes_added", "wall_s"}``.  Journaled in the logdir's run journal
+    (stage ``archive``) so `sofa resume` replays a killed ingest."""
+    from sofa_tpu import durability
+
+    logdir = cfg.logdir
+    t0 = time.perf_counter()
+    store = ArchiveStore(root, create=True)
+    journal = durability.Journal(logdir)
+    journal.begin("archive", key=durability.logdir_raw_key(logdir),
+                  archive_root=os.path.abspath(root))
+
+    from sofa_tpu.telemetry import maybe_span
+
+    with maybe_span("archive_scan", cat="stage"):
+        ledger = durability.load_digests(logdir)
+        if ledger is None:
+            ledger = durability.compute_digests(logdir)
+        targets: Dict[str, dict] = dict(ledger.get("files") or {})
+
+    files: Dict[str, dict] = {}
+    new_objects = 0
+    bytes_added = 0
+    with maybe_span("archive_objects", cat="stage"):
+        for rel, ent in sorted(targets.items()):
+            path = os.path.join(logdir, rel)
+            expected = None
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # vanished since the ledger: nothing to archive
+            if st.st_size == ent.get("bytes") \
+                    and st.st_mtime_ns == ent.get("mtime_ns"):
+                expected = ent.get("sha256")
+            try:
+                sha, added = store.put_file(path, expected)
+            except OSError as e:
+                print_warning(f"archive: cannot store {rel}: {e} — "
+                              "skipped (the run doc will not reference it)")
+                continue
+            files[rel] = {"sha256": sha, "bytes": int(st.st_size),
+                          "kind": ent.get("kind")
+                          or durability._file_kind(rel)}
+            if added:
+                new_objects += 1
+                bytes_added += added
+        # The run manifest is the health record of the run — archive it
+        # too (the digest ledger skips it by design), but NORMALIZED: the
+        # archive/regress verbs' own sections and the per-write timestamp
+        # are stripped, so the act of archiving can never change the next
+        # ingest's content (re-ingest must stay a pure catalog append).
+        blob = _normalized_manifest(logdir)
+        if blob is not None:
+            from sofa_tpu.telemetry import MANIFEST_NAME
+
+            sha, added = store.put_bytes(blob)
+            files[MANIFEST_NAME] = {"sha256": sha, "bytes": len(blob),
+                                    "kind": "derived"}
+            if added:
+                new_objects += 1
+                bytes_added += added
+
+    run_id = run_content_id(files)
+    features = _read_features_csv(os.path.join(logdir, "features.csv"))
+    doc = {
+        "schema": RUN_SCHEMA, "version": RUN_VERSION,
+        "run": run_id, "t": round(time.time(), 3),
+        "logdir": os.path.abspath(logdir),
+        "hostname": _hostname(),
+        "label": label or "",
+        "files": files,
+        "features": features,
+    }
+    with maybe_span("archive_commit", cat="stage"):
+        prev = store.load_run(run_id)
+        if prev is None or prev.get("files") != files:
+            with durability.atomic_write(store.run_doc_path(run_id),
+                                         fsync=True) as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+        # The catalog line is the ingest's commit point: fsck adopts a
+        # run doc whose append never landed.
+        catalog.append_event(root, "ingest", run=run_id,
+                             logdir=os.path.abspath(logdir),
+                             files=len(files), new_objects=new_objects,
+                             bytes_added=bytes_added,
+                             **({"label": label} if label else {}))
+    journal.commit("archive", key=durability.logdir_raw_key(logdir),
+                   run=run_id)
+    summary = {"run": run_id, "files": len(files),
+               "new_objects": new_objects, "bytes_added": bytes_added,
+               "wall_s": round(time.perf_counter() - t0, 3)}
+    if tel is not None:
+        tel.set_meta(archive={**summary, "root": os.path.abspath(root)})
+    print_progress(
+        f"archive: run {run_id[:12]} — {len(files)} file(s), "
+        f"{new_objects} new object(s), {bytes_added / 2**20:.2f} MiB added "
+        f"-> {root}")
+    return summary
+
+
+_SELF_VERBS = ("archive", "regress")
+
+
+def _normalized_manifest(logdir: str) -> Optional[bytes]:
+    """run_manifest.json reduced to canonical bytes that are a pure
+    function of the RUN: the archive/regress self-sections, the per-write
+    timestamp, and the last-writer-wins ``env``/``config`` snapshots
+    (pid, the writing verb's own flags) are stripped — so archiving a
+    run, or re-archiving it, can never change what the next ingest sees.
+    The health ledger itself (collectors, sources, pipeline runs, stages)
+    is what the archive preserves."""
+    from sofa_tpu.telemetry import load_manifest
+
+    doc = load_manifest(logdir)
+    if doc is None:
+        return None
+    for volatile in ("generated_unix", "env", "config"):
+        doc.pop(volatile, None)
+    runs = doc.get("runs")
+    if isinstance(runs, dict):
+        for verb in _SELF_VERBS:
+            runs.pop(verb, None)
+    meta = doc.get("meta")
+    if isinstance(meta, dict):
+        for key in _SELF_VERBS:
+            meta.pop(key, None)
+    if isinstance(doc.get("stages"), list):
+        doc["stages"] = [s for s in doc["stages"]
+                         if s.get("verb") not in _SELF_VERBS]
+    return json.dumps(doc, indent=1, sort_keys=True).encode()
+
+
+def _hostname() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# gc.
+# ---------------------------------------------------------------------------
+
+def gc(root: str, keep: int = 0, keep_days: float = 0.0) -> dict:
+    """Drop ingest runs beyond the retention policy and sweep objects no
+    surviving run references.  The ONLY deletion path for archived data.
+
+    ``keep``: newest N ingest runs survive (0 = no count limit);
+    ``keep_days``: runs ingested within the last D days survive (0 = no
+    age limit).  A run survives if EITHER rule keeps it."""
+    store = ArchiveStore(root)
+    entries = catalog.read_catalog(root)
+    runs = catalog.ingest_entries(entries)
+    cutoff = (time.time() - keep_days * 86400.0) if keep_days > 0 else None
+    dropped: List[str] = []
+    kept: List[dict] = []
+    for i, e in enumerate(runs):
+        newest_n = keep > 0 and i >= len(runs) - keep
+        fresh = cutoff is not None and e.get("t", 0) >= cutoff
+        if newest_n or fresh or (keep <= 0 and cutoff is None):
+            kept.append(e)
+        else:
+            dropped.append(e["run"])
+    for run_id in dropped:
+        try:
+            os.unlink(store.run_doc_path(run_id))
+        except OSError as e:
+            print_warning(f"archive gc: cannot drop run doc "
+                          f"{run_id[:12]}: {e}")
+    # Sweep objects referenced by no surviving run doc (including docs
+    # that were never cataloged — fsck's adoption path owns those, gc
+    # must not pull bytes out from under them).
+    referenced = set()
+    for run_id in store.run_ids():
+        doc = store.load_run(run_id) or {}
+        for ent in (doc.get("files") or {}).values():
+            referenced.add(ent.get("sha256"))
+    swept = 0
+    freed = 0
+    obj_root = os.path.join(root, OBJECTS_DIR_NAME)
+    for dirpath, _dirs, names in os.walk(obj_root):
+        for name in names:
+            if name.endswith(".tmp") or name in referenced:
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                freed += os.path.getsize(path)
+                os.unlink(path)
+                swept += 1
+            except OSError as e:
+                print_warning(f"archive gc: cannot sweep object "
+                              f"{name[:12]}: {e}")
+    # Compact the catalog: ingest lines of surviving runs + every
+    # non-ingest event (the bench trajectory is history, not retention).
+    keep_ids = {e["run"] for e in kept}
+    compacted = [e for e in entries
+                 if e.get("ev") != "ingest" or e.get("run") in keep_ids]
+    catalog.rewrite(root, compacted)
+    summary = {"dropped_runs": len(dropped), "swept_objects": swept,
+               "freed_bytes": freed}
+    catalog.append_event(root, "gc", **summary)
+    print_progress(
+        f"archive gc: dropped {len(dropped)} run(s), swept {swept} "
+        f"object(s), freed {freed / 2**20:.2f} MiB")
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# fsck.
+# ---------------------------------------------------------------------------
+
+def archive_fsck(root: str, repair: bool = False) -> Optional[dict]:
+    """Verify store integrity; returns the report dict or None when
+    ``root`` is not an archive.  Verdicts: ARCHIVE_FSCK_VERDICTS (damage)
+    plus informational ``unreferenced`` (gc's job, not damage)."""
+    store = ArchiveStore(root)
+    if not store.exists:
+        return None
+    report: Dict[str, list] = {v: [] for v in ARCHIVE_FSCK_VERDICTS}
+    report["unreferenced"] = []
+    entries = catalog.read_catalog(root)
+    cataloged = {e.get("run") for e in entries if e.get("ev") == "ingest"}
+    referenced: Dict[str, str] = {}
+    for run_id in store.run_ids():
+        doc = store.load_run(run_id)
+        if doc is None:
+            report["corrupt"].append(f"runs/{run_id}.json")
+            continue
+        if run_id not in cataloged:
+            report["uncataloged"].append(run_id)
+        for rel, ent in sorted((doc.get("files") or {}).items()):
+            sha = ent.get("sha256", "")
+            referenced.setdefault(sha, f"{run_id[:12]}:{rel}")
+            if not store.has_object(sha):
+                report["missing"].append(f"{run_id[:12]}:{rel}")
+    checked = 0
+    obj_root = os.path.join(root, OBJECTS_DIR_NAME)
+    for dirpath, _dirs, names in os.walk(obj_root):
+        for name in sorted(names):
+            path = os.path.join(dirpath, name)
+            if name.endswith(".tmp"):
+                report["orphaned"].append(
+                    os.path.relpath(path, root).replace(os.sep, "/"))
+                continue
+            checked += 1
+            if _sha256_file(path) != name:
+                report["corrupt"].append(
+                    os.path.relpath(path, root).replace(os.sep, "/"))
+            elif name not in referenced:
+                report["unreferenced"].append(name)
+    for dirpath, dirs, names in os.walk(root):
+        if os.path.basename(dirpath) == OBJECTS_DIR_NAME:
+            dirs[:] = []  # object tmps already classified above
+            continue
+        for name in names:
+            if name.endswith(".tmp"):
+                report["orphaned"].append(os.path.relpath(
+                    os.path.join(dirpath, name), root).replace(os.sep, "/"))
+    report["checked"] = checked
+    if repair:
+        _archive_repair(store, report)
+    return report
+
+
+def _sha256_file(path: str) -> Optional[str]:
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(_HASH_CHUNK)
+                if not chunk:
+                    break
+                h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+def _archive_repair(store: ArchiveStore, report: Dict[str, list]) -> None:
+    """Adopt uncataloged runs, restore corrupt objects from their source
+    logdir when it still holds matching bytes (quarantine otherwise),
+    and sweep tmp orphans.  Mutates ``report`` toward post-repair truth."""
+    root = store.root
+    for run_id in list(report.get("uncataloged") or []):
+        doc = store.load_run(run_id) or {}
+        catalog.append_event(root, "ingest", run=run_id,
+                             logdir=doc.get("logdir", ""),
+                             files=len(doc.get("files") or {}),
+                             new_objects=0, bytes_added=0, recovered=True)
+        report["uncataloged"].remove(run_id)
+        print_progress(f"archive fsck: re-adopted uncataloged run "
+                       f"{run_id[:12]} into the catalog")
+    # sha -> (source logdir, rel) from the run docs, for re-copy repair.
+    sources: Dict[str, Tuple[str, str]] = {}
+    for run_id in store.run_ids():
+        doc = store.load_run(run_id) or {}
+        for rel, ent in (doc.get("files") or {}).items():
+            sources.setdefault(ent.get("sha256", ""),
+                               (doc.get("logdir", ""), rel))
+    for relpath in list(report.get("corrupt") or []):
+        sha = os.path.basename(relpath)
+        src = sources.get(sha)
+        restored = False
+        if src and src[0]:
+            cand = os.path.join(src[0], src[1])
+            if os.path.isfile(cand) and _sha256_file(cand) == sha:
+                try:
+                    os.unlink(store.object_path(sha))
+                except OSError:
+                    pass
+                try:
+                    store.put_file(cand, None)
+                    restored = True
+                except OSError as e:
+                    print_warning(f"archive fsck: re-copy of {sha[:12]} "
+                                  f"from {cand} failed: {e}")
+        if restored:
+            report["corrupt"].remove(relpath)
+            print_progress(f"archive fsck: restored object {sha[:12]} "
+                           f"from {src[0]}")
+            continue
+        qdir = os.path.join(root, QUARANTINE_DIR_NAME)
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            os.replace(os.path.join(root, relpath),
+                       os.path.join(qdir, sha))
+            report["corrupt"].remove(relpath)
+            report.setdefault("missing", []).append(
+                f"{(sources.get(sha) or ('?', '?'))[1]} (quarantined "
+                f"{sha[:12]})")
+            print_warning(f"archive fsck: object {sha[:12]} is rotted and "
+                          "its source is gone — quarantined (runs "
+                          "referencing it now report missing)")
+        except OSError as e:
+            print_warning(f"archive fsck: cannot quarantine {sha[:12]}: "
+                          f"{e}")
+    for rel in list(report.get("orphaned") or []):
+        try:
+            os.unlink(os.path.join(root, rel))
+            report["orphaned"].remove(rel)
+        except OSError as e:
+            print_warning(f"archive fsck: cannot sweep {rel}: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Tile diff — the multi-run board view's fast path.
+# ---------------------------------------------------------------------------
+
+def tile_diff(doc_a: dict, doc_b: dict) -> dict:
+    """Per-series tile comparison of two archived runs BY CONTENT HASH —
+    identical tiles compare equal without either payload being read
+    (the pyramid is content-keyed and gzip'd deterministically, so
+    unchanged data means byte-identical objects).  Returns::
+
+        {"series": {name: {"unchanged": n, "changed": n,
+                           "only_a": n, "only_b": n}},
+         "totals": {...same counters summed...}}
+    """
+    def tiles_of(doc: dict) -> Dict[str, str]:
+        out = {}
+        for rel, ent in (doc.get("files") or {}).items():
+            if rel.startswith("_tiles/") and rel.endswith(".json.gz"):
+                out[rel] = ent.get("sha256", "")
+        return out
+
+    a, b = tiles_of(doc_a), tiles_of(doc_b)
+    series: Dict[str, Dict[str, int]] = {}
+
+    def bucket(rel: str) -> Dict[str, int]:
+        parts = rel.split("/")
+        name = parts[1] if len(parts) > 2 else "?"
+        return series.setdefault(name, {"unchanged": 0, "changed": 0,
+                                        "only_a": 0, "only_b": 0})
+
+    for rel in sorted(set(a) | set(b)):
+        s = bucket(rel)
+        if rel not in b:
+            s["only_a"] += 1
+        elif rel not in a:
+            s["only_b"] += 1
+        elif a[rel] == b[rel]:
+            s["unchanged"] += 1
+        else:
+            s["changed"] += 1
+    totals = {"unchanged": 0, "changed": 0, "only_a": 0, "only_b": 0}
+    for s in series.values():
+        for k in totals:
+            totals[k] += s[k]
+    return {"series": series, "totals": totals}
+
+
+# ---------------------------------------------------------------------------
+# `sofa archive` verb.
+# ---------------------------------------------------------------------------
+
+def _fmt_mib(n) -> str:
+    return f"{(n or 0) / 2**20:.2f}MiB"
+
+
+def render_ls(root: str) -> List[str]:
+    entries = catalog.read_catalog(root)
+    runs = catalog.ingest_entries(entries)
+    bench = catalog.bench_entries(entries)
+    lines = [f"archive: {root} — {len(runs)} run(s), "
+             f"{len(bench)} bench event(s)"]
+    rows = [["RUN", "WHEN", "FILES", "ADDED", "LOGDIR"]]
+    for e in runs:
+        when = time.strftime("%Y-%m-%d %H:%M",
+                             time.localtime(e.get("t", 0)))
+        rows.append([e["run"][:12], when, str(e.get("files", "?")),
+                     _fmt_mib(e.get("bytes_added")),
+                     str(e.get("logdir", ""))[-48:]])
+    from sofa_tpu.telemetry import _table
+
+    lines += _table(rows)
+    return lines
+
+
+def render_show(store: ArchiveStore, doc: dict) -> List[str]:
+    files = doc.get("files") or {}
+    by_kind: Dict[str, List[int]] = {}
+    for ent in files.values():
+        k = by_kind.setdefault(ent.get("kind", "?"), [0, 0])
+        k[0] += 1
+        k[1] += ent.get("bytes", 0)
+    lines = [f"run {doc.get('run', '?')}",
+             f"  ingested {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(doc.get('t', 0)))}"
+             f" from {doc.get('logdir', '?')}"
+             + (f" [{doc['label']}]" if doc.get("label") else "")]
+    for kind, (n, b) in sorted(by_kind.items()):
+        lines.append(f"  {kind}: {n} file(s), {_fmt_mib(b)}")
+    feats = doc.get("features") or {}
+    if feats:
+        lines.append(f"  features ({len(feats)}):")
+        for name in sorted(feats)[:20]:
+            lines.append(f"    {name:<36} {feats[name]:>12.6g}")
+        if len(feats) > 20:
+            lines.append(f"    ... {len(feats) - 20} more")
+    n_tiles = sum(1 for rel in files if rel.startswith("_tiles/"))
+    if n_tiles:
+        lines.append(f"  tiles: {n_tiles} pyramid file(s) "
+                     "(content-addressed; board diffs them by hash)")
+    return lines
+
+
+def sofa_archive(cfg, action: str, arg: str = "") -> int:
+    """``sofa archive <logdir> | ls | show <run> | gc [--keep N]
+    [--keep_days D]`` — the trace-database verb."""
+    from sofa_tpu import telemetry
+    from sofa_tpu.archive import resolve_root
+
+    root = resolve_root(cfg)
+    if action in ("", None):
+        print_error("archive needs an action: `sofa archive <logdir>` "
+                    "to ingest, or ls / show <run> / gc")
+        return 2
+    if action == "ls":
+        store = ArchiveStore(root)
+        if not store.exists:
+            print_error(f"no archive at {root} — `sofa archive <logdir>` "
+                        "creates one")
+            return 2
+        print("\n".join(render_ls(root)))
+        return 0
+    if action == "show":
+        store = ArchiveStore(root)
+        run_id = store.resolve_run_id(arg) if arg else None
+        if run_id is None:
+            print_error(f"archive show: no unique run matches {arg!r} "
+                        "(need a >= 6-char unique id prefix; see "
+                        "`sofa archive ls`)")
+            return 2
+        doc = store.load_run(run_id)
+        if doc is None:
+            print_error(f"archive show: run doc for {run_id[:12]} is "
+                        "unreadable — run `sofa fsck` on the archive root")
+            return 2
+        print_title(f"archived run {run_id[:12]}")
+        print("\n".join(render_show(store, doc)))
+        return 0
+    if action == "gc":
+        keep = int(getattr(cfg, "archive_keep", 0) or 0)
+        keep_days = float(getattr(cfg, "archive_keep_days", 0.0) or 0.0)
+        if keep <= 0 and keep_days <= 0:
+            print_error("archive gc needs a retention policy: --keep N "
+                        "and/or --keep_days D (refusing to guess)")
+            return 2
+        if not ArchiveStore(root).exists:
+            print_error(f"no archive at {root}")
+            return 2
+        gc(root, keep=keep, keep_days=keep_days)
+        return 0
+    # default: the action is a logdir to ingest
+    if not os.path.isdir(action):
+        print_error(f"archive: {action!r} is not a logdir or a known "
+                    "action (ls / show / gc)")
+        return 2
+    import copy
+
+    c = copy.deepcopy(cfg)
+    c.logdir = action
+    c.__post_init__()
+    tel = telemetry.begin("archive")
+    try:
+        ingest_run(c, root, label=getattr(cfg, "archive_label", "") or "",
+                   tel=tel)
+        tel.write(c.logdir, rc=0, cfg=c)
+        return 0
+    finally:
+        telemetry.end(tel)

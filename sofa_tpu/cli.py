@@ -65,11 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "clean", "setup", "resume",
-        "fsck",
+        "fsck", "archive", "regress",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
-                        "(status/resume/fsck); path to lint (lint)")
+                        "(status/resume/fsck); path to lint (lint); "
+                        "logdir or ls/show/gc (archive); run (regress)")
+    p.add_argument("extra", nargs="?", default="",
+                   help="second positional: the run id for `archive show`, "
+                        "the baseline run for `regress`")
 
     g = p.add_argument_group("pipeline")
     g.add_argument("--logdir")
@@ -191,7 +195,31 @@ def build_parser() -> argparse.ArgumentParser:
     g = p.add_argument_group("fsck")
     g.add_argument("--repair", action="store_true", default=False,
                    help="fsck: invalidate the poisoned cache/tile entries, "
-                        "sweep orphans, and re-derive damaged artifacts")
+                        "sweep orphans, and re-derive damaged artifacts "
+                        "(on an archive root: re-adopt uncataloged runs, "
+                        "restore/quarantine rotted objects)")
+
+    g = p.add_argument_group("archive / regress")
+    g.add_argument("--archive_root",
+                   help="multi-run trace archive root (SOFA_ARCHIVE_ROOT "
+                        "env equivalent; default ./sofa_archive)")
+    g.add_argument("--label", dest="archive_label",
+                   help="archive: free-form tag stored with the ingested "
+                        "run")
+    g.add_argument("--keep", type=int, dest="archive_keep",
+                   help="archive gc: keep the newest N runs")
+    g.add_argument("--keep_days", type=float, dest="archive_keep_days",
+                   help="archive gc: keep runs ingested within D days")
+    g.add_argument("--rolling", type=int, dest="regress_rolling",
+                   help="regress: compare against a rolling baseline over "
+                        "the newest N archived runs instead of a second "
+                        "run argument")
+    g.add_argument("--pct", type=float, dest="regress_pct",
+                   help="regress --rolling: baseline percentile "
+                        "(default 50 = median)")
+    g.add_argument("--regress_threshold", type=float,
+                   help="relative %% move a regressed/improved verdict "
+                        "requires (default 10)")
 
     g = p.add_argument_group("viz")
     g.add_argument("--viz_port", type=int)
@@ -242,6 +270,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "enable_swarms", "is_idle_threshold", "profile_region", "spotlight",
         "hint_server", "iterations_from",
         "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
+        "archive_root", "archive_label", "archive_keep", "archive_keep_days",
+        "regress_rolling", "regress_pct", "regress_threshold",
     ):
         if was_set(name):
             setattr(cfg, name, passed[name])
@@ -444,6 +474,14 @@ def _run(argv=None) -> int:
             from sofa_tpu.durability import sofa_fsck
             print_main_progress("SOFA fsck")
             return sofa_fsck(cfg, repair=args.repair)
+        if cmd == "archive":
+            from sofa_tpu.archive.store import sofa_archive
+            print_main_progress("SOFA archive")
+            return sofa_archive(cfg, args.usr_command, args.extra)
+        if cmd == "regress":
+            from sofa_tpu.archive.verdict import sofa_regress
+            print_main_progress("SOFA regress")
+            return sofa_regress(cfg, args.usr_command, args.extra)
         if cmd == "lint":
             from sofa_tpu.lint.cli import run_lint
             # lint is config-free: the positional argument is a path, and

@@ -171,6 +171,17 @@ class SofaConfig:
     base_logdir: Optional[str] = None
     match_logdir: Optional[str] = None
 
+    # --- archive / regress (sofa_tpu/archive/) ------------------------------
+    archive_root: str = ""           # --archive_root; empty = SOFA_ARCHIVE_ROOT
+                                     # env, else ./sofa_archive
+    archive_label: str = ""          # --label tag on `sofa archive <logdir>`
+    archive_keep: int = 0            # `sofa archive gc --keep N`
+    archive_keep_days: float = 0.0   # `sofa archive gc --keep_days D`
+    regress_rolling: int = 0         # `sofa regress --rolling N` catalog
+                                     # baseline (0 = pairwise only)
+    regress_pct: float = 50.0        # rolling-baseline percentile
+    regress_threshold: float = 10.0  # relative % move a verdict requires
+
     # --- viz ---------------------------------------------------------------
     viz_port: int = 8000
     # Bind address.  Unlike the reference (http.server on all interfaces,

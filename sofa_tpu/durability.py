@@ -105,6 +105,19 @@ def atomic_write(path: str, mode: str = "w", fsync: bool = False,
         raise
 
 
+def fsync_append(path: str, text: str) -> None:
+    """Journal-style durable append: one write, flushed and fsync'd before
+    returning, so a crash mid-append leaves at worst one torn final line —
+    which the JSONL readers (read_journal, archive.catalog) skip.  THE way
+    append-only ledgers reach disk; whole-file artifacts use
+    :func:`atomic_write` instead."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+
+
 @contextlib.contextmanager
 def atomic_replace(path: str):
     """Yield a ``<path>.tmp`` pathname for writers that need their own
@@ -146,11 +159,8 @@ class Journal:
     def _append(self, entry: dict) -> None:
         entry = {**entry, "t": round(time.time(), 3), "pid": os.getpid()}
         try:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(self.path, "a") as f:
-                f.write(json.dumps(entry, separators=(",", ":")) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+            fsync_append(self.path,
+                         json.dumps(entry, separators=(",", ":")) + "\n")
             self._maybe_compact()
         except OSError as e:
             if not self._warned:
@@ -258,6 +268,9 @@ def logdir_raw_key(logdir: str) -> str:
 _DIGEST_SKIP_FILES = frozenset({
     DIGESTS_NAME, JOURNAL_NAME, "run_manifest.json", "sofa_self_trace.json",
     "_derived.writing", "docker.cid",
+    # regenerated at will by `sofa regress` without a pipeline digest
+    # refresh — digesting it would turn every re-regress into fsck damage
+    "regress_verdict.json",
 })
 _DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
@@ -280,11 +293,19 @@ def _sha256(path: str) -> Optional[str]:
 
 def _digest_targets(logdir: str) -> List[str]:
     """Relative paths of every artifact the integrity ledger covers."""
+    from sofa_tpu.archive import is_archive_root
+
     out: List[str] = []
     for root, dirs, files in os.walk(logdir):
         rel_root = os.path.relpath(root, logdir)
         parts = [] if rel_root == "." else rel_root.split(os.sep)
         if parts and parts[0] in _DIGEST_SKIP_DIRS:
+            dirs[:] = []
+            continue
+        if parts and is_archive_root(root):
+            # a multi-run archive nested under the logdir keeps its own
+            # integrity ledger (archive_fsck) — digesting it here would
+            # re-archive the archive on the next ingest
             dirs[:] = []
             continue
         dirs[:] = sorted(d for d in dirs if d not in _DIGEST_SKIP_DIRS)
@@ -453,11 +474,16 @@ def fsck_scan(logdir: str, digests: "dict | None" = None) -> Optional[dict]:
             report["corrupt"].append(rel)
     # Orphans: interrupted tmp+rename leftovers + tile files outside the
     # ledger (a half-built pyramid whose index never landed).
+    from sofa_tpu.archive import is_archive_root
+
     for root, dirs, names in os.walk(logdir):
         rel_root = os.path.relpath(root, logdir)
         parts = [] if rel_root == "." else rel_root.split(os.sep)
         if parts and parts[0] in ("_inject", "board", "__pycache__"):
             dirs[:] = []
+            continue
+        if parts and is_archive_root(root):
+            dirs[:] = []  # the archive's own fsck owns its tmp files
             continue
         for name in names:
             rel = "/".join(parts + [name]) if parts else name
@@ -539,6 +565,13 @@ def sofa_fsck(cfg, repair: bool = False) -> int:
     if not os.path.isdir(cfg.logdir):
         print_error(f"logdir {cfg.logdir} does not exist")
         return 2
+    from sofa_tpu.archive import is_archive_root
+
+    if is_archive_root(cfg.logdir):
+        # The positional is a multi-run archive root, not a logdir: verify
+        # the store instead (objects re-hash to their names, run docs'
+        # references exist, crash leftovers classified).
+        return _archive_fsck_verb(cfg.logdir, repair)
     reap_stale_sentinel(cfg.logdir)
     report = fsck_scan(cfg.logdir)
     if report is None:
@@ -575,6 +608,37 @@ def sofa_fsck(cfg, repair: bool = False) -> int:
         return 1
     print_progress(f"fsck: {report.get('checked', 0)} artifact(s) "
                    f"verified, all healthy")
+    return 0
+
+
+def _archive_fsck_verb(root: str, repair: bool) -> int:
+    """fsck over an archive root (sofa_tpu/archive/store.py): same exit
+    contract as the logdir scan — 0 healthy / 1 damage / 2 no store."""
+    from sofa_tpu.archive.store import ARCHIVE_FSCK_VERDICTS, archive_fsck
+    from sofa_tpu.printing import print_progress, print_warning
+
+    report = archive_fsck(root, repair=repair)
+    if report is None:
+        return 2
+    for verdict in ARCHIVE_FSCK_VERDICTS:
+        for rel in sorted(report.get(verdict) or []):
+            print(f"  {verdict:<11} {rel}")
+    n_unref = len(report.get("unreferenced") or [])
+    if n_unref:
+        print_progress(f"fsck: {n_unref} unreferenced object(s) — not "
+                       "damage; `sofa archive gc` sweeps them")
+    counts = {v: len(report.get(v) or []) for v in ARCHIVE_FSCK_VERDICTS}
+    n_bad = sum(counts.values())
+    if n_bad:
+        summary = ", ".join(f"{counts[v]} {v}"
+                            for v in ARCHIVE_FSCK_VERDICTS if counts[v])
+        print_warning(f"fsck: archive {root}: {report.get('checked', 0)} "
+                      f"object(s) checked — {summary}"
+                      + ("" if repair else "; `sofa fsck --repair` "
+                         "re-adopts/quarantines"))
+        return 1
+    print_progress(f"fsck: archive {root}: {report.get('checked', 0)} "
+                   "object(s) verified, all healthy")
     return 0
 
 
@@ -622,8 +686,11 @@ def sofa_resume(cfg) -> int:
                       "preprocess — replaying it")
     an = state.get("analyze")
     need_an = an is not None and (not an["committed"] or need_pre)
+    ar = state.get("archive")
+    need_ar = ar is not None and (not ar["committed"] or need_pre
+                                  or need_an)
 
-    if not (need_pre or need_an):
+    if not (need_pre or need_an or need_ar):
         print_progress("resume: every journaled stage is committed and "
                        "matches the raw files — nothing to replay")
         return 0
@@ -640,5 +707,21 @@ def sofa_resume(cfg) -> int:
 
         print_progress("resume: replaying analyze")
         sofa_analyze(cfg, frames=frames)
+    if need_ar:
+        # The archive_root rides the begin entry — the replay must land in
+        # the same store the killed ingest was writing (objects it already
+        # committed dedup; the catalog line is the commit point).
+        root = next((e.get("archive_root") for e in reversed(entries)
+                     if e.get("stage") == "archive" and e.get("ev") == "begin"
+                     and e.get("archive_root")), None)
+        if root is None:
+            from sofa_tpu.archive import resolve_root
+
+            root = resolve_root(cfg)
+        from sofa_tpu.archive.store import ingest_run
+
+        print_progress(f"resume: replaying archive ingest into {root} "
+                       "(already-stored objects are deduped)")
+        ingest_run(cfg, root)
     print_progress("resume: journal replay complete")
     return 0

@@ -56,10 +56,17 @@ def _delta_table(base: pd.DataFrame, match: pd.DataFrame, value_col: str,
                  out_path: str) -> pd.DataFrame:
     """Outer-join two per-key aggregates into the shared diff shape.
 
-    delta = match - base; ratio uses the one inf convention both diffs rely
-    on: keys new in match get ratio=inf so the mover filter — and the
-    reader — can't miss a regression that only exists in match, while a key
-    with zero value in BOTH runs is unchanged (ratio 1), not a mover.
+    ``delta = match - base``.  ``ratio`` carries THE inf convention every
+    diff consumer (the mover filters here, the board's diff page, the
+    regression engine in sofa_tpu/archive/baseline.py) relies on:
+
+      * key only in match (base value 0, match value > 0) -> ``ratio=inf``
+        — a regression that exists only in the new run must be impossible
+        to miss; a finite placeholder would sort it under real movers;
+      * key with zero value in BOTH runs -> ``ratio=1`` (unchanged, not a
+        mover — 0/0 is "nothing happened twice", not a change);
+      * key only in base (vanished in match) -> ``ratio=0``.
+
     Sorted by |delta| and written to out_path.
     """
     import numpy as np
@@ -156,6 +163,9 @@ def sofa_mem_diff(cfg) -> Optional[pd.DataFrame]:
     return table
 
 
+_CLUSTER_COLUMNS = ("cluster_ID", "name", "duration")
+
+
 def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
     base_path = os.path.join(cfg.base_logdir, "auto_caption.csv")
     match_path = os.path.join(cfg.match_logdir, "auto_caption.csv")
@@ -163,8 +173,29 @@ def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
         if not os.path.isfile(p):
             print_warning(f"diff: {p} missing — run with --enable_hsg or `sofa diff`")
             return None
-    base = _cluster_signatures(pd.read_csv(base_path))
-    match = _cluster_signatures(pd.read_csv(match_path))
+    tables = []
+    for p in (base_path, match_path):
+        # One side lacking the cluster columns (an auto_caption.csv from a
+        # foreign/older run, or an empty clustering) degrades the diff to
+        # a warning — it must not raise out of a multi-diff `sofa diff`
+        # with the TPU/mem diffs still unwritten.
+        try:
+            df = pd.read_csv(p)
+        except Exception as e:  # noqa: BLE001 — unreadable side: skip the diff, not the verb
+            print_warning(f"diff: cannot read {p} ({e}) — skipping "
+                          "swarm diff")
+            return None
+        missing = [c for c in _CLUSTER_COLUMNS if c not in df.columns]
+        if missing or df.empty:
+            why = (f"missing column(s) {missing}" if missing
+                   else "no clustered samples")
+            print_warning(f"diff: {p} has {why} — skipping swarm diff "
+                          "(re-run `sofa analyze --enable_hsg` on that "
+                          "logdir)")
+            return None
+        tables.append(df)
+    base = _cluster_signatures(tables[0])
+    match = _cluster_signatures(tables[1])
     mapping = match_swarms(base, match)
 
     rows = []

@@ -62,7 +62,9 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  "_derived.writing",
                  # durability layer (sofa_tpu/durability.py): crash journal
                  # + sha256 integrity ledger sidecar
-                 "_journal.jsonl", "_digests.json"]
+                 "_journal.jsonl", "_digests.json",
+                 # `sofa regress` verdict (sofa_tpu/archive/verdict.py)
+                 "regress_verdict.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles"]
 
@@ -925,8 +927,14 @@ def sofa_clean(cfg) -> None:
 
     Also sweeps orphaned ``*.tmp`` files ANYWHERE under the logdir — the
     leftovers of interrupted tmp+rename writes (durability.atomic_write):
-    they are committed to nothing and shadow nothing, pure disk waste."""
+    they are committed to nothing and shadow nothing, pure disk waste.
+
+    A multi-run trace archive nested under the logdir (sofa_tpu/archive/,
+    marked by its ``sofa_archive.json``) is NEVER swept — it holds other
+    runs' history and `sofa archive gc` is its only deletion path."""
     import shutil
+
+    from sofa_tpu.archive import is_archive_root
 
     if not os.path.isdir(cfg.logdir):
         print_info("nothing to clean")
@@ -938,6 +946,12 @@ def sofa_clean(cfg) -> None:
         # (permissions, live mount, races) must not abort the clean with
         # the rest of the derived files still on disk.
         try:
+            if os.path.isdir(path) and is_archive_root(path):
+                print_warning(
+                    f"clean: {path} is a trace archive (multi-run history) "
+                    "— left untouched; `sofa archive gc` is its only "
+                    "deletion path")
+                continue
             if name in DERIVED_FILES or (
                 name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
             ):
@@ -948,7 +962,11 @@ def sofa_clean(cfg) -> None:
                 removed += 1
         except OSError as e:
             print_warning(f"cannot clean {path}: {e}")
-    for root, _dirs, files in os.walk(cfg.logdir):
+    top = os.path.normpath(cfg.logdir)
+    for root, dirs, files in os.walk(cfg.logdir):
+        if os.path.normpath(root) != top and is_archive_root(root):
+            dirs[:] = []  # the archive's fsck owns its tmp leftovers
+            continue
         for name in files:
             if not name.endswith(".tmp"):
                 continue

@@ -109,7 +109,8 @@ _ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
 
 # Self-trace thread lanes: one per pipeline verb so the viewer shows the
 # verbs as parallel tracks of the single "sofa" process.
-_SELF_TRACE_LANES = {"record": 1, "preprocess": 2, "analyze": 3}
+_SELF_TRACE_LANES = {"record": 1, "preprocess": 2, "analyze": 3,
+                     "archive": 5, "regress": 6}
 _OTHER_LANE = 4
 
 _WARNING_TAIL_MAX = 20

@@ -59,8 +59,31 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
     protocol_version = "HTTP/1.1"  # keep-alive across a zoom's tile burst
     server_version = "sofa_tpu"
 
+    def __init__(self, *args, archive_root=None, **kwargs):
+        # The multi-run archive lives OUTSIDE the logdir; the /archive/
+        # route maps onto it so the board's multi-run diff page can fetch
+        # the catalog, run manifests, and content-addressed objects.
+        self.archive_root = archive_root
+        super().__init__(*args, **kwargs)
+
     def log_message(self, fmt, *args):  # noqa: A003
         pass
+
+    def _translate_archive(self, path: str) -> "str | None":
+        """Map /archive/<rel> under the archive root; None on traversal
+        attempts (every ``..`` component is rejected outright)."""
+        import urllib.parse
+
+        rel = urllib.parse.unquote(
+            path.split("?", 1)[0].split("#", 1)[0])[len("/archive/"):]
+        parts = []
+        for p in rel.split("/"):
+            if not p or p == ".":
+                continue
+            if p == "..":
+                return None
+            parts.append(p)
+        return os.path.join(os.path.abspath(self.archive_root), *parts)
 
     def translate_path(self, path):  # noqa: A003
         # /tiles/... is the public route for the on-disk _tiles/ pyramid
@@ -69,6 +92,9 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
         clean = path.split("?", 1)[0].split("#", 1)[0]
         if clean.startswith("/tiles/"):
             path = "/_tiles/" + path[len("/tiles/"):]
+        elif clean.startswith("/archive/") and self.archive_root:
+            return self._translate_archive(path) or \
+                super().translate_path("/archive-denied")
         return super().translate_path(path)
 
     # -- helpers -----------------------------------------------------------
@@ -98,7 +124,13 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
         path = self.translate_path(self.path)
         if os.path.isdir(path):
             return super().send_head()  # index.html redirect / listing
-        if self._is_data(path) and derived_writing(self.directory):
+        in_archive = bool(self.archive_root) and \
+            path.startswith(os.path.abspath(self.archive_root) + os.sep)
+        # Archive artifacts land atomically (tmp+rename) and objects are
+        # immutable by construction — the logdir's mid-write 503 guard
+        # does not apply to them.
+        if not in_archive and self._is_data(path) \
+                and derived_writing(self.directory):
             # CSVs stream and tiles land file-by-file: while a writer
             # holds the guard, data responses would race torn bytes.
             return self._unavailable()
@@ -164,7 +196,13 @@ def sofa_viz(cfg, serve_forever: bool = True):
     from sofa_tpu.trace import reap_stale_sentinel
 
     reap_stale_sentinel(cfg.logdir)
-    handler = functools.partial(_BoardHandler, directory=cfg.logdir)
+    from sofa_tpu.archive import is_archive_root, resolve_root
+
+    archive_root = resolve_root(cfg)
+    if not is_archive_root(archive_root):
+        archive_root = None  # no store: /archive/ 404s like any miss
+    handler = functools.partial(_BoardHandler, directory=cfg.logdir,
+                                archive_root=archive_root)
     http.server.ThreadingHTTPServer.allow_reuse_address = True
     http.server.ThreadingHTTPServer.daemon_threads = True
     httpd = None
@@ -199,6 +237,11 @@ def sofa_viz(cfg, serve_forever: bool = True):
             f"LOD tiles: /{TILES_DIR_NAME}/ (pre-gzipped; served with "
             "Accept-Encoding negotiation — deep zoom on the timeline "
             "fetches these viewport-driven)")
+    if archive_root:
+        print_progress(
+            f"trace archive: /archive/ (root {archive_root}; the board's "
+            "Archive page diffs any two catalog runs tile-by-tile — "
+            "identical tiles compare by hash, no payload fetched)")
     if os.path.isfile(os.path.join(cfg.logdir, SELF_TRACE_NAME)):
         print_progress(
             f"self-telemetry: /{SELF_TRACE_NAME} (Chrome-trace of sofa's "

@@ -1,0 +1,546 @@
+"""Fleet trace archive + regression service (ISSUE 7).
+
+Covers: byte-level dedup across ingests, gc retention, catalog torn-tail
+tolerance, archive fsck detection/repair, rolling-percentile baseline
+math, typed-verdict exit codes via real subprocess, the tile-diff
+"unchanged" fast path, the `sofa clean` archive guard, `sofa resume`
+replay of a killed ingest, and ml/diff.py's degradation contract.  The
+end-to-end SIGKILL proof lives in tools/chaos_matrix.py's
+kill-mid-archive cell.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pandas as pd
+import pytest
+
+from sofa_tpu import durability
+from sofa_tpu.archive import catalog, is_archive_root, resolve_root
+from sofa_tpu.archive import baseline as bl
+from sofa_tpu.archive.store import (
+    ArchiveStore,
+    archive_fsck,
+    gc,
+    ingest_run,
+    run_content_id,
+    tile_diff,
+)
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.preprocess import sofa_preprocess
+from sofa_tpu.record import sofa_clean
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_logdir(tmp_path, name="log", elapsed=1.5,
+                 step_time=0.05) -> SofaConfig:
+    """Smallest archivable logdir: preprocess output + a feature vector."""
+    ld = str(tmp_path / name) + "/"
+    os.makedirs(ld, exist_ok=True)
+    with open(ld + "sofa_time.txt", "w") as f:
+        f.write("1000.0\n")
+    with open(ld + "misc.txt", "w") as f:
+        f.write(f"elapsed_time {elapsed}\ncores 2\npid 1\nrc 0\n")
+    cfg = SofaConfig(logdir=ld)
+    sofa_preprocess(cfg)
+    with open(ld + "features.csv", "w") as f:
+        f.write("name,value\n"
+                f"elapsed_time,{elapsed}\n"
+                f"step_time_mean,{step_time}\n"
+                "tpu_ops,100\n")
+    durability.write_digests(ld)
+    return cfg
+
+
+def _store_bytes(root: str) -> int:
+    total = 0
+    for dirpath, _dirs, names in os.walk(os.path.join(root, "objects")):
+        for n in names:
+            total += os.path.getsize(os.path.join(dirpath, n))
+    return total
+
+
+# --- dedup ------------------------------------------------------------------
+
+def test_double_ingest_grows_store_by_catalog_entry_only(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    s1 = ingest_run(cfg, root)
+    bytes_after_first = _store_bytes(root)
+    cat_lines = len(catalog.read_catalog(root))
+    s2 = ingest_run(cfg, root)
+    assert s2["run"] == s1["run"]          # content-addressed run id
+    assert s2["new_objects"] == 0 and s2["bytes_added"] == 0
+    assert _store_bytes(root) == bytes_after_first
+    assert len(catalog.read_catalog(root)) == cat_lines + 1
+    # readers dedup by run id: still ONE run
+    assert len(catalog.ingest_entries(catalog.read_catalog(root))) == 1
+
+
+def test_shared_objects_dedup_across_different_runs(tmp_path):
+    cfg_a = _mini_logdir(tmp_path, "a", elapsed=1.5)
+    cfg_b = _mini_logdir(tmp_path, "b", elapsed=2.5)
+    root = str(tmp_path / "arch")
+    s1 = ingest_run(cfg_a, root)
+    s2 = ingest_run(cfg_b, root)
+    assert s2["run"] != s1["run"]
+    # the unchanged artifacts (sofa_time.txt, identical empty frames)
+    # landed once: the second ingest added fewer objects than it has files
+    assert s2["new_objects"] < s2["files"]
+
+
+def test_run_content_id_is_order_independent():
+    files = {"a.csv": {"sha256": "aa"}, "b.csv": {"sha256": "bb"}}
+    flipped = dict(reversed(list(files.items())))
+    assert run_content_id(files) == run_content_id(flipped)
+    assert run_content_id(files) != run_content_id(
+        {"a.csv": {"sha256": "aa"}})
+
+
+# --- catalog ----------------------------------------------------------------
+
+def test_catalog_torn_tail_tolerated(tmp_path):
+    root = str(tmp_path / "arch")
+    ArchiveStore(root, create=True)
+    catalog.append_event(root, "ingest", run="x" * 64, files=1)
+    catalog.append_event(root, "bench", metric="m", value=1.0)
+    with open(catalog.catalog_path(root), "a") as f:
+        f.write('{"ev":"ingest","run":"torn-mid-wri')   # the crash case
+    entries = catalog.read_catalog(root)
+    assert len(entries) == 2
+    assert catalog.bench_entries(entries)[0]["value"] == 1.0
+
+
+# --- gc ---------------------------------------------------------------------
+
+def test_gc_keep_retention_sweeps_unreferenced_objects(tmp_path):
+    root = str(tmp_path / "arch")
+    cfgs = [_mini_logdir(tmp_path, f"r{i}", elapsed=1.0 + i)
+            for i in range(3)]
+    for c in cfgs:
+        ingest_run(c, root)
+    store = ArchiveStore(root)
+    assert len(store.run_ids()) == 3
+    bytes_before = _store_bytes(root)
+    summary = gc(root, keep=2)
+    assert summary["dropped_runs"] == 1
+    assert summary["swept_objects"] > 0
+    assert len(store.run_ids()) == 2
+    assert _store_bytes(root) < bytes_before
+    # shared objects survive: remaining runs still extract completely
+    report = archive_fsck(root)
+    assert not report["missing"] and not report["corrupt"]
+    # gc'd state is still catalog-consistent
+    assert len(catalog.ingest_entries(catalog.read_catalog(root))) == 2
+
+
+def test_gc_requires_policy_via_cli(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "archive", "gc",
+         "--archive_root", str(tmp_path / "arch")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT))
+    assert r.returncode == 2    # refuses to guess a retention policy
+
+
+# --- fsck -------------------------------------------------------------------
+
+def test_fsck_detects_and_repairs_corrupted_frame(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    ingest_run(cfg, root)
+    store = ArchiveStore(root)
+    run_id = store.run_ids()[0]
+    doc = store.load_run(run_id)
+    sha = doc["files"]["tputrace.csv"]["sha256"]
+    with open(store.object_path(sha), "ab") as f:
+        f.write(b"rot")                       # silent bit-rot
+    report = archive_fsck(root)
+    assert any(sha in c for c in report["corrupt"])
+    # repair: the source logdir still holds matching bytes -> restored
+    report = archive_fsck(root, repair=True)
+    assert not report["corrupt"]
+    report = archive_fsck(root)
+    assert not report["corrupt"] and not report["missing"]
+
+
+def test_fsck_quarantines_when_source_gone(tmp_path):
+    import shutil
+
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    ingest_run(cfg, root)
+    store = ArchiveStore(root)
+    doc = store.load_run(store.run_ids()[0])
+    sha = doc["files"]["tputrace.csv"]["sha256"]
+    with open(store.object_path(sha), "ab") as f:
+        f.write(b"rot")
+    shutil.rmtree(cfg.logdir)                 # source gone: unrepairable
+    report = archive_fsck(root, repair=True)
+    assert not report["corrupt"]              # quarantined, not left rotted
+    assert any("quarantined" in m for m in report["missing"])
+    assert os.path.isfile(os.path.join(root, "_quarantine", sha))
+
+
+def test_fsck_adopts_uncataloged_run(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    ingest_run(cfg, root)
+    # simulate a crash between run-doc write and catalog append
+    os.unlink(catalog.catalog_path(root))
+    report = archive_fsck(root)
+    assert len(report["uncataloged"]) == 1
+    report = archive_fsck(root, repair=True)
+    assert not report["uncataloged"]
+    entries = catalog.ingest_entries(catalog.read_catalog(root))
+    assert len(entries) == 1 and entries[0]["run"] == \
+        ArchiveStore(root).run_ids()[0]
+
+
+def test_fsck_verb_dispatches_on_archive_root(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    ingest_run(cfg, root)
+    assert durability.sofa_fsck(SofaConfig(logdir=root)) == 0
+    # orphaned tmp is damage until repaired
+    stage = os.path.join(root, "objects", "zz")
+    os.makedirs(stage, exist_ok=True)
+    with open(os.path.join(stage, "dead.tmp"), "w") as f:
+        f.write("x")
+    assert durability.sofa_fsck(SofaConfig(logdir=root)) == 1
+    assert durability.sofa_fsck(SofaConfig(logdir=root), repair=True) == 0
+
+
+# --- resume replay ----------------------------------------------------------
+
+def test_resume_replays_uncommitted_archive_stage(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    ingest_run(cfg, root)
+    run_id = ArchiveStore(root).run_ids()[0]
+    # drop the archive commit marker: a crash one instruction short
+    jpath = cfg.path(durability.JOURNAL_NAME)
+    with open(jpath) as f:
+        lines = [ln for ln in f.read().splitlines()
+                 if not ('"commit"' in ln and '"archive"' in ln)]
+    with open(jpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    assert durability.sofa_resume(cfg) == 0
+    # replay re-ingested into the SAME root (from the begin entry), deduped
+    entries = catalog.ingest_entries(catalog.read_catalog(root))
+    assert len(entries) == 1 and entries[0]["run"] == run_id
+    report = archive_fsck(root)
+    assert not any(report[v] for v in ("corrupt", "missing", "orphaned",
+                                       "uncataloged"))
+
+
+# --- sofa clean guard -------------------------------------------------------
+
+def test_clean_never_sweeps_nested_archive_root(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    nested = cfg.path("board")     # a DERIVED_DIRS name, worst case
+    ingest_run(cfg, nested)
+    assert is_archive_root(nested)
+    marker_mtime = os.path.getmtime(os.path.join(nested,
+                                                 "sofa_archive.json"))
+    sofa_clean(cfg)
+    assert is_archive_root(nested)                 # survived the sweep
+    assert os.path.isfile(catalog.catalog_path(nested))
+    assert len(ArchiveStore(nested).run_ids()) == 1
+    assert os.path.getmtime(os.path.join(
+        nested, "sofa_archive.json")) == marker_mtime
+    assert not os.path.isfile(cfg.path("report.js"))  # clean still cleaned
+
+
+def test_digests_skip_nested_archive(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    nested = cfg.path("my_archive")
+    ingest_run(cfg, nested)
+    doc = durability.compute_digests(cfg.logdir)
+    assert not any(rel.startswith("my_archive/") for rel in doc["files"])
+
+
+# --- rolling baseline math --------------------------------------------------
+
+def test_median_ci_floor_and_coverage():
+    assert bl.median_ci([1.0] * 5) is None          # below the floor
+    lo, hi = bl.median_ci([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0])
+    assert lo <= 4.0 <= hi
+    assert lo >= 1.0 and hi <= 7.0
+
+
+def test_percentile_interpolation():
+    xs = [1.0, 2.0, 3.0, 4.0]
+    assert bl.percentile(xs, 0) == 1.0
+    assert bl.percentile(xs, 100) == 4.0
+    assert bl.percentile(xs, 50) == pytest.approx(2.5)
+
+
+def test_polarity_classes():
+    assert bl.polarity("elapsed_time") == 1
+    assert bl.polarity("step_time_mean") == 1
+    assert bl.polarity("resnet50_profiling_overhead") == 1
+    assert bl.polarity("comm_ici_bandwidth") == -1
+    assert bl.polarity("tpu_ops") == 0
+
+
+def test_rolling_verdict_discipline():
+    samples = [1.0, 1.01, 0.99, 1.02, 0.98, 1.0, 1.01]
+    # far outside the CI and the threshold: regressed
+    v = bl.rolling_verdict(2.0, samples, 50.0, 10.0, 1)
+    assert v["verdict"] == "regressed" and "CI" in v["reason"]
+    # improvement in the good direction
+    v = bl.rolling_verdict(0.5, samples, 50.0, 10.0, 1)
+    assert v["verdict"] == "improved"
+    # inside the threshold: noise even when outside the (tight) CI
+    v = bl.rolling_verdict(1.05, samples, 50.0, 10.0, 1)
+    assert v["verdict"] == "noise"
+    # too few samples: noise BY CONTRACT, with the count in the reason
+    v = bl.rolling_verdict(9.9, samples[:4], 50.0, 10.0, 1)
+    assert v["verdict"] == "noise" and "4" in v["reason"]
+    # no polarity: noise no matter the move
+    v = bl.rolling_verdict(9.9, samples, 50.0, 10.0, 0)
+    assert v["verdict"] == "noise" and "polarity" in v["reason"]
+
+
+def test_pairwise_ratio_inf_convention():
+    v = bl.pairwise_verdict(3.0, 0.0, 10.0, 1)
+    assert v["ratio"] == float("inf") and v["verdict"] == "regressed"
+    v = bl.pairwise_verdict(0.0, 0.0, 10.0, 1)
+    assert v["ratio"] == 1.0 and v["verdict"] == "noise"
+    v = bl.pairwise_verdict(3.0, 0.0, 10.0, -1)
+    assert v["verdict"] == "improved"       # new in run, good polarity
+
+
+# --- typed-verdict exit codes (real subprocess) -----------------------------
+
+def _run_cli(*args, **env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT,
+               **env_extra)
+    return subprocess.run([sys.executable, "-m", "sofa_tpu", *args],
+                          capture_output=True, text=True, timeout=180,
+                          env=env, cwd=_ROOT)
+
+
+def test_regress_exit_codes_via_subprocess(tmp_path):
+    cfg = _mini_logdir(tmp_path, "base", elapsed=1.5, step_time=0.05)
+    slow = _mini_logdir(tmp_path, "slow", elapsed=2.9, step_time=0.09)
+    # run vs itself: all noise, exit 0
+    r = _run_cli("regress", cfg.logdir, cfg.logdir)
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(cfg.path("regress_verdict.json")))
+    assert doc["verdict"] == "noise"
+    assert doc["counts"]["regressed"] == 0
+    assert all(row["verdict"] == "noise" for row in doc["features"])
+    # slowed run vs base: regressed, exit 1
+    r = _run_cli("regress", slow.logdir, cfg.logdir)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.load(open(slow.path("regress_verdict.json")))
+    assert doc["verdict"] == "regressed"
+    assert doc["schema"] == "sofa_tpu/regress_verdict"
+    names = {row["name"] for row in doc["features"]
+             if row["verdict"] == "regressed"}
+    assert "elapsed_time" in names
+    # usage error: no baseline and no --rolling
+    r = _run_cli("regress", cfg.logdir)
+    assert r.returncode == 2
+
+
+def test_archive_and_regress_rolling_via_subprocess(tmp_path):
+    root = str(tmp_path / "arch")
+    for i in range(6):
+        c = _mini_logdir(tmp_path, f"r{i}", elapsed=1.5 + i * 0.001)
+        r = _run_cli("archive", c.logdir, "--archive_root", root)
+        assert r.returncode == 0, r.stderr
+    slow = _mini_logdir(tmp_path, "slow", elapsed=3.0)
+    r = _run_cli("regress", slow.logdir, "--rolling", "6",
+                 "--archive_root", root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    r = _run_cli("archive", "ls", "--archive_root", root)
+    assert r.returncode == 0 and "6 run(s)" in r.stdout
+
+
+def test_verdict_schema_validates(tmp_path):
+    cfg = _mini_logdir(tmp_path, "base")
+    r = _run_cli("regress", cfg.logdir, cfg.logdir)
+    assert r.returncode == 0
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "manifest_check", os.path.join(_ROOT, "tools",
+                                           "manifest_check.py"))
+        mc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mc)
+    finally:
+        sys.path.pop(0)
+    doc = json.load(open(cfg.path("regress_verdict.json")))
+    assert mc.validate_verdict(doc) == []
+    bad = dict(doc, verdict="maybe")
+    assert mc.validate_verdict(bad)
+    # CLI path: a verdict file validates through check_path
+    assert mc.check_path(cfg.path("regress_verdict.json")) == 0
+    # the manifest gained archive/regress-aware sections and stays valid
+    manifest = json.load(open(cfg.path("run_manifest.json")))
+    assert "regress" in manifest["runs"]
+    assert mc.validate_manifest(manifest) == []
+
+
+# --- tile diff fast path ----------------------------------------------------
+
+def test_tile_diff_unchanged_fast_path():
+    files_a = {
+        "_tiles/s1/0/0.json.gz": {"sha256": "aaa"},
+        "_tiles/s1/1/0.json.gz": {"sha256": "bbb"},
+        "_tiles/s2/0/0.json.gz": {"sha256": "ccc"},
+        "report.js": {"sha256": "zzz"},          # non-tile: ignored
+    }
+    files_b = {
+        "_tiles/s1/0/0.json.gz": {"sha256": "aaa"},   # unchanged
+        "_tiles/s1/1/0.json.gz": {"sha256": "BBB"},   # changed
+        "_tiles/s3/0/0.json.gz": {"sha256": "ddd"},   # new series
+    }
+    d = tile_diff({"files": files_a}, {"files": files_b})
+    assert d["series"]["s1"] == {"unchanged": 1, "changed": 1,
+                                 "only_a": 0, "only_b": 0}
+    assert d["series"]["s2"]["only_a"] == 1
+    assert d["series"]["s3"]["only_b"] == 1
+    assert d["totals"]["unchanged"] == 1
+
+
+def test_tile_diff_never_reads_payloads(monkeypatch):
+    """The fast path is hash-only: comparing two runs must not open a
+    single object."""
+    import builtins
+
+    files = {f"_tiles/s/0/{i}.json.gz": {"sha256": f"s{i}"}
+             for i in range(32)}
+
+    def boom(*a, **kw):
+        raise AssertionError("tile_diff read a payload")
+
+    monkeypatch.setattr(builtins, "open", boom)
+    d = tile_diff({"files": files}, {"files": dict(files)})
+    assert d["totals"]["unchanged"] == 32 and d["totals"]["changed"] == 0
+
+
+# --- ml/diff robustness (satellite) -----------------------------------------
+
+def test_swarm_diff_degrades_without_cluster_columns(tmp_path, capsys):
+    from sofa_tpu.ml.diff import sofa_swarm_diff
+
+    base = tmp_path / "b"
+    match = tmp_path / "m"
+    for d in (base, match):
+        d.mkdir()
+    pd.DataFrame({"cluster_ID": [0, 0], "name": ["f", "g"],
+                  "duration": [1.0, 2.0]}).to_csv(
+        base / "auto_caption.csv", index=False)
+    # match side LACKS cluster_ID — a foreign/older auto_caption.csv
+    pd.DataFrame({"name": ["f"], "duration": [1.0]}).to_csv(
+        match / "auto_caption.csv", index=False)
+    cfg = SofaConfig(logdir=str(tmp_path / "out"),
+                     base_logdir=str(base), match_logdir=str(match))
+    out = sofa_swarm_diff(cfg)       # must warn, not raise
+    assert out is None
+    assert "cluster_ID" in capsys.readouterr().err
+
+
+def test_delta_table_ratio_inf_convention(tmp_path):
+    from sofa_tpu.ml.diff import _delta_table
+
+    base = pd.DataFrame({"time": [1.0, 0.0]}, index=["stays", "zeros"])
+    match = pd.DataFrame({"time": [2.0, 0.0, 3.0]},
+                         index=["stays", "zeros", "appears"])
+    out = str(tmp_path / "d.csv")
+    table = _delta_table(base, match, "time", out).set_index("index")
+    assert table.loc["appears", "ratio"] == float("inf")   # new key
+    assert table.loc["zeros", "ratio"] == 1.0              # 0/0 unchanged
+    assert table.loc["stays", "ratio"] == 2.0
+    assert os.path.isfile(out)
+
+
+# --- bench catalog (satellite) ----------------------------------------------
+
+def test_bench_import_idempotent(tmp_path):
+    root = str(tmp_path / "repo")
+    os.makedirs(root)
+    with open(os.path.join(root, "BENCH_r01.json"), "w") as f:
+        json.dump({"metric": "resnet50_profiling_overhead", "value": 1.25,
+                   "preprocess_wall_time_s": 2.5,
+                   "captured_unix": 1700000000}, f)
+    aroot = str(tmp_path / "arch")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_import.py"),
+         root, "--archive_root", aroot],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    entries = catalog.bench_entries(catalog.read_catalog(aroot))
+    assert {e["metric"] for e in entries} == {
+        "resnet50_profiling_overhead", "preprocess_wall_time_s"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_import.py"),
+         root, "--archive_root", aroot],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0
+    assert len(catalog.bench_entries(catalog.read_catalog(aroot))) == 2
+
+
+def test_bench_archive_evidence_rides_extras(tmp_path, monkeypatch):
+    sys.path.insert(0, _ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    aroot = str(tmp_path / "arch")
+    monkeypatch.setenv("SOFA_ARCHIVE_ROOT", aroot)
+    out = bench._archive_evidence(
+        0.5, {"preprocess_wall_time_s": 2.0, "report_js_bytes": 1000})
+    assert out["regress_verdict"]["verdict"] == "noise"   # 1 round: no CI
+    assert out["regress_verdict"]["metrics"][
+        "resnet50_profiling_overhead"] == "noise"
+    entries = catalog.bench_entries(catalog.read_catalog(aroot))
+    assert len(entries) == 3
+    # opt-out leaves no trace
+    monkeypatch.setenv("SOFA_BENCH_ARCHIVE", "0")
+    assert bench._archive_evidence(0.5, {}) == {}
+
+
+# --- archive verb surface ---------------------------------------------------
+
+def test_archive_show_and_resolve_prefix(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    s = ingest_run(cfg, root)
+    store = ArchiveStore(root)
+    assert store.resolve_run_id(s["run"][:8]) == s["run"]
+    assert store.resolve_run_id("abc") is None      # too short
+    r = _run_cli("archive", "show", s["run"][:12], "--archive_root", root)
+    assert r.returncode == 0 and "features" in r.stdout
+
+
+def test_extract_roundtrip(tmp_path):
+    cfg = _mini_logdir(tmp_path)
+    root = str(tmp_path / "arch")
+    s = ingest_run(cfg, root)
+    dest = str(tmp_path / "restored")
+    n = ArchiveStore(root).extract(s["run"], dest)
+    assert n == s["files"]
+    with open(cfg.path("features.csv")) as f_orig, \
+            open(os.path.join(dest, "features.csv")) as f_rest:
+        assert f_orig.read() == f_rest.read()
+
+
+def test_resolve_root_precedence(monkeypatch):
+    cfg = SofaConfig(archive_root="/x/y")
+    assert resolve_root(cfg) == "/x/y"
+    monkeypatch.setenv("SOFA_ARCHIVE_ROOT", "/env/root")
+    assert resolve_root(SofaConfig()) == "/env/root"
+    monkeypatch.delenv("SOFA_ARCHIVE_ROOT")
+    assert resolve_root(None) == "sofa_archive"

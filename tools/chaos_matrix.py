@@ -95,6 +95,26 @@ from sofa_tpu.preprocess import sofa_preprocess
 sofa_preprocess(SofaConfig(logdir=logdir))
 """
 
+# Kill-mid-archive: SIGKILL during the object-store copy loop of
+# `sofa archive`, then prove `sofa resume` replays the ingest and both
+# the store and the logdir come out fsck-clean and catalog-consistent.
+_ARCHIVE_KILL_SNIPPET = """
+import os, signal, sys
+sys.path.insert(0, sys.argv[4])
+logdir, root, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+from sofa_tpu.archive import store as astore
+count = [0]
+orig = astore.ArchiveStore.put_file
+def hook(self, *a, **kw):
+    count[0] += 1
+    if count[0] >= n:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return orig(self, *a, **kw)
+astore.ArchiveStore.put_file = hook
+from sofa_tpu.config import SofaConfig
+astore.ingest_run(SofaConfig(logdir=logdir), root)
+"""
+
 
 def _load_manifest_check():
     import importlib.util
@@ -231,6 +251,59 @@ def _run_kill_cell(name: str, point: str, workdir: str, synth: str,
     return problems
 
 
+def _run_archive_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """SIGKILL sofa mid-`archive` ingest, then prove `sofa resume`
+    completes it: the catalog holds the run, the store fscks clean, and
+    the second (replayed) ingest deduped every object the killed one
+    already committed."""
+    import random
+
+    from sofa_tpu.archive import catalog as acat
+    from sofa_tpu.archive.store import ArchiveStore, archive_fsck
+    from sofa_tpu.durability import sofa_resume
+
+    logdir = os.path.join(workdir, "kill-mid-archive") + "/"
+    root = os.path.join(workdir, "kill-mid-archive-store")
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    sofa_preprocess(cfg)  # digests + derived artifacts to archive
+
+    n = random.randint(2, 8)
+    repo = os.path.dirname(_TOOLS)
+    r = subprocess.run(
+        [sys.executable, "-c", _ARCHIVE_KILL_SNIPPET, logdir, root,
+         str(n), repo],
+        capture_output=True, text=True, timeout=600)
+    if r.returncode != -9:
+        return problems + [f"crash child exited rc={r.returncode} "
+                           f"(expected SIGKILL -9 after put #{n}); "
+                           f"stderr tail: {r.stderr.strip()[-200:]}"]
+    rc = sofa_resume(cfg)
+    if rc != 0:
+        problems.append(f"sofa resume rc={rc}")
+    report = archive_fsck(root)
+    if report is None:
+        return problems + ["no archive store after resume"]
+    for verdict in ("corrupt", "missing", "orphaned", "uncataloged"):
+        if report.get(verdict):
+            problems.append(
+                f"archive fsck: {len(report[verdict])} {verdict} after "
+                f"resume: {report[verdict][:3]}")
+    store = ArchiveStore(root)
+    runs = acat.ingest_entries(acat.read_catalog(root))
+    if len(runs) != 1:
+        problems.append(f"catalog holds {len(runs)} run(s), expected 1")
+    elif store.load_run(runs[0]["run"]) is None:
+        problems.append("cataloged run doc unreadable")
+    doc = telemetry.load_manifest(logdir)
+    if doc is not None:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -238,9 +311,10 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS)
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 1
     width = max(len(n) for n, _s in
-                [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS)
+                [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
+                + [("kill-mid-archive", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -263,6 +337,16 @@ def main(argv=None) -> int:
               "then sofa resume)")
         for p in problems:
             print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_archive_kill_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'kill-mid-archive'.ljust(width)}  {status}  (SIGKILL during "
+          "archive ingest, then sofa resume)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
     print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "
           "survived with a valid manifest + report")
     return 1 if failures else 0

@@ -29,7 +29,9 @@ from sofa_tpu.telemetry import (  # noqa: E402
     SOURCE_STATUSES,
 )
 
-_KNOWN_VERBS = ("record", "preprocess", "analyze")
+_KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress")
+_VERDICTS = ("regressed", "improved", "noise")
+_VERDICT_SCHEMA = "sofa_tpu/regress_verdict"
 
 
 def _is_num(v) -> bool:
@@ -208,6 +210,30 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
         elif not isinstance(fsck.get("problems"), dict):
             probs.append("meta.fsck.problems: missing verdict counts")
 
+    # meta.archive / meta.regress (written by the `sofa archive` /
+    # `sofa regress` verbs, sofa_tpu/archive/): ingest summary + verdict
+    # pointer must be sane when present.
+    archive = (doc.get("meta") or {}).get("archive")
+    if archive is not None:
+        if not isinstance(archive, dict):
+            probs.append("meta.archive: not an object")
+        else:
+            run = archive.get("run")
+            if not (isinstance(run, str) and len(run) == 64):
+                probs.append("meta.archive.run: not a 64-hex run id")
+            for key in ("files", "new_objects", "bytes_added"):
+                v = archive.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.archive.{key}: missing or not a "
+                                 "non-negative int")
+    regress = (doc.get("meta") or {}).get("regress")
+    if regress is not None:
+        if not isinstance(regress, dict) or \
+                regress.get("verdict") not in _VERDICTS:
+            probs.append(f"meta.regress.verdict: not in {_VERDICTS}")
+        elif not isinstance(regress.get("counts"), dict):
+            probs.append("meta.regress.counts: missing verdict counts")
+
     stages = doc.get("stages", [])
     if not isinstance(stages, list):
         probs.append("stages: not a list")
@@ -246,10 +272,60 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
     return probs
 
 
+def validate_verdict(doc, require_passing: bool = False) -> List[str]:
+    """Schema problems in a ``regress_verdict.json``
+    (sofa_tpu/archive/verdict.py).  ``require_passing`` additionally
+    fails on an overall ``regressed`` verdict — the CI-gate mode."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["verdict is not a JSON object"]
+    if doc.get("schema") != _VERDICT_SCHEMA:
+        probs.append(f"schema: expected {_VERDICT_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if not isinstance(doc.get("version"), int):
+        probs.append("version: missing or not an int")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    if doc.get("verdict") not in _VERDICTS:
+        probs.append(f"verdict: {doc.get('verdict')!r} not in {_VERDICTS}")
+    counts = doc.get("counts")
+    if not isinstance(counts, dict) or any(
+            not isinstance(counts.get(v), int) for v in _VERDICTS):
+        probs.append("counts: missing per-verdict int counters")
+    for section in ("features", "clusters"):
+        rows = doc.get(section)
+        if not isinstance(rows, list):
+            probs.append(f"{section}: not a list")
+            continue
+        for i, r in enumerate(rows):
+            if not isinstance(r, dict) or \
+                    not isinstance(r.get("name"), str) or \
+                    r.get("verdict") not in _VERDICTS:
+                probs.append(f"{section}[{i}]: needs a name and a typed "
+                             f"verdict in {_VERDICTS}")
+            elif r.get("verdict") != "noise" and \
+                    not isinstance(r.get("reason"), str):
+                probs.append(f"{section}[{i}]: a non-noise verdict must "
+                             "state its reason")
+    base = doc.get("baseline")
+    if not isinstance(base, dict) or base.get("mode") not in (
+            "pairwise", "rolling"):
+        probs.append("baseline.mode: not pairwise/rolling")
+    if require_passing and doc.get("verdict") == "regressed":
+        probs.append("gate: overall verdict is regressed")
+    return probs
+
+
 def check_path(path: str, require_healthy: bool = False) -> int:
-    """0 valid / 1 invalid / 2 missing; problems go to stderr."""
+    """0 valid / 1 invalid / 2 missing; problems go to stderr.  A path
+    that is (or holds only) a ``regress_verdict.json``, or whose document
+    carries the verdict schema, is validated as a verdict instead."""
     if os.path.isdir(path):
-        path = os.path.join(path, MANIFEST_NAME)
+        mpath = os.path.join(path, MANIFEST_NAME)
+        if not os.path.isfile(mpath) and os.path.isfile(
+                os.path.join(path, "regress_verdict.json")):
+            mpath = os.path.join(path, "regress_verdict.json")
+        path = mpath
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -259,6 +335,14 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     except ValueError as e:
         print(f"manifest_check: {path} is not JSON: {e}", file=sys.stderr)
         return 1
+    if isinstance(doc, dict) and doc.get("schema") == _VERDICT_SCHEMA:
+        probs = validate_verdict(doc, require_passing=require_healthy)
+        for p in probs:
+            print(f"manifest_check: verdict: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; verdict: "
+                  f"{doc.get('verdict')})")
+        return 1 if probs else 0
     probs = validate_manifest(doc, require_healthy=require_healthy)
     for p in probs:
         print(f"manifest_check: {p}", file=sys.stderr)
