@@ -343,6 +343,24 @@ try:
         out["tile_build_wall_time_s"] = stage.get("dur_s")
 except Exception as e:
     out["viz_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# analyze-path evidence (sofa_tpu/analysis/registry.py): wall time of the
+# full registry-scheduled pass run over the preprocessed logdir, plus the
+# meta.passes ledger's health counts — a failed pass is visible in the
+# bench trajectory even when the timing looks fine.
+try:
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.telemetry import load_manifest
+    t0 = time.perf_counter()
+    sofa_analyze(cfg)
+    out["analyze_wall_time_s"] = round(time.perf_counter() - t0, 3)
+    doc = load_manifest(cfg.logdir) or {{}}
+    ledger = ((doc.get("meta") or {{}}).get("passes") or {{}}).get(
+        "passes") or {{}}
+    out["analyze_pass_count"] = len(ledger)
+    out["analyze_failed_passes"] = sum(
+        1 for e in ledger.values() if e.get("status") == "failed")
+except Exception as e:
+    out["analyze_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # durability evidence (sofa_tpu/durability.py): fsck over the healthy
 # logdir, then drop the preprocess commit marker (a crash one instruction
 # before the commit) and time `sofa resume` — the number proves committed
@@ -390,7 +408,9 @@ print(json.dumps(out))
         # `sofa resume` wall time (sofa_tpu/durability.py).
         for key in ("report_js_bytes", "tile_build_wall_time_s",
                     "viz_evidence_error", "fsck_ok", "resume_wall_time_s",
-                    "durability_evidence_error"):
+                    "durability_evidence_error", "analyze_wall_time_s",
+                    "analyze_pass_count", "analyze_failed_passes",
+                    "analyze_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
@@ -399,6 +419,10 @@ print(json.dumps(out))
         if "fsck_ok" in out:
             _log(f"bench: fsck_ok={out['fsck_ok']}, resume wall "
                  f"{out.get('resume_wall_time_s')}s (crash-replay)")
+        if "analyze_wall_time_s" in out:
+            _log(f"bench: analyze wall {out['analyze_wall_time_s']}s, "
+                 f"{out.get('analyze_pass_count')} pass(es), "
+                 f"{out.get('analyze_failed_passes')} failed")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.
@@ -456,7 +480,8 @@ def _lint_evidence() -> dict:
 # rounds still extend the trajectory).
 _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "preprocess_warm_wall_time_s", "tile_build_wall_time_s",
-                     "resume_wall_time_s", "report_js_bytes")
+                     "resume_wall_time_s", "report_js_bytes",
+                     "analyze_wall_time_s")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

@@ -20,6 +20,7 @@ from typing import List, Optional, Tuple
 
 from sofa_tpu.analysis.features import Features
 from sofa_tpu.analysis.comm import load_topology
+from sofa_tpu.analysis.registry import analysis_pass
 from sofa_tpu.printing import print_hint
 
 
@@ -32,6 +33,11 @@ def _factorizations(n: int) -> List[Tuple[int, ...]]:
     return sorted(out, key=lambda p: abs(p[0] - p[1]))
 
 
+@analysis_pass(
+    name="mesh_advice", order=240,
+    provides_features=("mesh_advice",),
+    provides_artifacts=("mesh_advice.txt",),
+)
 def mesh_advice(frames, cfg, features: Features) -> None:
     topo = load_topology(cfg)
     if topo is None:

@@ -19,6 +19,7 @@ import numpy as np
 import pandas as pd
 
 from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
 from sofa_tpu.printing import print_title
 from sofa_tpu.trace import CK_NAMES, CopyKind
 
@@ -75,6 +76,17 @@ def _wire_bytes(sel: pd.DataFrame, kind: int, n_devices: int) -> float:
     return total
 
 
+@analysis_pass(
+    name="comm_profile", order=210,
+    reads_frames=("tputrace",),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "payload", "groups"),
+    provides_features=("comm_*_time", "comm_*_bytes", "comm_*_ici_bytes",
+                       "comm_ici_bytes", "comm_ici_bandwidth", "comm_time",
+                       "comm_ratio", "ici_est_bytes"),
+    provides_artifacts=("comm.csv", "ici_matrix.csv"),
+    after=("spotlight",),
+)
 def comm_profile(frames, cfg, features: Features) -> None:
     from sofa_tpu.trace import narrow, roi_clip
 
@@ -258,6 +270,14 @@ def ici_traffic_matrix(coll: pd.DataFrame, topo: Optional[dict]) -> Optional[pd.
     return pd.DataFrame(mat, index=labels, columns=labels)
 
 
+@analysis_pass(
+    name="comm_scatter", order=220,
+    reads_frames=("tputrace", "nettrace"),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "payload", "pkt_src", "pkt_dst"),
+    provides_artifacts=("commtrace.csv",),
+    after=("spotlight",),
+)
 def comm_scatter(frames, cfg, features: Features) -> None:
     """Time-resolved communication events for the board's comm scatter —
     the reference's zoomable d3 time-scatter (x=time, y=peer, dot
@@ -405,6 +425,15 @@ def _busy_bins(ops: pd.DataFrame, edges: np.ndarray) -> np.ndarray:
     return busy
 
 
+@analysis_pass(
+    name="net_profile", order=100,
+    reads_frames=("nettrace", "tputrace"),
+    reads_columns=("timestamp", "duration", "category", "payload",
+                   "pkt_src", "pkt_dst"),
+    provides_features=("net_packets", "net_total_bytes", "net_total_time",
+                       "dcn_top_peer_corr", "dcn_top_peer"),
+    provides_artifacts=("netrank.csv",),
+)
 def net_profile(frames, cfg, features: Features) -> None:
     """Host-network (DCN) packet profile (reference sofa_analyze.py:385-493)."""
     df = frames.get("nettrace")
@@ -473,6 +502,13 @@ def net_profile(frames, cfg, features: Features) -> None:
     pairs[out_cols].to_csv(cfg.path("netrank.csv"), index=False)
 
 
+@analysis_pass(
+    name="netbandwidth_profile", order=90,
+    reads_frames=("netbandwidth",),
+    reads_columns=("name", "event", "payload"),
+    provides_features=("net_*_q1", "net_*_median", "net_*_q3",
+                       "net_*_total_bytes"),
+)
 def netbandwidth_profile(frames, cfg, features: Features) -> None:
     """NIC byte-counter profile (reference sofa_analyze.py:531-594)."""
     df = frames.get("netbandwidth")

@@ -13,6 +13,7 @@ import numpy as np
 import pandas as pd
 
 from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
 from sofa_tpu.printing import print_title
 
 
@@ -36,6 +37,15 @@ def _window_series(df, name_filter, t0, t1, window, value_col="event"):
     return edges, out
 
 
+@analysis_pass(
+    name="concurrency_breakdown", order=230,
+    reads_frames=("mpstat", "tpuutil", "netbandwidth"),
+    reads_columns=("timestamp", "deviceId", "name"),
+    provides_features=("elapsed_*_ratio", "breakdown_windows",
+                       "breakdown_elapsed", "corr_tpu_*"),
+    provides_artifacts=("performance.csv",),
+    after=("spotlight",),
+)
 def concurrency_breakdown(frames, cfg, features: Features) -> None:
     mpstat = frames.get("mpstat")
     if mpstat is None or mpstat.empty:

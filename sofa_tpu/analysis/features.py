@@ -31,6 +31,13 @@ class Features:
                 return v
         return None
 
+    def merge_from(self, other: "Features") -> None:
+        """Append another accumulator's rows (the registry's per-pass
+        buffers merge in canonical order so features.csv is identical to
+        the legacy sequential loop's output)."""
+        self._rows.extend(other._rows)
+        self._info.extend(other._info)
+
     def by_regex(self, pattern: str) -> List[Tuple[str, float]]:
         """Latest value of every feature whose full name matches pattern.
 

@@ -23,6 +23,23 @@ SERVICE = "sofa_tpu.hint.HintService"
 METHOD = f"/{SERVICE}/Hint"
 
 
+# Explicit network deadlines: analyze must not stall on an unreachable or
+# wedged advice server.  Connect (channel-ready) and read (RPC) budgets are
+# separate so a routable-but-dead host fails in seconds, not at the TCP
+# stack's leisure; both are env-tunable for slow links.
+DEFAULT_CONNECT_TIMEOUT_S = 3.0
+DEFAULT_READ_TIMEOUT_S = 5.0
+
+
+def _env_timeout(var: str, default: float) -> float:
+    raw = os.environ.get(var, "").strip()
+    try:
+        val = float(raw) if raw else default
+    except ValueError:
+        return default
+    return val if val > 0 else default
+
+
 def discover_server(cfg) -> str | None:
     if cfg.hint_server:
         return cfg.hint_server
@@ -30,15 +47,26 @@ def discover_server(cfg) -> str | None:
     return host
 
 
-def request_hints(server: str, features, hostname: str = "", timeout: float = 5.0) -> List[str]:
+def request_hints(server: str, features, hostname: str = "",
+                  timeout: "float | None" = None,
+                  connect_timeout: "float | None" = None) -> List[str]:
     import grpc
 
+    if timeout is None:
+        timeout = _env_timeout("SOFA_HINT_TIMEOUT_S",
+                               DEFAULT_READ_TIMEOUT_S)
+    if connect_timeout is None:
+        connect_timeout = _env_timeout("SOFA_HINT_CONNECT_TIMEOUT_S",
+                                       DEFAULT_CONNECT_TIMEOUT_S)
     if ":" not in server:
         server += ":50051"
     req = hint_pb2.HintRequest(hostname=hostname or os.uname().nodename)
     for name, value in features.to_frame().itertuples(index=False):
         req.features[name] = float(value)
     with grpc.insecure_channel(server) as channel:
+        # Bounded connect: without this, the first RPC's deadline also
+        # absorbs name-resolution/TCP stalls and the error is ambiguous.
+        grpc.channel_ready_future(channel).result(timeout=connect_timeout)
         call = channel.unary_unary(
             METHOD,
             request_serializer=hint_pb2.HintRequest.SerializeToString,
@@ -46,6 +74,23 @@ def request_hints(server: str, features, hostname: str = "", timeout: float = 5.
         )
         resp = call(req, timeout=timeout)
     return list(resp.hints)
+
+
+def fetch_hints(cfg, features) -> List[str]:
+    """The analyze-facing entry point: discover + request with bounded
+    deadlines, degrading to a telemetry-routed warning (empty result) on
+    any network/service failure instead of raising into the pipeline."""
+    from sofa_tpu.printing import print_warning
+
+    server = discover_server(cfg)
+    if not server:
+        return []
+    try:
+        return request_hints(server, features)
+    except Exception as e:  # noqa: BLE001 — remote advice is best-effort
+        print_warning(f"hint server {server}: {type(e).__name__}: {e} — "
+                      "continuing without remote hints")
+        return []
 
 
 def serve(port: int = 50051, block: bool = True):

@@ -10,9 +10,18 @@ from __future__ import annotations
 import pandas as pd
 
 from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
 from sofa_tpu.printing import print_title
 
 
+@analysis_pass(
+    name="cpu_profile", order=20,
+    reads_frames=("cputrace",),
+    reads_columns=("duration", "deviceId", "name"),
+    provides_features=("cpu_samples", "cpu_core*_exec_time"),
+    provides_artifacts=("cpu_top.csv",),
+    after=("spotlight",),
+)
 def cpu_profile(frames, cfg, features: Features) -> None:
     df = frames.get("cputrace")
     if df is None or df.empty:
@@ -34,6 +43,13 @@ def cpu_profile(frames, cfg, features: Features) -> None:
     top.to_csv(cfg.path("cpu_top.csv"))
 
 
+@analysis_pass(
+    name="mpstat_profile", order=30,
+    reads_frames=("mpstat",),
+    reads_columns=("duration", "deviceId", "name", "event"),
+    provides_features=("num_cores", "mpstat_*_pct", "mpstat_*_time",
+                       "cpu_util"),
+)
 def mpstat_profile(frames, cfg, features: Features) -> None:
     df = frames.get("mpstat")
     if df is None or df.empty:
@@ -58,6 +74,12 @@ def mpstat_profile(frames, cfg, features: Features) -> None:
     features.add("cpu_util", (usr + sys_) / 100.0)
 
 
+@analysis_pass(
+    name="vmstat_profile", order=40,
+    reads_frames=("vmstat",),
+    reads_columns=("name", "event"),
+    provides_features=("vmstat_mean_*",),
+)
 def vmstat_profile(frames, cfg, features: Features) -> None:
     df = frames.get("vmstat")
     if df is None or df.empty:
@@ -68,6 +90,14 @@ def vmstat_profile(frames, cfg, features: Features) -> None:
             features.add(f"vmstat_mean_{metric}", float(rows["event"].mean()))
 
 
+@analysis_pass(
+    name="diskstat_profile", order=50,
+    reads_frames=("diskstat",),
+    reads_columns=("timestamp", "deviceId", "name", "event", "payload"),
+    provides_features=("disk_*_r_bw_mean", "disk_*_w_bw_mean",
+                       "disk_total_bytes"),
+    provides_artifacts=("disk_summary.csv",),
+)
 def diskstat_profile(frames, cfg, features: Features) -> None:
     df = frames.get("diskstat")
     if df is None or df.empty:
@@ -94,6 +124,12 @@ def diskstat_profile(frames, cfg, features: Features) -> None:
     features.add("disk_total_bytes", float(total_bytes))
 
 
+@analysis_pass(
+    name="blktrace_latency_profile", order=60,
+    reads_frames=("blktrace",),
+    reads_columns=("timestamp", "duration", "name", "payload"),
+    provides_features=("blktrace_*",),
+)
 def blktrace_latency_profile(frames, cfg, features: Features) -> None:
     """Per-IO D->C latency quartiles + totals (the reference's btt-based
     pass, sofa_analyze.py:596-638, computed from our own event pairing)."""
@@ -119,6 +155,13 @@ def blktrace_latency_profile(frames, cfg, features: Features) -> None:
         features.add("blktrace_bandwidth", float(df["payload"].sum()) / span)
 
 
+@analysis_pass(
+    name="strace_profile", order=70,
+    reads_frames=("strace",),
+    reads_columns=("duration", "name"),
+    provides_features=("syscall_total_time", "syscall_count"),
+    provides_artifacts=("strace_top.csv",),
+)
 def strace_profile(frames, cfg, features: Features) -> None:
     df = frames.get("strace")
     if df is None or df.empty:
@@ -134,6 +177,13 @@ def strace_profile(frames, cfg, features: Features) -> None:
     top.head(20).to_csv(cfg.path("strace_top.csv"))
 
 
+@analysis_pass(
+    name="pystacks_profile", order=80,
+    reads_frames=("pystacks",),
+    reads_columns=("timestamp", "name"),
+    provides_features=("py_samples",),
+    provides_artifacts=("pystacks_top.csv",),
+)
 def pystacks_profile(frames, cfg, features: Features) -> None:
     df = frames.get("pystacks")
     if df is None or df.empty:

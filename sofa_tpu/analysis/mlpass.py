@@ -1,0 +1,59 @@
+"""Registry wrappers for the optional ML analysis passes (aisi / hsg).
+
+These were the special-cased blocks at the tail of ``analyze.py``'s
+``_analyze_body``: iteration detection (``ml/aisi.py``) and hot-swarm
+clustering (``ml/hsg.py``), each gated by its cfg flag and each feeding
+extra board series into ``report.js``.  On the registry they are plain
+passes — gated by ``enabled_when``, fault-isolated like every other
+pass, and their series ride the executor's ``provides_series`` channel
+instead of an ad-hoc ``extra_series`` list.
+
+The heavy lifting stays in ``sofa_tpu/ml/`` (imported lazily so default
+runs never pay for it); these wrappers forward the features object, so
+the feature writes happen in the helpers — sofa-lint SL011 recognizes
+the forwarding and trusts the declaration.
+"""
+
+from __future__ import annotations
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
+
+
+@analysis_pass(
+    name="aisi", order=250,
+    reads_frames=("tputrace", "tpumodules", "tpusteps", "hosttrace",
+                  "pystacks"),
+    provides_features=("aisi_iterations", "aisi_step_time_mean",
+                       "aisi_step_time_gmean", "aisi_step_time_std",
+                       "aisi_comm_ratio"),
+    provides_artifacts=("iterations.csv",),
+    provides_series=True,
+    after=("spotlight",),
+    enabled_when=("enable_aisi",),
+)
+def aisi(frames, cfg, features: Features):
+    """Iteration detection + per-step profile (``--enable_aisi``)."""
+    from sofa_tpu.ml.aisi import iteration_series, sofa_aisi
+
+    iters = sofa_aisi(frames, cfg, features)
+    marker = iteration_series(iters)
+    return [marker] if marker is not None else []
+
+
+@analysis_pass(
+    name="hsg", order=260,
+    reads_frames=("cputrace", "pystacks", "tputrace"),
+    provides_features=("hsg_swarms",),
+    provides_artifacts=("auto_caption.csv",),
+    provides_series=True,
+    after=("spotlight",),
+    enabled_when=("enable_hsg", "enable_swarms"),
+)
+def hsg(frames, cfg, features: Features):
+    """Hot-swarm clustering over sampled stacks (``--enable_hsg`` /
+    ``--enable_swarms``)."""
+    from sofa_tpu.ml.hsg import sofa_hsg, swarm_series
+
+    clustered = sofa_hsg(frames, cfg, features)
+    return list(swarm_series(clustered, cfg.num_swarms))

@@ -1,0 +1,488 @@
+"""Contract-verified analysis-pass registry (the PASTA-style refactor).
+
+Every analysis pass is a registered unit declaring its contract up front:
+
+* which trace **frames** and **columns** it reads (columns are validated
+  against ``trace.COLUMNS`` at registration time),
+* which **features** it consumes (fnmatch-style patterns over feature
+  names — ``tpu*_op_time`` covers the per-device family),
+* what it **produces**: feature patterns, derived artifacts (CSV/txt
+  files in the logdir), and optionally board series (the pass returns a
+  list of :class:`sofa_tpu.trace.SofaSeries`),
+* explicit ``after`` edges for non-feature dependencies (the spotlight
+  pass mutates ``cfg.roi_begin/roi_end``; every ROI-clipping pass
+  declares ``after=("spotlight",)``).
+
+Scheduling is derived from the declarations alone: a pass that reads a
+feature pattern some other pass provides runs in a later wave; passes in
+one wave fan out on the shared ``--jobs`` thread pool
+(``sofa_tpu/pool.py``).  Determinism is preserved regardless of pool
+width: each pass appends features into a private buffer, reads see
+completed passes' buffers in *canonical* (legacy ``_PASSES``) order, and
+the buffers merge into the shared :class:`Features` in that same
+canonical order — so ``--jobs 1`` and ``--jobs 4`` produce byte-identical
+``features.csv`` and hint output.
+
+Fault isolation matches the collector contract: a crashing pass degrades
+to a telemetry-routed warning and a sticky ``failed`` entry in the run
+manifest's ``meta.passes`` ledger (schema v5); analyze continues.
+
+The declarations are *statically enforceable*: sofa-lint rules
+SL010–SL013 (``sofa_tpu/lint/pass_rules.py``) check each decorated pass
+body against its declaration, verify the cross-pass dependency graph
+from the declarations alone, and forbid direct pass-to-pass calls.  Keep
+the decorator arguments literal (plain string tuples) — the lint reads
+them from the AST without importing anything.
+
+``sofa passes`` renders the resolved DAG, per-pass contracts, and the
+last run's timings (docs/ANALYSIS.md "Writing an analysis pass").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.printing import print_title, print_warning
+
+#: Pass outcome vocabulary in the manifest's ``meta.passes`` ledger.
+PASS_STATUSES = ("ok", "failed", "skipped")
+
+#: Features the analyze driver itself provides before any pass runs —
+#: reads of these need no producing pass (sofa-lint SL012 knows this
+#: list; keep it a plain literal).
+AMBIENT_FEATURES = ("elapsed_time", "num_cores")
+
+
+class RegistryError(ValueError):
+    """A broken pass declaration or an unschedulable pass graph."""
+
+
+@dataclass(frozen=True)
+class PassSpec:
+    """One registered analysis pass and its declared contract."""
+
+    name: str
+    fn: Callable
+    #: canonical merge/tie-break position (legacy ``_PASSES`` order for
+    #: the migrated built-ins; plugins default past every built-in).
+    order: int
+    reads_frames: Tuple[str, ...] = ()
+    reads_columns: Tuple[str, ...] = ()
+    reads_features: Tuple[str, ...] = ()
+    provides_features: Tuple[str, ...] = ()
+    provides_artifacts: Tuple[str, ...] = ()
+    provides_series: bool = False
+    after: Tuple[str, ...] = ()
+    #: cfg attribute names gating the pass (enabled when ANY is truthy;
+    #: empty = always on).
+    enabled_when: Tuple[str, ...] = ()
+    origin: str = "builtin"
+    seq: int = 0
+
+    def enabled(self, cfg) -> bool:
+        if not self.enabled_when:
+            return True
+        return any(getattr(cfg, attr, False) for attr in self.enabled_when)
+
+
+_lock = threading.RLock()
+_registry: Dict[str, PassSpec] = {}
+#: every builtin spec ever registered — the decorators run only on first
+#: module import, so ``load_builtin_passes`` after a ``clear``/``scoped``
+#: restores from this archive instead of hoping the import re-fires.
+_declared_builtins: Dict[str, PassSpec] = {}
+_seq = 0
+_origin = ["builtin"]
+
+
+def _as_tuple(value, what: str) -> Tuple[str, ...]:
+    if isinstance(value, str):
+        raise RegistryError(f"{what} must be a tuple of strings, got the "
+                            f"bare string {value!r}")
+    out = tuple(value)
+    for v in out:
+        if not isinstance(v, str) or not v:
+            raise RegistryError(f"{what} entries must be non-empty strings, "
+                                f"got {v!r}")
+    return out
+
+
+def register_pass(fn: Callable, *, name: str, order: int = 0,
+                  reads_frames=(), reads_columns=(), reads_features=(),
+                  provides_features=(), provides_artifacts=(),
+                  provides_series: bool = False, after=(),
+                  enabled_when=()) -> PassSpec:
+    """Register a pass callable ``fn(frames, cfg, features)``.
+
+    Validates the contract loudly at registration time: duplicate names
+    and columns outside ``trace.COLUMNS`` are coding errors, not runtime
+    degradations.  Returns the spec; ``fn`` is stored unchanged (direct
+    calls in tests keep working)."""
+    global _seq
+    from sofa_tpu.trace import COLUMNS
+
+    if not name or not isinstance(name, str):
+        raise RegistryError(f"pass name must be a non-empty string: {name!r}")
+    spec_cols = _as_tuple(reads_columns, f"pass {name}: reads_columns")
+    unknown = [c for c in spec_cols if c not in COLUMNS]
+    if unknown:
+        raise RegistryError(
+            f"pass {name}: reads_columns {unknown} not in trace.COLUMNS — "
+            "fix the declaration or add the column to trace.py")
+    with _lock:
+        if name in _registry:
+            raise RegistryError(f"pass {name!r} is already registered "
+                                f"(by {_registry[name].origin})")
+        _seq += 1
+        spec = PassSpec(
+            name=name, fn=fn,
+            order=order if order else 1000 + _seq,
+            reads_frames=_as_tuple(reads_frames,
+                                   f"pass {name}: reads_frames"),
+            reads_columns=spec_cols,
+            reads_features=_as_tuple(reads_features,
+                                     f"pass {name}: reads_features"),
+            provides_features=_as_tuple(provides_features,
+                                        f"pass {name}: provides_features"),
+            provides_artifacts=_as_tuple(provides_artifacts,
+                                         f"pass {name}: provides_artifacts"),
+            provides_series=bool(provides_series),
+            after=_as_tuple(after, f"pass {name}: after"),
+            enabled_when=_as_tuple(enabled_when,
+                                   f"pass {name}: enabled_when"),
+            origin=_origin[-1], seq=_seq)
+        _registry[name] = spec
+        # Archive genuine builtins only: a pass whose function lives in
+        # the sofa_tpu package.  Test/plugin registrations must not be
+        # resurrected by a later load_builtin_passes.
+        if spec.origin == "builtin" and \
+                (getattr(fn, "__module__", "") or "").startswith("sofa_tpu."):
+            _declared_builtins[name] = spec
+    return spec
+
+
+def analysis_pass(**contract):
+    """Decorator form of :func:`register_pass` — THE spelling sofa-lint's
+    SL010–SL013 extract contracts from; keep every argument a literal."""
+    def deco(fn: Callable) -> Callable:
+        register_pass(fn, **contract)
+        return fn
+    return deco
+
+
+@contextlib.contextmanager
+def plugin_origin(label: str):
+    """Passes registered inside this context are tagged as third-party
+    (``plugin:<spec>``) in ``sofa passes`` and ``meta.passes``."""
+    _origin.append(f"plugin:{label}")
+    try:
+        yield
+    finally:
+        _origin.pop()
+
+
+@contextlib.contextmanager
+def scoped():
+    """Snapshot the registry and restore it on exit (tests, chaos cells)."""
+    with _lock:
+        before = dict(_registry)
+    try:
+        yield
+    finally:
+        with _lock:
+            _registry.clear()
+            _registry.update(before)
+
+
+def clear() -> None:
+    with _lock:
+        _registry.clear()
+
+
+def registered() -> List[PassSpec]:
+    """Every registered pass in canonical order (order, then seq)."""
+    with _lock:
+        specs = list(_registry.values())
+    return sorted(specs, key=lambda s: (s.order, s.seq))
+
+
+def get(name: str) -> Optional[PassSpec]:
+    with _lock:
+        return _registry.get(name)
+
+
+def load_builtin_passes() -> None:
+    """Import the analysis modules so their decorators register (idempotent).
+
+    Import order does not matter — canonical order comes from each pass's
+    explicit ``order`` declaration.  The decorators only fire on FIRST
+    import; after a ``clear`` (or inside ``scoped``) the cached modules
+    re-import as no-ops, so missing builtins are restored from the
+    declaration archive instead."""
+    import sofa_tpu.analysis.advice  # noqa: F401
+    import sofa_tpu.analysis.comm  # noqa: F401
+    import sofa_tpu.analysis.concurrency  # noqa: F401
+    import sofa_tpu.analysis.host  # noqa: F401
+    import sofa_tpu.analysis.mlpass  # noqa: F401
+    import sofa_tpu.analysis.sol  # noqa: F401
+    import sofa_tpu.analysis.tpu  # noqa: F401
+    with _lock:
+        for name, spec in _declared_builtins.items():
+            _registry.setdefault(name, spec)
+
+
+# --- pattern algebra --------------------------------------------------------
+
+def patterns_overlap(a: str, b: str) -> bool:
+    """Whether two fnmatch-style feature patterns can name the same
+    feature.  Symmetric literal-vs-pattern check: exact names match
+    wildcard declarations and vice versa; two wildcard patterns match
+    when one covers the other's literal skeleton.  Deliberately simple —
+    sofa-lint SL010/SL012 and the scheduler share this exact function,
+    so what lints clean is what schedules."""
+    return fnmatchcase(a, b) or fnmatchcase(b, a)
+
+
+def covered(pattern: str, declared) -> bool:
+    return any(patterns_overlap(pattern, d) for d in declared)
+
+
+# --- scheduling -------------------------------------------------------------
+
+def pass_dependencies(specs: List[PassSpec]) -> Dict[str, List[str]]:
+    """name -> sorted producer/after dependency names, from declarations
+    alone.  A pass reading a feature pattern depends on every OTHER pass
+    providing an overlapping pattern; ``after`` edges add non-feature
+    ordering (ROI mutation)."""
+    by_name = {s.name: s for s in specs}
+    deps: Dict[str, set] = {s.name: set() for s in specs}
+    for s in specs:
+        for dep in s.after:
+            if dep in by_name and dep != s.name:
+                deps[s.name].add(dep)
+        for pat in s.reads_features:
+            if covered(pat, AMBIENT_FEATURES):
+                continue
+            for other in specs:
+                if other.name != s.name and covered(pat,
+                                                    other.provides_features):
+                    deps[s.name].add(other.name)
+    return {k: sorted(v) for k, v in deps.items()}
+
+
+def resolve_schedule(specs: List[PassSpec],
+                     strict: bool = False) -> List[List[PassSpec]]:
+    """Kahn-level waves over the declared dependency graph, canonical
+    order within each wave.  A cycle raises in ``strict`` mode (``sofa
+    passes`` reports it); at runtime it degrades to canonical-order
+    execution of the cyclic remainder with a warning — analysis must not
+    be un-runnable because a plugin mis-declared."""
+    specs = sorted(specs, key=lambda s: (s.order, s.seq))
+    deps = pass_dependencies(specs)
+    done: set = set()
+    waves: List[List[PassSpec]] = []
+    pending = list(specs)
+    while pending:
+        ready = [s for s in pending if all(d in done for d in deps[s.name])]
+        if not ready:
+            cyclic = [s.name for s in pending]
+            if strict:
+                raise RegistryError(
+                    f"dependency cycle among passes: {cyclic}")
+            print_warning(
+                f"analysis registry: dependency cycle among {cyclic} — "
+                "running them in canonical order (fix the declarations; "
+                "`sofa lint` flags this as SL012)")
+            ready = pending
+        waves.append(ready)
+        done.update(s.name for s in ready)
+        pending = [s for s in pending if s.name not in done]
+    return waves
+
+
+# --- deterministic feature views --------------------------------------------
+
+class _PassFeatures:
+    """The Features facade handed to one pass: writes land in a private
+    buffer; reads see the shared base plus every *completed* pass's
+    buffer in canonical order — so results are independent of which pool
+    thread finished first, and the final merge (canonical order) yields
+    the exact row sequence the legacy sequential loop produced."""
+
+    def __init__(self, base: Features, completed: List[Features]):
+        self._base = base
+        self._completed = completed  # canonical order, frozen per wave
+        self.buf = Features()
+
+    def add(self, name: str, value: float) -> None:
+        self.buf.add(name, value)
+
+    def add_info(self, name: str, value: str) -> None:
+        self.buf.add_info(name, value)
+
+    def _layers(self):
+        return [self._base] + self._completed + [self.buf]
+
+    def get(self, name: str) -> Optional[float]:
+        for layer in reversed(self._layers()):
+            v = layer.get(name)
+            if v is not None:
+                return v
+        return None
+
+    def by_regex(self, pattern: str):
+        import re
+
+        rx = re.compile(pattern)
+        latest: Dict[str, float] = {}
+        for layer in self._layers():
+            for n, v in layer._rows:
+                if rx.fullmatch(n):
+                    latest[n] = v
+        return sorted(latest.items())
+
+    def to_frame(self):
+        import pandas as pd
+
+        rows = [r for layer in self._layers() for r in layer._rows]
+        return pd.DataFrame(rows, columns=["name", "value"])
+
+
+# --- execution --------------------------------------------------------------
+
+def run_passes(frames, cfg, features: Features, tel=None,
+               jobs: Optional[int] = None):
+    """Execute every registered pass under the declared schedule.
+
+    Returns ``(report, series)``: the ``meta.passes`` ledger dict and the
+    board series produced by series-providing passes (canonical order).
+    One crashing pass degrades to a warning + sticky ``failed`` status;
+    everything else runs."""
+    from sofa_tpu import pool, telemetry
+
+    specs = registered()
+    jobs = pool.cfg_jobs(cfg) if jobs is None else max(1, int(jobs))
+    enabled = [s for s in specs if s.enabled(cfg)]
+    report: Dict[str, dict] = {}
+    for s in specs:
+        if s not in enabled:
+            report[s.name] = {
+                "status": "skipped", "origin": s.origin,
+                "skip_reason": "/".join(s.enabled_when) + " off",
+            }
+    waves = resolve_schedule(enabled)
+    buffers: Dict[str, Features] = {}
+    series_by_pass: Dict[str, list] = {}
+    completed: List[Features] = []  # canonical-order buffers, grows per wave
+    wave_of = {s.name: i for i, wave in enumerate(waves) for s in wave}
+
+    def run_one(spec: PassSpec) -> None:
+        view = _PassFeatures(features, list(completed))
+        buffers[spec.name] = view.buf
+        entry = report.setdefault(spec.name, {})
+        entry.update(origin=spec.origin, wave=wave_of[spec.name])
+        t0 = time.perf_counter()
+        span = (tel.span(spec.name, cat="analyze") if tel is not None
+                else telemetry.maybe_span(spec.name, cat="analyze"))
+        try:
+            with span:
+                out = spec.fn(frames, cfg, view)
+            if spec.provides_series and out:
+                series_by_pass[spec.name] = list(out)
+            entry["status"] = "ok"
+        except Exception as e:  # noqa: BLE001 — per-pass fault isolation
+            print_warning(f"analyze pass {spec.name}: {e}")
+            entry["status"] = "failed"
+            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+        entry["wall_s"] = round(time.perf_counter() - t0, 6)
+
+    for wave in waves:
+        pool.thread_map(run_one, wave, jobs)
+        # expose this wave's output to later waves, canonical order
+        completed = _canonical_buffers(buffers)
+
+    # final merge: byte-identical to the legacy sequential loop
+    for spec in sorted(enabled, key=lambda s: (s.order, s.seq)):
+        buf = buffers.get(spec.name)
+        if buf is not None:
+            features.merge_from(buf)
+    series = [s for spec in sorted(enabled,
+                                   key=lambda s: (s.order, s.seq))
+              for s in series_by_pass.get(spec.name, ())]
+    ledger = {
+        "schedule": [[s.name for s in wave] for wave in waves],
+        "order": [s.name for s in sorted(enabled,
+                                         key=lambda s: (s.order, s.seq))],
+        "jobs": jobs,
+        "passes": report,
+    }
+    return ledger, series
+
+
+def _canonical_buffers(buffers: Dict[str, Features]) -> List[Features]:
+    names = sorted(buffers, key=lambda n: (_registry[n].order,
+                                           _registry[n].seq))
+    return [buffers[n] for n in names]
+
+
+# --- `sofa passes` ----------------------------------------------------------
+
+def sofa_passes(cfg) -> int:
+    """Render the resolved pass DAG, per-pass contracts, and — when the
+    logdir holds a manifest with ``meta.passes`` — the last run's
+    per-pass timings and statuses.  Exit 2 on an unschedulable graph."""
+    from sofa_tpu import telemetry
+
+    load_builtin_passes()
+    specs = registered()
+    enabled = [s for s in specs if s.enabled(cfg)]
+    try:
+        waves = resolve_schedule(enabled, strict=True)
+    except RegistryError as e:
+        print_warning(str(e))
+        return 2
+    deps = pass_dependencies(enabled)
+    last = ((telemetry.load_manifest(cfg.logdir) or {}).get("meta") or {}) \
+        .get("passes") or {}
+    last_passes = last.get("passes") or {}
+
+    print_title(f"SOFA analysis passes — {len(specs)} registered, "
+                f"{len(enabled)} enabled, {len(waves)} wave(s)")
+    for i, wave in enumerate(waves):
+        print(f"wave {i}: {', '.join(s.name for s in wave)}")
+    print()
+    for spec in specs:
+        run = last_passes.get(spec.name) or {}
+        tail = ""
+        if run.get("status"):
+            tail = f"  [last run: {run['status']}"
+            if isinstance(run.get("wall_s"), (int, float)):
+                tail += f" {run['wall_s']:.3f}s"
+            if run.get("error"):
+                tail += f" — {run['error'][:60]}"
+            tail += "]"
+        gate = (f" (gated by {'/'.join(spec.enabled_when)};"
+                f" {'on' if spec.enabled(cfg) else 'off'})"
+                if spec.enabled_when else "")
+        print(f"{spec.name}  [{spec.origin}]{gate}{tail}")
+        if spec.reads_frames:
+            print(f"  reads frames:   {', '.join(spec.reads_frames)}")
+        if spec.reads_columns:
+            print(f"  reads columns:  {', '.join(spec.reads_columns)}")
+        if spec.reads_features:
+            print(f"  reads features: {', '.join(spec.reads_features)}")
+        if spec.provides_features:
+            print(f"  provides:       {', '.join(spec.provides_features)}")
+        if spec.provides_artifacts:
+            print(f"  artifacts:      {', '.join(spec.provides_artifacts)}")
+        if spec.provides_series:
+            print("  board series:   yes")
+        if deps.get(spec.name):
+            print(f"  after:          {', '.join(deps[spec.name])}")
+    return 0

@@ -1,0 +1,152 @@
+"""Speed-of-light (SOLAR) groundwork: the attainable-peak analysis pass.
+
+``tools/kernel_perf.py`` already knew how to read a chip's attainable
+peak — XPlane plane stats first (``tpu_meta.json``, written by the
+xplane ingest), device-kind datasheet table second — but only as a
+standalone MFU-tracking tool.  This module promotes that read into the
+first *registered* analysis pass (``sol_roofline``): every analyze run
+now records how far each device ran from its hardware limit, per HLO op
+class, which is the quantitative footing the SOLAR roadmap item
+(per-op-class rooflines, bound-ness board overlay) builds on.
+
+Unlike ``roofline_profile`` (which needs the measured per-device peaks
+in ``tpu_meta.json`` and goes silent without them), ``sol_roofline``
+falls back to the datasheet bf16 peak for the trace's ``device_kind``
+— so a capture from a machine whose runtime didn't report plane stats
+still gets a speed-of-light distance, with the peak's provenance
+recorded as an info feature.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pandas as pd
+
+from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
+from sofa_tpu.trace import CopyKind, narrow, roi_clip
+
+# Datasheet bf16 peaks per chip generation (TFLOP/s per chip) — the
+# fallback when the profiler's plane stats don't carry the peak.  Moved
+# here from tools/kernel_perf.py, which now imports it.
+KIND_PEAKS = {
+    "v6e": 918.0, "v6": 918.0,
+    "v5p": 459.0,
+    "v5e": 197.0, "v5litepod": 197.0, "v5": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+}
+
+
+def peak_from_kind(kind: str) -> "float | None":
+    """Datasheet bf16 peak for a ``device_kind`` string, longest match
+    first (``"TPU v5 lite"`` -> v5)."""
+    k = (kind or "").lower().replace("tpu", "").strip()
+    for key, val in sorted(KIND_PEAKS.items(), key=lambda kv: -len(kv[0])):
+        if key in k:
+            return val
+    return None
+
+
+def load_attainable_peaks(cfg) -> dict:
+    """device_id(str) -> {"peak_tflops", "peak_hbm_gbps", "peak_source"} from
+    the plane-stats sidecar; empty when absent/unreadable."""
+    path = cfg.path("tpu_meta.json")
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out = {}
+    for dev, peaks in meta.items():
+        if not isinstance(peaks, dict):
+            continue
+        tflops = float(peaks.get("peak_teraflops_per_second", 0) or 0)
+        gbps = float(peaks.get("peak_hbm_bw_gigabytes_per_second", 0) or 0)
+        if tflops > 0:
+            out[str(dev)] = {"peak_tflops": tflops, "peak_hbm_gbps": gbps,
+                             "peak_source": "plane stats"}
+    return out
+
+
+@analysis_pass(
+    name="sol_roofline", order=270,
+    reads_frames=("tputrace",),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "device_kind", "hlo_category", "flops",
+                   "bytes_accessed"),
+    provides_features=("tpu*_sol_peak_tflops", "tpu*_sol_distance",
+                       "sol_peak_source"),
+    provides_artifacts=("sol_roofline.csv",),
+    after=("spotlight",),
+)
+def sol_roofline(frames, cfg, features: Features) -> None:
+    """Distance from speed of light, per device and HLO op class.
+
+    For every kernel op with flops metadata the attainable time is
+    ``flops / peak_flops`` (plus ``bytes / peak_hbm_bw`` when the memory
+    peak is known — the roofline max); the *distance* is actual time over
+    attainable time, duration-weighted.  1.0 = at the hardware limit.
+    Emits ``tpu<N>_sol_peak_tflops`` / ``tpu<N>_sol_distance`` features,
+    the per-class table ``sol_roofline.csv``, and the provenance of each
+    peak (plane stats vs datasheet) as ``sol_peak_source``."""
+    df = frames.get("tputrace")
+    if df is None or df.empty:
+        return
+    df = narrow(df, ["timestamp", "duration", "deviceId", "category",
+                     "copyKind", "device_kind", "hlo_category", "flops",
+                     "bytes_accessed"])
+    df = roi_clip(df, cfg)
+    rows = df[(df["category"] == 0)
+              & (df["copyKind"] == int(CopyKind.KERNEL))
+              & (df["duration"] > 0) & (df["flops"] > 0)]
+    if rows.empty:
+        return
+    measured = load_attainable_peaks(cfg)
+    out = []
+    sources = set()
+    for device_id, dev in rows.groupby("deviceId"):
+        peaks = measured.get(str(int(device_id)))
+        if peaks:
+            peak_tflops = peaks["peak_tflops"]
+            peak_gbps = peaks["peak_hbm_gbps"]
+            source = peaks["peak_source"]
+        else:
+            kinds = dev["device_kind"].astype(str)
+            kind = kinds.mode().iloc[0] if len(kinds) else ""
+            dk_peak = peak_from_kind(kind)
+            if dk_peak is None:
+                continue  # unknown chip: no defensible bound
+            peak_tflops, peak_gbps = dk_peak, 0.0
+            source = f"datasheet bf16 for device_kind {kind!r}"
+        sources.add(source)
+        agg = dev.groupby("hlo_category").agg(
+            time=("duration", "sum"), count=("duration", "count"),
+            flops=("flops", "sum"), nbytes=("bytes_accessed", "sum"))
+        sol = agg["flops"] / (peak_tflops * 1e12)
+        if peak_gbps > 0:
+            sol = pd.concat(
+                [sol, agg["nbytes"] / (peak_gbps * 1e9)], axis=1).max(axis=1)
+        agg["sol_time"] = sol
+        # Distance >= 1 by clipping: overcounted cost metadata must not
+        # report a class as running faster than the hardware allows.
+        agg["sol_distance"] = (agg["time"] / sol.where(sol > 0)).clip(
+            lower=1.0)
+        agg["deviceId"] = int(device_id)
+        agg["peak_tflops"] = peak_tflops
+        out.append(agg)
+        total = float(agg["time"].sum())
+        weighted = float((agg["time"] * agg["sol_distance"]).sum())
+        features.add(f"tpu{device_id}_sol_peak_tflops", peak_tflops)
+        if total > 0:
+            features.add(f"tpu{device_id}_sol_distance", weighted / total)
+    if not out:
+        return
+    table = (pd.concat(out).reset_index()
+             .sort_values(["deviceId", "time"], ascending=[True, False]))
+    table.to_csv(cfg.path("sol_roofline.csv"), index=False)
+    features.add_info("sol_peak_source", "; ".join(sorted(sources)))

@@ -11,10 +11,27 @@ from __future__ import annotations
 import pandas as pd
 
 from sofa_tpu.analysis.features import Features
+from sofa_tpu.analysis.registry import analysis_pass
 from sofa_tpu.printing import print_hint, print_title, print_warning
 from sofa_tpu.trace import CopyKind, narrow, roi_bounds as _roi_bounds, roi_clip
 
 
+@analysis_pass(
+    name="tpu_profile", order=110,
+    reads_frames=("tputrace", "tpumodules"),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "name", "hlo_category", "phase", "flops",
+                   "bytes_accessed", "source"),
+    provides_features=("tpu_devices", "tpu_ops", "tpu*_op_time",
+                       "tpu*_kernel_time", "tpu*_collective_time",
+                       "tpu_total_flops", "tpu_total_bytes_accessed",
+                       "tpu_fw_time", "tpu_bw_time", "tpu_bw_fw_ratio",
+                       "hlo_time_*", "tpu_customcall_unattributed_time",
+                       "tpu_module_launches"),
+    provides_artifacts=("tpu_top_ops.csv", "tpu_categories.csv",
+                        "tpu_modules_summary.csv"),
+    after=("spotlight",),
+)
 def tpu_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tputrace")
     if df is None or df.empty:
@@ -104,6 +121,13 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         features.add("tpu_module_launches", int(per_mod["count"].sum()))
 
 
+@analysis_pass(
+    name="overlap_profile", order=130,
+    reads_frames=("tputrace",),
+    reads_columns=("timestamp", "duration", "deviceId", "category"),
+    provides_features=("tpu*_async_time", "tpu*_async_hidden_pct"),
+    after=("spotlight",),
+)
 def overlap_profile(frames, cfg, features: Features) -> None:
     """How much async data movement hides under compute, per device.
 
@@ -146,6 +170,13 @@ def overlap_profile(frames, cfg, features: Features) -> None:
                      100.0 * min(hidden / total, 1.0))
 
 
+@analysis_pass(
+    name="step_skew_profile", order=140,
+    reads_frames=("tpusteps",),
+    reads_columns=("timestamp", "duration", "deviceId", "event"),
+    provides_features=("step_time_mean", "step_skew_mean", "step_skew_max"),
+    provides_artifacts=("tpu_step_skew.csv",),
+)
 def step_skew_profile(frames, cfg, features: Features) -> None:
     """Straggler detection across devices from the per-device step spans.
 
@@ -217,6 +248,15 @@ def _intersect_intervals(a, b):
     return np.asarray(out, dtype=float).reshape(-1, 2)
 
 
+@analysis_pass(
+    name="input_pipeline_profile", order=150,
+    reads_frames=("tpusteps", "tputrace"),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "event"),
+    provides_features=("tpu*_step_gap_pct", "tpu*_step_h2d_pct"),
+    provides_artifacts=("tpu_input_pipeline.csv",),
+    after=("spotlight",),
+)
 def input_pipeline_profile(frames, cfg, features: Features) -> None:
     """Input-pipeline boundedness: device idle gaps INSIDE steps.
 
@@ -313,6 +353,15 @@ def input_pipeline_profile(frames, cfg, features: Features) -> None:
         features.add(f"tpu{device_id}_step_h2d_pct", float(h2d_pct))
 
 
+@analysis_pass(
+    name="op_tree_profile", order=120,
+    reads_frames=("tputrace",),
+    reads_columns=("timestamp", "duration", "category", "op_path", "flops",
+                   "bytes_accessed"),
+    provides_features=("op_tree_paths",),
+    provides_artifacts=("tpu_op_tree.csv",),
+    after=("spotlight",),
+)
 def op_tree_profile(frames, cfg, features: Features) -> None:
     """Hierarchical time attribution over the JAX program structure.
 
@@ -364,6 +413,17 @@ def op_tree_profile(frames, cfg, features: Features) -> None:
               .to_string(index=False))
 
 
+@analysis_pass(
+    name="roofline_profile", order=160,
+    reads_frames=("tputrace",),
+    reads_columns=("timestamp", "duration", "deviceId", "category",
+                   "copyKind", "name", "flops", "bytes_accessed"),
+    provides_features=("tpu*_roofline_efficiency", "tpu*_compute_bound_time",
+                       "tpu*_memory_bound_time",
+                       "tpu*_arithmetic_intensity"),
+    provides_artifacts=("roofline.csv",),
+    after=("spotlight",),
+)
 def roofline_profile(frames, cfg, features: Features) -> None:
     """Per-op speed-of-light analysis against the chip's peak rates.
 
@@ -447,6 +507,12 @@ def roofline_profile(frames, cfg, features: Features) -> None:
             index=False))
 
 
+@analysis_pass(
+    name="tpuutil_profile", order=180,
+    reads_frames=("tpuutil",),
+    reads_columns=("name", "event"),
+    provides_features=("*_mean", "*_max", "*_median"),
+)
 def tpuutil_profile(frames, cfg, features: Features) -> None:
     df = frames.get("tpuutil")
     if df is None or df.empty:
@@ -461,6 +527,15 @@ def tpuutil_profile(frames, cfg, features: Features) -> None:
         features.add(f"{metric}_median", float(q.loc[0.5]))
 
 
+@analysis_pass(
+    name="tpumon_profile", order=190,
+    reads_frames=("tpumon",),
+    reads_columns=("timestamp", "name", "deviceId", "event", "payload"),
+    provides_features=("tpumon_samples", "tpumon_span",
+                       "tpu*_hbm_used_mean_gb", "tpu*_hbm_used_max_gb",
+                       "tpu*_hbm_occupancy_mean", "tpu*_hbm_occupancy_max",
+                       "tpu*_hbm_peak_gb"),
+)
 def tpumon_profile(frames, cfg, features: Features) -> None:
     """Live HBM occupancy/liveness features (the nvsmi_profile analogue,
     reference sofa_analyze.py:259-341) from the in-process sampler — present
@@ -489,6 +564,13 @@ def tpumon_profile(frames, cfg, features: Features) -> None:
             features.add(f"tpu{device_id}_hbm_peak_gb", peak / 1e9)
 
 
+@analysis_pass(
+    name="memprof_profile", order=200,
+    provides_features=("memprof_held_gb", "memprof_buffers",
+                       "memprof_sites", "memprof_devices",
+                       "memprof_trigger", "memprof_top_site"),
+    provides_artifacts=("tpu_memprof.csv",),
+)
 def memprof_profile(frames, cfg, features: Features) -> None:
     """HBM attribution: which allocation sites held the occupancy peak.
 
@@ -559,6 +641,12 @@ def _hysteresis_roi(ev, ts, dur, high: float, low: float, up_count: int,
     return begin, float(ts[j] - dur[j])
 
 
+@analysis_pass(
+    name="spotlight", order=10,
+    reads_frames=("tpuutil",),
+    reads_columns=("timestamp", "duration", "name", "event"),
+    provides_features=("roi_begin", "roi_end"),
+)
 def spotlight_roi(frames, cfg, features: Features) -> None:
     """Set cfg.roi_begin/roi_end from TensorCore utilization.
 
@@ -598,6 +686,18 @@ def spotlight_roi(frames, cfg, features: Features) -> None:
         print_hint(f"spotlight ROI: {begin:.3f}s .. {end:.3f}s")
 
 
+@analysis_pass(
+    name="serving_profile", order=170,
+    reads_frames=("tputrace", "tpumodules"),
+    reads_columns=("timestamp", "duration", "category", "module", "name",
+                   "flops", "bytes_accessed"),
+    provides_features=("serving_prefill_time", "serving_decode_time",
+                       "serving_prefill_intensity",
+                       "serving_decode_intensity",
+                       "serving_decode_hbm_gbps", "serving_decode_calls",
+                       "serving_ttft"),
+    after=("spotlight",),
+)
 def serving_profile(frames, cfg, features: Features) -> None:
     """Prefill/decode phase split for serving (inference) captures.
 

@@ -16,7 +16,7 @@ from typing import Dict, List
 
 import pandas as pd
 
-from sofa_tpu.analysis import advice, comm, concurrency, host, tpu
+from sofa_tpu.analysis import advice, registry
 from sofa_tpu.analysis.features import Features
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.preprocess import read_misc
@@ -27,33 +27,6 @@ CSV_SOURCES = [
     "cputrace", "hosttrace", "mpstat", "vmstat", "diskstat", "netbandwidth",
     "nettrace", "strace", "pystacks", "tputrace", "tpumodules", "tpuutil",
     "tpumon", "tpusteps", "customtrace", "blktrace",
-]
-
-_PASSES = [
-    ("spotlight", tpu.spotlight_roi),
-    ("cpu_profile", host.cpu_profile),
-    ("mpstat_profile", host.mpstat_profile),
-    ("vmstat_profile", host.vmstat_profile),
-    ("diskstat_profile", host.diskstat_profile),
-    ("blktrace_latency_profile", host.blktrace_latency_profile),
-    ("strace_profile", host.strace_profile),
-    ("pystacks_profile", host.pystacks_profile),
-    ("netbandwidth_profile", comm.netbandwidth_profile),
-    ("net_profile", comm.net_profile),
-    ("tpu_profile", tpu.tpu_profile),
-    ("op_tree_profile", tpu.op_tree_profile),
-    ("overlap_profile", tpu.overlap_profile),
-    ("step_skew_profile", tpu.step_skew_profile),
-    ("input_pipeline_profile", tpu.input_pipeline_profile),
-    ("roofline_profile", tpu.roofline_profile),
-    ("serving_profile", tpu.serving_profile),
-    ("tpuutil_profile", tpu.tpuutil_profile),
-    ("tpumon_profile", tpu.tpumon_profile),
-    ("memprof_profile", tpu.memprof_profile),
-    ("comm_profile", comm.comm_profile),
-    ("comm_scatter", comm.comm_scatter),
-    ("concurrency_breakdown", concurrency.concurrency_breakdown),
-    ("mesh_advice", advice.mesh_advice),
 ]
 
 
@@ -220,37 +193,19 @@ def _analyze_body(cfg: SofaConfig, frames, tel) -> Features:
     misc = read_misc(cfg)
     features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))
 
-    for name, fn in _PASSES:
-        try:
-            with tel.span(name, cat="analyze"):
-                fn(frames, cfg, features)
-        except Exception as e:  # noqa: BLE001 — per-pass degradation
-            print_warning(f"analyze pass {name}: {e}")
+    # Every analysis pass — built-ins, the gated ML passes, third-party
+    # plugin passes — runs under the contract-declared registry: waves
+    # derived from the declarations, per-pass fault isolation (a crash
+    # degrades like one failed collector), per-pass spans, and the
+    # meta.passes ledger in the run manifest (sofa_tpu/analysis/registry).
+    registry.load_builtin_passes()
+    pass_report, extra_series = registry.run_passes(
+        frames, cfg, features, tel=tel)
+    tel.set_meta(passes=pass_report)
 
     if not features.get("num_cores") and misc.get("cores"):
         features.add("num_cores", int(misc["cores"]))
 
-    extra_series = []
-    if cfg.enable_aisi:
-        try:
-            from sofa_tpu.ml.aisi import iteration_series, sofa_aisi
-
-            with tel.span("aisi", cat="analyze"):
-                iters = sofa_aisi(frames, cfg, features)
-            marker = iteration_series(iters)
-            if marker is not None:
-                extra_series.append(marker)
-        except Exception as e:  # noqa: BLE001
-            print_warning(f"aisi: {e}")
-    if cfg.enable_hsg or cfg.enable_swarms:
-        try:
-            from sofa_tpu.ml.hsg import sofa_hsg, swarm_series
-
-            with tel.span("hsg", cat="analyze"):
-                clustered = sofa_hsg(frames, cfg, features)
-            extra_series.extend(swarm_series(clustered, cfg.num_swarms))
-        except Exception as e:  # noqa: BLE001
-            print_warning(f"hsg: {e}")
     if extra_series:
         try:
             _append_report_series(cfg, extra_series)
@@ -278,14 +233,16 @@ def _analyze_body(cfg: SofaConfig, frames, tel) -> Features:
 
     # Remote advice service, when configured or discoverable from the
     # environment ($SOFA_HINT_SERVER — the POTATO autodiscovery analogue).
+    # Bounded end to end (connect + read deadlines inside fetch_hints): an
+    # unreachable or wedged server degrades to a telemetry-routed warning,
+    # never a stalled analyze.
     try:
-        from sofa_tpu.analysis.hint_service import discover_server, request_hints
+        from sofa_tpu.analysis.hint_service import fetch_hints
 
-        server = discover_server(cfg)
-        if server:
-            from sofa_tpu.printing import print_hint
+        with tel.span("hint_service", cat="stage"):
+            for hint in fetch_hints(cfg, features):
+                from sofa_tpu.printing import print_hint
 
-            for hint in request_hints(server, features):
                 print_hint(f"[remote] {hint}")
     except Exception as e:  # noqa: BLE001
         print_warning(f"hint server: {e}")

@@ -18,6 +18,11 @@ CLI (/root/reference/bin/sofa:328-376):
   lint              AST invariant checker for sofa_tpu's own contracts
                     (sofa_tpu/lint/, docs/STATIC_ANALYSIS.md); exits 1 on
                     findings not grandfathered in lint_baseline.json
+  passes            render the analysis-pass registry (sofa_tpu/analysis/
+                    registry.py): the resolved dependency DAG, each pass's
+                    declared contract, and — when logdir holds a manifest —
+                    the last run's per-pass timings/statuses; exits 2 on an
+                    unschedulable graph
   resume            replay the crash journal's uncommitted suffix after a
                     killed verb (sofa_tpu/durability.py): committed work
                     is served from the content-keyed caches, the rest
@@ -64,12 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version", version=f"sofa_tpu {__version__}")
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
-        "export", "top", "status", "lint", "clean", "setup", "resume",
-        "fsck", "archive", "regress",
+        "export", "top", "status", "lint", "passes", "clean", "setup",
+        "resume", "fsck", "archive", "regress",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
-                        "(status/resume/fsck); path to lint (lint); "
+                        "(status/resume/fsck/passes); path to lint (lint); "
                         "logdir or ls/show/gc (archive); run (regress)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
@@ -458,7 +463,7 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
-        if cmd in ("status", "resume", "fsck"):
+        if cmd in ("status", "resume", "fsck", "passes"):
             if args.usr_command and "logdir" not in vars(args):
                 # `sofa status sofalog/` reads more naturally than
                 # --logdir for a logdir-only verb; an explicit flag wins.
@@ -467,6 +472,9 @@ def _run(argv=None) -> int:
             if cmd == "status":
                 from sofa_tpu.telemetry import sofa_status
                 return sofa_status(cfg)
+            if cmd == "passes":
+                from sofa_tpu.analysis.registry import sofa_passes
+                return sofa_passes(cfg)
             if cmd == "resume":
                 from sofa_tpu.durability import sofa_resume
                 print_main_progress("SOFA resume")

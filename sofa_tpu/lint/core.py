@@ -58,6 +58,26 @@ class Finding:
                 "severity": self.severity, "message": self.message}
 
 
+@dataclass(frozen=True)
+class PassDecl:
+    """One ``@analysis_pass(...)`` declaration, read straight from the AST
+    (never by importing): the cross-file facts SL010–SL013 verify pass
+    bodies and the dependency graph against."""
+
+    name: str
+    func: str
+    relpath: str
+    line: int
+    reads_frames: tuple = ()
+    reads_columns: tuple = ()
+    reads_features: tuple = ()
+    provides_features: tuple = ()
+    provides_artifacts: tuple = ()
+    provides_series: bool = False
+    after: tuple = ()
+    enabled_when: tuple = ()
+
+
 @dataclass
 class ProjectContext:
     """Cross-file facts rules consult (kept deliberately small)."""
@@ -65,23 +85,50 @@ class ProjectContext:
     #: The unified trace schema (trace.COLUMNS), extracted from the AST of
     #: trace.py — empty set disables the schema-drift rule.
     columns: frozenset = frozenset()
+    #: Every @analysis_pass declaration in the linted tree (pass_rules.py).
+    passes: tuple = ()
+    #: AMBIENT_FEATURES from analysis/registry.py — features the analyze
+    #: driver provides without a producing pass.
+    ambient_features: tuple = ()
 
     @classmethod
-    def detect(cls, files: Sequence[str]) -> "ProjectContext":
+    def detect(cls, files: Sequence[str],
+               base: Optional[str] = None) -> "ProjectContext":
         """Build the context from the tree being linted: find a trace.py
         declaring BASE_COLUMNS/EXTRA_COLUMNS and read the literals out of
-        its AST.  Falls back to this package's own trace.py so linting a
-        single file still knows the schema."""
+        its AST (falling back to this package's own trace.py so linting a
+        single file still knows the schema), collect every
+        ``@analysis_pass`` declaration, and read AMBIENT_FEATURES from
+        the registry module.  ``base`` must match the relpath anchor the
+        engine uses so declarations join up with FileContext.relpath."""
         candidates = [f for f in files if os.path.basename(f) == "trace.py"]
-        here = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "trace.py")
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        here = os.path.join(pkg, "trace.py")
         if os.path.isfile(here):
             candidates.append(here)
+        columns: frozenset = frozenset()
         for cand in candidates:
             cols = _columns_from_trace(cand)
             if cols:
-                return cls(columns=frozenset(cols))
-        return cls()
+                columns = frozenset(cols)
+                break
+        passes: List[PassDecl] = []
+        base = os.path.abspath(base or os.getcwd())
+        for f in files:
+            ab = os.path.abspath(f)
+            rel = (os.path.relpath(ab, base)
+                   if ab.startswith(base + os.sep) else ab)
+            passes.extend(_pass_decls_from_file(f, rel.replace(os.sep, "/")))
+        ambient = ()
+        reg_candidates = [f for f in files
+                          if os.path.basename(f) == "registry.py"]
+        reg_candidates.append(os.path.join(pkg, "analysis", "registry.py"))
+        for cand in reg_candidates:
+            ambient = _ambient_from_registry(cand)
+            if ambient:
+                break
+        return cls(columns=columns, passes=tuple(passes),
+                   ambient_features=ambient)
 
 
 def _columns_from_trace(path: str) -> List[str]:
@@ -103,6 +150,78 @@ def _columns_from_trace(path: str) -> List[str]:
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)]
             lists[tgt.id] = vals
     return lists.get("BASE_COLUMNS", []) + lists.get("EXTRA_COLUMNS", [])
+
+
+def _ambient_from_registry(path: str) -> tuple:
+    """AMBIENT_FEATURES literal out of analysis/registry.py's AST."""
+    try:
+        with open(path, "rb") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return ()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "AMBIENT_FEATURES" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return ()
+
+
+def _str_tuple(node) -> tuple:
+    """String literals out of a tuple/list AST literal (non-literals and
+    non-strings are dropped — the runtime registry rejects those loudly)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _pass_decls_from_file(path: str, relpath: str) -> List[PassDecl]:
+    """Every ``@analysis_pass(...)`` (bare or attribute-qualified) in one
+    file, contracts read as literals.  Purely syntactic — a decorator of
+    that name is treated as a pass declaration wherever it appears."""
+    try:
+        with open(path, "rb") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return []
+    out: List[PassDecl] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if not isinstance(deco, ast.Call):
+                continue
+            fn = deco.func
+            deco_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if deco_name != "analysis_pass":
+                continue
+            kw = {k.arg: k.value for k in deco.keywords if k.arg}
+            name_node = kw.get("name")
+            name = (name_node.value
+                    if isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str) else node.name)
+            series_node = kw.get("provides_series")
+            out.append(PassDecl(
+                name=name, func=node.name, relpath=relpath,
+                line=deco.lineno,
+                reads_frames=_str_tuple(kw.get("reads_frames")),
+                reads_columns=_str_tuple(kw.get("reads_columns")),
+                reads_features=_str_tuple(kw.get("reads_features")),
+                provides_features=_str_tuple(kw.get("provides_features")),
+                provides_artifacts=_str_tuple(kw.get("provides_artifacts")),
+                provides_series=bool(
+                    isinstance(series_node, ast.Constant)
+                    and series_node.value),
+                after=_str_tuple(kw.get("after")),
+                enabled_when=_str_tuple(kw.get("enabled_when")),
+            ))
+    return out
 
 
 class FileContext:
@@ -329,9 +448,9 @@ def lint_paths(paths: Sequence[str], rules: Sequence[Rule],
     ``python tools/sofa_lint.py sofa_tpu/`` invocation from the repo root.
     """
     files = iter_python_files(paths)
-    if project is None:
-        project = ProjectContext.detect(files)
     base = os.path.abspath(base or os.getcwd())
+    if project is None:
+        project = ProjectContext.detect(files, base=base)
     engine = LintEngine(rules, project)
     findings: List[Finding] = []
     for f in files:

@@ -433,6 +433,10 @@ class NonAtomicDerivedWrite(Rule):
             "stream writers)")
 
 
+from sofa_tpu.lint.pass_rules import (  # noqa: E402 — SL010-SL013 live in
+    PASS_RULES,                         # their own module; one rule set
+)
+
 ALL_RULES = (
     BoundedSubprocess,
     SilentBroadExcept,
@@ -443,7 +447,7 @@ ALL_RULES = (
     RawArtifactBypass,
     DirectKill,
     NonAtomicDerivedWrite,
-)
+) + PASS_RULES
 
 
 def default_rules() -> List[Rule]:

@@ -5,6 +5,15 @@ The reference imports any module named on PYTHONPATH and calls
 plugins/dummy_plugin.py).  We generalize: ``--plugin mod`` or ``--plugin
 mod:func`` — the callable receives the SofaConfig before the pipeline runs and
 may mutate it (register filters, tweak collector knobs, ...).
+
+Third-party **analysis passes** ride the same entry point: a plugin module
+(or its callable) registers passes through
+``sofa_tpu.analysis.registry.analysis_pass`` / ``register_pass``; anything
+registered while the plugin loads is tagged ``plugin:<spec>`` so it is
+attributable in ``sofa passes`` and the manifest's ``meta.passes`` ledger,
+and the registry executor fault-isolates it — a crashing third-party pass
+degrades to a warning + ``failed`` status instead of aborting analyze.
+A plugin that crashes while *loading* degrades here the same way.
 """
 
 from __future__ import annotations
@@ -15,16 +24,24 @@ from sofa_tpu.printing import print_error, print_info
 
 
 def load_plugins(cfg) -> None:
+    from sofa_tpu.analysis import registry
+
     for spec in cfg.plugins:
         mod_name, _, func_name = spec.partition(":")
-        try:
-            mod = importlib.import_module(mod_name)
-        except ImportError as e:
-            print_error(f"plugin {spec!r}: cannot import {mod_name!r}: {e}")
-            continue
-        func = getattr(mod, func_name or mod_name.rsplit(".", 1)[-1], None)
-        if not callable(func):
-            print_error(f"plugin {spec!r}: no callable entry point")
-            continue
+        with registry.plugin_origin(spec):
+            try:
+                mod = importlib.import_module(mod_name)
+            except ImportError as e:
+                print_error(f"plugin {spec!r}: cannot import {mod_name!r}: {e}")
+                continue
+            func = getattr(mod, func_name or mod_name.rsplit(".", 1)[-1], None)
+            if not callable(func):
+                print_error(f"plugin {spec!r}: no callable entry point")
+                continue
+            try:
+                func(cfg)
+            except Exception as e:  # noqa: BLE001 — one bad plugin must not kill the verb
+                print_error(f"plugin {spec!r}: entry point raised "
+                            f"{type(e).__name__}: {e} — plugin skipped")
+                continue
         print_info(f"plugin {spec!r} loaded")
-        func(cfg)

@@ -89,13 +89,22 @@ MANIFEST_SCHEMA = "sofa_tpu/run_manifest"
 # VALUE, hence the bump) plus the additive ``digests`` integrity ledger,
 # ``rotated_files``/``budget_bytes`` collector fields, and the
 # ``meta.disk_budget``/``meta.fsck`` sections (sofa_tpu/durability.py).
-MANIFEST_VERSION = 4
+# v5: the ``meta.passes`` analysis-pass ledger (sofa_tpu/analysis/
+# registry.py) — per-pass status ok/failed/skipped, wall time, wave, and
+# origin, plus the resolved schedule.  A new health vocabulary a strict
+# consumer must know (a ``failed`` pass is unhealthy to
+# manifest_check --require-healthy, like a failed collector), hence the
+# bump rather than a silent additive key.
+MANIFEST_VERSION = 5
 
 COLLECTOR_STATUSES = ("probed", "started", "stopped", "failed", "skipped",
                       "killed", "died", "timed_out", "truncated_by_budget")
 SOURCE_STATUSES = ("parsed", "cached", "degraded", "empty", "quarantined",
                    "failed")
 CACHE_OUTCOMES = ("hit", "miss", "bypass")
+# Analysis-pass outcomes in meta.passes (sofa_tpu/analysis/registry.py
+# owns the executor; keep the vocabularies in sync).
+PASS_STATUSES = ("ok", "failed", "skipped")
 
 # Terminal bad outcomes: sticky over the benign started/stopped that the
 # epilogue's flush still records afterwards.
@@ -496,6 +505,14 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
             out.append(f"ingest source {name} had corrupt raw input — "
                        f"quarantined to {where}; its series are empty "
                        "this run")
+    passes = ((doc.get("meta") or {}).get("passes") or {}).get("passes")
+    if isinstance(passes, dict):
+        for name, ent in sorted(passes.items()):
+            if ent.get("status") == "failed":
+                why = ent.get("error") or "crashed"
+                out.append(f"analysis pass {name} failed ({why}) — its "
+                           "features and artifacts are missing this run; "
+                           "`sofa passes` shows its contract")
     fsck = (doc.get("meta") or {}).get("fsck")
     if isinstance(fsck, dict) and fsck.get("ok") is False:
         problems = fsck.get("problems") or {}
@@ -592,6 +609,22 @@ def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
                 probs = fsck.get("problems") or {}
                 n = sum(v for v in probs.values() if isinstance(v, int))
                 line += f" — last fsck: {n} problem(s)"
+        lines.append(line)
+    passes = (doc.get("meta") or {}).get("passes")
+    if isinstance(passes, dict) and isinstance(passes.get("passes"), dict):
+        ledger = passes["passes"]
+        n_failed = sum(1 for e in ledger.values()
+                       if e.get("status") == "failed")
+        n_skipped = sum(1 for e in ledger.values()
+                        if e.get("status") == "skipped")
+        line = (f"  analysis passes: {len(ledger)} registered, "
+                f"{len(ledger) - n_failed - n_skipped} ok")
+        if n_failed:
+            line += f", {n_failed} FAILED"
+            rc = 1
+        if n_skipped:
+            line += f", {n_skipped} skipped (gated off)"
+        line += " (`sofa passes` shows the DAG)"
         lines.append(line)
     budget = (doc.get("meta") or {}).get("disk_budget")
     if isinstance(budget, dict):

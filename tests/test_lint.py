@@ -463,3 +463,197 @@ def test_mutation_is_caught(tmp_path):
     mut.write_text(src.replace(", timeout=_scan_timeout_s()", ""))
     findings = lint_paths([str(mut)], default_rules(), base=str(tmp_path))
     assert any(f.rule_id == "SL001" and f.line > 0 for f in findings)
+
+
+# --- SL010-SL013: analysis-pass contract rules ------------------------------
+
+def run_pass_rules(tmp_path, files):
+    """Write {relname: src} fixture modules, detect the pass declarations
+    across all of them, lint them all; returns SL01x findings only (the
+    fixtures may incidentally trip unrelated rules)."""
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        paths.append(str(p))
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    return [f for f in fs if f.rule_id in ("SL010", "SL011", "SL012",
+                                           "SL013")]
+
+
+def test_sl010_flags_undeclared_frame_column_feature_access(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="leaky", reads_frames=("tputrace",),
+                       reads_columns=("timestamp",),
+                       provides_features=("leaky_metric",))
+        def leaky(frames, cfg, features):
+            df = frames.get("cputrace")          # undeclared frame
+            x = frames["mpstat"]                 # undeclared frame
+            y = df["duration"]                   # undeclared column
+            features.add("other_metric", 1.0)    # undeclared write
+            features.get("foreign_metric")       # undeclared read
+            features.add("leaky_metric", 1.0)
+    '''})
+    msgs = [f.message for f in fs if f.rule_id == "SL010"]
+    assert len(msgs) == 5, msgs
+    assert any("'cputrace'" in m for m in msgs)
+    assert any("'mpstat'" in m for m in msgs)
+    assert any("'duration'" in m for m in msgs)
+    assert any("'other_metric'" in m for m in msgs)
+    assert any("'foreign_metric'" in m for m in msgs)
+
+
+def test_sl010_clean_when_declared_including_patterns(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="tidy", reads_frames=("tputrace",),
+                       reads_columns=("timestamp", "duration", "deviceId"),
+                       provides_features=("tpu*_op_time", "tidy_total"))
+        def tidy(frames, cfg, features):
+            df = frames.get("tputrace")
+            for device_id, dev in df.groupby("deviceId"):
+                features.add(f"tpu{device_id}_op_time",
+                             float(dev["duration"].sum()))
+            features.add("tidy_total", features.get("tidy_total") or 0.0)
+            features.get("elapsed_time")  # ambient: driver-provided
+            rows = features.by_regex(r"tpu\\d+_op_time")  # own output
+    '''})
+    assert fs == []
+
+
+def test_sl011_flags_phantom_outputs(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="phantom",
+                       provides_features=("written_metric", "ghost_metric"),
+                       provides_artifacts=("ghost.csv",))
+        def phantom(frames, cfg, features):
+            features.add("written_metric", 1.0)
+    '''})
+    msgs = [f.message for f in fs if f.rule_id == "SL011"]
+    assert len(msgs) == 2, msgs
+    assert any("'ghost_metric'" in m for m in msgs)
+    assert any("'ghost.csv'" in m for m in msgs)
+
+
+def test_sl011_trusts_forwarded_features(tmp_path):
+    """A wrapper that hands the features object to a helper delegates its
+    writes (the aisi/hsg pattern) — the declaration is trusted."""
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="wrapper",
+                       provides_features=("delegated_metric",))
+        def wrapper(frames, cfg, features):
+            from helpers import compute
+            compute(frames, cfg, features)
+    '''})
+    assert fs == []
+
+
+def test_sl012_flags_unprovided_read_unknown_after_and_cycle(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="orphan", reads_features=("nobody_makes_this",))
+        def orphan(frames, cfg, features):
+            features.get("nobody_makes_this")
+
+        @analysis_pass(name="dangling", after=("no_such_pass",))
+        def dangling(frames, cfg, features):
+            pass
+
+        @analysis_pass(name="loop_a", after=("loop_b",))
+        def loop_a(frames, cfg, features):
+            pass
+
+        @analysis_pass(name="loop_b", after=("loop_a",))
+        def loop_b(frames, cfg, features):
+            pass
+    '''})
+    msgs = [f.message for f in fs if f.rule_id == "SL012"]
+    assert any("'nobody_makes_this'" in m and "no registered pass" in m
+               for m in msgs)
+    assert any("'no_such_pass'" in m for m in msgs)
+    assert sum("cycle" in m for m in msgs) == 2  # loop_a and loop_b
+
+
+def test_sl012_sees_cross_file_providers(tmp_path):
+    """A read is satisfied by a provider declared in ANOTHER module: the
+    graph is validated across the whole linted tree."""
+    fs = run_pass_rules(tmp_path, {
+        "producer.py": '''
+            from sofa_tpu.analysis.registry import analysis_pass
+
+            @analysis_pass(name="maker", provides_features=("shared_*",))
+            def maker(frames, cfg, features):
+                features.add("shared_count", 1.0)
+        ''',
+        "consumer.py": '''
+            from sofa_tpu.analysis.registry import analysis_pass
+
+            @analysis_pass(name="taker", reads_features=("shared_count",))
+            def taker(frames, cfg, features):
+                features.get("shared_count")
+        ''',
+    })
+    assert fs == []
+
+
+def test_sl013_flags_direct_pass_call(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        @analysis_pass(name="first", provides_features=("first_metric",))
+        def first(frames, cfg, features):
+            features.add("first_metric", 1.0)
+
+        @analysis_pass(name="second", reads_features=("first_metric",))
+        def second(frames, cfg, features):
+            first(frames, cfg, features)  # composition outside the scheduler
+            features.get("first_metric")
+    '''})
+    msgs = [f.message for f in fs if f.rule_id == "SL013"]
+    assert len(msgs) == 1
+    assert "'first'" in msgs[0] and "directly" in msgs[0]
+
+
+def test_sl013_allows_helper_calls(tmp_path):
+    fs = run_pass_rules(tmp_path, {"p.py": '''
+        from sofa_tpu.analysis.registry import analysis_pass
+
+        def shared_helper(df):
+            return df
+
+        @analysis_pass(name="caller", reads_frames=("tputrace",))
+        def caller(frames, cfg, features):
+            shared_helper(frames.get("tputrace"))
+    '''})
+    assert fs == []
+
+
+def test_pass_rules_catch_seeded_mutation_of_shipped_pass(tmp_path):
+    """ISSUE 8 acceptance: copy the shipped sol.py pass and sneak in an
+    undeclared column read + an undeclared feature write — both must
+    surface as fresh SL010 findings."""
+    src = open(os.path.join(REPO, "sofa_tpu", "analysis", "sol.py")).read()
+    assert 'features.add_info("sol_peak_source"' in src
+    mut = src.replace('features.add_info("sol_peak_source"',
+                      'features.add("sol_sneaky_metric", 1.0)\n'
+                      '    features.add_info("sol_peak_source"')
+    mut = mut.replace('rows.empty', 'rows["groups"].empty')
+    p = tmp_path / "sol.py"
+    p.write_text(mut)
+    project = ProjectContext.detect([str(p)], base=str(tmp_path))
+    fs = [f for f in lint_paths([str(p)], default_rules(), project=project,
+                                base=str(tmp_path))
+          if f.rule_id == "SL010"]
+    assert any("'sol_sneaky_metric'" in f.message for f in fs)
+    assert any("'groups'" in f.message for f in fs)

@@ -304,6 +304,54 @@ def _run_archive_kill_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_crash_pass_cell(workdir: str, synth: str, mc) -> List[str]:
+    """Register a deliberately crashing analysis pass, then run the full
+    analyze: the registry executor must degrade it to a sticky ``failed``
+    entry in meta.passes while every other pass runs and report.js + a
+    schema-valid manifest still emit (sofa_tpu/analysis/registry.py)."""
+    from sofa_tpu.analysis import registry
+    from sofa_tpu.analyze import sofa_analyze
+
+    logdir = os.path.join(workdir, "crash-pass") + "/"
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    frames = sofa_preprocess(cfg)
+    with registry.scoped():
+        registry.load_builtin_passes()
+
+        def chaos_crash(frames, cfg, features):
+            raise RuntimeError("chaos: deliberate pass crash")
+
+        registry.register_pass(chaos_crash, name="chaos_crash")
+        features = sofa_analyze(cfg, frames=frames)
+    if not features.get("cpu_samples"):
+        problems.append("crashing pass took the other passes' features "
+                        "down with it")
+    if not os.path.isfile(cfg.path("report.js")):
+        problems.append("no report.js")
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        return problems + ["no run_manifest.json"]
+    problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    ledger = ((doc.get("meta") or {}).get("passes") or {}).get(
+        "passes") or {}
+    ent = ledger.get("chaos_crash") or {}
+    if ent.get("status") != "failed":
+        problems.append("chaos_crash pass not recorded as failed in "
+                        "meta.passes")
+    if "deliberate pass crash" not in str(ent.get("error", "")):
+        problems.append("meta.passes entry lost the crash error")
+    if any(e.get("status") == "failed" for n, e in ledger.items()
+           if n != "chaos_crash"):
+        problems.append("a healthy pass was marked failed")
+    if mc.validate_manifest(doc, require_healthy=True) == []:
+        problems.append("manifest_check --require-healthy missed the "
+                        "failed pass")
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -311,7 +359,7 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 1
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 2
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None)])
@@ -345,6 +393,16 @@ def main(argv=None) -> int:
     failures += bool(problems)
     print(f"{'kill-mid-archive'.ljust(width)}  {status}  (SIGKILL during "
           "archive ingest, then sofa resume)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_crash_pass_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'crash-pass'.ljust(width)}  {status}  (crashing registered "
+          "analysis pass, then sofa analyze)")
     for p in problems:
         print(f"{' ' * width}    - {p}")
     print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "

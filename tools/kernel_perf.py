@@ -33,16 +33,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# Datasheet bf16 peaks per chip generation (TFLOP/s per chip) — the
-# fallback when the profiler's plane stats don't carry the peak.
-KIND_PEAKS = {
-    "v6e": 918.0, "v6": 918.0,
-    "v5p": 459.0,
-    "v5e": 197.0, "v5litepod": 197.0, "v5": 197.0,
-    "v4": 275.0,
-    "v3": 123.0,
-}
+# The datasheet peak table and its kind-matcher now live with the
+# registered sol_roofline analysis pass — one table for the standalone
+# MFU tool and every analyze run (sofa_tpu/analysis/sol.py).
+from sofa_tpu.analysis.sol import KIND_PEAKS, peak_from_kind  # noqa: E402,F401
 
 MFU_TARGET_PCT = 40.0          # target: 16k fwd at >= 40% of bf16 peak
 VALIDATE_FLOOR_TFLOPS = 4.0    # loud-failure floor under tunnel-load swing
@@ -55,14 +51,6 @@ def attention_flops(b: int, t: int, h: int, d: int,
     per_matmul = 2.0 * b * h * t * t * d * (0.5 if causal else 1.0)
     n = 2.0 + (5.0 if bwd else 0.0)
     return per_matmul * n
-
-
-def peak_from_kind(kind: str) -> "float | None":
-    k = (kind or "").lower().replace("tpu", "").strip()
-    for key, val in sorted(KIND_PEAKS.items(), key=lambda kv: -len(kv[0])):
-        if key in k:
-            return val
-    return None
 
 
 def discover_peak():
@@ -234,5 +222,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, REPO)
     sys.exit(main())

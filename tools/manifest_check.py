@@ -26,6 +26,7 @@ from sofa_tpu.telemetry import (  # noqa: E402
     MANIFEST_NAME,
     MANIFEST_SCHEMA,
     MANIFEST_VERSION,
+    PASS_STATUSES,
     SOURCE_STATUSES,
 )
 
@@ -226,6 +227,47 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 if not isinstance(v, int) or isinstance(v, bool) or v < 0:
                     probs.append(f"meta.archive.{key}: missing or not a "
                                  "non-negative int")
+    # meta.passes (schema v5): the analysis-pass ledger written by the
+    # registry executor (sofa_tpu/analysis/registry.py).  Statuses must
+    # stay in-vocabulary and the resolved schedule must cover the ledger.
+    passes_meta = (doc.get("meta") or {}).get("passes")
+    pass_ledger = {}
+    if passes_meta is not None:
+        if not isinstance(passes_meta, dict):
+            probs.append("meta.passes: not an object")
+        else:
+            sched = passes_meta.get("schedule")
+            if not isinstance(sched, list) or any(
+                    not isinstance(w, list)
+                    or any(not isinstance(n, str) for n in w)
+                    for w in sched):
+                probs.append("meta.passes.schedule: not a list of "
+                             "name-list waves")
+                sched = []
+            if not isinstance(passes_meta.get("jobs"), int) \
+                    or isinstance(passes_meta.get("jobs"), bool):
+                probs.append("meta.passes.jobs: missing or not an int")
+            pass_ledger = passes_meta.get("passes")
+            if not isinstance(pass_ledger, dict):
+                probs.append("meta.passes.passes: missing per-pass ledger")
+                pass_ledger = {}
+            scheduled = {n for w in sched for n in w}
+            for name, ent in sorted(pass_ledger.items()):
+                if not isinstance(ent, dict):
+                    probs.append(f"meta.passes.passes.{name}: not an object")
+                    continue
+                if ent.get("status") not in PASS_STATUSES:
+                    probs.append(f"meta.passes.passes.{name}.status: "
+                                 f"{ent.get('status')!r} not in "
+                                 f"{PASS_STATUSES}")
+                if ent.get("status") != "skipped":
+                    if not _is_num(ent.get("wall_s")):
+                        probs.append(f"meta.passes.passes.{name}.wall_s: "
+                                     "missing or not a number")
+                    if name not in scheduled:
+                        probs.append(f"meta.passes.passes.{name}: ran but "
+                                     "absent from meta.passes.schedule")
+
     regress = (doc.get("meta") or {}).get("regress")
     if regress is not None:
         if not isinstance(regress, dict) or \
@@ -265,6 +307,11 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             if ent.get("status") in ("quarantined", "failed"):
                 probs.append(f"unhealthy: source {name} "
                              f"{ent.get('status')}")
+        for name, ent in sorted(pass_ledger.items()):
+            if isinstance(ent, dict) and ent.get("status") == "failed":
+                probs.append(f"unhealthy: analysis pass {name} failed"
+                             + (f" ({ent['error']})"
+                                if ent.get("error") else ""))
         for verb, run in runs.items():
             if isinstance(run, dict) and (run.get("counters") or {}).get(
                     "errors"):
