@@ -325,11 +325,47 @@ sys.path.insert(0, {root!r})
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.preprocess import sofa_preprocess
 cfg = SofaConfig(logdir={logdir!r})
+# what-if evidence (sofa_tpu/whatif/): zero-scenario identity replay —
+# |replayed mean - measured mean| as a percentage.  The first bench
+# metric that needs NO hardware at all: it gauges the replay model's
+# fidelity, so a model regression shows in the trajectory even when the
+# tunnel is dead for the whole round.  It runs on a pristine SIDE COPY
+# of the synthetic device frames, staged before the preprocess below:
+# preprocess regenerates frame CSVs from RAW collector files, and this
+# harness has no raw xplane, so preprocessing (and the later resume
+# replay) would clobber the very step spans the replay calibrates
+# against.
+import shutil as _sh, tempfile as _tf
+wout = {{}}
+try:
+    from sofa_tpu.whatif import REPORT_NAME, sofa_whatif
+    wdir = os.path.join(_tf.mkdtemp(prefix="sofa_whatif_"), "")
+    try:
+        for fname in ("tpusteps.csv", "tputrace.csv", "sofa_time.txt",
+                      "misc.txt", "tpu_meta.json"):
+            if os.path.isfile(cfg.path(fname)):
+                _sh.copy(cfg.path(fname), os.path.join(wdir, fname))
+        wcfg = SofaConfig(logdir=wdir)
+        rc = sofa_whatif(wcfg)
+        with open(wcfg.path(REPORT_NAME)) as f:
+            wdoc = json.load(f)
+        err = (wdoc.get("calibration") or {{}}).get("identity_error_pct")
+        if err is not None:
+            wout["whatif_identity_error_pct"] = err
+        if rc != 0:
+            wout["whatif_evidence_error"] = (
+                f"whatif rc={{rc}}: "
+                + str((wdoc.get("calibration") or {{}}).get("reason")))[:160]
+    finally:
+        _sh.rmtree(wdir, ignore_errors=True)
+except Exception as e:
+    wout["whatif_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 t0 = time.perf_counter(); sofa_preprocess(cfg)
 cold = time.perf_counter() - t0
 t0 = time.perf_counter(); sofa_preprocess(cfg)
 warm = time.perf_counter() - t0
 out = {{"cold": round(cold, 3), "warm": round(warm, 3)}}
+out.update(wout)
 # viz-path evidence (sofa_tpu/tiles.py): the columnar report.js payload
 # and the LOD tile-pyramid build time from the manifest's tiles stage.
 try:
@@ -410,7 +446,8 @@ print(json.dumps(out))
                     "viz_evidence_error", "fsck_ok", "resume_wall_time_s",
                     "durability_evidence_error", "analyze_wall_time_s",
                     "analyze_pass_count", "analyze_failed_passes",
-                    "analyze_evidence_error"):
+                    "analyze_evidence_error", "whatif_identity_error_pct",
+                    "whatif_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
@@ -423,6 +460,10 @@ print(json.dumps(out))
             _log(f"bench: analyze wall {out['analyze_wall_time_s']}s, "
                  f"{out.get('analyze_pass_count')} pass(es), "
                  f"{out.get('analyze_failed_passes')} failed")
+        if "whatif_identity_error_pct" in out:
+            _log(f"bench: whatif identity error "
+                 f"{out['whatif_identity_error_pct']}% (zero-scenario "
+                 "replay vs measured — no hardware needed)")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.
@@ -481,7 +522,7 @@ def _lint_evidence() -> dict:
 _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "preprocess_warm_wall_time_s", "tile_build_wall_time_s",
                      "resume_wall_time_s", "report_js_bytes",
-                     "analyze_wall_time_s")
+                     "analyze_wall_time_s", "whatif_identity_error_pct")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

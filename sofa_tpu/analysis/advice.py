@@ -228,6 +228,27 @@ def generate_hints(features: Features, cfg) -> List[str]:
             "host CPU is saturated — data pipeline or Python overhead may be"
             " gating the TPU"
         )
+
+    # What-if payoffs (the whatif_model pass priced the two canonical
+    # scenarios over this run's own step timeline — sofa_tpu/whatif/):
+    # rank the predicted savings, largest first, and point at the verb
+    # that previews the full composition with calibrated error bars.
+    payoffs = []
+    for name, scenario, story in (
+        ("whatif_overlap_payoff_pct", "overlap:*",
+         "hiding serialized collectives behind step compute"),
+        ("whatif_sol_payoff_pct", "scale:*=sol",
+         "running every kernel class at its measured speed-of-light "
+         "headroom"),
+    ):
+        pct = get(name)
+        if pct is not None and pct >= 2.0:
+            payoffs.append((pct, scenario, story))
+    for pct, scenario, story in sorted(payoffs, reverse=True):
+        hints.append(
+            f"[whatif] {story} is predicted to cut mean step time by "
+            f"{pct:.1f}% — preview with `sofa whatif <logdir> --apply "
+            f"{scenario}` (calibrated error bars in whatif_report.json)")
     return hints
 
 

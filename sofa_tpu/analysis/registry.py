@@ -232,6 +232,7 @@ def load_builtin_passes() -> None:
     import sofa_tpu.analysis.mlpass  # noqa: F401
     import sofa_tpu.analysis.sol  # noqa: F401
     import sofa_tpu.analysis.tpu  # noqa: F401
+    import sofa_tpu.whatif.model  # noqa: F401
     with _lock:
         for name, spec in _declared_builtins.items():
             _registry.setdefault(name, spec)

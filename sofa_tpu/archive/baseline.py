@@ -58,10 +58,10 @@ def percentile(xs: List[float], pct: float) -> float:
 # Feature polarity: which direction is a regression?
 # ---------------------------------------------------------------------------
 
-# Higher is worse: durations, latencies, skew, overhead.
+# Higher is worse: durations, latencies, skew, overhead, model error.
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
-    r"|_idle)")
+    r"|_idle|_error_pct$)")
 # Lower is worse: rates and utilization.
 _WORSE_LOW = re.compile(
     r"(bandwidth|_gbps|per_sec|throughput|flops|images_per_sec|_util$)")

@@ -23,6 +23,11 @@ CLI (/root/reference/bin/sofa:328-376):
                     declared contract, and — when logdir holds a manifest —
                     the last run's per-pass timings/statuses; exits 2 on an
                     unschedulable graph
+  whatif            hardware-free what-if replay over a recorded logdir
+                    (sofa_tpu/whatif/): re-time the step timeline under
+                    --apply scenarios and report predicted step time with
+                    calibrated error bars; exits 1 when the zero-scenario
+                    identity gate fails (uncalibrated)
   resume            replay the crash journal's uncommitted suffix after a
                     killed verb (sofa_tpu/durability.py): committed work
                     is served from the content-keyed caches, the rest
@@ -70,12 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
-        "resume", "fsck", "archive", "regress",
+        "resume", "fsck", "archive", "regress", "whatif",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
-                        "(status/resume/fsck/passes); path to lint (lint); "
-                        "logdir or ls/show/gc (archive); run (regress)")
+                        "(status/resume/fsck/passes/whatif); path to lint "
+                        "(lint); logdir or ls/show/gc (archive); run "
+                        "(regress)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
                         "the baseline run for `regress`")
@@ -235,8 +241,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--cluster_hosts", help="comma-joined host list for multi-host runs")
 
     g = p.add_argument_group("setup")
-    g.add_argument("--apply", action="store_true", default=False,
-                   help="setup: run the fix commands instead of printing them")
+    # ONE --apply flag, two verbs: `sofa setup --apply` (bare: run the fix
+    # commands) and `sofa whatif <logdir> --apply <scenarios>` (valued:
+    # comma-joined scenario specs, docs/WHATIF.md — unknown scenarios
+    # degrade, never abort).
+    g.add_argument("--apply", nargs="?", const=True, default=False,
+                   metavar="SCENARIOS",
+                   help="setup: run the fix commands instead of printing "
+                        "them; whatif: comma-joined scenarios to replay, "
+                        "e.g. 'overlap:all-reduce,scale:fusion=sol,link:2'")
     g.add_argument("--empower", action="append", dest="empower", default=None,
                    help="setup: utility to grant profiling capabilities "
                         "(e.g. --empower tcpdump); repeatable")
@@ -280,6 +293,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
     ):
         if was_set(name):
             setattr(cfg, name, passed[name])
+    if isinstance(passed.get("apply"), str):
+        cfg.whatif_apply = passed["apply"]
     if was_set("no_ingest_cache"):
         cfg.ingest_cache = not passed["no_ingest_cache"]
     if was_set("no_tiles"):
@@ -463,7 +478,7 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
-        if cmd in ("status", "resume", "fsck", "passes"):
+        if cmd in ("status", "resume", "fsck", "passes", "whatif"):
             if args.usr_command and "logdir" not in vars(args):
                 # `sofa status sofalog/` reads more naturally than
                 # --logdir for a logdir-only verb; an explicit flag wins.
@@ -475,6 +490,10 @@ def _run(argv=None) -> int:
             if cmd == "passes":
                 from sofa_tpu.analysis.registry import sofa_passes
                 return sofa_passes(cfg)
+            if cmd == "whatif":
+                from sofa_tpu.whatif import sofa_whatif
+                print_main_progress("SOFA whatif")
+                return sofa_whatif(cfg)
             if cmd == "resume":
                 from sofa_tpu.durability import sofa_resume
                 print_main_progress("SOFA resume")
@@ -502,7 +521,7 @@ def _run(argv=None) -> int:
         if cmd == "setup":
             from sofa_tpu.setup_env import sofa_setup
             print_main_progress("SOFA setup")
-            return sofa_setup(utilities=args.empower, apply=args.apply,
+            return sofa_setup(utilities=args.empower, apply=bool(args.apply),
                               probe_device=not getattr(
                                   args, "no_device_probe", False))
     except KeyboardInterrupt:

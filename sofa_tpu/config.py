@@ -182,6 +182,13 @@ class SofaConfig:
     regress_pct: float = 50.0        # rolling-baseline percentile
     regress_threshold: float = 10.0  # relative % move a verdict requires
 
+    # --- whatif (sofa_tpu/whatif/) ------------------------------------------
+    whatif_apply: str = ""           # --apply: comma-joined scenario specs
+                                     # (overlap:<pat> | scale:<pat>=<f|sol>
+                                     # | link:<f> | batch:<f>); empty =
+                                     # identity replay only (the
+                                     # calibration gate)
+
     # --- viz ---------------------------------------------------------------
     viz_port: int = 8000
     # Bind address.  Unlike the reference (http.server on all interfaces,

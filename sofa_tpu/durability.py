@@ -268,9 +268,10 @@ def logdir_raw_key(logdir: str) -> str:
 _DIGEST_SKIP_FILES = frozenset({
     DIGESTS_NAME, JOURNAL_NAME, "run_manifest.json", "sofa_self_trace.json",
     "_derived.writing", "docker.cid",
-    # regenerated at will by `sofa regress` without a pipeline digest
-    # refresh — digesting it would turn every re-regress into fsck damage
-    "regress_verdict.json",
+    # regenerated at will by `sofa regress` / `sofa whatif` without a
+    # pipeline digest refresh — digesting them would turn every re-run
+    # into fsck damage
+    "regress_verdict.json", "whatif_report.json",
 })
 _DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
@@ -689,8 +690,11 @@ def sofa_resume(cfg) -> int:
     ar = state.get("archive")
     need_ar = ar is not None and (not ar["committed"] or need_pre
                                   or need_an)
+    wi = state.get("whatif")
+    need_wi = wi is not None and (not wi["committed"] or need_pre
+                                  or need_an)
 
-    if not (need_pre or need_an or need_ar):
+    if not (need_pre or need_an or need_ar or need_wi):
         print_progress("resume: every journaled stage is committed and "
                        "matches the raw files — nothing to replay")
         return 0
@@ -723,5 +727,18 @@ def sofa_resume(cfg) -> int:
         print_progress(f"resume: replaying archive ingest into {root} "
                        "(already-stored objects are deduped)")
         ingest_run(cfg, root)
+    if need_wi:
+        # The scenario spec rides the begin entry, like archive_root: the
+        # replay must answer the same question the killed run was asked.
+        spec = next((e.get("apply") for e in reversed(entries)
+                     if e.get("stage") == "whatif" and e.get("ev") == "begin"
+                     and isinstance(e.get("apply"), str)), None)
+        if spec is not None:
+            cfg.whatif_apply = spec
+        from sofa_tpu.whatif import sofa_whatif
+
+        print_progress("resume: replaying whatif "
+                       f"(--apply {cfg.whatif_apply or '<identity>'})")
+        sofa_whatif(cfg)
     print_progress("resume: journal replay complete")
     return 0

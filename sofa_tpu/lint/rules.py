@@ -380,7 +380,7 @@ _DERIVED_WRITER_FILES = (
     "trace.py", "telemetry.py", "tiles.py", "preprocess.py", "analyze.py",
     "ingest/cache.py", "ingest/pcap.py", "export_folded.py",
     "export_perfetto.py", "export_static.py", "analysis/", "ml/",
-    "durability.py", "archive/",
+    "durability.py", "archive/", "whatif/",
 )
 
 _OPEN_FNS = frozenset({"open", "io.open", "gzip.open", "bz2.open",

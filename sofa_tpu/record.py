@@ -64,7 +64,9 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # + sha256 integrity ledger sidecar
                  "_journal.jsonl", "_digests.json",
                  # `sofa regress` verdict (sofa_tpu/archive/verdict.py)
-                 "regress_verdict.json"]
+                 "regress_verdict.json",
+                 # `sofa whatif` prediction report (sofa_tpu/whatif/)
+                 "whatif_report.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles"]
 

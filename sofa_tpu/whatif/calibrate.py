@@ -1,0 +1,89 @@
+"""Calibration: what makes a what-if prediction honest.
+
+Two disciplines, both borrowed from the parts of the repo that already
+refuse to manufacture confidence:
+
+* **Error bars from the run's own variance.**  The measured per-step
+  times give a nonparametric 95 % CI of the median via binomial order
+  statistics (``archive/baseline.py`` — the regression engine's
+  interval).  Its half-widths are the run's own step-to-step jitter
+  scale; a predicted mean is reported as ``predicted ± those
+  half-widths``.  Below ``MIN_CI_SAMPLES`` steps no such interval exists
+  — a sample range is NOT a 95 % CI — so the verdict degrades to
+  ``uncalibrated`` with the reason stated.
+
+* **The identity gate.**  Replaying the model with *zero* scenarios must
+  reproduce the measured mean step time within that interval (translated
+  to the measured mean).  The model's decomposition makes the identity
+  replay exact by construction, so a gate failure means the model is
+  damaged (spans lost, clipping bugs, a tampered model file) — and every
+  scenario prediction built on it would inherit the damage.  An
+  ``uncalibrated`` verdict poisons the report loudly: ``manifest_check
+  --require-healthy`` treats it as unhealthy and ``sofa whatif`` exits 1.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from sofa_tpu.archive.baseline import MIN_CI_SAMPLES, median, median_ci
+
+#: Calibration verdict vocabulary (report + meta.whatif).
+CALIBRATION_VERDICTS = ("calibrated", "uncalibrated")
+
+
+def calibration(measured: List[float], identity_mean: float) -> dict:
+    """The report's ``calibration`` section from the measured per-step
+    times and the zero-scenario replayed mean."""
+    n = len(measured)
+    out: dict = {"n_steps": n}
+    if n == 0:
+        out.update(verdict="uncalibrated",
+                   reason="no step spans in the trace — nothing to "
+                          "calibrate against (is tpusteps captured?)")
+        return out
+    mean = sum(measured) / n
+    med = median(measured)
+    out.update(measured_mean_s=round(mean, 9),
+               measured_median_s=round(med, 9),
+               identity_mean_s=round(identity_mean, 9),
+               identity_error_pct=round(
+                   100.0 * abs(identity_mean - mean) / mean, 6)
+                   if mean > 0 else 0.0)
+    ci = median_ci(measured)
+    if ci is None:
+        out.update(ci=None, verdict="uncalibrated",
+                   reason=f"only {n} step sample(s) — no defensible 95% "
+                          f"CI (need >= {MIN_CI_SAMPLES})")
+        return out
+    lo, hi = ci
+    out["ci"] = [round(lo, 9), round(hi, 9)]
+    # The gate interval is the median CI translated to the measured mean:
+    # same variance scale, centered on the quantity the replay reproduces.
+    gate_lo = mean - (med - lo)
+    gate_hi = mean + (hi - med)
+    if gate_lo <= identity_mean <= gate_hi:
+        out.update(verdict="calibrated",
+                   reason=f"zero-scenario replay reproduces the measured "
+                          f"mean within [{gate_lo:g}, {gate_hi:g}]")
+    else:
+        out.update(verdict="uncalibrated",
+                   reason=f"zero-scenario replay ({identity_mean:g}s) "
+                          f"falls outside [{gate_lo:g}, {gate_hi:g}] — "
+                          "the timeline model does not reproduce this "
+                          "run; scenario predictions would inherit the "
+                          "error")
+    return out
+
+
+def error_bars(calib: dict, predicted_mean: float) -> "Optional[list]":
+    """``[lo, hi]`` around a predicted mean: the measured median CI's
+    half-widths translated to the prediction; None when the run was too
+    short for a defensible interval."""
+    ci = calib.get("ci")
+    med = calib.get("measured_median_s")
+    if not ci or med is None:
+        return None
+    lo = predicted_mean - (med - ci[0])
+    hi = predicted_mean + (ci[1] - med)
+    return [round(max(lo, 0.0), 9), round(max(hi, 0.0), 9)]

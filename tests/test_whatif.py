@@ -1,0 +1,526 @@
+"""sofa_tpu/whatif/ — the hardware-free what-if replay engine.
+
+Covers the ISSUE 9 acceptance surface: scenario parsing (incl.
+unknown-scenario degradation), model decomposition exactness, replay
+determinism across ``--jobs``, the identity calibration gate (pass AND
+fail), ``sol``-scaling fed from a synthetic ``sol_roofline.csv``, CLI
+exit codes, report schema validation via tools/manifest_check.py,
+``meta.whatif`` manifest plumbing, ``sofa clean`` / ``sofa resume``
+integration, the registered ``whatif_model`` pass, and a pod_synth
+end-to-end (slow-marked).
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.trace import make_frame, write_csv
+from sofa_tpu.whatif import (REPORT_NAME, WHATIF_SCHEMA, run_whatif,
+                             sofa_whatif, whatif_hints)
+from sofa_tpu.whatif.calibrate import calibration, error_bars
+from sofa_tpu.whatif.model import build_model
+from sofa_tpu.whatif.replay import (load_sol_table, measured_step_times,
+                                    replay)
+from sofa_tpu.whatif.scenarios import parse_scenario, parse_scenarios
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_DEV, N_STEPS = 2, 8
+STEP_S, COMPUTE_S, COLL_S, GAP_S = 0.05, 0.03, 0.01, 0.01
+
+
+def _mc():
+    spec = importlib.util.spec_from_file_location(
+        "manifest_check", os.path.join(ROOT, "tools", "manifest_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def synth_frames(n_dev=N_DEV, n_steps=N_STEPS):
+    """Per step: 30 ms fusion compute, 10 ms fully-exposed all-reduce,
+    10 ms gap — a decomposition every test can predict by hand."""
+    ops, steps = [], []
+    for dev in range(n_dev):
+        t = 0.0
+        for s in range(n_steps):
+            steps.append({"timestamp": t, "duration": STEP_S,
+                          "deviceId": dev, "event": float(s),
+                          "name": f"step {s}", "device_kind": "tpu"})
+            ops.append({"timestamp": t, "duration": COMPUTE_S,
+                        "deviceId": dev, "category": 0, "copyKind": 0,
+                        "name": "fusion.1", "hlo_category": "fusion",
+                        "flops": 3e12, "bytes_accessed": 1e6,
+                        "device_kind": "tpu"})
+            ops.append({"timestamp": t + COMPUTE_S, "duration": COLL_S,
+                        "deviceId": dev, "category": 0, "copyKind": 21,
+                        "name": "all-reduce.1",
+                        "hlo_category": "all-reduce",
+                        "device_kind": "tpu"})
+            t += STEP_S
+    return {"tputrace": make_frame(ops), "tpusteps": make_frame(steps)}
+
+
+def write_logdir(logdir, frames):
+    os.makedirs(logdir, exist_ok=True)
+    write_csv(frames["tputrace"], os.path.join(logdir, "tputrace.csv"))
+    write_csv(frames["tpusteps"], os.path.join(logdir, "tpusteps.csv"))
+    with open(os.path.join(logdir, "misc.txt"), "w") as f:
+        f.write("elapsed_time 0.4\ncores 2\npid 1\nrc 0\n")
+
+
+@pytest.fixture
+def frames():
+    return synth_frames()
+
+
+@pytest.fixture
+def cfg(logdir, frames):
+    write_logdir(logdir, frames)
+    return SofaConfig(logdir=logdir)
+
+
+# --------------------------------------------------------------------------
+# scenarios.py — parsing + degradation
+# --------------------------------------------------------------------------
+
+def test_parse_each_kind():
+    s = parse_scenario("overlap:all-reduce")
+    assert (s.kind, s.pattern) == ("overlap", "all-reduce")
+    s = parse_scenario("scale:fusion*=0.5")
+    assert (s.kind, s.pattern, s.factor) == ("scale", "fusion*", 0.5)
+    s = parse_scenario("scale:*=sol")
+    assert s.factor == "sol"
+    assert parse_scenario("link:2").factor == 2.0
+    assert parse_scenario("batch:1.5").factor == 1.5
+
+
+def test_parse_unknown_degrades_not_aborts():
+    scenarios, problems = parse_scenarios(
+        "frobnicate:9,scale:fusion=0.5,overlap")
+    assert len(scenarios) == 3
+    assert [s.known for s in scenarios] == [False, True, False]
+    assert len(problems) == 2
+    assert "unknown scenario kind" in problems[0]
+
+
+@pytest.mark.parametrize("bad", ["link:abc", "link:0", "link:-2",
+                                 "scale:fusion=", "scale:=0.5",
+                                 "scale:fusion", "batch:"])
+def test_parse_malformed_is_unknown(bad):
+    s = parse_scenario(bad)
+    assert not s.known and s.problem
+
+
+def test_parse_empty_spec():
+    assert parse_scenarios("") == ([], [])
+    assert parse_scenarios(" , ,") == ([], [])
+
+
+# --------------------------------------------------------------------------
+# model.py — decomposition exactness
+# --------------------------------------------------------------------------
+
+def test_model_components_sum_to_step_duration(frames, cfg):
+    model = build_model(frames, cfg)
+    per = model.groupby(["deviceId", "step"]).agg(
+        dur=("dur", "first"), total=("seconds", "sum"))
+    assert len(per) == N_DEV * N_STEPS
+    assert np.allclose(per["dur"], per["total"])
+    by_kind = model.groupby("kind")["seconds"].sum()
+    n = N_DEV * N_STEPS
+    assert by_kind["compute"] == pytest.approx(COMPUTE_S * n)
+    assert by_kind["collective"] == pytest.approx(COLL_S * n)
+    assert by_kind["gap"] == pytest.approx(GAP_S * n)
+
+
+def test_model_empty_without_steps(cfg):
+    assert build_model({}, cfg).empty
+    assert build_model({"tpusteps": make_frame([])}, cfg).empty
+
+
+def test_model_ops_missing_is_all_gap(frames, cfg):
+    model = build_model({"tpusteps": frames["tpusteps"]}, cfg)
+    assert set(model["kind"]) == {"gap"}
+    assert model["seconds"].sum() == pytest.approx(
+        STEP_S * N_DEV * N_STEPS)
+
+
+# --------------------------------------------------------------------------
+# replay.py — scenario semantics + attribution
+# --------------------------------------------------------------------------
+
+def test_identity_replay_reproduces_measured(frames, cfg):
+    model = build_model(frames, cfg)
+    r = replay(model, [])
+    assert r["mean_predicted_s"] == pytest.approx(r["mean_measured_s"])
+    for s in r["steps"]:
+        assert s["predicted_s"] == pytest.approx(s["measured_s"])
+
+
+def test_overlap_hides_exposed_collective(frames, cfg):
+    model = build_model(frames, cfg)
+    scenarios, _ = parse_scenarios("overlap:all-reduce")
+    r = replay(model, scenarios)
+    # the 10 ms exposure hides entirely (30 ms compute available)
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S - COLL_S)
+    att = r["attribution"][0]
+    assert att["status"] == "applied"
+    assert att["delta_s"] == pytest.approx(COLL_S)
+
+
+def test_overlap_bounded_by_available_compute(cfg):
+    # collective twice the compute: only the compute-sized part can hide
+    ops, steps = [], []
+    for s in range(6):
+        t = s * 0.1
+        steps.append({"timestamp": t, "duration": 0.1, "deviceId": 0,
+                      "event": float(s), "device_kind": "tpu"})
+        ops.append({"timestamp": t, "duration": 0.02, "deviceId": 0,
+                    "category": 0, "copyKind": 0, "name": "fusion.1",
+                    "hlo_category": "fusion"})
+        ops.append({"timestamp": t + 0.02, "duration": 0.06, "deviceId": 0,
+                    "category": 0, "copyKind": 21, "name": "all-reduce.1",
+                    "hlo_category": "all-reduce"})
+    model = build_model({"tputrace": make_frame(ops),
+                         "tpusteps": make_frame(steps)}, cfg)
+    scenarios, _ = parse_scenarios("overlap:*")
+    r = replay(model, scenarios)
+    assert r["attribution"][0]["delta_s"] == pytest.approx(0.02)
+
+
+def test_scale_and_link_and_batch(frames, cfg):
+    model = build_model(frames, cfg)
+    r = replay(model, parse_scenarios("scale:fusion=0.5")[0])
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S - COMPUTE_S / 2)
+    r = replay(model, parse_scenarios("link:2")[0])
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S - COLL_S / 2)
+    r = replay(model, parse_scenarios("batch:2")[0])
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S + COMPUTE_S)
+
+
+def test_attribution_is_marginal_and_sums(frames, cfg):
+    model = build_model(frames, cfg)
+    scenarios, _ = parse_scenarios(
+        "overlap:all-reduce,scale:fusion=0.5,frobnicate:9")
+    r = replay(model, scenarios)
+    deltas = [a["delta_s"] for a in r["attribution"]]
+    assert sum(deltas) == pytest.approx(
+        r["mean_measured_s"] - r["mean_predicted_s"])
+    assert r["attribution"][2]["status"] == "unknown"
+    assert r["attribution"][2]["delta_s"] == 0.0
+
+
+def test_scale_sol_from_synthetic_roofline_csv(frames, cfg):
+    # sol_time/time = 0.5 for fusion on both devices -> compute halves
+    pd.DataFrame([
+        {"deviceId": d, "hlo_category": "fusion", "time": 0.24,
+         "sol_time": 0.12} for d in range(N_DEV)
+    ]).to_csv(cfg.path("sol_roofline.csv"), index=False)
+    sol = load_sol_table(cfg)
+    assert sol[(0, "fusion")] == pytest.approx(0.5)
+    model = build_model(frames, cfg)
+    r = replay(model, parse_scenarios("scale:*=sol")[0], sol)
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S - COMPUTE_S / 2)
+
+
+def test_scale_sol_without_roofline_degrades(frames, cfg):
+    model = build_model(frames, cfg)
+    r = replay(model, parse_scenarios("scale:*=sol")[0], {})
+    att = r["attribution"][0]
+    assert att["status"] == "no_match"
+    assert "sol_roofline.csv" in att["note"]
+    assert r["mean_predicted_s"] == pytest.approx(STEP_S)
+
+
+# --------------------------------------------------------------------------
+# calibrate.py — the identity gate
+# --------------------------------------------------------------------------
+
+def test_calibration_gate_passes_on_exact_identity():
+    measured = [0.05, 0.051, 0.049, 0.05, 0.052, 0.048, 0.05]
+    c = calibration(measured, sum(measured) / len(measured))
+    assert c["verdict"] == "calibrated"
+    assert c["identity_error_pct"] == pytest.approx(0.0)
+    assert c["ci"] is not None
+
+
+def test_calibration_gate_fails_on_model_damage():
+    measured = [0.05, 0.051, 0.049, 0.05, 0.052, 0.048, 0.05]
+    c = calibration(measured, 0.08)   # replay 60% off: broken model
+    assert c["verdict"] == "uncalibrated"
+    assert "outside" in c["reason"]
+
+
+def test_calibration_needs_a_defensible_ci():
+    c = calibration([0.05, 0.05, 0.05], 0.05)
+    assert c["verdict"] == "uncalibrated"
+    assert "no defensible 95% CI" in c["reason"]
+    assert error_bars(c, 0.04) is None
+    assert calibration([], 0.0)["verdict"] == "uncalibrated"
+
+
+def test_error_bars_translate_measured_variance():
+    measured = [0.04, 0.05, 0.05, 0.05, 0.05, 0.06, 0.05]
+    c = calibration(measured, sum(measured) / len(measured))
+    bars = error_bars(c, 0.03)
+    lo, hi = c["ci"]
+    med = c["measured_median_s"]
+    assert bars == [pytest.approx(0.03 - (med - lo)),
+                    pytest.approx(0.03 + (hi - med))]
+
+
+# --------------------------------------------------------------------------
+# the verb: report, schema, manifest, CLI, clean, resume, determinism
+# --------------------------------------------------------------------------
+
+def test_run_whatif_writes_schema_valid_report(cfg):
+    cfg.whatif_apply = "overlap:*,scale:fusion=0.5,frobnicate:9"
+    doc = run_whatif(cfg)
+    assert doc["schema"] == WHATIF_SCHEMA
+    assert os.path.isfile(cfg.path(REPORT_NAME))
+    mc = _mc()
+    assert mc.validate_whatif(doc) == []
+    assert doc["calibration"]["verdict"] == "calibrated"
+    assert [s["status"] for s in doc["scenarios"]] == \
+        ["parsed", "parsed", "unknown"]
+    assert doc["problems"]
+    assert len(doc["steps"]) == N_DEV * N_STEPS
+    assert doc["predicted"]["error_bars"] is not None
+
+
+def test_report_jobs_determinism(tmp_path, frames):
+    docs = []
+    for jobs in (1, 4):
+        d = str(tmp_path / f"j{jobs}") + "/"
+        write_logdir(d, frames)
+        cfg = SofaConfig(logdir=d, jobs=jobs,
+                         whatif_apply="overlap:*,scale:fusion=0.5")
+        docs.append(run_whatif(cfg))
+    for doc in docs:
+        doc.pop("generated_unix")
+    assert docs[0] == docs[1]
+
+
+def test_cli_exit_codes(cfg, tmp_path):
+    from sofa_tpu.cli import main
+
+    assert main(["whatif", cfg.logdir, "--apply", "overlap:*"]) == 0
+    # too few steps for a defensible CI -> uncalibrated -> exit 1
+    short = str(tmp_path / "short") + "/"
+    write_logdir(short, synth_frames(n_dev=1, n_steps=3))
+    assert main(["whatif", short]) == 1
+    # nothing to replay -> exit 2
+    assert main(["whatif", str(tmp_path / "nope") + "/"]) == 2
+
+
+def test_cli_apply_flag_shared_with_setup():
+    from sofa_tpu.cli import build_parser, config_from_args
+
+    cfg = config_from_args(build_parser().parse_args(
+        ["whatif", "x/", "--apply", "overlap:*,link:2"]))
+    assert cfg.whatif_apply == "overlap:*,link:2"
+    # setup's bare --apply stays a boolean, not a scenario spec
+    args = build_parser().parse_args(["setup", "--apply"])
+    assert args.apply is True
+    assert config_from_args(args).whatif_apply == ""
+
+
+def test_meta_whatif_in_manifest(cfg):
+    cfg.whatif_apply = "overlap:*"
+    assert sofa_whatif(cfg) == 0
+    from sofa_tpu.telemetry import load_manifest
+
+    doc = load_manifest(cfg.logdir)
+    mc = _mc()
+    assert mc.validate_manifest(doc) == []
+    meta = doc["meta"]["whatif"]
+    assert meta["verdict"] == "calibrated"
+    assert meta["n_steps"] == N_DEV * N_STEPS
+    assert "whatif" in doc["runs"]
+
+
+def test_require_healthy_flags_uncalibrated(tmp_path):
+    short = str(tmp_path / "short") + "/"
+    write_logdir(short, synth_frames(n_dev=1, n_steps=3))
+    cfg = SofaConfig(logdir=short)
+    assert sofa_whatif(cfg) == 1
+    mc = _mc()
+    from sofa_tpu.telemetry import load_manifest
+
+    doc = load_manifest(short)
+    assert mc.validate_manifest(doc) == []
+    probs = mc.validate_manifest(doc, require_healthy=True)
+    assert any("uncalibrated" in p for p in probs)
+    # the report itself is auto-detected and gate-checked the same way
+    with open(os.path.join(short, REPORT_NAME)) as f:
+        report = json.load(f)
+    assert mc.validate_whatif(report) == []
+    assert any("uncalibrated" in p for p in
+               mc.validate_whatif(report, require_healthy=True))
+    assert mc.check_path(os.path.join(short, REPORT_NAME)) == 0
+
+
+def test_whatif_hints_rank_top_payoffs(cfg):
+    cfg.whatif_apply = "overlap:*,scale:fusion=0.5"
+    doc = run_whatif(cfg)
+    hints = whatif_hints(doc)
+    assert hints and all(h.startswith("[whatif]") for h in hints)
+    # largest predicted saving first (scale saves 15 ms, overlap 10 ms)
+    assert "scale:fusion=0.5" in hints[0]
+
+
+def test_advice_pipeline_ranks_whatif_features(cfg):
+    from sofa_tpu.analysis.advice import generate_hints
+    from sofa_tpu.analysis.features import Features
+
+    f = Features()
+    f.add("whatif_overlap_payoff_pct", 8.0)
+    f.add("whatif_sol_payoff_pct", 21.0)
+    hints = [h for h in generate_hints(f, cfg) if h.startswith("[whatif]")]
+    assert len(hints) == 2
+    assert "speed-of-light" in hints[0]      # bigger payoff ranks first
+    assert "sofa whatif" in hints[0]
+
+
+def test_clean_removes_report_and_model(cfg):
+    cfg.whatif_apply = ""
+    assert sofa_whatif(cfg) == 0
+    with open(cfg.path("whatif_model.csv"), "w") as f:
+        f.write("deviceId\n")  # the pass artifact, present after analyze
+    from sofa_tpu.record import sofa_clean
+
+    sofa_clean(cfg)
+    assert not os.path.exists(cfg.path(REPORT_NAME))
+    assert not os.path.exists(cfg.path("whatif_model.csv"))
+    assert os.path.exists(cfg.path("misc.txt"))  # raw inputs survive
+
+
+def test_resume_replays_uncommitted_whatif(cfg):
+    from sofa_tpu.durability import JOURNAL_NAME, sofa_resume
+
+    cfg.whatif_apply = "overlap:*"
+    assert sofa_whatif(cfg) == 0
+    jpath = cfg.path(JOURNAL_NAME)
+    with open(jpath) as f:
+        lines = [ln for ln in f.read().splitlines()
+                 if not ('"commit"' in ln and '"whatif"' in ln)]
+    with open(jpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.unlink(cfg.path(REPORT_NAME))
+    cfg.whatif_apply = ""  # the replay must recover the spec from begin
+    assert sofa_resume(cfg) == 0
+    with open(cfg.path(REPORT_NAME)) as f:
+        doc = json.load(f)
+    assert [s["spec"] for s in doc["scenarios"]] == ["overlap:*"]
+
+
+# --------------------------------------------------------------------------
+# the registered pass
+# --------------------------------------------------------------------------
+
+def test_whatif_model_pass_registered_and_scheduled():
+    from sofa_tpu.analysis import registry
+
+    registry.load_builtin_passes()
+    spec = registry.get("whatif_model")
+    assert spec is not None
+    assert "tpusteps" in spec.reads_frames
+    assert "tpu*_sol_distance" in spec.reads_features
+    # scheduled strictly after its sol_roofline feature producer
+    enabled = [s for s in registry.registered()
+               if s.enabled(SofaConfig())]
+    waves = registry.resolve_schedule(enabled, strict=True)
+    wave_of = {s.name: i for i, w in enumerate(waves) for s in w}
+    assert wave_of["whatif_model"] > wave_of["sol_roofline"]
+
+
+def test_whatif_model_pass_emits_features_and_artifact(cfg, frames):
+    from sofa_tpu.analysis import registry
+    from sofa_tpu.analysis.features import Features
+
+    with registry.scoped():
+        registry.load_builtin_passes()
+        features = Features()
+        ledger, _series = registry.run_passes(frames, cfg, features)
+        assert ledger["passes"]["whatif_model"]["status"] == "ok"
+    assert features.get("whatif_steps") == N_DEV * N_STEPS
+    assert features.get("whatif_step_time_mean") == pytest.approx(STEP_S)
+    assert features.get("whatif_identity_error_pct") == pytest.approx(0.0)
+    assert features.get("whatif_overlap_payoff_pct") == pytest.approx(
+        100.0 * COLL_S / STEP_S)
+    model = pd.read_csv(cfg.path("whatif_model.csv"))
+    assert set(model["kind"]) == {"compute", "collective", "gap"}
+
+
+# --------------------------------------------------------------------------
+# end to end
+# --------------------------------------------------------------------------
+
+def test_e2e_analyze_then_whatif_with_sol(cfg):
+    """The acceptance flow on a hand-sized trace: analyze builds
+    sol_roofline.csv (plane-stats peak chosen so fusion headroom is 2x),
+    then `scale:*=sol` + `overlap:*` each predict finite step times with
+    attribution and stated error bars, and the identity gate passes."""
+    with open(cfg.path("tpu_meta.json"), "w") as f:
+        json.dump({str(d): {"peak_teraflops_per_second": 200.0,
+                            "peak_hbm_bw_gigabytes_per_second": 1000.0}
+                   for d in range(N_DEV)}, f)
+    from sofa_tpu.analyze import sofa_analyze
+
+    sofa_analyze(cfg)
+    assert os.path.isfile(cfg.path("sol_roofline.csv"))
+    with open(cfg.path("hints.txt")) as f:
+        assert "[whatif]" in f.read()
+
+    cfg.whatif_apply = "overlap:*,scale:*=sol"
+    assert sofa_whatif(cfg) == 0
+    with open(cfg.path(REPORT_NAME)) as f:
+        doc = json.load(f)
+    assert _mc().validate_whatif(doc, require_healthy=True) == []
+    pred = doc["predicted"]
+    assert np.isfinite(pred["step_time_mean_s"])
+    assert pred["error_bars"] is not None
+    att = {a["scenario"]: a for a in pred["attribution"]}
+    assert att["overlap:*"]["status"] == "applied"
+    assert att["overlap:*"]["delta_s"] == pytest.approx(COLL_S)
+    assert att["scale:*=sol"]["status"] == "applied"
+    # sol headroom 2x on 3e12*8-flop fusion vs the 200 TF peak:
+    # 24e12/200e12 = 0.12 s attainable vs 0.24 s measured per device
+    assert att["scale:*=sol"]["delta_s"] == pytest.approx(
+        COMPUTE_S / 2, rel=0.01)
+    assert pred["step_time_mean_s"] == pytest.approx(
+        STEP_S - COLL_S - COMPUTE_S / 2, rel=0.01)
+
+
+@pytest.mark.slow
+def test_pod_synth_e2e(tmp_path):
+    """ISSUE 9 acceptance on the real harness: pod_synth, analyze, then
+    the zero-scenario identity gate passes and both canonical scenarios
+    produce finite calibrated predictions."""
+    import subprocess
+    import sys
+
+    logdir = str(tmp_path / "pod") + "/"
+    subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "pod_synth.py"),
+         logdir], check=True, capture_output=True, timeout=600)
+    cfg = SofaConfig(logdir=logdir)
+    from sofa_tpu.analyze import sofa_analyze
+
+    sofa_analyze(cfg)
+    cfg.whatif_apply = "overlap:*,scale:*=sol"
+    assert sofa_whatif(cfg) == 0
+    with open(cfg.path(REPORT_NAME)) as f:
+        doc = json.load(f)
+    assert _mc().validate_whatif(doc, require_healthy=True) == []
+    assert doc["calibration"]["verdict"] == "calibrated"
+    assert np.isfinite(doc["predicted"]["step_time_mean_s"])
+    assert doc["predicted"]["error_bars"] is not None
+    att = doc["predicted"]["attribution"]
+    assert [a["scenario"] for a in att] == ["overlap:*", "scale:*=sol"]

@@ -352,6 +352,59 @@ def _run_crash_pass_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_whatif_cell(workdir: str, synth: str, mc) -> List[str]:
+    """`sofa whatif` over a degraded trace: corrupt the pcap so
+    preprocess quarantines a source, then prove the replay still yields a
+    schema-valid ``whatif_report.json`` with a stated calibration verdict
+    and a schema-valid manifest carrying ``meta.whatif`` — a degraded
+    capture must degrade the *answer's confidence*, never the report."""
+    import json
+
+    from sofa_tpu.whatif import REPORT_NAME, sofa_whatif
+
+    logdir = os.path.join(workdir, "whatif-degraded") + "/"
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    with open(cfg.path("sofa.pcap"), "wb") as f:
+        f.write(b"chaos: positively not a pcap file")
+    sofa_preprocess(cfg)
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        return ["no run_manifest.json"]
+    if (doc.get("sources") or {}).get("nettrace", {}).get(
+            "status") != "quarantined":
+        problems.append("nettrace not quarantined — the cell's fault "
+                        "never landed")
+    # Preprocess regenerated the frame CSVs from raw collector files, and
+    # the synth harness has no raw xplane — restore the device frames so
+    # the replay calibrates against real step spans (as it would on a
+    # capture whose xplane ingest succeeded while the pcap rotted).
+    for fname in ("tpusteps.csv", "tputrace.csv"):
+        shutil.copy(synth + fname, cfg.path(fname))
+    rc = sofa_whatif(cfg)
+    if rc not in (0, 1):
+        problems.append(f"sofa whatif rc={rc} on a degraded trace "
+                        "(expected 0 calibrated / 1 uncalibrated)")
+    try:
+        with open(cfg.path(REPORT_NAME)) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        return problems + [f"no readable {REPORT_NAME}: {e}"]
+    problems += [f"report: {p}" for p in mc.validate_whatif(report)]
+    if not (report.get("calibration") or {}).get("n_steps"):
+        problems.append("replay saw no step spans — the restored device "
+                        "frames never reached the model")
+    doc = telemetry.load_manifest(logdir)
+    problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    meta = ((doc or {}).get("meta") or {}).get("whatif")
+    if not isinstance(meta, dict) or meta.get("verdict") != (
+            report.get("calibration") or {}).get("verdict"):
+        problems.append("meta.whatif missing or disagrees with the report")
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -359,10 +412,10 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 2
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 3
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
-                + [("kill-mid-archive", None)])
+                + [("kill-mid-archive", None), ("whatif-degraded", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -403,6 +456,16 @@ def main(argv=None) -> int:
     failures += bool(problems)
     print(f"{'crash-pass'.ljust(width)}  {status}  (crashing registered "
           "analysis pass, then sofa analyze)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_whatif_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'whatif-degraded'.ljust(width)}  {status}  (corrupt pcap -> "
+          "quarantine, then sofa whatif)")
     for p in problems:
         print(f"{' ' * width}    - {p}")
     print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "

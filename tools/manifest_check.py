@@ -30,9 +30,14 @@ from sofa_tpu.telemetry import (  # noqa: E402
     SOURCE_STATUSES,
 )
 
-_KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress")
+_KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress",
+                "whatif")
 _VERDICTS = ("regressed", "improved", "noise")
 _VERDICT_SCHEMA = "sofa_tpu/regress_verdict"
+_WHATIF_SCHEMA = "sofa_tpu/whatif_report"
+_WHATIF_CALIBRATION = ("calibrated", "uncalibrated")
+_WHATIF_SCENARIO_STATUSES = ("parsed", "unknown")
+_WHATIF_ATTRIBUTION_STATUSES = ("applied", "no_match", "unknown")
 
 
 def _is_num(v) -> bool:
@@ -268,6 +273,30 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                         probs.append(f"meta.passes.passes.{name}: ran but "
                                      "absent from meta.passes.schedule")
 
+    # meta.whatif (written by the `sofa whatif` verb, sofa_tpu/whatif/):
+    # the calibration verdict + identity error the report carries in full.
+    whatif = (doc.get("meta") or {}).get("whatif")
+    if whatif is not None:
+        if not isinstance(whatif, dict):
+            probs.append("meta.whatif: not an object")
+            whatif = None
+        else:
+            if whatif.get("verdict") not in _WHATIF_CALIBRATION:
+                probs.append(f"meta.whatif.verdict: "
+                             f"{whatif.get('verdict')!r} not in "
+                             f"{_WHATIF_CALIBRATION}")
+            v = whatif.get("identity_error_pct")
+            if not _is_num(v) or v < 0:
+                probs.append("meta.whatif.identity_error_pct: missing or "
+                             "not a non-negative number")
+            for key in ("n_steps", "scenarios"):
+                v = whatif.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.whatif.{key}: missing or not a "
+                                 "non-negative int")
+            if not isinstance(whatif.get("report"), str):
+                probs.append("meta.whatif.report: missing report filename")
+
     regress = (doc.get("meta") or {}).get("regress")
     if regress is not None:
         if not isinstance(regress, dict) or \
@@ -312,6 +341,11 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 probs.append(f"unhealthy: analysis pass {name} failed"
                              + (f" ({ent['error']})"
                                 if ent.get("error") else ""))
+        if isinstance(whatif, dict) and \
+                whatif.get("verdict") == "uncalibrated":
+            probs.append("unhealthy: the what-if identity gate is "
+                         "uncalibrated — the replay model does not "
+                         "reproduce this run's measured step times")
         for verb, run in runs.items():
             if isinstance(run, dict) and (run.get("counters") or {}).get(
                     "errors"):
@@ -363,15 +397,105 @@ def validate_verdict(doc, require_passing: bool = False) -> List[str]:
     return probs
 
 
+def validate_whatif(doc, require_healthy: bool = False) -> List[str]:
+    """Schema problems in a ``whatif_report.json`` (sofa_tpu/whatif/).
+    ``require_healthy`` additionally fails on an ``uncalibrated``
+    identity gate — a prediction the model cannot vouch for."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["whatif report is not a JSON object"]
+    if doc.get("schema") != _WHATIF_SCHEMA:
+        probs.append(f"schema: expected {_WHATIF_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if not isinstance(doc.get("version"), int):
+        probs.append("version: missing or not an int")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    calib = doc.get("calibration")
+    if not isinstance(calib, dict):
+        probs.append("calibration: missing")
+        calib = {}
+    verdict = calib.get("verdict")
+    if verdict not in _WHATIF_CALIBRATION:
+        probs.append(f"calibration.verdict: {verdict!r} not in "
+                     f"{_WHATIF_CALIBRATION}")
+    if not isinstance(calib.get("reason"), str):
+        probs.append("calibration.reason: a verdict must state its reason")
+    n = calib.get("n_steps")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        probs.append("calibration.n_steps: missing or not a "
+                     "non-negative int")
+    elif n > 0:
+        for key in ("measured_mean_s", "measured_median_s",
+                    "identity_mean_s", "identity_error_pct"):
+            if not _is_num(calib.get(key)):
+                probs.append(f"calibration.{key}: missing or not a number")
+        ci = calib.get("ci")
+        if ci is not None and not (
+                isinstance(ci, list) and len(ci) == 2
+                and all(_is_num(v) for v in ci)):
+            probs.append("calibration.ci: not null or a [lo, hi] pair")
+    scenarios = doc.get("scenarios")
+    if not isinstance(scenarios, list):
+        probs.append("scenarios: not a list")
+        scenarios = []
+    for i, s in enumerate(scenarios):
+        if not isinstance(s, dict) or not isinstance(s.get("spec"), str) \
+                or s.get("status") not in _WHATIF_SCENARIO_STATUSES:
+            probs.append(f"scenarios[{i}]: needs a spec and a status in "
+                         f"{_WHATIF_SCENARIO_STATUSES}")
+    pred = doc.get("predicted")
+    if not isinstance(pred, dict):
+        probs.append("predicted: missing")
+        pred = {}
+    if not _is_num(pred.get("step_time_mean_s")):
+        probs.append("predicted.step_time_mean_s: missing or not a number")
+    bars = pred.get("error_bars")
+    if bars is not None and not (
+            isinstance(bars, list) and len(bars) == 2
+            and all(_is_num(v) for v in bars)):
+        probs.append("predicted.error_bars: not null or a [lo, hi] pair")
+    att = pred.get("attribution")
+    if not isinstance(att, list):
+        probs.append("predicted.attribution: not a list")
+        att = []
+    for i, a in enumerate(att):
+        if not isinstance(a, dict) \
+                or not isinstance(a.get("scenario"), str) \
+                or a.get("status") not in _WHATIF_ATTRIBUTION_STATUSES \
+                or not _is_num(a.get("delta_s")):
+            probs.append(f"predicted.attribution[{i}]: needs scenario, a "
+                         f"status in {_WHATIF_ATTRIBUTION_STATUSES}, and "
+                         "a numeric delta_s")
+    steps = doc.get("steps")
+    if not isinstance(steps, list):
+        probs.append("steps: not a list")
+        steps = []
+    for i, s in enumerate(steps):
+        if not isinstance(s, dict) or not all(
+                _is_num(s.get(k)) for k in ("deviceId", "step",
+                                            "measured_s", "predicted_s")):
+            probs.append(f"steps[{i}]: needs numeric deviceId/step/"
+                         "measured_s/predicted_s")
+            break  # one line for a malformed overlay, not thousands
+    if require_healthy and verdict == "uncalibrated":
+        probs.append("gate: the identity replay is uncalibrated ("
+                     + str(calib.get("reason", "?")) + ")")
+    return probs
+
+
 def check_path(path: str, require_healthy: bool = False) -> int:
     """0 valid / 1 invalid / 2 missing; problems go to stderr.  A path
-    that is (or holds only) a ``regress_verdict.json``, or whose document
-    carries the verdict schema, is validated as a verdict instead."""
+    that is (or holds only) a ``regress_verdict.json`` /
+    ``whatif_report.json``, or whose document carries one of their
+    schemas, is validated as that document instead."""
     if os.path.isdir(path):
         mpath = os.path.join(path, MANIFEST_NAME)
-        if not os.path.isfile(mpath) and os.path.isfile(
-                os.path.join(path, "regress_verdict.json")):
-            mpath = os.path.join(path, "regress_verdict.json")
+        if not os.path.isfile(mpath):
+            for alt in ("regress_verdict.json", "whatif_report.json"):
+                if os.path.isfile(os.path.join(path, alt)):
+                    mpath = os.path.join(path, alt)
+                    break
         path = mpath
     try:
         with open(path) as f:
@@ -382,6 +506,14 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     except ValueError as e:
         print(f"manifest_check: {path} is not JSON: {e}", file=sys.stderr)
         return 1
+    if isinstance(doc, dict) and doc.get("schema") == _WHATIF_SCHEMA:
+        probs = validate_whatif(doc, require_healthy=require_healthy)
+        for p in probs:
+            print(f"manifest_check: whatif: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; identity gate: "
+                  f"{(doc.get('calibration') or {}).get('verdict')})")
+        return 1 if probs else 0
     if isinstance(doc, dict) and doc.get("schema") == _VERDICT_SCHEMA:
         probs = validate_verdict(doc, require_passing=require_healthy)
         for p in probs:

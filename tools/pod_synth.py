@@ -66,10 +66,23 @@ steps = []
 for dev in range(N_DEV):
     t0 = 0.0
     for s in range(50):
+        # event carries the step number, like the XPlane ingest's
+        # StepMarker rows — the whatif model keys steps on it.
         steps.append({"timestamp": t0, "duration": 0.048, "deviceId": dev,
-                      "name": f"step {s}", "device_kind": "tpu"})
+                      "event": float(s), "name": f"step {s}",
+                      "device_kind": "tpu"})
         t0 += 0.05
 write_csv(make_frame(steps), OUT + "tpusteps.csv")
+
+# Plane-stats attainable peaks, as the xplane ingest would record them:
+# feeds roofline_profile and sol_roofline (whose headroom table the
+# `sofa whatif` scale:*=sol scenario consumes).
+import json  # noqa: E402
+
+with open(OUT + "tpu_meta.json", "w") as f:
+    json.dump({str(dev): {"peak_teraflops_per_second": 275.0,
+                          "peak_hbm_bw_gigabytes_per_second": 1200.0}
+               for dev in range(N_DEV)}, f)
 
 util = []
 for dev in range(N_DEV):
