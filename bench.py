@@ -516,6 +516,31 @@ def _lint_evidence() -> dict:
         return {"lint_error": f"{type(e).__name__}: {e}"[:160]}
 
 
+def _artifact_evidence() -> dict:
+    """Artifact-lifecycle closure riding the evidence extras: build the
+    `sofa artifacts` inventory (sofa_tpu/artifacts.py) and report
+    ``artifact_inventory_ok`` + ``artifact_count``, so a bench round
+    whose code leaked an unregistered artifact past `sofa clean` or
+    blind-sided fsck is visibly unhealthy.  Needs no device; shares the
+    SOFA_BENCH_LINT=0 opt-out with the lint gate (same static-analysis
+    family)."""
+    if os.environ.get("SOFA_BENCH_LINT", "1") != "1":
+        return {}
+    _state["phase"] = "artifact-inventory evidence"
+    try:
+        from sofa_tpu.artifacts import build_inventory
+
+        doc = build_inventory()
+        ok = bool(doc.get("ok"))
+        _log(f"bench: artifact inventory {'OK' if ok else 'FAILED'} "
+             f"({doc['counts']['artifacts']} artifacts, "
+             f"{doc['counts']['violations']} violations)")
+        return {"artifact_inventory_ok": ok,
+                "artifact_count": int(doc["counts"]["artifacts"])}
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        return {"artifact_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 # Metrics whose trajectory the archive catalog tracks round over round
 # (the headline plus the device-free report-path numbers, so dead-tunnel
 # rounds still extend the trajectory).
@@ -816,6 +841,7 @@ def main() -> int:
         # keeps this round's trajectory non-null even with a dead tunnel.
         extra.update(_preprocess_wall_evidence())
         extra.update(_lint_evidence())
+        extra.update(_artifact_evidence())
         # Dead-tunnel rounds still extend the archived trajectory: the
         # report-path metrics need no device, and the rolling verdict
         # proves the round against the catalog's history.
@@ -907,6 +933,7 @@ def main() -> int:
     # evidence run must still find the real result above).
     pre = _preprocess_wall_evidence()
     pre.update(_lint_evidence())
+    pre.update(_artifact_evidence())
     pre.update(_archive_evidence(round(overhead, 3), {**extra, **pre}))
     if pre:
         _emit(round(overhead, 3), p_value=p_value, extra={**extra, **pre})

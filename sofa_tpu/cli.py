@@ -18,6 +18,12 @@ CLI (/root/reference/bin/sofa:328-376):
   lint              AST invariant checker for sofa_tpu's own contracts
                     (sofa_tpu/lint/, docs/STATIC_ANALYSIS.md); exits 1 on
                     findings not grandfathered in lint_baseline.json
+  artifacts         artifact-lifecycle inventory (sofa_tpu/artifacts.py):
+                    every artifact -> writers/readers/clean/digest/fsck/
+                    manifest_check coverage from the statically-extracted
+                    flow graph SL014-SL018 enforce; optional logdir audit;
+                    --json emits schema sofa_tpu/artifact_inventory
+                    (exit 2 on closure violations)
   passes            render the analysis-pass registry (sofa_tpu/analysis/
                     registry.py): the resolved dependency DAG, each pass's
                     declared contract, and — when logdir holds a manifest —
@@ -75,13 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", choices=[
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
-        "resume", "fsck", "archive", "regress", "whatif",
+        "resume", "fsck", "archive", "regress", "whatif", "artifacts",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
-                        "(status/resume/fsck/passes/whatif); path to lint "
-                        "(lint); logdir or ls/show/gc (archive); run "
-                        "(regress)")
+                        "(status/resume/fsck/passes/whatif/artifacts); "
+                        "path to lint (lint); logdir or ls/show/gc "
+                        "(archive); run (regress)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
                         "the baseline run for `regress`")
@@ -258,6 +264,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="setup: skip the bounded device-backend health "
                         "probe (host-only checks)")
 
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=False,
+                   help="artifacts: machine-readable inventory on stdout "
+                        "(schema sofa_tpu/artifact_inventory, validated "
+                        "by tools/manifest_check.py)")
     p.add_argument("--plugin", action="append", dest="plugins",
                    help="module[:func] called with the config at startup")
     return p
@@ -451,28 +462,9 @@ def _run(argv=None) -> int:
             if not (cfg.base_logdir and cfg.match_logdir):
                 print_error("diff needs --base_logdir and --match_logdir")
                 return 2
-            import copy
-            from sofa_tpu.analysis.features import Features
-            from sofa_tpu.ml.diff import (
-                sofa_mem_diff,
-                sofa_swarm_diff,
-                sofa_tpu_diff,
-            )
-            from sofa_tpu.ml.hsg import sofa_hsg
-            from sofa_tpu.preprocess import sofa_preprocess
+            from sofa_tpu.ml.diff import sofa_diff
             print_main_progress("SOFA diff")
-            for d in (cfg.base_logdir, cfg.match_logdir):
-                c = copy.deepcopy(cfg)
-                c.logdir = d
-                c.__post_init__()
-                frames = sofa_preprocess(c)
-                sofa_hsg(frames, c, Features())  # writes auto_caption.csv
-            sofa_swarm_diff(cfg)
-            sofa_tpu_diff(cfg)
-            sofa_mem_diff(cfg)
-            from sofa_tpu.analyze import stage_board
-            stage_board(cfg)  # `sofa viz --logdir <diff dir>` -> Diff page
-            return 0
+            return sofa_diff(cfg)
         if cmd == "viz":
             from sofa_tpu.viz import sofa_viz
             print_main_progress("SOFA viz")
@@ -514,6 +506,12 @@ def _run(argv=None) -> int:
             # lint is config-free: the positional argument is a path, and
             # the nested parser owns the exit-code contract (0/1/2).
             return run_lint([args.usr_command] if args.usr_command else [])
+        if cmd == "artifacts":
+            from sofa_tpu.artifacts import sofa_artifacts
+            # config-free like lint: the positional is an optional logdir
+            # to audit against the extracted graph.
+            return sofa_artifacts(logdir=args.usr_command or None,
+                                  as_json=args.as_json)
         if cmd == "clean":
             from sofa_tpu.record import sofa_clean
             sofa_clean(cfg)

@@ -234,7 +234,7 @@ def logdir_raw_key(logdir: str) -> str:
     like the ingest cache's per-source keys, aggregated over the logdir.
     A committed preprocess whose key no longer matches has stale outputs
     and must replay."""
-    from sofa_tpu.record import RAW_FILES
+    from sofa_tpu.trace import RAW_FILES
 
     sigs: List[tuple] = []
     for name in RAW_FILES:
@@ -263,19 +263,13 @@ def logdir_raw_key(logdir: str) -> str:
 # Digests.
 # ---------------------------------------------------------------------------
 
-# Never digested: the ledgers themselves (they change on every write,
-# including fsck's own), the journal, live sentinels, and scratch dirs.
-_DIGEST_SKIP_FILES = frozenset({
-    DIGESTS_NAME, JOURNAL_NAME, "run_manifest.json", "sofa_self_trace.json",
-    "_derived.writing", "docker.cid",
-    # regenerated at will by `sofa regress` / `sofa whatif` without a
-    # pipeline digest refresh — digesting them would turn every re-run
-    # into fsck damage
-    "regress_verdict.json", "whatif_report.json",
-})
-_DIGEST_SKIP_DIRS = frozenset({
-    "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
-})
+# The skip-list lives in trace.py's artifact lifecycle registry (one
+# source of truth beside DERIVED_FILES/DIRS; sofa-lint SL015 verifies its
+# closure).  Local aliases keep this module's call sites readable.
+from sofa_tpu.trace import (  # noqa: E402 — registry import, no heavy deps beyond what this module already pulls
+    DIGEST_SKIP_DIRS as _DIGEST_SKIP_DIRS,
+    DIGEST_SKIP_FILES as _DIGEST_SKIP_FILES,
+)
 
 
 def _sha256(path: str) -> Optional[str]:
@@ -318,7 +312,7 @@ def _digest_targets(logdir: str) -> List[str]:
 
 
 def _file_kind(rel: str) -> str:
-    from sofa_tpu.record import RAW_FILES
+    from sofa_tpu.trace import RAW_FILES
 
     if rel in RAW_FILES or rel.startswith("xprof/"):
         return "raw"

@@ -98,6 +98,11 @@ def _run(args: argparse.Namespace) -> int:
     else:
         baseline = Baseline.load(baseline_path)
         new, old = baseline.split(fingerprinted)
+    # Deterministic report order — (rule, file, line) in BOTH output
+    # modes, so CI diffs of findings are stable across runs and sort
+    # tweaks in the engine can never churn a committed report.
+    new = sorted(new, key=lambda f: (f.rule_id, f.file, f.line,
+                                     f.message))
 
     if args.as_json:
         print(json.dumps({

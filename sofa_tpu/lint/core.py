@@ -90,6 +90,10 @@ class ProjectContext:
     #: AMBIENT_FEATURES from analysis/registry.py — features the analyze
     #: driver provides without a producing pass.
     ambient_features: tuple = ()
+    #: The artifact-lifecycle flow graph (lint/artifact_rules.py) —
+    #: None/inactive unless the linted set carries a registry-bearing
+    #: trace.py, so fixtures and single-file lints skip SL014–SL018.
+    artifacts: object = None
 
     @classmethod
     def detect(cls, files: Sequence[str],
@@ -127,8 +131,12 @@ class ProjectContext:
             ambient = _ambient_from_registry(cand)
             if ambient:
                 break
+        from sofa_tpu.lint.artifact_rules import build_artifact_graph
+
+        artifacts = build_artifact_graph(files, base=base,
+                                         passes=tuple(passes))
         return cls(columns=columns, passes=tuple(passes),
-                   ambient_features=ambient)
+                   ambient_features=ambient, artifacts=artifacts)
 
 
 def _columns_from_trace(path: str) -> List[str]:

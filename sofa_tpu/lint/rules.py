@@ -433,6 +433,9 @@ class NonAtomicDerivedWrite(Rule):
             "stream writers)")
 
 
+from sofa_tpu.lint.artifact_rules import (  # noqa: E402 — SL014-SL018:
+    ARTIFACT_RULES,                     # artifact-lifecycle flow analysis
+)
 from sofa_tpu.lint.pass_rules import (  # noqa: E402 — SL010-SL013 live in
     PASS_RULES,                         # their own module; one rule set
 )
@@ -447,7 +450,7 @@ ALL_RULES = (
     RawArtifactBypass,
     DirectKill,
     NonAtomicDerivedWrite,
-) + PASS_RULES
+) + PASS_RULES + ARTIFACT_RULES
 
 
 def default_rules() -> List[Rule]:

@@ -233,3 +233,40 @@ def sofa_swarm_diff(cfg) -> Optional[pd.DataFrame]:
         f"diff: matched {len(matched)}/{len(table)} swarms; wrote {out_path}"
     )
     return table
+
+
+def sofa_diff(cfg) -> int:
+    """``sofa diff --base_logdir A --match_logdir B`` — the verb driver.
+
+    Preprocess + swarm-cluster both sides, write the three diff tables
+    (swarm/tpu/mem) plus the board staging, then refresh every touched
+    logdir's digest ledger: the diff REWRITES artifacts (auto_caption.csv,
+    the diff tables) inside logdirs whose ledgers an earlier pipeline run
+    may have sealed — without the refresh the next `sofa fsck` would read
+    this verb's own output as corruption (the blind spot sofa-lint SL015
+    guards).
+    """
+    import copy
+
+    from sofa_tpu import durability
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.ml.hsg import sofa_hsg
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    for d in (cfg.base_logdir, cfg.match_logdir):
+        c = copy.deepcopy(cfg)
+        c.logdir = d
+        c.__post_init__()
+        frames = sofa_preprocess(c)
+        sofa_hsg(frames, c, Features())  # writes auto_caption.csv
+    sofa_swarm_diff(cfg)
+    sofa_tpu_diff(cfg)
+    sofa_mem_diff(cfg)
+    from sofa_tpu.analyze import stage_board
+
+    stage_board(cfg)  # `sofa viz --logdir <diff dir>` -> Diff page
+    for d in {os.path.normpath(p)
+              for p in (cfg.logdir, cfg.base_logdir, cfg.match_logdir)}:
+        if os.path.isdir(d):
+            durability.write_digests(d)
+    return 0

@@ -39,36 +39,15 @@ from sofa_tpu.printing import (
     print_warning,
 )
 
-# Raw collector outputs (kept by `sofa clean`).
-RAW_FILES = [
-    "sofa_time.txt", "timebase.txt", "misc.txt", "mpstat.txt", "diskstat.txt",
-    "netstat.txt", "cpuinfo.txt", "vmstat.txt", "perf.data", "time.txt",
-    "strace.txt", "pystacks.txt", "sofa.pcap", "blktrace.txt", "kallsyms",
-    "tpu_topo.json", "xprof_marker.txt", "sofa.err", "tpumon.txt",
-    "memprof.pb.gz", "memprof.pb.gz.meta.json", "platform_restore.txt",
-]
-
-# Derived files (removed by `sofa clean`).
-DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".css", ".json.gz",
-                    ".pdf", ".png", ".folded")
-DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
-                 "hints.txt", "tpu_meta.json",
-                 # self-telemetry artifacts (sofa_tpu/telemetry.py): removed
-                 # by `sofa clean`, and _clean_stale wipes them at record
-                 # start so manifests never mix across runs.
-                 "run_manifest.json", "sofa_self_trace.json",
-                 # mid-write sentinel (trace.derived_write_guard) — a
-                 # crashed writer may leave it behind
-                 "_derived.writing",
-                 # durability layer (sofa_tpu/durability.py): crash journal
-                 # + sha256 integrity ledger sidecar
-                 "_journal.jsonl", "_digests.json",
-                 # `sofa regress` verdict (sofa_tpu/archive/verdict.py)
-                 "regress_verdict.json",
-                 # `sofa whatif` prediction report (sofa_tpu/whatif/)
-                 "whatif_report.json"]
-DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
-                "_tiles"]
+# The artifact lifecycle registry moved to trace.py (one source of truth
+# for clean/digest/fsck/lint — PR 10); re-exported here because record is
+# the historical home every consumer imported them from.
+from sofa_tpu.trace import (  # noqa: F401  (re-export)
+    DERIVED_DIRS,
+    DERIVED_FILES,
+    DERIVED_SUFFIXES,
+    RAW_FILES,
+)
 
 
 def build_collectors(cfg):

@@ -507,6 +507,74 @@ def write_report_js_doc(doc: dict, path: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Artifact lifecycle registry — THE source of truth for what lives in a
+# logdir.  Every consumer of "what is a derived artifact" reads these
+# five tables (record._clean_stale, `sofa clean`, the digest ledger +
+# `sofa fsck` in durability.py, `sofa artifacts`), and sofa-lint rules
+# SL014/SL015 statically verify the writers in the tree agree with them:
+# an artifact written but absent here leaks past `sofa clean`; a
+# skip-list entry naming nothing registered is a typo'd fsck blind spot.
+# Keep docs/OBSERVABILITY.md's inventory section in sync.
+# ---------------------------------------------------------------------------
+
+# Raw collector outputs (kept by `sofa clean`; digested as kind "raw").
+RAW_FILES = [
+    "sofa_time.txt", "timebase.txt", "misc.txt", "mpstat.txt", "diskstat.txt",
+    "netstat.txt", "cpuinfo.txt", "vmstat.txt", "perf.data", "time.txt",
+    "strace.txt", "pystacks.txt", "sofa.pcap", "blktrace.txt", "kallsyms",
+    "tpu_topo.json", "xprof_marker.txt", "sofa.err", "tpumon.txt",
+    "memprof.pb.gz", "memprof.pb.gz.meta.json", "platform_restore.txt",
+]
+
+# Derived files (removed by `sofa clean`).  Anything not in RAW_FILES
+# whose name ends with a DERIVED_SUFFIXES suffix is also swept — frame
+# CSVs, analysis tables, and exports register by suffix, not by name.
+DERIVED_SUFFIXES = (".csv", ".parquet", ".js", ".html", ".css", ".json.gz",
+                    ".pdf", ".png", ".folded")
+DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
+                 "hints.txt", "tpu_meta.json",
+                 # `perf script` conversion output the cputrace ingest
+                 # regenerates from perf.data — found leaking past clean
+                 # by the first `sofa artifacts` logdir audit
+                 "perf.script",
+                 # self-telemetry artifacts (sofa_tpu/telemetry.py): removed
+                 # by `sofa clean`, and _clean_stale wipes them at record
+                 # start so manifests never mix across runs.
+                 "run_manifest.json", "sofa_self_trace.json",
+                 # mid-write sentinel (derived_write_guard below) — a
+                 # crashed writer may leave it behind
+                 "_derived.writing",
+                 # durability layer (sofa_tpu/durability.py): crash journal
+                 # + sha256 integrity ledger sidecar
+                 "_journal.jsonl", "_digests.json",
+                 # container-id breadcrumb docker publishes for record's
+                 # process scoping — scratch, not evidence
+                 "docker.cid",
+                 # `sofa regress` verdict (sofa_tpu/archive/verdict.py)
+                 "regress_verdict.json",
+                 # `sofa whatif` prediction report (sofa_tpu/whatif/)
+                 "whatif_report.json"]
+DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
+                "_tiles"]
+
+# Never digested (the fsck ledger's skip-list): the ledgers themselves —
+# they change on every write, including fsck's own — live sentinels, and
+# artifacts regenerated at will by verbs that do not refresh digests
+# (digesting those would turn every re-run into fsck damage).  SL015
+# verifies every entry still names a registered artifact.
+DIGEST_SKIP_FILES = frozenset({
+    "_digests.json", "_journal.jsonl", "run_manifest.json",
+    "sofa_self_trace.json", "_derived.writing", "docker.cid",
+    # regenerated at will by `sofa regress` / `sofa whatif` without a
+    # pipeline digest refresh
+    "regress_verdict.json", "whatif_report.json",
+})
+DIGEST_SKIP_DIRS = frozenset({
+    "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
+})
+
+
+# ---------------------------------------------------------------------------
 # Derived-artifact write guard — the shared mid-write degradation path.
 #
 # Frame CSVs are streamed (not atomic) and the tile pyramid lands file by

@@ -22,6 +22,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sofa_tpu.workloads.compat import shard_map
+
 
 def _bus_factor(kind: str, n: int) -> float:
     """Bytes actually crossing links per byte of input, per nccl-tests math."""
@@ -69,7 +71,7 @@ def _make_op(kind: str, axis: str, mesh: Mesh):
     # manual-axes inference can't prove it; the replication is real, so the
     # static check is safely disabled for that op only.
     kwargs = {"check_vma": False} if kind == "all_gather" else {}
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axis, None),),
+    fn = shard_map(body, mesh=mesh, in_specs=(P(axis, None),),
                        out_specs=out_spec, **kwargs)
     return jax.jit(fn)
 

@@ -1,0 +1,56 @@
+"""JAX API compatibility shims shared by the workload modules.
+
+``shard_map`` has moved twice across the JAX releases this repo meets in
+the wild: modern releases export it as ``jax.shard_map``, while the
+0.4.x line only ships ``jax.experimental.shard_map.shard_map`` (and on
+some versions the top-level name exists merely as a deprecation stub
+that *raises* on access).  Every workload imports the symbol from here
+so the probe happens exactly once, at import time, instead of five
+copies of the try/except drifting apart.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, **kwargs):
+        """The modern ``jax.shard_map`` signature on the experimental
+        implementation: the varying-manual-axes check was renamed
+        ``check_rep`` -> ``check_vma``; callers write the modern
+        spelling and this adapter translates for old releases."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _exp_shard_map(f, **kwargs)
+
+try:
+    pcast = jax.lax.pcast
+except AttributeError:
+    from jax.experimental.shard_map import pbroadcast as _rep_pbroadcast
+
+    def pcast(x, axes, to):
+        """Modern ``lax.pcast`` on old releases: the only direction the
+        workloads use is replicated -> varying, which the check_rep era
+        spelled ``shard_map.pbroadcast`` (the explicit cast its rep
+        check asks for in its error messages — NOT lax.pbroadcast, the
+        from-source collective)."""
+        if to != "varying":
+            raise NotImplementedError(
+                f"pcast(to={to!r}) has no pre-jax.shard_map equivalent")
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        return _rep_pbroadcast(x, axes)
+
+def tpu_compiler_params(**kwargs):
+    """``pallas.tpu.CompilerParams`` across its rename: old releases
+    ship the same dataclass as ``TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "pcast", "tpu_compiler_params"]

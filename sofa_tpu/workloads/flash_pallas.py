@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sofa_tpu.workloads.compat import tpu_compiler_params
 from sofa_tpu.workloads.ring_attention import NEG_INF
 
 
@@ -295,7 +296,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=cost,
         name="sofa_flash_fwd",
@@ -633,7 +634,7 @@ def _flash_backward(q, k, v, g, out, lse,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=int(8 * b * h * t * tk * d * frac),
@@ -679,7 +680,7 @@ def _flash_backward(q, k, v, g, out, lse,
         ],
         out_shape=[jax.ShapeDtypeStruct((bh, d, t), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=int(6 * b * h * t * tk * d * frac),

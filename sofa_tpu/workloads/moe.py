@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sofa_tpu.workloads.compat import shard_map
 from sofa_tpu.workloads.ring_attention import plain_causal_attention
 from sofa_tpu.workloads.transformer import _rmsnorm
 
@@ -269,7 +270,7 @@ def forward(params, tokens, cfg: MoEConfig,
                 # replicated scalar.
                 return out, lax.pmean(aux, "data")
 
-            out, aux = jax.shard_map(
+            out, aux = shard_map(
                 fn, mesh=mesh,
                 in_specs=(spec_x, spec_w, spec_w),
                 out_specs=(spec_x, P()))(flat, w_up, w_down)

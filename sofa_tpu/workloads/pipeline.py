@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sofa_tpu.workloads.compat import pcast, shard_map
 from sofa_tpu.workloads.ring_attention import plain_causal_attention
 from sofa_tpu.workloads.transformer import _rmsnorm, _rope
 
@@ -161,7 +162,7 @@ def pipeline_loss(params, tokens, cfg: PipelineConfig, mesh: Mesh,
         # type they leave with: {V:(data,stage)} — tokens vary over data,
         # the per-stage layer params add stage.  pcast the zero carries up
         # front (a bare jnp.zeros is fully invariant and fails the check).
-        out0 = lax.pcast(injected * 0.0, (stage_axis,),
+        out0 = pcast(injected * 0.0, (stage_axis,),
                          to="varying")                 # [M, mb_b, T, D]
         carry0 = out0[0]
         fwd_perm = [(i, (i + 1) % s_count) for i in range(s_count)]
@@ -195,7 +196,7 @@ def pipeline_loss(params, tokens, cfg: PipelineConfig, mesh: Mesh,
         local = jnp.where(sid == s_count - 1, jnp.mean(logz - gold), 0.0)
         return lax.pmean(lax.psum(local, stage_axis), data_axis)
 
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs()["layers"], P(None, None), P(None),
                   P(None, None), P(data_axis, None)),

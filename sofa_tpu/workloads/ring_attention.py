@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sofa_tpu.workloads.compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -111,7 +113,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     spec = P(batch_axis, seq_axis, head_axis, None)
     fn = functools.partial(ring_attention_local, axis_name=seq_axis,
                            causal=causal)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
 
 

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sofa_tpu.workloads.compat import shard_map
+
 from sofa_tpu.workloads.flash_pallas import (
     _flash_backward,
     _flash_forward,
@@ -323,5 +325,5 @@ def _mapped(local_fn, q, k, v, mesh, seq_axis, batch_axis, head_axis):
     # check_vma=False: pallas_call's out_shape carries no varying-manual-axes
     # type, which the VMA checker (rightly) rejects; the kernel output is
     # per-shard by construction here.
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,491 @@
+"""Artifact-lifecycle flow analysis: SL014–SL018 fixtures, the seeded
+registry mutation, the shipped-tree closure gate, and the `sofa
+artifacts` inventory verb (schema, exit codes, logdir audit).
+
+Fixture trees opt into companions per rule: a registry-bearing trace.py
+activates the graph; tools/manifest_check.py enables SL016 + the SL018
+validator leg; board/ enables SL017; docs/OBSERVABILITY.md enables the
+SL018 docs leg.  Absent companions keep those rules inert, mirroring how
+a single-file `sofa lint` run behaves.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from sofa_tpu.lint.core import ProjectContext, lint_paths
+from sofa_tpu.lint.rules import default_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+ARTIFACT_IDS = ("SL014", "SL015", "SL016", "SL017", "SL018")
+
+REGISTRY = """
+    RAW_FILES = ["raw.txt"]
+    DERIVED_SUFFIXES = (".csv",)
+    DERIVED_FILES = ["good.json", "dead.json"]
+    DERIVED_DIRS = ["_scratch"]
+    DIGEST_SKIP_FILES = frozenset({"good.json"})
+    DIGEST_SKIP_DIRS = frozenset({"_scratch"})
+"""
+
+
+def run_artifact_rules(tmp_path, files, extra_paths=()):
+    """Write {relname: src} under tmp_path/pkg (registry tree), lint the
+    .py files, return only the artifact-rule findings."""
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        if rel.endswith(".py"):
+            paths.append(str(p))
+    paths.extend(str(tmp_path / rel) for rel in extra_paths)
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    return [f for f in fs if f.rule_id in ARTIFACT_IDS]
+
+
+# --- SL014 ------------------------------------------------------------------
+
+def test_sl014_flags_unregistered_writer(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/m.py": """
+            from sofa_tpu.durability import atomic_write
+            def w(logdir):
+                with atomic_write("leak.bin") as f:
+                    f.write("x")
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL014"]
+    assert fs[0].file.endswith("pkg/m.py") and "leak.bin" in fs[0].message
+
+
+def test_sl014_ok_registered_suffix_dir_and_raw(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/m.py": """
+            import os
+            from sofa_tpu.durability import atomic_write, fsync_append
+            def w(logdir):
+                with atomic_write("good.json") as f:      # registered
+                    f.write("x")
+                with atomic_write("table.csv") as f:      # suffix
+                    f.write("x")
+                with atomic_write(os.path.join("_scratch", "x.bin")) as f:
+                    f.write("x")                          # registered dir
+                fsync_append("raw.txt", "line")           # raw file
+            def r():
+                open("good.json").read()
+                open("dead.json").read()
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+def test_sl014_resolves_constants_and_scope_assigns(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/m.py": """
+            import os
+            from sofa_tpu.durability import atomic_write
+            NAME = "leak2.bin"
+            def w(logdir):
+                path = os.path.join(logdir, NAME)
+                with atomic_write(path) as f:
+                    f.write("x")
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL014"]
+    assert "leak2.bin" in fs[0].message
+
+
+# --- SL015 ------------------------------------------------------------------
+
+def test_sl015_flags_unregistered_skip_entry_and_dir(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": """
+            RAW_FILES = []
+            DERIVED_SUFFIXES = (".csv",)
+            DERIVED_FILES = ["good.json"]
+            DERIVED_DIRS = []
+            DIGEST_SKIP_FILES = frozenset({"typo.json"})
+            DIGEST_SKIP_DIRS = frozenset({"_ghost"})
+        """,
+    })
+    ids = sorted(f.rule_id for f in fs)
+    assert ids == ["SL015", "SL015"]
+    msgs = " ".join(f.message for f in fs)
+    assert "typo.json" in msgs and "_ghost" in msgs
+    assert all(f.file.endswith("trace.py") for f in fs)
+
+
+def test_sl015_flags_digestless_verb_writer(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/cli.py": """
+            from pkg.verb import sofa_thing
+        """,
+        "pkg/verb.py": """
+            from sofa_tpu.durability import atomic_write
+            def sofa_thing(cfg):
+                with atomic_write("thing.csv") as f:
+                    f.write("x")
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL015"]
+    assert fs[0].file.endswith("verb.py") and "thing.csv" in fs[0].message
+
+
+def test_sl015_ok_when_skip_listed_or_digests_refreshed(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/cli.py": """
+            from pkg.verb import sofa_thing
+            from pkg.verb2 import sofa_other
+        """,
+        "pkg/verb.py": """
+            from sofa_tpu.durability import atomic_write
+            def sofa_thing(cfg):
+                with atomic_write("good.json") as f:    # skip-listed
+                    f.write("x")
+        """,
+        "pkg/verb2.py": """
+            from sofa_tpu.durability import atomic_write, write_digests
+            def sofa_other(cfg):
+                with atomic_write("other.csv") as f:
+                    f.write("x")
+                write_digests(cfg.logdir)               # refreshes
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+# --- SL016 ------------------------------------------------------------------
+
+MANIFEST_CHECK_FIXTURE = """
+    def validate_manifest(doc):
+        probs = []
+        bar = (doc.get("meta") or {}).get("bar")
+        if bar is None:
+            probs.append("meta.bar missing")
+        return probs
+"""
+
+
+def test_sl016_both_directions(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/t.py": """
+            def write(tel):
+                tel.set_meta(foo={"x": 1})
+        """,
+        "tools/manifest_check.py": MANIFEST_CHECK_FIXTURE,
+    })
+    ids = sorted(f.rule_id for f in fs)
+    assert ids == ["SL016", "SL016"]
+    by_msg = {f.message.split()[2]: f for f in fs}
+    assert "meta.foo" in str({f.message for f in fs})
+    assert "meta.bar" in str({f.message for f in fs})
+    unval = next(f for f in fs if "meta.foo" in f.message)
+    assert unval.file.endswith("pkg/t.py")
+    unwritten = next(f for f in fs if "meta.bar" in f.message)
+    assert unwritten.file.endswith("tools/manifest_check.py")
+
+
+def test_sl016_inert_without_manifest_check(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/t.py": """
+            def write(tel):
+                tel.set_meta(foo={"x": 1})
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+# --- SL017 ------------------------------------------------------------------
+
+def test_sl017_endpoint_without_producer_and_dead_artifact(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/m.py": """
+            def r():
+                open("good.json").read()
+        """,
+        "pkg/board/page.html": """
+            <script>fetch("ghost.csv");</script>
+        """,
+    })
+    ids = sorted(f.rule_id for f in fs)
+    assert ids == ["SL017", "SL017"]
+    ghost = next(f for f in fs if "ghost.csv" in f.message)
+    assert ghost.severity == "error" and ghost.file.endswith("page.html")
+    dead = next(f for f in fs if "dead.json" in f.message)
+    assert dead.severity == "warn" and dead.file.endswith("trace.py")
+
+
+def test_sl017_ok_with_producer_route_and_readers(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/viz.py": """
+            ROUTE = "/tiles/"
+        """,
+        "pkg/m.py": """
+            from sofa_tpu.durability import atomic_write
+            def w():
+                with atomic_write("series.csv") as f:
+                    f.write("x")
+            def r():
+                open("good.json").read()
+                open("dead.json").read()
+        """,
+        "pkg/board/page.html": """
+            <script>
+            fetch("series.csv"); fetch("good.json");
+            fetch("tiles/s/0/0.json.gz"); fetch("raw.txt");
+            </script>
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+# --- SL018 ------------------------------------------------------------------
+
+def test_sl018_writer_validator_and_docs_agreement(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/w.py": """
+            FOO_SCHEMA = "sofa_tpu/foo"
+            FOO_VERSION = 2
+        """,
+        "tools/manifest_check.py": """
+            _FOO_SCHEMA = "sofa_tpu/foo"
+            _FOO_VERSION = 1
+        """,
+        "docs/OBSERVABILITY.md": """
+            | schema id | version | writer | validator |
+            |---|---|---|---|
+            | `sofa_tpu/foo` | 3 | w.py | manifest_check |
+        """,
+    })
+    msgs = sorted(f.message for f in fs if f.rule_id == "SL018")
+    assert len(msgs) == 2
+    assert any("manifest_check pins v1" in m for m in msgs)
+    assert any("says v3" in m for m in msgs)
+
+
+def test_sl018_missing_docs_row_and_stale_validator(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/w.py": """
+            FOO_SCHEMA = "sofa_tpu/foo"
+            FOO_VERSION = 1
+        """,
+        "tools/manifest_check.py": """
+            _GONE_SCHEMA = "sofa_tpu/gone"
+            _GONE_VERSION = 1
+        """,
+        "docs/OBSERVABILITY.md": """
+            nothing tabled here
+        """,
+    })
+    msgs = [f.message for f in fs if f.rule_id == "SL018"]
+    assert any("no row in docs/OBSERVABILITY.md" in m for m in msgs)
+    assert any("stale validator" in m for m in msgs)
+
+
+def test_sl018_clean_when_all_three_agree(tmp_path):
+    fs = run_artifact_rules(tmp_path, {
+        "pkg/trace.py": REGISTRY,
+        "pkg/w.py": """
+            FOO_SCHEMA = "sofa_tpu/foo"
+            FOO_VERSION = 2
+        """,
+        "tools/manifest_check.py": """
+            _FOO_SCHEMA = "sofa_tpu/foo"
+            _FOO_VERSION = 2
+        """,
+        "docs/OBSERVABILITY.md": """
+            | `sofa_tpu/foo` | 2 | w.py | manifest_check |
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+# --- seeded mutation over the shipped tree ---------------------------------
+
+def test_dropping_registry_entry_fires_sl014(tmp_path):
+    """Acceptance: drop a DERIVED_FILES entry on a copy of the real
+    trace.py and the real telemetry.py's writer site surfaces as SL014."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    src = open(os.path.join(REPO, "sofa_tpu", "trace.py")).read()
+    assert '"run_manifest.json", "sofa_self_trace.json",' in src
+    (pkg / "trace.py").write_text(src.replace(
+        '"run_manifest.json", "sofa_self_trace.json",',
+        '"sofa_self_trace.json",'))
+    tel = open(os.path.join(REPO, "sofa_tpu", "telemetry.py")).read()
+    (pkg / "telemetry.py").write_text(tel)
+    paths = [str(pkg / "trace.py"), str(pkg / "telemetry.py")]
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    hits = [f for f in fs if f.rule_id == "SL014"
+            and "run_manifest.json" in f.message]
+    assert hits and hits[0].file.endswith("telemetry.py")
+
+
+# --- the shipped-tree closure gate -----------------------------------------
+
+def test_shipped_tree_has_zero_artifact_findings():
+    """Stronger than the baseline gate: the artifact rules must be fully
+    burned down on the shipped tree — no grandfathering."""
+    pkg = os.path.join(REPO, "sofa_tpu")
+    fs = lint_paths([pkg], default_rules(), base=REPO)
+    artifact = [f for f in fs if f.rule_id in ARTIFACT_IDS]
+    assert artifact == [], [f.render() for f in artifact]
+
+
+# --- the inventory verb -----------------------------------------------------
+
+def test_build_inventory_full_closure():
+    from sofa_tpu.artifacts import build_inventory
+
+    doc = build_inventory()
+    assert doc["ok"] is True
+    assert doc["counts"]["violations"] == 0
+    names = {r["name"] for r in doc["artifacts"]}
+    for expected in ("report.js", "features.csv", "run_manifest.json",
+                     "whatif_report.json", "regress_verdict.json",
+                     "sol_roofline.csv"):
+        assert expected in names
+    for r in doc["artifacts"]:
+        assert r["clean"] != "UNREGISTERED", r
+    # every registered derived artifact fully covered by digest policy
+    manifest = next(r for r in doc["artifacts"]
+                    if r["name"] == "run_manifest.json")
+    assert manifest["digest"] == "skip-list"
+    assert manifest["writers"]
+
+
+def test_inventory_schema_validates():
+    from sofa_tpu.artifacts import build_inventory
+    import manifest_check
+
+    doc = build_inventory()
+    assert manifest_check.validate_inventory(doc) == []
+    assert manifest_check.validate_inventory(
+        doc, require_healthy=True) == []
+    broken = dict(doc, version=99)
+    assert manifest_check.validate_inventory(broken)
+
+
+def test_inventory_detects_logdir_leak(tmp_path):
+    from sofa_tpu.artifacts import build_inventory, sofa_artifacts
+
+    logdir = tmp_path / "log"
+    logdir.mkdir()
+    (logdir / "report.js").write_text("sofa_traces = {};")
+    (logdir / "mpstat.txt").write_text("raw")
+    assert sofa_artifacts(str(logdir)) == 0
+    (logdir / "rogue.bin").write_text("leak me")
+    assert sofa_artifacts(str(logdir)) == 2
+    doc = build_inventory(str(logdir))
+    assert doc["logdir"]["unaccounted"] == ["rogue.bin"]
+    assert doc["ok"] is False
+
+
+def test_cli_artifacts_verb_json(capsys):
+    from sofa_tpu.cli import main
+
+    assert main(["artifacts", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "sofa_tpu/artifact_inventory"
+    assert doc["ok"] is True
+
+
+def test_manifest_check_dispatches_inventory_doc(tmp_path, capsys):
+    from sofa_tpu.artifacts import build_inventory
+    import manifest_check
+
+    path = tmp_path / "inv.json"
+    path.write_text(json.dumps(build_inventory()))
+    assert manifest_check.check_path(str(path)) == 0
+
+
+# --- deterministic output ordering -----------------------------------------
+
+def test_lint_output_sorted_by_rule_file_line(tmp_path, capsys):
+    from sofa_tpu.lint.cli import run_lint
+
+    (tmp_path / "b.py").write_text(
+        "import subprocess\nsubprocess.run(['a'])\n"
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+    (tmp_path / "a.py").write_text(
+        "import subprocess\nsubprocess.run(['a'])\n")
+    rc = run_lint([str(tmp_path), "--no-baseline", "--json",
+                   "--base", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    keys = [(f["rule"], f["file"], f["line"]) for f in doc["new"]]
+    assert keys == sorted(keys)
+    rc = run_lint([str(tmp_path), "--no-baseline", "--base",
+                   str(tmp_path)])
+    out = capsys.readouterr().out.splitlines()
+    rendered = [ln for ln in out if ": SL" in ln]
+    parsed = [(ln.split(" ")[1], ln.split(":")[0]) for ln in rendered]
+    assert parsed == sorted(parsed)
+
+
+# --- registry aliasing ------------------------------------------------------
+
+def test_record_reexports_trace_registry():
+    import sofa_tpu.record as record
+    import sofa_tpu.trace as trace
+
+    assert record.DERIVED_FILES is trace.DERIVED_FILES
+    assert record.RAW_FILES is trace.RAW_FILES
+    assert record.DERIVED_DIRS is trace.DERIVED_DIRS
+    assert "docker.cid" in trace.DERIVED_FILES
+
+
+def test_durability_skip_list_is_trace_registry():
+    from sofa_tpu import durability, trace
+
+    assert durability._DIGEST_SKIP_FILES is trace.DIGEST_SKIP_FILES
+    assert durability._DIGEST_SKIP_DIRS is trace.DIGEST_SKIP_DIRS
+
+
+# --- pod_synth e2e (slow) ---------------------------------------------------
+
+@pytest.mark.slow
+def test_pod_synth_inventory_e2e(tmp_path):
+    """Acceptance: `sofa artifacts --json <pod_synth logdir>` lists every
+    derived artifact with full coverage and exits 0."""
+    import subprocess
+
+    logdir = str(tmp_path / "pod")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pod_synth.py"),
+         "--raw", "--logdir", logdir],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "report", "--logdir", logdir],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "sofa_tpu", "artifacts", logdir, "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout[r.stdout.index("{"):])
+    assert doc["ok"] is True and doc["logdir"]["unaccounted"] == []
+    assert doc["logdir"]["files_checked"] > 10

@@ -33,8 +33,15 @@ from sofa_tpu.telemetry import (  # noqa: E402
 _KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress",
                 "whatif")
 _VERDICTS = ("regressed", "improved", "noise")
+# Version pins per schema id: sofa-lint SL018 verifies these literals
+# agree with the writers' *_VERSION constants and the schema registry
+# table in docs/OBSERVABILITY.md — bump all three together.
 _VERDICT_SCHEMA = "sofa_tpu/regress_verdict"
+_VERDICT_VERSION = 1
 _WHATIF_SCHEMA = "sofa_tpu/whatif_report"
+_WHATIF_VERSION = 1
+_INVENTORY_SCHEMA = "sofa_tpu/artifact_inventory"
+_INVENTORY_VERSION = 1
 _WHATIF_CALIBRATION = ("calibrated", "uncalibrated")
 _WHATIF_SCENARIO_STATUSES = ("parsed", "unknown")
 _WHATIF_ATTRIBUTION_STATUSES = ("applied", "no_match", "unknown")
@@ -216,6 +223,37 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
         elif not isinstance(fsck.get("problems"), dict):
             probs.append("meta.fsck.problems: missing verdict counts")
 
+    # meta.pool (preprocess's pool sizing) and meta.ingest_cache (the
+    # content-keyed cache's hit/miss ledger): small, but their rot is how
+    # a perf regression hides — jobs silently stuck at 1, a cache that
+    # never hits.
+    pool = (doc.get("meta") or {}).get("pool")
+    if pool is not None:
+        if not isinstance(pool, dict):
+            probs.append("meta.pool: not an object")
+        else:
+            for key in ("jobs", "cpu_count"):
+                v = pool.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    probs.append(f"meta.pool.{key}: missing or not a "
+                                 "positive int")
+    icache = (doc.get("meta") or {}).get("ingest_cache")
+    if icache is not None:
+        if not isinstance(icache, dict) or \
+                not isinstance(icache.get("enabled"), bool):
+            probs.append("meta.ingest_cache: not an object with a bool "
+                         "enabled")
+        else:
+            for key in ("hits", "misses"):
+                v = icache.get(key)
+                if not isinstance(v, list) or \
+                        any(not isinstance(s, str) for s in v):
+                    probs.append(f"meta.ingest_cache.{key}: not a list of "
+                                 "source names")
+            if not isinstance(icache.get("stored_bytes", {}), dict):
+                probs.append("meta.ingest_cache.stored_bytes: not an "
+                             "object")
+
     # meta.archive / meta.regress (written by the `sofa archive` /
     # `sofa regress` verbs, sofa_tpu/archive/): ingest summary + verdict
     # pointer must be sane when present.
@@ -363,8 +401,9 @@ def validate_verdict(doc, require_passing: bool = False) -> List[str]:
     if doc.get("schema") != _VERDICT_SCHEMA:
         probs.append(f"schema: expected {_VERDICT_SCHEMA!r}, "
                      f"got {doc.get('schema')!r}")
-    if not isinstance(doc.get("version"), int):
-        probs.append("version: missing or not an int")
+    if doc.get("version") != _VERDICT_VERSION:
+        probs.append(f"version: expected {_VERDICT_VERSION}, "
+                     f"got {doc.get('version')!r}")
     if not _is_num(doc.get("generated_unix")):
         probs.append("generated_unix: missing or not a number")
     if doc.get("verdict") not in _VERDICTS:
@@ -407,8 +446,9 @@ def validate_whatif(doc, require_healthy: bool = False) -> List[str]:
     if doc.get("schema") != _WHATIF_SCHEMA:
         probs.append(f"schema: expected {_WHATIF_SCHEMA!r}, "
                      f"got {doc.get('schema')!r}")
-    if not isinstance(doc.get("version"), int):
-        probs.append("version: missing or not an int")
+    if doc.get("version") != _WHATIF_VERSION:
+        probs.append(f"version: expected {_WHATIF_VERSION}, "
+                     f"got {doc.get('version')!r}")
     if not _is_num(doc.get("generated_unix")):
         probs.append("generated_unix: missing or not a number")
     calib = doc.get("calibration")
@@ -484,6 +524,61 @@ def validate_whatif(doc, require_healthy: bool = False) -> List[str]:
     return probs
 
 
+def validate_inventory(doc, require_healthy: bool = False) -> List[str]:
+    """Schema problems in a ``sofa artifacts --json`` document
+    (sofa_tpu/artifacts.py).  ``require_healthy`` additionally fails on
+    closure violations — the CI-gate mode bench.py rides."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["inventory is not a JSON object"]
+    if doc.get("schema") != _INVENTORY_SCHEMA:
+        probs.append(f"schema: expected {_INVENTORY_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _INVENTORY_VERSION:
+        probs.append(f"version: expected {_INVENTORY_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    if not isinstance(doc.get("ok"), bool):
+        probs.append("ok: missing or not a bool")
+    rows = doc.get("artifacts")
+    if not isinstance(rows, list) or not rows:
+        probs.append("artifacts: missing or empty")
+        rows = []
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict) or not isinstance(r.get("name"), str) \
+                or r.get("kind") not in ("raw", "derived") \
+                or not isinstance(r.get("clean"), str) \
+                or not isinstance(r.get("digest"), str) \
+                or not isinstance(r.get("read"), bool) \
+                or not isinstance(r.get("writers"), list):
+            probs.append(f"artifacts[{i}]: needs name, kind raw/derived, "
+                         "clean/digest coverage strings, a bool read, "
+                         "and a writers list")
+            break  # one line for a malformed table, not eighty
+    viol = doc.get("violations")
+    if not isinstance(viol, list):
+        probs.append("violations: not a list")
+        viol = []
+    counts = doc.get("counts")
+    if not isinstance(counts, dict) or not all(
+            isinstance(counts.get(k), int)
+            for k in ("artifacts", "writers", "violations")):
+        probs.append("counts: missing artifact/writer/violation counters")
+    audit = doc.get("logdir")
+    if audit is not None and (
+            not isinstance(audit, dict)
+            or not isinstance(audit.get("unaccounted"), list)):
+        probs.append("logdir: not an object with an unaccounted list")
+    if require_healthy:
+        if viol:
+            probs.append(f"gate: {len(viol)} closure violation(s)")
+        if audit and audit.get("unaccounted"):
+            probs.append("gate: on-disk files no registry accounts for: "
+                         + ", ".join(audit["unaccounted"][:8]))
+    return probs
+
+
 def check_path(path: str, require_healthy: bool = False) -> int:
     """0 valid / 1 invalid / 2 missing; problems go to stderr.  A path
     that is (or holds only) a ``regress_verdict.json`` /
@@ -506,6 +601,15 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     except ValueError as e:
         print(f"manifest_check: {path} is not JSON: {e}", file=sys.stderr)
         return 1
+    if isinstance(doc, dict) and doc.get("schema") == _INVENTORY_SCHEMA:
+        probs = validate_inventory(doc, require_healthy=require_healthy)
+        for p in probs:
+            print(f"manifest_check: inventory: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; "
+                  f"{(doc.get('counts') or {}).get('artifacts')} "
+                  f"artifact(s), ok={doc.get('ok')})")
+        return 1 if probs else 0
     if isinstance(doc, dict) and doc.get("schema") == _WHATIF_SCHEMA:
         probs = validate_whatif(doc, require_healthy=require_healthy)
         for p in probs:
