@@ -486,12 +486,38 @@ print(json.dumps(out))
         shutil.rmtree(os.path.dirname(logdir), ignore_errors=True)
 
 
+#: Rule-id prefix -> evidence family for the per-family finding counts
+#: (docs/STATIC_ANALYSIS.md's catalog sections).
+_LINT_FAMILIES = (
+    ("core", range(0, 10)),          # SL000–SL009: runtime contracts
+    ("passes", range(10, 14)),       # SL010–SL013: pass registry
+    ("artifacts", range(14, 19)),    # SL014–SL018: artifact lifecycle
+    ("concurrency", range(19, 24)),  # SL019–SL023: guards & ordering
+)
+
+
+def _lint_families(by_rule: dict) -> dict:
+    counts = {name: 0 for name, _r in _LINT_FAMILIES}
+    for rule, n in (by_rule or {}).items():
+        try:
+            num = int(rule[2:])
+        except (ValueError, IndexError):
+            continue
+        for name, rng in _LINT_FAMILIES:
+            if num in rng:
+                counts[name] += int(n)
+    return counts
+
+
 def _lint_evidence() -> dict:
     """Static-analysis gate riding the evidence extras: run sofa-lint over
-    the package and report ``lint_ok`` + the new-finding count, so a bench
-    round whose code silently broke a runtime contract (unbounded
-    subprocess, swallowed except) is visibly unhealthy even when its
-    numbers look fine.  Needs no device; opt out with SOFA_BENCH_LINT=0.
+    the package and report ``lint_ok`` + the new-finding count, the wall
+    time of the lint itself (the engine must stay cheap enough to run on
+    every commit), and per-rule-family finding counts — so a bench round
+    whose code silently broke a runtime contract (unbounded subprocess,
+    swallowed except, an unguarded shared write) is visibly unhealthy
+    even when its numbers look fine.  Needs no device; opt out with
+    SOFA_BENCH_LINT=0.  Emitted on success AND dead-tunnel paths.
     """
     import subprocess
 
@@ -500,18 +526,25 @@ def _lint_evidence() -> dict:
     _state["phase"] = "sofa-lint evidence"
     root = os.path.dirname(os.path.abspath(__file__))
     try:
+        t0 = time.monotonic()
         r = subprocess.run(
             [sys.executable, os.path.join(root, "tools", "sofa_lint.py"),
              os.path.join(root, "sofa_tpu"), "--json"],
             capture_output=True, text=True, timeout=120)
+        wall = round(time.monotonic() - t0, 3)
         if r.returncode == 2:
             return {"lint_error": (r.stderr.strip().splitlines()
-                                   or ["internal error"])[-1][:160]}
+                                   or ["internal error"])[-1][:160],
+                    "lint_wall_time_s": wall}
         doc = json.loads(r.stdout)
         n_new = len(doc.get("new", []))
         _log(f"bench: sofa-lint {'OK' if not n_new else 'FAILED'} "
-             f"({n_new} new, {doc.get('baselined', 0)} baselined)")
-        return {"lint_ok": n_new == 0, "lint_new_findings": n_new}
+             f"({n_new} new, {doc.get('baselined', 0)} baselined, "
+             f"{wall:.2f}s)")
+        return {"lint_ok": n_new == 0, "lint_new_findings": n_new,
+                "lint_wall_time_s": wall,
+                "lint_findings_by_family": _lint_families(
+                    doc.get("by_rule"))}
     except Exception as e:  # noqa: BLE001 — evidence is best-effort
         return {"lint_error": f"{type(e).__name__}: {e}"[:160]}
 

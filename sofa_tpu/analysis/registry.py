@@ -41,13 +41,13 @@ last run's timings (docs/ANALYSIS.md "Writing an analysis pass").
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from dataclasses import dataclass, field
 from fnmatch import fnmatchcase
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sofa_tpu.analysis.features import Features
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_title, print_warning
 
 #: Pass outcome vocabulary in the manifest's ``meta.passes`` ledger.
@@ -91,7 +91,11 @@ class PassSpec:
         return any(getattr(cfg, attr, False) for attr in self.enabled_when)
 
 
-_lock = threading.RLock()
+# Registered from import-time decorators, plugin loads, AND the per-host
+# cluster-analyze workers (load_builtin_passes after a scoped clear) — a
+# declared guard, not an anonymous lock (SL019).
+_lock = Guard("analysis.registry",
+              protects=("_registry", "_declared_builtins"))
 _registry: Dict[str, PassSpec] = {}
 #: every builtin spec ever registered — the decorators run only on first
 #: module import, so ``load_builtin_passes`` after a ``clear``/``scoped``

@@ -17,7 +17,9 @@ CLI (/root/reference/bin/sofa:328-376):
                     exits nonzero on failed collectors
   lint              AST invariant checker for sofa_tpu's own contracts
                     (sofa_tpu/lint/, docs/STATIC_ANALYSIS.md); exits 1 on
-                    findings not grandfathered in lint_baseline.json
+                    findings not grandfathered in lint_baseline.json;
+                    --rule SLxxx[,SLyyy] filters, --explain SLxxx prints
+                    the rule's catalog row, --jobs fans out per-file
   artifacts         artifact-lifecycle inventory (sofa_tpu/artifacts.py):
                     every artifact -> writers/readers/clean/digest/fsck/
                     manifest_check coverage from the statically-extracted
@@ -264,6 +266,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="setup: skip the bounded device-backend health "
                         "probe (host-only checks)")
 
+    g = p.add_argument_group("lint")
+    g.add_argument("--rule", dest="lint_rule", metavar="SLxxx[,SLyyy]",
+                   help="lint: only report these rule id(s)")
+    g.add_argument("--explain", dest="lint_explain", metavar="SLxxx",
+                   help="lint: print the rule's catalog row and exit")
+
     p.add_argument("--json", action="store_true", dest="as_json",
                    default=False,
                    help="artifacts: machine-readable inventory on stdout "
@@ -505,7 +513,14 @@ def _run(argv=None) -> int:
             from sofa_tpu.lint.cli import run_lint
             # lint is config-free: the positional argument is a path, and
             # the nested parser owns the exit-code contract (0/1/2).
-            return run_lint([args.usr_command] if args.usr_command else [])
+            argv = [args.usr_command] if args.usr_command else []
+            if getattr(args, "lint_rule", None):
+                argv += ["--rule", args.lint_rule]
+            if getattr(args, "lint_explain", None):
+                argv += ["--explain", args.lint_explain]
+            if "jobs" in vars(args):
+                argv += ["--jobs", str(vars(args)["jobs"])]
+            return run_lint(argv)
         if cmd == "artifacts":
             from sofa_tpu.artifacts import sofa_artifacts
             # config-free like lint: the positional is an optional logdir

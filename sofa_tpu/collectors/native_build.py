@@ -13,12 +13,15 @@ import shutil
 import subprocess
 from typing import Optional
 
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_info, print_warning
 
 NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 
 # Tools whose build already failed in this process: retrying g++ per call
-# would cost up to the full build timeout per ingested file.
+# would cost up to the full build timeout per ingested file.  Collectors
+# starting on the main flow and ingest pool workers both record failures.
+_BUILD_GUARD = Guard("native_build.failed", protects=("_FAILED",))
 _FAILED: set = set()
 
 # Link flags per tool (appended after the source so ld resolves symbols).
@@ -42,7 +45,8 @@ def ensure_built(tool: str) -> Optional[str]:
         return None
     gxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
     if gxx is None:
-        _FAILED.add(tool)
+        with _BUILD_GUARD:
+            _FAILED.add(tool)
         print_warning(f"native {tool}: no C++ compiler; using Python fallback")
         return None
     tmp = f"{binary}.build.{os.getpid()}"
@@ -55,7 +59,8 @@ def ensure_built(tool: str) -> Optional[str]:
         print_info(f"native {tool}: built with {gxx}")
         return binary
     except (subprocess.SubprocessError, OSError) as e:
-        _FAILED.add(tool)
+        with _BUILD_GUARD:
+            _FAILED.add(tool)
         print_warning(f"native {tool}: build failed ({e}); using Python fallback")
         return None
     finally:

@@ -430,15 +430,51 @@ def _platform_guard():
         time.sleep(0.005)
 
 
-# The guard runs whenever the injection is present (tpumon/pystacks-only
-# runs included), not just when XPlane tracing is enabled.
-_g = threading.Thread(target=_platform_guard, daemon=True,
-                      name="sofa_tpu_platform_guard")
-_g.start()
+_ARMED = {"done": False}
 
-if _OPTS.get("enable", False):
-    _t = threading.Thread(target=_watch, daemon=True, name="sofa_tpu_xprof_watch")
-    _t.start()
+
+def _arm_watchers():
+    # Idempotent: several jax.* imports can race through the finder before
+    # the flag flips, and jax may already be imported when we install.
+    if _ARMED["done"]:
+        return
+    _ARMED["done"] = True
+    # The guard runs whenever the injection is present (tpumon/pystacks-
+    # only runs included), not just when XPlane tracing is enabled.
+    g = threading.Thread(target=_platform_guard, daemon=True,
+                         name="sofa_tpu_platform_guard")
+    g.start()
+    if _OPTS.get("enable", False):
+        t = threading.Thread(target=_watch, daemon=True,
+                             name="sofa_tpu_xprof_watch")
+        t.start()
+
+
+class _LazyArmOnJaxImport:
+    # Lazy thread start (sofa-lint SL022): importing this sitecustomize
+    # must have no thread side effects.  Every python in the child tree —
+    # spawn-mode pool workers, launcher sidecars, helper scripts that
+    # never touch jax — inherits the injection; before this hook each of
+    # them carried polling watcher threads from import to exit.  The
+    # finder never finds anything (always returns None so the normal
+    # import machinery proceeds); it only OBSERVES the first `import jax`
+    # starting and arms the watchers, which then poll for the import to
+    # complete exactly as before.  It stays on sys.meta_path afterwards —
+    # removing an entry mid-import would mutate the list the import
+    # system is iterating — and degrades to one flag check per import.
+    def find_spec(self, name, path=None, target=None):
+        if not _ARMED["done"] and (name == "jax"
+                                   or name.startswith("jax.")):
+            _arm_watchers()
+        return None
+
+
+if "jax" in sys.modules:
+    _arm_watchers()
+else:
+    # Position 0: appended finders never see names an earlier finder
+    # resolves, and `jax` always resolves.
+    sys.meta_path.insert(0, _LazyArmOnJaxImport())
 
 if os.environ.get("SOFA_TPU_PYSTACKS_HZ"):
     from sofa_tpu_pystacks import start_sampler  # lives beside this file

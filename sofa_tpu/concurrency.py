@@ -1,0 +1,118 @@
+"""Declared-guard concurrency primitives.
+
+The repo already carries real concurrency — the supervisor watchdog,
+collector sampler threads, pool workers, ThreadingHTTPServer handlers —
+and every frontier on the ROADMAP (`sofa live` tail-ingest, the `sofa
+agent` fleet daemon, the out-of-core columnar engine) adds more.  Until
+now each lock was an anonymous ``threading.Lock`` whose protected state
+lived only in the author's head; nothing could check that a new write
+site took the right lock, or any lock at all.
+
+:class:`Guard` is a named lock that *declares* the state it protects::
+
+    _REGISTRY_GUARD = Guard("telemetry.registry", protects=("_active",))
+    ...
+    with _REGISTRY_GUARD:
+        _active.append(tel)
+
+The declaration is machine-checked two ways:
+
+* **statically** — sofa-lint rule SL019 (sofa_tpu/lint/concurrency_rules)
+  extracts every ``Guard(...)`` declaration and verifies that each write
+  to a protected name happens inside a ``with <that guard>:`` block, and
+  that state written from two execution contexts has a declared guard at
+  all;
+* **at runtime (debug mode)** — with ``SOFA_DEBUG_GUARDS=1`` in the
+  environment, :meth:`Guard.assert_held` raises when called off the
+  owning thread, so a race a reviewer missed fails a test instead of
+  corrupting a manifest.  Outside debug mode the assert is a no-op
+  attribute check — guards add no measurable cost to the hot path.
+
+Guards are reentrant by default (the converted call sites — telemetry's
+merge-by-verb ledgers — re-enter through helper methods) and expose the
+context-manager protocol plus ``acquire``/``release`` for the rare
+non-lexical holder.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["Guard", "debug_guards_enabled"]
+
+
+def debug_guards_enabled() -> bool:
+    """Read the debug switch at call time (not import time) so tests can
+    flip SOFA_DEBUG_GUARDS without re-importing the module."""
+    return os.environ.get("SOFA_DEBUG_GUARDS", "") == "1"
+
+
+class Guard:
+    """A named lock that declares the state it protects.
+
+    ``protects`` names the attributes / module globals whose every write
+    must happen under this guard — the contract SL019 enforces statically.
+    The names are data for the linter and the debug assert's error
+    message; the guard itself is an ordinary (re-entrant) lock.
+    """
+
+    __slots__ = ("name", "protects", "_lock", "_owner", "_depth")
+
+    def __init__(self, name: str, protects=(), reentrant: bool = True):
+        if not name or not isinstance(name, str):
+            raise ValueError(f"Guard needs a non-empty name, got {name!r}")
+        self.name = name
+        self.protects = tuple(protects)
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._owner: "int | None" = None
+        self._depth = 0
+
+    # -- lock protocol -----------------------------------------------------
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            self._depth += 1
+        return got
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth <= 0:
+            self._owner = None
+            self._depth = 0
+        self._lock.release()
+
+    def __enter__(self) -> "Guard":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # -- introspection / debug asserts ------------------------------------
+    def held(self) -> bool:
+        """True when the CALLING thread currently holds the guard."""
+        return self._owner == threading.get_ident()
+
+    def assert_held(self) -> None:
+        """Debug-mode invariant: the caller must hold the guard.
+
+        Cheap by contract — a single env-flag check when debug guards are
+        off.  Writers of guard-protected state call this at the top of
+        the mutation so an unguarded access found in review (or seeded by
+        the race-marked tests) fails loudly instead of racing."""
+        if not debug_guards_enabled():
+            return
+        if not self.held():
+            raise AssertionError(
+                f"guard {self.name!r} (protects {list(self.protects)}) is "
+                "not held by this thread — an unguarded access to declared "
+                "shared state")
+
+    def __repr__(self) -> str:
+        state = "held" if self._owner is not None else "free"
+        return (f"Guard({self.name!r}, protects={list(self.protects)}, "
+                f"{state})")

@@ -151,6 +151,11 @@ def parse(text: str) -> FaultPlan:
 # the verb's plan.
 
 _PLAN: Optional[FaultPlan] = None
+#: Armed die-timers (arm_die), cancelled by clear() — an injected "death"
+#: scheduled near the end of a run must not fire into the NEXT run's
+#: collectors after the verb that armed it already cleaned up (SL023's
+#: stop-path invariant for timers).
+_TIMERS: List[threading.Timer] = []
 
 
 def active() -> Optional[FaultPlan]:
@@ -185,6 +190,8 @@ def install_from(cfg=None) -> Optional[FaultPlan]:
 def clear() -> None:
     global _PLAN
     _PLAN = None
+    while _TIMERS:
+        _TIMERS.pop().cancel()
 
 
 # --- hook points -------------------------------------------------------------
@@ -215,6 +222,7 @@ def arm_die(col) -> None:
         return
     t = threading.Timer(spec.delay_s or 0.0, col.fault_kill)
     t.daemon = True
+    _TIMERS.append(t)  # clear() cancels stragglers at verb teardown
     t.start()
 
 

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 import pandas as pd
 
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_info, print_warning
 
 CACHE_DIR_NAME = "_ingest_cache"
@@ -87,6 +88,10 @@ class IngestCache:
     def __init__(self, root: str, enabled: bool = True):
         self.root = root
         self.enabled = enabled
+        # One cache instance serves every ingest pool worker: the
+        # hit/miss/size ledgers are cross-context shared state (SL019).
+        self._ledger_guard = Guard("ingest_cache.ledgers", protects=(
+            "hits", "misses", "stored_bytes"))
         self.hits: List[str] = []
         self.misses: List[str] = []
         self.stored_bytes: Dict[str, int] = {}
@@ -106,10 +111,12 @@ class IngestCache:
             with open(self._key_path(source)) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
-            self.misses.append(source)
+            with self._ledger_guard:
+                self.misses.append(source)
             return None
         if doc.get("key") != key:
-            self.misses.append(source)
+            with self._ledger_guard:
+                self.misses.append(source)
             return None
         from sofa_tpu.trace import _conform
 
@@ -123,14 +130,17 @@ class IngestCache:
                 elif os.path.isfile(pk):
                     frames[name] = _conform(pd.read_pickle(pk))
                 else:
-                    self.misses.append(source)
+                    with self._ledger_guard:
+                        self.misses.append(source)
                     return None
         except Exception as e:  # noqa: BLE001 — a corrupt cache entry is a miss
             print_warning(f"ingest cache: unreadable entry for {source} "
                           f"({e}); reparsing from raw")
-            self.misses.append(source)
+            with self._ledger_guard:
+                self.misses.append(source)
             return None
-        self.hits.append(source)
+        with self._ledger_guard:
+            self.hits.append(source)
         return {"frames": frames, "meta": doc.get("meta") or {}}
 
     def invalidate(self, source: str) -> None:
@@ -192,7 +202,8 @@ class IngestCache:
                     if os.path.isfile(pq):
                         os.unlink(pq)
                     stored += os.path.getsize(pk)
-            self.stored_bytes[source] = stored
+            with self._ledger_guard:
+                self.stored_bytes[source] = stored
             doc = {"key": key, "frames": sorted(frames), "meta": meta or {}}
             # Key json LAST — a crash mid-store leaves a stale key that
             # simply mismatches, never a key pointing at missing frames.

@@ -16,7 +16,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
+import textwrap
 from typing import List, Optional
 
 from sofa_tpu.lint.baseline import (
@@ -26,6 +28,8 @@ from sofa_tpu.lint.baseline import (
 )
 from sofa_tpu.lint.core import lint_paths
 from sofa_tpu.lint.rules import default_rules
+
+_RULE_ID_RE = re.compile(r"^SL\d{3}$")
 
 
 def _default_paths() -> List[str]:
@@ -55,6 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory findings' relative paths (and baseline "
                         "fingerprints) are anchored to (default: the "
                         "directory containing the baseline file)")
+    p.add_argument("--rule", default=None, metavar="SLxxx[,SLyyy]",
+                   help="only report findings of these rule id(s); output "
+                        "order and the 0/1/2 exit contract are unchanged")
+    p.add_argument("--explain", default=None, metavar="SLxxx",
+                   help="print the rule's docs/STATIC_ANALYSIS.md catalog "
+                        "row (falling back to the rule docstring) and "
+                        "exit without linting")
+    p.add_argument("--jobs", type=int, default=1,
+                   help="per-file lint fan-out width (output is byte-"
+                        "identical at any width); 1 = serial")
     return p
 
 
@@ -69,11 +83,61 @@ def run_lint(argv: Optional[List[str]] = None) -> int:
         return 2
 
 
+def _parse_rule_filter(spec: str) -> List[str]:
+    rules = [r.strip().upper() for r in spec.split(",") if r.strip()]
+    bad = [r for r in rules if not _RULE_ID_RE.match(r)]
+    if bad:
+        raise ValueError(f"--rule expects SLnnn ids, got {bad}")
+    return rules
+
+
+def _explain(rule_id: str) -> int:
+    """Print the rule's doc-catalog row (the one source of truth for what
+    each rule guards), or its class docstring when the docs file is not
+    beside this checkout.  rc 0 on success, 2 for an unknown rule."""
+    rule_id = rule_id.strip().upper()
+    if not _RULE_ID_RE.match(rule_id):
+        print(f"sofa-lint: {rule_id!r} is not a rule id (SLnnn)",
+              file=sys.stderr)
+        return 2
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    docs = os.path.join(os.path.dirname(pkg), "docs", "STATIC_ANALYSIS.md")
+    try:
+        with open(docs, encoding="utf-8") as f:
+            for line in f:
+                row = line.strip()
+                if row.startswith(f"| {rule_id} "):
+                    cells = [c.strip() for c in row.strip("|").split("|")]
+                    if len(cells) >= 4:
+                        print(f"{cells[0]} [{cells[1]}] — guards: "
+                              f"{cells[2]}")
+                        print(textwrap.fill(cells[3], width=78))
+                        return 0
+    except OSError:
+        pass
+    for rule in default_rules():
+        if rule.rule_id == rule_id:
+            doc = (type(rule).__doc__ or "").strip()
+            print(f"{rule_id} [{rule.severity}]")
+            print(textwrap.fill(" ".join(doc.split()), width=78))
+            return 0
+    known = sorted({r.rule_id for r in default_rules()} | {"SL000"})
+    print(f"sofa-lint: unknown rule {rule_id!r} (known: "
+          f"{known[0]}..{known[-1]})", file=sys.stderr)
+    return 2
+
+
 def _run(args: argparse.Namespace) -> int:
+    if args.explain:
+        return _explain(args.explain)
     paths = args.paths or _default_paths()
     baseline_path = args.baseline or locate_baseline(paths[0])
     base = args.base or os.path.dirname(os.path.abspath(baseline_path))
-    findings = lint_paths(paths, default_rules(), base=base)
+    findings = lint_paths(paths, default_rules(), base=base,
+                          jobs=max(int(args.jobs or 1), 1))
+    if args.rule:
+        wanted = set(_parse_rule_filter(args.rule))
+        findings = [f for f in findings if f.rule_id in wanted]
 
     def line_text_for(f):
         path = f.file if os.path.isabs(f.file) else os.path.join(base, f.file)
@@ -105,10 +169,14 @@ def _run(args: argparse.Namespace) -> int:
                                      f.message))
 
     if args.as_json:
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
         print(json.dumps({
             "new": [f.to_dict() for f in new],
             "baselined": len(old),
             "total": len(findings),
+            "by_rule": dict(sorted(by_rule.items())),
             "baseline": baseline_path if not args.no_baseline else None,
         }, indent=1))
         return 1 if new else 0

@@ -94,6 +94,10 @@ class ProjectContext:
     #: None/inactive unless the linted set carries a registry-bearing
     #: trace.py, so fixtures and single-file lints skip SL014–SL018.
     artifacts: object = None
+    #: The concurrency/execution-context graph (lint/concurrency_rules.py)
+    #: — None/inactive when the context is built by hand (fixture
+    #: isolation), so SL019–SL023 only run under detect().
+    concurrency: object = None
 
     @classmethod
     def detect(cls, files: Sequence[str],
@@ -132,11 +136,14 @@ class ProjectContext:
             if ambient:
                 break
         from sofa_tpu.lint.artifact_rules import build_artifact_graph
+        from sofa_tpu.lint.concurrency_rules import build_concurrency_graph
 
         artifacts = build_artifact_graph(files, base=base,
                                          passes=tuple(passes))
+        concurrency = build_concurrency_graph(files, base=base)
         return cls(columns=columns, passes=tuple(passes),
-                   ambient_features=ambient, artifacts=artifacts)
+                   ambient_features=ambient, artifacts=artifacts,
+                   concurrency=concurrency)
 
 
 def _columns_from_trace(path: str) -> List[str]:
@@ -448,22 +455,37 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 def lint_paths(paths: Sequence[str], rules: Sequence[Rule],
                project: Optional[ProjectContext] = None,
-               base: Optional[str] = None) -> List[Finding]:
+               base: Optional[str] = None, jobs: int = 1) -> List[Finding]:
     """Lint files/directories; findings sorted by (file, line, rule).
 
     ``base`` anchors the relpaths findings (and baseline fingerprints) are
     keyed on — defaults to the current directory, matching the
     ``python tools/sofa_lint.py sofa_tpu/`` invocation from the repo root.
+    ``jobs`` > 1 fans the per-file walks across a thread pool (rules keep
+    per-file scratch on the FileContext and read the project graphs
+    read-only, so files are independent); results keep file order and the
+    final sort makes the report byte-identical at any pool width.
     """
     files = iter_python_files(paths)
     base = os.path.abspath(base or os.getcwd())
     if project is None:
         project = ProjectContext.detect(files, base=base)
     engine = LintEngine(rules, project)
-    findings: List[Finding] = []
-    for f in files:
+
+    def rel_of(f: str) -> str:
         ab = os.path.abspath(f)
-        rel = os.path.relpath(ab, base) if ab.startswith(base + os.sep) else ab
-        findings.extend(engine.lint_file(f, rel))
+        return os.path.relpath(ab, base) if ab.startswith(base + os.sep) \
+            else ab
+
+    findings: List[Finding] = []
+    if jobs > 1 and len(files) > 1:
+        from sofa_tpu.pool import thread_map
+
+        for per_file in thread_map(
+                lambda f: engine.lint_file(f, rel_of(f)), files, jobs):
+            findings.extend(per_file)
+    else:
+        for f in files:
+            findings.extend(engine.lint_file(f, rel_of(f)))
     findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
     return findings

@@ -436,6 +436,9 @@ class NonAtomicDerivedWrite(Rule):
 from sofa_tpu.lint.artifact_rules import (  # noqa: E402 — SL014-SL018:
     ARTIFACT_RULES,                     # artifact-lifecycle flow analysis
 )
+from sofa_tpu.lint.concurrency_rules import (  # noqa: E402 — SL019-SL023:
+    CONCURRENCY_RULES,                  # concurrency & commit ordering
+)
 from sofa_tpu.lint.pass_rules import (  # noqa: E402 — SL010-SL013 live in
     PASS_RULES,                         # their own module; one rule set
 )
@@ -450,7 +453,7 @@ ALL_RULES = (
     RawArtifactBypass,
     DirectKill,
     NonAtomicDerivedWrite,
-) + PASS_RULES + ARTIFACT_RULES
+) + PASS_RULES + ARTIFACT_RULES + CONCURRENCY_RULES
 
 
 def default_rules() -> List[Rule]:

@@ -133,12 +133,14 @@ class _DockerPerfScope:
     def __init__(self, cfg, perf: PerfCollector, cidfile: str):
         import threading
 
+        from sofa_tpu.concurrency import Guard
+
         self.cfg, self.perf, self.cidfile = cfg, perf, cidfile
         self.proc: "subprocess.Popen | None" = None
         self._stop = threading.Event()
-        # Serializes launch vs stop: after stop() holds the lock and sets
+        # Serializes launch vs stop: after stop() holds the guard and sets
         # _stop, a late-waking watcher can never launch an orphan perf.
-        self._lock = threading.Lock()
+        self._lock = Guard("record.docker_perf", protects=("proc",))
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def start(self) -> None:
@@ -223,7 +225,11 @@ class _DockerPerfScope:
                 print_progress(
                     f"perf scoped to container {cid[:12]} ({how})")
                 return
-            self.proc = None
+            # Under the guard: stop()'s join is bounded (timeout=70), so
+            # a wedged watcher can still be here while stop() reads proc
+            # to terminate it — the clear must not race that read.
+            with self._lock:
+                self.proc = None
         print_warning(
             f"docker-scoped perf exited immediately for {cid[:12]} "
             f"(tried {'; '.join(tried)}) — container CPU samples "

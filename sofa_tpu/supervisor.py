@@ -47,6 +47,7 @@ import time
 from typing import Dict, List
 
 from sofa_tpu import telemetry
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_warning
 
 # Polls with zero output growth (while alive) before the one-time stall
@@ -75,6 +76,12 @@ class CollectorSupervisor:
         self._stopped = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sofa_supervisor")
+        # The watchdog thread owns the per-collector dicts inside _state;
+        # the guard covers the CONTAINERS, which budget_summary reads from
+        # the main thread (stop()'s join is bounded, so a wedged check can
+        # still be running when record asks for the summary).
+        self._lock = Guard("supervisor.state",
+                           protects=("_state", "_truncated"))
         self._state: Dict[str, dict] = {}
         per_mb = float(getattr(cfg, "collector_disk_budget_mb", 0) or 0)
         total_mb = float(getattr(cfg, "disk_budget_mb", 0) or 0)
@@ -117,11 +124,12 @@ class CollectorSupervisor:
         alive = col.alive()
         if alive is None:
             return  # not watchable (prefix-only / one-shot collectors)
-        st = self._state.setdefault(col.name, {
-            "deaths": 0, "restarts": 0, "retry_at": None,
-            "gave_up": False, "bytes": -1, "stall_polls": 0,
-            "stalled_flagged": False, "rotated": 0,
-        })
+        with self._lock:
+            st = self._state.setdefault(col.name, {
+                "deaths": 0, "restarts": 0, "retry_at": None,
+                "gave_up": False, "bytes": -1, "stall_polls": 0,
+                "stalled_flagged": False, "rotated": 0,
+            })
         if st["gave_up"]:
             return
         if st["retry_at"] is not None:
@@ -194,8 +202,10 @@ class CollectorSupervisor:
     def _enforce_total_budget(self) -> None:
         """--disk_budget across every watched collector: on breach, the
         biggest producer pays first (its own files oldest-first)."""
-        tracked = [(st["bytes"], name) for name, st in self._state.items()
-                   if st["bytes"] > 0 and not st["gave_up"]]
+        with self._lock:
+            tracked = [(st["bytes"], name)
+                       for name, st in self._state.items()
+                       if st["bytes"] > 0 and not st["gave_up"]]
         total = sum(b for b, _n in tracked)
         if total <= self._total_cap:
             return
@@ -205,7 +215,8 @@ class CollectorSupervisor:
             if col is None:
                 continue
             over = total - self._total_cap
-            st = self._state[name]
+            with self._lock:
+                st = self._state[name]
             freed = self._enforce_budget(col, st, b, b - over,
                                          "the run's --disk_budget")
             total -= freed
@@ -256,7 +267,8 @@ class CollectorSupervisor:
                 f"({freed / 2**20:.1f} MB freed)")
         if used - freed > cap:
             st["gave_up"] = True
-            self._truncated.append(col.name)
+            with self._lock:
+                self._truncated.append(col.name)
             telemetry.collector_event(col.name, "truncated_by_budget",
                                       budget_bytes=cap,
                                       bytes_captured=int(used - freed))
@@ -275,10 +287,11 @@ class CollectorSupervisor:
         configured (the section only appears when the feature is on)."""
         if not (self._per_cap or self._total_cap):
             return None
-        return {
-            "budget_mb": self._total_cap // 2 ** 20 or None,
-            "collector_budget_mb": self._per_cap // 2 ** 20 or None,
-            "rotated_files": sum(st.get("rotated", 0)
-                                 for st in self._state.values()),
-            "truncated": sorted(set(self._truncated)),
-        }
+        with self._lock:
+            return {
+                "budget_mb": self._total_cap // 2 ** 20 or None,
+                "collector_budget_mb": self._per_cap // 2 ** 20 or None,
+                "rotated_files": sum(st.get("rotated", 0)
+                                     for st in self._state.values()),
+                "truncated": sorted(set(self._truncated)),
+            }

@@ -62,10 +62,10 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import threading
 import time
 from typing import Dict, List, Optional
 
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import (  # printing imports us lazily, no cycle
     print_error,
     print_title,
@@ -124,7 +124,10 @@ _OTHER_LANE = 4
 
 _WARNING_TAIL_MAX = 20
 
-_registry_lock = threading.RLock()
+# The active-run stack is written by every verb's begin/end AND read from
+# collector/supervisor threads and pool workers via current()/console_event
+# — a declared guard (SL019) rather than an anonymous lock.
+_registry_lock = Guard("telemetry.registry", protects=("_active",))
 _active: List["Telemetry"] = []
 
 
@@ -139,7 +142,12 @@ class Telemetry:
     def __init__(self, verb: str):
         self.verb = verb
         self.started_unix = time.time()
-        self._lock = threading.RLock()
+        # One guard per run: spans/counters/ledgers are written from the
+        # main verb flow, pool workers, collector threads, and the
+        # supervisor watchdog all at once.
+        self._lock = Guard("telemetry.run", protects=(
+            "spans", "counters", "collectors", "sources", "meta",
+            "warning_tail", "_seq"))
         self.spans: List[dict] = []
         self.counters: Dict[str, int] = {"warnings": 0, "errors": 0}
         self.collectors: Dict[str, dict] = {}

@@ -30,12 +30,41 @@ import os
 import posixpath
 import socket
 
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_error, print_progress
 
 # Requests answered 503 while the write-guard sentinel is up: the board's
 # data artifacts (report.js, frame CSVs, tiles, manifests).  Board chrome
 # (HTML/board JS/CSS) keeps serving — only data can be torn mid-write.
 _DATA_SUFFIXES = (".csv", ".parquet", ".json", ".json.gz")
+
+
+class _BoardServer(http.server.ThreadingHTTPServer):
+    """The board's server.  Subclassing carries the socket/thread policy
+    as CLASS attributes instead of mutating ThreadingHTTPServer globally —
+    the old module-level assignment changed every other HTTP server in the
+    process (the SL019 shared-state class of bug).  Handler threads share
+    one request ledger under a declared guard; `sofa viz` prints it at
+    shutdown so a fleet operator can see 503 churn at a glance."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._stats_guard = Guard("viz.server_stats", protects=("stats",))
+        self.stats: dict = {}
+
+    def count_response(self, key: str) -> None:
+        with self._stats_guard:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def stats_line(self) -> "str | None":
+        with self._stats_guard:
+            stats = dict(self.stats)
+        if not stats:
+            return None
+        return ", ".join(f"{v} {k}" for k, v in sorted(stats.items()))
 
 
 def _display_host(bind: str) -> str:
@@ -104,7 +133,13 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
                 or posixpath.basename(rel) == "report.js"
                 or "/_tiles/" in rel)
 
+    def _count(self, key: str) -> None:
+        counter = getattr(self.server, "count_response", None)
+        if counter is not None:  # plain test harnesses use a bare server
+            counter(key)
+
     def _unavailable(self):
+        self._count("503_mid_write")
         self.send_response(503)
         self.send_header("Retry-After", "1")
         self.send_header("Content-Length", "0")
@@ -112,6 +147,7 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
         return None
 
     def _not_modified(self, etag: str):
+        self._count("304_revalidated")
         self.send_response(304)
         self.send_header("ETag", etag)
         self.end_headers()
@@ -177,6 +213,7 @@ class _BoardHandler(http.server.SimpleHTTPRequestHandler):
             ctype = self.guess_type(path)
             f = open(actual, "rb")
             length = st.st_size
+        self._count("200_served")
         self.send_response(200)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(length))
@@ -203,14 +240,11 @@ def sofa_viz(cfg, serve_forever: bool = True):
         archive_root = None  # no store: /archive/ 404s like any miss
     handler = functools.partial(_BoardHandler, directory=cfg.logdir,
                                 archive_root=archive_root)
-    http.server.ThreadingHTTPServer.allow_reuse_address = True
-    http.server.ThreadingHTTPServer.daemon_threads = True
     httpd = None
     last_err = None
     for port_try in range(cfg.viz_port, cfg.viz_port + 20):
         try:
-            httpd = http.server.ThreadingHTTPServer(
-                (cfg.viz_bind, port_try), handler)
+            httpd = _BoardServer((cfg.viz_bind, port_try), handler)
             break
         except OSError as e:
             last_err = e
@@ -254,5 +288,8 @@ def sofa_viz(cfg, serve_forever: bool = True):
             pass
         finally:
             httpd.server_close()
+            served = httpd.stats_line()
+            if served:
+                print_progress(f"viz served: {served}")
         return None
     return httpd

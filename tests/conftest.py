@@ -96,6 +96,27 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running regression test (tier-1 runs "
         "-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "race: Guard-protected concurrency test re-run under a "
+        "tiny sys.setswitchinterval so real races surface in CI "
+        "(tests/test_concurrency_lint.py)")
+
+
+@pytest.fixture(autouse=True)
+def _race_amplifier(request):
+    """Tests marked ``race`` run with sys.setswitchinterval(1e-6): the
+    interpreter preempts threads every few bytecodes instead of every
+    5 ms, turning a latent data race on Guard-protected state from a
+    one-in-a-million flake into a near-certain assertion failure."""
+    if request.node.get_closest_marker("race") is None:
+        yield
+        return
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(old)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,590 @@
+"""SL019–SL023 concurrency & commit-ordering lint: per-rule pos/neg
+fixtures, the seeded-mutation gate (deleting a Guard from telemetry.py
+must fire SL019 at the right site), the shipped-tree zero-findings gate,
+--jobs determinism, the import-side-effect contract, the Guard primitive
+itself, and race-marked runtime tests that hammer Guard-protected state
+under a tiny switch interval.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from sofa_tpu.concurrency import Guard
+from sofa_tpu.lint.cli import run_lint
+from sofa_tpu.lint.core import ProjectContext, lint_paths
+from sofa_tpu.lint.rules import default_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONC_RULES = ("SL019", "SL020", "SL021", "SL022", "SL023")
+
+
+def run_conc(tmp_path, files):
+    """Write {relname: src} fixtures, detect (context graph included),
+    lint; returns only the SL019–SL023 findings."""
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        paths.append(str(p))
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    return [f for f in fs if f.rule_id in CONC_RULES]
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# --- SL019: declared-guard contracts ----------------------------------------
+
+def test_sl019_write_outside_declared_guard(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        from sofa_tpu.concurrency import Guard
+
+        _G = Guard("m.items", protects=("_items",))
+        _items = []
+
+        def bad(x):
+            _items.append(x)
+
+        def good(x):
+            with _G:
+                _items.append(x)
+    """})
+    assert ids(fs) == ["SL019"]
+    assert "_items" in fs[0].message and "declared guard" in fs[0].message
+    # the finding anchors at bad()'s append, not good()'s
+    assert fs[0].line == 8
+
+
+def test_sl019_multi_context_write_needs_guard(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        _count = {}
+
+        def worker():
+            _count["n"] = 1
+
+        def main():
+            t = threading.Thread(target=worker)
+            t.start()
+            _count["n"] = 2
+            t.join()
+    """})
+    assert ids(fs) == ["SL019"]
+    assert "multiple execution contexts" in fs[0].message
+
+
+def test_sl019_imported_class_attr_mutation(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import http.server
+
+        http.server.ThreadingHTTPServer.daemon_threads = True
+    """})
+    assert ids(fs) == ["SL019"]
+    assert "process-global" in fs[0].message
+
+
+def test_sl019_clean_patterns(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        from sofa_tpu import printing
+        from sofa_tpu.concurrency import Guard
+
+        printing.verbose = True  # module config var: the startup idiom
+
+        _G = Guard("m.state", protects=("_state",))
+        _state = {}
+
+        def worker():
+            with _G:
+                _state["k"] = 1
+
+        def main():
+            t = threading.Thread(target=worker)
+            t.start()
+            with _G:
+                _state["k"] = 2
+            t.join()
+    """})
+    assert fs == []
+
+
+# --- SL020: blocking under a guard, lock-order cycles -----------------------
+
+def test_sl020_blocking_calls_under_lock(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import subprocess
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                subprocess.run(["ls"], timeout=1)
+                time.sleep(0.1)
+
+        def ok():
+            with _lock:
+                x = 1
+            subprocess.run(["ls"], timeout=1)
+    """})
+    assert ids(fs) == ["SL020", "SL020"]
+    assert all(f.severity == "warn" for f in fs)
+
+
+def test_sl020_lock_order_cycle(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _b:
+                with _a:
+                    pass
+    """})
+    cycles = [f for f in fs if "cycle" in f.message]
+    assert len(cycles) == 1 and cycles[0].rule_id == "SL020"
+    assert cycles[0].severity == "error"
+
+
+def test_sl020_consistent_order_is_clean(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _a:
+                with _b:
+                    pass
+    """})
+    assert fs == []
+
+
+# --- SL021: commit ordering -------------------------------------------------
+
+_VERB_TMPL = """
+    from sofa_tpu.durability import Journal, atomic_write, write_digests
+
+    def sofa_demo(logdir):
+        j = Journal(logdir)
+        {body}
+"""
+
+
+def _verb(body):
+    return _VERB_TMPL.format(body=body.replace("\n", "\n        "))
+
+
+def test_sl021_write_after_commit(tmp_path):
+    fs = run_conc(tmp_path, {"verbmod.py": _verb("""
+j.begin("demo")
+with atomic_write(logdir + "/out.json") as f:
+    f.write("{}")
+write_digests(logdir)
+j.commit("demo")
+with atomic_write(logdir + "/late.json") as f:
+    f.write("{}")
+""")})
+    assert ids(fs) == ["SL021"]
+    assert "after commit()" in fs[0].message and "late.json" in fs[0].message
+
+
+def test_sl021_begin_without_commit_and_inverted_window(tmp_path):
+    fs = run_conc(tmp_path, {
+        "nocommit.py": _verb('j.begin("demo")'),
+        "inverted.py": _verb('j.commit("demo")\nj.begin("demo")'),
+    })
+    msgs = {f.file.split("/")[-1]: f.message for f in fs}
+    assert "never commit()" in msgs["nocommit.py"]
+    assert "before its begin()" in msgs["inverted.py"]
+
+
+def test_sl021_write_between_digest_and_commit(tmp_path):
+    fs = run_conc(tmp_path, {"verbmod.py": _verb("""
+j.begin("demo")
+write_digests(logdir)
+with atomic_write(logdir + "/out.json") as f:
+    f.write("{}")
+j.commit("demo")
+""")})
+    assert ids(fs) == ["SL021"]
+    assert "digest refresh" in fs[0].message
+
+
+def test_sl021_well_ordered_verb_is_clean(tmp_path):
+    fs = run_conc(tmp_path, {"verbmod.py": _verb("""
+j.begin("demo")
+with atomic_write(logdir + "/out.json") as f:
+    f.write("{}")
+write_digests(logdir)
+j.commit("demo")
+""")})
+    assert fs == []
+
+
+# --- SL022: thread-context safety -------------------------------------------
+
+def test_sl022_module_level_thread_spawn(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        def _poll():
+            pass
+
+        _t = threading.Thread(target=_poll, daemon=True)
+        _t.start()
+    """})
+    assert ids(fs) == ["SL022"]
+    assert "module import time" in fs[0].message
+
+
+def test_sl022_signal_off_main_thread(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import signal
+        import threading
+
+        def _handler(sig, frm):
+            pass
+
+        def _w():
+            signal.signal(signal.SIGTERM, _handler)
+
+        def go():
+            t = threading.Thread(target=_w)
+            t.start()
+            t.join()
+    """})
+    assert ids(fs) == ["SL022"]
+    assert "non-main execution context" in fs[0].message
+
+
+def test_sl022_sentinel_check_then_act(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import os
+
+        def racing(logdir):
+            return os.path.exists(
+                os.path.join(logdir, "_derived.writing"))
+    """})
+    assert ids(fs) == ["SL022"]
+    assert "derived_writing" in fs[0].message
+
+
+def test_sl022_embedded_template_import_spawn(tmp_path):
+    pad = "#" + " padding" * 30
+    fs = run_conc(tmp_path, {"coll.py": f'''
+        _TEMPLATE = """
+        {pad}
+        import threading
+
+        def _poll():
+            pass
+
+        _t = threading.Thread(target=_poll, daemon=True)
+        _t.start()
+        """
+    '''})
+    assert ids(fs) == ["SL022"]
+    assert "embedded template" in fs[0].message
+    # the finding lands on the REAL file's line, inside the string
+    assert fs[0].line > 5
+
+
+def test_sl022_lazy_template_is_clean(tmp_path):
+    pad = "#" + " padding" * 30
+    fs = run_conc(tmp_path, {"coll.py": f'''
+        _TEMPLATE = """
+        {pad}
+        import sys
+        import threading
+
+        def _arm():
+            t = threading.Thread(target=_poll, daemon=True)
+            t.start()
+            t.join()
+
+        def _poll():
+            pass
+        """
+    '''})
+    assert fs == []
+
+
+# --- SL023: shutdown liveness -----------------------------------------------
+
+def test_sl023_thread_without_stop_path(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        class Daemonette:
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                pass
+    """})
+    assert ids(fs) == ["SL023"]
+    assert "no reachable stop path" in fs[0].message
+
+
+def test_sl023_accepts_join_return_and_cancel_registry(tmp_path):
+    fs = run_conc(tmp_path, {"m.py": """
+        import threading
+
+        _TIMERS = []
+
+        class Svc:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def stop(self):
+                self._thread.join(timeout=5)
+
+            def _run(self):
+                pass
+
+        def bounded(fn, timeout):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            t.join(timeout)
+
+        def handoff(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+
+        def arm(fn, delay):
+            t = threading.Timer(delay, fn)
+            _TIMERS.append(t)
+            t.start()
+
+        def clear():
+            while _TIMERS:
+                _TIMERS.pop().cancel()
+    """})
+    assert fs == []
+
+
+# --- the seeded-mutation gate ----------------------------------------------
+
+def test_removing_a_guard_from_telemetry_fires_sl019(tmp_path):
+    src = open(os.path.join(REPO, "sofa_tpu", "telemetry.py")).read()
+    guarded = ("        with self._lock:\n"
+               "            self.counters[name] = "
+               "self.counters.get(name, 0) + n")
+    assert guarded in src
+    mutated = src.replace(
+        guarded,
+        "        self.counters[name] = self.counters.get(name, 0) + n")
+    p = tmp_path / "telemetry.py"
+    p.write_text(mutated)
+    project = ProjectContext.detect([str(p)], base=str(tmp_path))
+    fs = [f for f in lint_paths([str(p)], default_rules(), project=project,
+                                base=str(tmp_path))
+          if f.rule_id == "SL019"]
+    assert len(fs) == 1
+    assert "counters" in fs[0].message
+    # ...at the mutated write site
+    want = mutated.splitlines().index(
+        "        self.counters[name] = self.counters.get(name, 0) + n") + 1
+    assert fs[0].line == want
+
+
+# --- shipped-tree gates -----------------------------------------------------
+
+def test_shipped_tree_has_zero_concurrency_findings():
+    """The acceptance gate: no SL019–SL023 findings on the shipped tree,
+    baselined or not (the rules landed with their debt burned down)."""
+    fs = lint_paths([os.path.join(REPO, "sofa_tpu")], default_rules(),
+                    base=REPO)
+    conc = [f for f in fs if f.rule_id in CONC_RULES]
+    assert conc == []
+
+
+def test_jobs_output_byte_identical(capsys):
+    args = [os.path.join(REPO, "sofa_tpu"), "--no-baseline", "--json",
+            "--base", REPO]
+    rc1 = run_lint(args + ["--jobs", "1"])
+    out1 = capsys.readouterr().out
+    rc4 = run_lint(args + ["--jobs", "4"])
+    out4 = capsys.readouterr().out
+    assert rc1 == rc4
+    assert out1 == out4
+    assert json.loads(out1)["by_rule"]  # family counts ride the report
+
+
+def test_rule_filter_and_exit_contract(tmp_path, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text("import subprocess\nsubprocess.run(['a'])\n")
+    # SL001 fires unfiltered...
+    rc = run_lint([str(tmp_path), "--no-baseline",
+                   "--base", str(tmp_path)])
+    assert rc == 1
+    capsys.readouterr()
+    # ...and is invisible under a disjoint --rule filter (exit 0)
+    rc = run_lint([str(tmp_path), "--no-baseline",
+                   "--base", str(tmp_path), "--rule", "SL019,SL023"])
+    assert rc == 0
+    rc = run_lint([str(tmp_path), "--no-baseline",
+                   "--base", str(tmp_path), "--rule", "SL001"])
+    assert rc == 1
+    rc = run_lint([str(tmp_path), "--rule", "bogus"])
+    assert rc == 2
+
+
+def test_explain_prints_catalog_row(capsys):
+    assert run_lint(["--explain", "SL021"]) == 0
+    out = capsys.readouterr().out
+    assert "SL021" in out and "commit" in out.lower()
+    assert run_lint(["--explain", "SL999"]) == 2
+
+
+def test_import_sofa_tpu_spawns_no_threads():
+    """Acceptance: `import sofa_tpu` has zero thread side effects."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sofa_tpu, threading; "
+         "print(','.join(sorted(t.name for t in threading.enumerate())))"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "MainThread"
+
+
+def test_injected_sitecustomize_spawns_no_threads_without_jax(tmp_path):
+    """The canonical SL022 burn-down, verified end to end: importing the
+    generated sitecustomize (what every child python does) starts zero
+    threads until jax is imported."""
+    from sofa_tpu.collectors.xprof import _SITECUSTOMIZE
+
+    (tmp_path / "sitecustomize.py").write_text(_SITECUSTOMIZE)
+    env = {**os.environ, "PYTHONPATH": str(tmp_path),
+           "SOFA_TPU_XPROF_OPTS": json.dumps(
+               {"enable": True, "logdir": str(tmp_path)})}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import threading; "
+         "print(','.join(sorted(t.name for t in threading.enumerate())))"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "MainThread"
+
+
+# --- the Guard primitive ----------------------------------------------------
+
+def test_guard_is_reentrant_and_tracks_ownership():
+    g = Guard("test.guard", protects=("x",))
+    assert not g.held()
+    with g:
+        assert g.held()
+        with g:  # reentrant
+            assert g.held()
+        assert g.held()
+    assert not g.held()
+
+
+def test_guard_debug_assert(monkeypatch):
+    g = Guard("test.guard", protects=("x",))
+    monkeypatch.setenv("SOFA_DEBUG_GUARDS", "1")
+    with pytest.raises(AssertionError):
+        g.assert_held()
+    with g:
+        g.assert_held()  # no raise
+    monkeypatch.delenv("SOFA_DEBUG_GUARDS")
+    g.assert_held()  # no-op outside debug mode
+
+
+def test_guard_rejects_anonymous():
+    with pytest.raises(ValueError):
+        Guard("")
+
+
+# --- race-marked runtime tests (amplified by the conftest fixture) ----------
+
+@pytest.mark.race
+def test_telemetry_counters_survive_contention():
+    from sofa_tpu import telemetry
+
+    tel = telemetry.Telemetry("race")
+    n_threads, per = 8, 400
+
+    def hammer():
+        for _ in range(per):
+            tel.count("events")
+            tel.console("warning", "w")
+            tel.collector_event("col", bytes_captured=1)
+            tel.source_event("src", events=1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tel.counters["events"] == n_threads * per
+    assert tel.counters["warnings"] == n_threads * per
+    assert len(tel.warning_tail) <= 20
+
+
+@pytest.mark.race
+def test_telemetry_registry_survives_begin_end_churn():
+    from sofa_tpu import telemetry
+
+    def churn():
+        for _ in range(200):
+            tel = telemetry.begin("race")
+            telemetry.collector_event("c", "started")
+            telemetry.end(tel)
+
+    threads = [threading.Thread(target=churn) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.current() is None
+
+
+@pytest.mark.race
+def test_guard_excludes_writers():
+    g = Guard("race.guard", protects=("shared",))
+    shared = {"n": 0}
+
+    def bump():
+        for _ in range(2000):
+            with g:
+                shared["n"] = shared["n"] + 1
+
+    threads = [threading.Thread(target=bump) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert shared["n"] == 12000
