@@ -397,6 +397,44 @@ try:
         1 for e in ledger.values() if e.get("status") == "failed")
 except Exception as e:
     out["analyze_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# fleet evidence (sofa_tpu/archive/service.py + sofa_tpu/agent.py):
+# loopback `sofa serve` + `sofa agent --once` push of this pod_synth
+# logdir — spool ingest, have-list, object uploads, commit, all over a
+# real HTTP round trip on an ephemeral port.  Needs no hardware and no
+# network, so the fleet transport's wall time stays in the bench
+# trajectory even on dead-tunnel rounds.
+try:
+    import threading as _th
+    from sofa_tpu.agent import sofa_agent
+    from sofa_tpu.archive.service import service_url, sofa_serve
+    _fw = _tf.mkdtemp(prefix="sofa_fleet_")
+    fcfg = SofaConfig(logdir=cfg.logdir, serve_token="bench",
+                      serve_port=0)
+    httpd = sofa_serve(fcfg, root=os.path.join(_fw, "store"),
+                       serve_forever=False)
+    if httpd is None:
+        raise RuntimeError("serve failed to bind")
+    _sthread = _th.Thread(target=httpd.serve_forever, daemon=True)
+    _sthread.start()
+    try:
+        acfg = SofaConfig(logdir=cfg.logdir, serve_token="bench",
+                          agent_service=service_url(httpd),
+                          agent_spool=os.path.join(_fw, "spool"),
+                          agent_settle_s=0.0)
+        t0 = time.perf_counter()
+        rc = sofa_agent(acfg, watch=cfg.logdir, once=True)
+        if rc == 0:
+            out["fleet_push_wall_time_s"] = round(
+                time.perf_counter() - t0, 3)
+        else:
+            out["fleet_evidence_error"] = f"agent rc={{rc}}"
+    finally:
+        httpd.shutdown()
+        _sthread.join(timeout=10)
+        httpd.server_close()
+        _sh.rmtree(_fw, ignore_errors=True)
+except Exception as e:
+    out["fleet_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # durability evidence (sofa_tpu/durability.py): fsck over the healthy
 # logdir, then drop the preprocess commit marker (a crash one instruction
 # before the commit) and time `sofa resume` — the number proves committed
@@ -447,7 +485,8 @@ print(json.dumps(out))
                     "durability_evidence_error", "analyze_wall_time_s",
                     "analyze_pass_count", "analyze_failed_passes",
                     "analyze_evidence_error", "whatif_identity_error_pct",
-                    "whatif_evidence_error"):
+                    "whatif_evidence_error", "fleet_push_wall_time_s",
+                    "fleet_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
@@ -464,6 +503,10 @@ print(json.dumps(out))
             _log(f"bench: whatif identity error "
                  f"{out['whatif_identity_error_pct']}% (zero-scenario "
                  "replay vs measured — no hardware needed)")
+        if "fleet_push_wall_time_s" in out:
+            _log(f"bench: fleet push wall "
+                 f"{out['fleet_push_wall_time_s']}s (loopback serve + "
+                 "agent spool-and-push of the pod_synth logdir)")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.
@@ -580,7 +623,8 @@ def _artifact_evidence() -> dict:
 _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "preprocess_warm_wall_time_s", "tile_build_wall_time_s",
                      "resume_wall_time_s", "report_js_bytes",
-                     "analyze_wall_time_s", "whatif_identity_error_pct")
+                     "analyze_wall_time_s", "whatif_identity_error_pct",
+                     "fleet_push_wall_time_s")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

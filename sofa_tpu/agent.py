@@ -1,0 +1,271 @@
+"""`sofa agent` — the per-host fleet daemon: watch, spool, forward.
+
+The fleet control plane's host half (ROADMAP "Fleet control plane";
+docs/FLEET.md).  A long-lived loop that
+
+1. **watches** a directory for finished recordings (a logdir counts as
+   finished when its ``run_manifest.json`` exists, no pipeline verb
+   holds the mid-write sentinel, its journal has no begun-but-uncommitted
+   stage, and it has been quiet for ``--settle_s``);
+2. **spools** each finished run into a durable local content-addressed
+   archive (archive/spool.py — the bytes are safe before any network is
+   involved, and the ingest is journaled in the logdir so `sofa resume`
+   replays a kill);
+3. **forwards** spooled runs to the fleet service (`sofa serve`) over
+   the idempotent resumable upload protocol (archive/client.py): bounded
+   timeouts, capped exponential backoff with jitter, typed refusals.
+
+Failure stance: the service being unreachable, overloaded (503), or
+over quota (429) NEVER loses a run and never wedges the loop — the run
+stays spooled and the drain pass retries on the next tick, with the
+service attempts themselves backed off (jittered) so a thousand agents
+whose service just rebooted do not re-arrive as one wave.  A SIGKILLed
+agent restarts into the same spool and journal; thanks to the
+have-list protocol the resumed push re-sends zero committed objects.
+
+Each delivered (or spooled-only) run gets ``meta.agent`` — and, once the
+service acks the commit, ``meta.serve`` — in its own run manifest, so
+`sofa status` and tools/manifest_check.py can audit the transport leg
+exactly like any pipeline stage (docs/OBSERVABILITY.md).
+
+Exit codes (``--once``): 0 everything discovered is spooled and — when a
+service is configured — delivered; 1 degraded (spooled but undelivered);
+2 usage error.  Daemon mode runs until SIGINT.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from sofa_tpu import faults, telemetry
+from sofa_tpu.concurrency import jittered_backoff
+from sofa_tpu.printing import (
+    print_error,
+    print_progress,
+    print_warning,
+)
+
+
+def discover_logdirs(watch: str) -> List[str]:
+    """Candidate logdirs under ``watch``: the directory itself and its
+    immediate children that carry a run manifest."""
+    from sofa_tpu.telemetry import MANIFEST_NAME
+
+    out: List[str] = []
+    candidates = [watch]
+    try:
+        candidates += sorted(
+            os.path.join(watch, n) for n in os.listdir(watch)
+            if os.path.isdir(os.path.join(watch, n)))
+    except OSError:
+        return []
+    for d in candidates:
+        if os.path.isfile(os.path.join(d, MANIFEST_NAME)):
+            out.append(d if d.endswith("/") else d + "/")
+    return out
+
+
+def logdir_ready(logdir: str, settle_s: float = 0.5) -> bool:
+    """A run is shippable when nothing is still writing it: no live
+    mid-write sentinel, no begun-but-uncommitted journal stage, and the
+    manifest quiet for ``settle_s`` (a recording host finishing analyze
+    re-writes it within seconds)."""
+    from sofa_tpu.durability import journal_state, read_journal
+    from sofa_tpu.trace import derived_writing
+
+    if derived_writing(logdir):
+        return False
+    for stage, st in journal_state(read_journal(logdir)).items():
+        if stage != "push" and not st.get("committed"):
+            return False
+    from sofa_tpu.archive.spool import _manifest_mtime
+
+    mtime = _manifest_mtime(logdir)
+    if mtime is None:
+        return False
+    return (time.time() - mtime / 1e9) >= max(settle_s, 0.0)  # sofa-lint: disable=SL003 — compared against a file mtime, which IS wall clock; monotonic has no common epoch with it
+
+
+class _AgentPass:
+    """One scan+drain pass; holds the tick's tallies for meta.agent and
+    the exit code."""
+
+    def __init__(self):
+        self.discovered = 0
+        self.spooled = 0
+        self.pushed = 0
+        self.failed = 0
+
+
+def _push_meta(spool, client, logdir: str, run_id: str) -> dict:
+    """Deliver one spooled run; returns the meta.agent ``push`` section
+    (status pushed|spooled|rejected) and patches meta.serve on success."""
+    from sofa_tpu.archive.client import ServiceRejected, ServiceUnavailable
+
+    t0 = time.perf_counter()
+    base_attempts = client.attempts
+    try:
+        result = spool.push(run_id, client)
+    except ServiceRejected as e:
+        print_warning(f"agent: service rejected {run_id[:12]}: {e} — "
+                      "the run stays spooled"
+                      + (" (over quota: raise --quota_mb server-side or "
+                         "gc the tenant)" if e.quota else ""))
+        return {"status": "rejected", "error": str(e)[:300],
+                "quota": bool(e.quota),
+                "attempts": client.attempts - base_attempts,
+                "wall_s": round(time.perf_counter() - t0, 3)}
+    except ServiceUnavailable as e:
+        print_warning(f"agent: service unreachable for {run_id[:12]}: "
+                      f"{e} — spooled, will retry")
+        return {"status": "spooled", "error": str(e)[:300],
+                "attempts": client.attempts - base_attempts,
+                "wall_s": round(time.perf_counter() - t0, 3)}
+    spool.mark_pushed(logdir, run_id, result.get("server") or {})
+    return {"status": "pushed",
+            "objects_sent": result.get("objects_sent", 0),
+            "bytes_sent": result.get("bytes_sent", 0),
+            "attempts": client.attempts - base_attempts,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "server": result.get("server") or {}}
+
+
+def _process_logdir(cfg, spool, client, logdir: str,
+                    tick: _AgentPass) -> None:
+    """Spool (if changed) and forward (if a service is configured) one
+    finished logdir, recording meta.agent/meta.serve in its manifest."""
+    import copy
+
+    tick.discovered += 1
+    ent = spool.entry(logdir)
+    needs_spool = spool.needs_ingest(logdir)
+    needs_push = client is not None and (needs_spool
+                                         or not ent.get("pushed"))
+    if not (needs_spool or needs_push):
+        return
+    lcfg = copy.deepcopy(cfg)
+    lcfg.logdir = logdir
+    lcfg.__post_init__()
+    tel = telemetry.begin("agent")
+    try:
+        if needs_spool:
+            summary = spool.spool(lcfg)
+            if summary is None:
+                tick.failed += 1
+                return
+            tick.spooled += 1
+        run_id = spool.entry(logdir).get("run")
+        meta_agent = {
+            "spool": spool.root,
+            "run": run_id,
+            "service": client.base if client is not None else None,
+            "tenant": client.tenant if client is not None else None,
+        }
+        push = None
+        if client is not None and run_id:
+            push = _push_meta(spool, client, logdir, run_id)
+            meta_agent["push"] = push
+            if push["status"] == "pushed":
+                tick.pushed += 1
+                ack = push.get("server") or {}
+                tel.set_meta(serve={
+                    "url": client.base,
+                    "tenant": str(ack.get("tenant", client.tenant)),
+                    "run": str(ack.get("run", run_id)),
+                    "new": bool(ack.get("new")),
+                    "quota_used_mb": ack.get("quota_used_mb"),
+                    "committed_unix": round(time.time(), 3),
+                })
+            else:
+                tick.failed += 1
+        tel.set_meta(agent=meta_agent)
+        tel.write(logdir, rc=0 if (push is None
+                                   or push["status"] == "pushed") else 1,
+                  cfg=lcfg)
+        spool.refresh_fingerprint(logdir)
+    finally:
+        telemetry.end(tel)
+
+
+def _drain_orphans(spool, client, tick: _AgentPass) -> None:
+    """Push spooled runs whose source logdir is gone (deleted after
+    spooling — the spool is the only surviving copy, which is the
+    point): delivery must not depend on the source outliving the
+    outage."""
+    for run_id, logdir in spool.pending_runs().items():
+        if os.path.isdir(logdir):
+            continue  # the normal per-logdir path owns it
+        push = _push_meta(spool, client, logdir, run_id)
+        if push["status"] == "pushed":
+            tick.pushed += 1
+        else:
+            tick.failed += 1
+
+
+def sofa_agent(cfg, watch: "str | None" = None, once: bool = False) -> int:
+    """``sofa agent <watch_dir> [--service URL] [--once]`` — see the
+    module docstring for the loop and the exit contract."""
+    from sofa_tpu.archive.client import client_from_cfg
+    from sofa_tpu.archive.spool import Spool, resolve_spool
+
+    watch = watch or cfg.logdir
+    if not os.path.isdir(watch):
+        print_error(f"agent: watch directory {watch} does not exist")
+        return 2
+    plan = faults.install_from(cfg)
+    try:
+        spool = Spool(resolve_spool(cfg))
+        client = client_from_cfg(cfg)
+        if client is None:
+            print_progress(
+                f"agent: no --service configured — spool-only mode "
+                f"(runs land in {spool.root}; point --service at a "
+                "`sofa serve` endpoint to forward)")
+        poll_s = max(float(getattr(cfg, "agent_poll_s", 5.0) or 5.0), 0.05)
+        settle_s = float(getattr(cfg, "agent_settle_s", 0.5) or 0.0)
+        service_failures = 0
+        next_service_try = 0.0  # monotonic; 0 = try immediately
+        while True:
+            tick = _AgentPass()
+            # Service attempts are themselves backed off (jittered):
+            # after an outage, a fleet of agents must trickle back, not
+            # stampede.  --once always makes one full attempt.
+            gate_service = (client is not None and not once
+                            and time.monotonic() < next_service_try)
+            use_client = None if gate_service else client
+            for logdir in discover_logdirs(watch):
+                if os.path.abspath(logdir).startswith(spool.root):
+                    continue  # never ship the spool into itself
+                if not logdir_ready(logdir, settle_s=settle_s):
+                    continue
+                _process_logdir(cfg, spool, use_client, logdir, tick)
+            if use_client is not None:
+                _drain_orphans(spool, use_client, tick)
+            if use_client is not None:
+                if tick.failed:
+                    service_failures += 1
+                    backoff = jittered_backoff(
+                        service_failures,
+                        getattr(cfg, "agent_backoff_s", 0.5),
+                        getattr(cfg, "agent_backoff_cap_s", 30.0))
+                    next_service_try = time.monotonic() + backoff
+                elif tick.pushed or tick.discovered:
+                    service_failures = 0
+            if once:
+                undelivered = len(spool.pending_runs()) \
+                    if client is not None else 0
+                print_progress(
+                    f"agent: {tick.discovered} run(s) discovered, "
+                    f"{tick.spooled} spooled, {tick.pushed} pushed"
+                    + (f", {undelivered} awaiting the service"
+                       if undelivered else ""))
+                return 1 if undelivered else 0
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        print_progress("agent: stopped")
+        return 0
+    finally:
+        if plan is not None:
+            faults.clear()

@@ -1,0 +1,280 @@
+"""Fleet transport client: the agent's half of the upload protocol.
+
+Everything the service (archive/service.py) promises is only real if the
+client USES it, so this module is where the resilience contract lives:
+
+* **bounded**: every request carries a connect+read deadline
+  (``--push_timeout_s``) — a stalled link degrades to a retry, never a
+  wedged agent;
+* **retrying with jitter**: transient failures (refused connections,
+  timeouts, 5xx, 503-loaded/mid-gc, a hash-mismatch reject of a torn
+  upload) retry up to ``--push_retries`` times with capped exponential
+  backoff and jitter (concurrency.jittered_backoff); a server-sent
+  ``Retry-After`` is honored as the floor of the wait;
+* **typed refusals**: auth failures (401/403) and quota breaches
+  (429 ``{"error": "quota"}``) raise :class:`ServiceRejected` — they
+  will not clear on retry, so the agent keeps the run in its durable
+  spool instead of hammering the service;
+* **resumable**: :func:`push_run` always starts from the server's
+  have-list, so a push interrupted anywhere — client SIGKILL, service
+  death mid-upload, a dropped link — re-sends ZERO objects the server
+  already committed.
+
+Network fault injection (faults.py NET_KINDS, target ``service``) is
+threaded through :meth:`ServiceClient._attempt`: ``conn_refused``/
+``stall``/``http_500`` surface as the same exception the real failure
+would raise, and ``partial@<f>`` truncates the upload body so the
+SERVER's hash check — not a client shortcut — rejects it.  Every
+retry/resume path is thereby testable without a flaky network.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+from sofa_tpu import faults
+from sofa_tpu.concurrency import jittered_backoff
+from sofa_tpu.printing import print_warning
+
+
+class ServiceUnavailable(Exception):
+    """A transient transport failure — retry with backoff."""
+
+    def __init__(self, msg: str, status: Optional[int] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.status = status
+        self.retry_after = retry_after
+
+
+class ServiceRejected(Exception):
+    """A typed refusal that retrying cannot clear (auth, quota, bad
+    request) — the agent's cue to fall back to the durable spool."""
+
+    def __init__(self, msg: str, status: Optional[int] = None,
+                 quota: bool = False):
+        super().__init__(msg)
+        self.status = status
+        self.quota = quota
+
+
+class ServiceIncomplete(Exception):
+    """Commit refused: objects are missing server-side (409) — resume
+    from the attached have-list."""
+
+    def __init__(self, msg: str, missing):
+        super().__init__(msg)
+        self.missing = list(missing or [])
+
+
+class ServiceClient:
+    """One service endpoint + tenant + token, with the retry policy."""
+
+    def __init__(self, url: str, token: str, tenant: str = "default",
+                 timeout_s: float = 10.0, retries: int = 4,
+                 backoff_s: float = 0.5, backoff_cap_s: float = 30.0,
+                 rng=None):
+        self.base = url.rstrip("/")
+        self.token = token
+        self.tenant = tenant
+        self.timeout_s = max(float(timeout_s), 0.1)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = max(float(backoff_s), 0.0)
+        self.backoff_cap_s = max(float(backoff_cap_s), self.backoff_s)
+        import random
+
+        self.rng = rng if rng is not None else random
+        # transparency counters the agent folds into meta.agent
+        self.attempts = 0
+        self.retried = 0
+
+    # -- single attempt ----------------------------------------------------
+    def _attempt(self, method: str, path: str, body: "bytes | None",
+                 op: str, key: str) -> dict:
+        url = f"{self.base}{path}"
+        self.attempts += 1
+        try:
+            spec = faults.maybe_service_fault(op, key)
+            if spec is not None:
+                if spec.kind == "conn_refused":
+                    raise urllib.error.URLError(
+                        ConnectionRefusedError("injected conn_refused"))
+                if spec.kind == "stall":
+                    # models the read deadline having expired — the
+                    # exception the bounded timeout would raise, without
+                    # actually burning the wall-clock
+                    raise socket.timeout("injected stall")
+                if spec.kind == "http_500":
+                    raise urllib.error.HTTPError(
+                        url, 500, "injected http_500", None, None)
+                if spec.kind == "partial" and body and op == "put":
+                    # truncated-upload fault: only object bodies — the
+                    # SERVER's hash check is the rejection under test
+                    # (a cut JSON control request would just be a 400)
+                    body = body[:max(int(len(body) * spec.fraction), 1)]
+            req = urllib.request.Request(url, data=body, method=method)
+            req.add_header("Authorization", f"Bearer {self.token}")
+            if body is not None:
+                req.add_header("Content-Type", "application/octet-stream")
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            doc = _error_doc(e)
+            if e.code in (401, 403):
+                raise ServiceRejected(
+                    f"{op}: service rejected the token ({e.code})",
+                    status=e.code) from None
+            if e.code == 429 and doc.get("error") == "quota":
+                raise ServiceRejected(
+                    f"{op}: tenant {self.tenant!r} is over quota "
+                    f"({doc.get('used_mb')}/{doc.get('quota_mb')} MB)",
+                    status=429, quota=True) from None
+            if e.code == 409:
+                raise ServiceIncomplete(
+                    f"{op}: commit refused, "
+                    f"{len(doc.get('missing') or [])} object(s) missing "
+                    "server-side", doc.get("missing")) from None
+            if e.code in (408, 422, 425, 429) or e.code >= 500:
+                raise ServiceUnavailable(
+                    f"{op}: HTTP {e.code} ({doc.get('error') or e.reason})",
+                    status=e.code,
+                    retry_after=_retry_after(e)) from None
+            raise ServiceRejected(f"{op}: HTTP {e.code} "
+                                  f"({doc.get('error') or e.reason})",
+                                  status=e.code) from None
+        except (socket.timeout, TimeoutError) as e:
+            raise ServiceUnavailable(f"{op}: timed out after "
+                                     f"{self.timeout_s}s: {e}") from None
+        except urllib.error.URLError as e:
+            raise ServiceUnavailable(f"{op}: {e.reason}") from None
+        except (ConnectionError, OSError, ValueError) as e:
+            raise ServiceUnavailable(f"{op}: {e}") from None
+
+    # -- retry loop --------------------------------------------------------
+    def _call(self, method: str, path: str, body: "bytes | None",
+              op: str, key: str = "") -> dict:
+        attempt = 0
+        while True:
+            try:
+                return self._attempt(method, path, body, op, key)
+            except ServiceUnavailable as e:
+                if attempt >= self.retries:
+                    raise
+                delay = jittered_backoff(attempt, self.backoff_s,
+                                         self.backoff_cap_s, self.rng)
+                if e.retry_after is not None:
+                    delay = min(max(delay, float(e.retry_after)),
+                                self.backoff_cap_s)
+                self.retried += 1
+                attempt += 1
+                time.sleep(delay)
+
+    # -- protocol ----------------------------------------------------------
+    def ping(self) -> dict:
+        return self._call("GET", "/v1/ping", None, "ping")
+
+    def have(self, files: Dict[str, dict]) -> dict:
+        body = json.dumps({"files": files}).encode()
+        return self._call("POST", f"/v1/{self.tenant}/have", body, "have")
+
+    def put_object(self, sha: str, data: bytes) -> dict:
+        return self._call("PUT", f"/v1/{self.tenant}/object/{sha}", data,
+                          "put", key=sha)
+
+    def commit(self, doc: dict) -> dict:
+        body = json.dumps(doc).encode()
+        return self._call("POST", f"/v1/{self.tenant}/commit", body,
+                          "commit")
+
+
+def _error_doc(e: urllib.error.HTTPError) -> dict:
+    try:
+        doc = json.loads(e.read() or b"{}")
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError, AttributeError):
+        return {}
+
+
+def _retry_after(e: urllib.error.HTTPError) -> Optional[float]:
+    try:
+        v = (e.headers or {}).get("Retry-After")
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def client_from_cfg(cfg, rng=None) -> "ServiceClient | None":
+    """A client for the configured service, or None in spool-only mode
+    (no ``--service`` / SOFA_AGENT_SERVICE)."""
+    from sofa_tpu.archive.service import resolve_token
+
+    url = (getattr(cfg, "agent_service", "")
+           or os.environ.get("SOFA_AGENT_SERVICE", "") or "").strip()
+    if not url:
+        return None
+    return ServiceClient(
+        url, resolve_token(cfg),
+        tenant=getattr(cfg, "fleet_tenant", "default") or "default",
+        timeout_s=getattr(cfg, "agent_timeout_s", 10.0),
+        retries=getattr(cfg, "agent_retries", 4),
+        backoff_s=getattr(cfg, "agent_backoff_s", 0.5),
+        backoff_cap_s=getattr(cfg, "agent_backoff_cap_s", 30.0),
+        rng=rng)
+
+
+def push_run(store, run_id: str, client: ServiceClient) -> dict:
+    """Push one spooled run to the service; idempotent and resumable.
+
+    Always begins from the server's have-list, so only objects the
+    server lacks travel; returns ``{"run", "status", "objects_sent",
+    "bytes_sent", "new", "server": <commit ack>}``.  Raises the client's
+    typed exceptions on failure — the caller (sofa_tpu/agent.py) owns
+    the spool-and-retry-later decision."""
+    doc = store.load_run(run_id)
+    if doc is None:
+        raise ServiceRejected(
+            f"spooled run {run_id[:12]} has no readable run doc — run "
+            "`sofa archive fsck` on the spool", status=None)
+    files = doc.get("files") or {}
+    sent = 0
+    sent_bytes = 0
+    for round_no in (1, 2):
+        have = client.have(files)
+        if have.get("committed"):
+            return {"run": run_id, "status": "committed", "new": False,
+                    "objects_sent": sent, "bytes_sent": sent_bytes,
+                    "server": have}
+        for sha in have.get("missing") or []:
+            data = store.read_object(sha)
+            if data is None:
+                raise ServiceRejected(
+                    f"spool object {sha[:12]} is unreadable — run "
+                    "`sofa archive fsck` on the spool", status=None)
+            client.put_object(sha, data)
+            sent += 1
+            sent_bytes += len(data)
+        try:
+            ack = client.commit(doc)
+            return {"run": run_id, "status": "pushed",
+                    "new": bool(ack.get("new")), "objects_sent": sent,
+                    "bytes_sent": sent_bytes, "server": ack}
+        except ServiceIncomplete as e:
+            # an object vanished between have and commit (gc racing a
+            # slow push, or a competing agent's store sweep): one more
+            # have->put->commit round resolves it, a second miss is real
+            if round_no == 2:
+                raise ServiceUnavailable(
+                    f"commit still missing {len(e.missing)} object(s) "
+                    "after a resume round") from None
+            print_warning(
+                f"push {run_id[:12]}: server reports "
+                f"{len(e.missing)} object(s) missing at commit — "
+                "resuming from a fresh have-list")
+    raise ServiceUnavailable("unreachable")  # pragma: no cover

@@ -1,0 +1,574 @@
+"""`sofa serve` — the write-capable fleet archive service.
+
+PR 5 promoted `sofa viz` into a production *read* server; this module
+promotes the archive ``/archive/`` route into the fleet control plane's
+*ingest* half: a standalone, token-authenticated HTTP service over a
+multi-tenant archive root that `sofa agent` daemons (sofa_tpu/agent.py)
+push finished runs into.  Design pillars (docs/FLEET.md):
+
+**Idempotent, content-addressed, resumable.**  The unit of upload is one
+content-addressed object (the store's dedup unit, archive/store.py): the
+client first POSTs the run's ``(rel -> sha256)`` file map to ``have`` and
+gets back the exact set of objects the server lacks, uploads only those,
+then POSTs ``commit``.  A re-sent object is a no-op (the store already
+has those bytes); a replayed commit of a cataloged run is a no-op; an
+upload interrupted ANYWHERE resumes from a fresh have-list with zero
+re-sent committed objects.  The server re-hashes every uploaded body and
+rejects a mismatch (422) — a truncated or corrupted upload can never
+poison the store.
+
+**Tenancy + quotas.**  Every route is namespaced ``/v1/<tenant>/...``;
+each tenant is a full archive root under ``<root>/tenants/<tenant>/``
+(same marker, catalog, gc, and ``archive_fsck`` as a local archive).
+``--quota_mb`` caps each tenant's object store — a breach answers 429
+with a machine-readable ``{"error": "quota"}`` so agents degrade to
+their durable spool instead of retrying forever (the disk-budget stance
+of PR 6: the service can refuse, but it can never be filled up).
+
+**Honest backpressure.**  More than ``--max_inflight`` concurrent write
+requests, or a tenant root mid-gc (`sofa archive gc` holds the
+``derived_write_guard`` sentinel, the same pattern the viz server 503s
+on), answers 503 + ``Retry-After`` — a loaded or compacting service
+tells clients *when* to come back rather than timing them out.
+
+Auth is a single bearer token (``--token`` / ``SOFA_SERVE_TOKEN``,
+compared constant-time); the service refuses to start without one — an
+unauthenticated write endpoint is not a degraded mode, it is a bug.
+
+Chaos hook: ``SOFA_SERVE_EXIT_AFTER=<n>`` hard-exits the process at the
+start of the n-th write request — the kill-service-mid-upload cell in
+tools/chaos_matrix.py uses it to prove agent retry + store integrity.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import hmac
+import http.server
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from sofa_tpu.archive import catalog
+from sofa_tpu.archive.store import (
+    RUN_SCHEMA,
+    RUN_VERSION,
+    ArchiveStore,
+    run_content_id,
+)
+from sofa_tpu.concurrency import Guard
+from sofa_tpu.printing import print_error, print_progress, print_warning
+
+SERVICE_SCHEMA = "sofa_tpu/fleet_service"
+# Protocol version: bumps on any BREAKING route/payload change, additive
+# keys do not (the run-manifest policy, docs/OBSERVABILITY.md).
+SERVICE_VERSION = 1
+
+#: Marker written at the served root (a container of tenant archive
+#: roots — each tenant dir carries its own ``sofa_archive.json``).
+FLEET_MARKER_NAME = "sofa_fleet.json"
+
+TENANTS_DIR_NAME = "tenants"
+
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+_SHA_RE = re.compile(r"^[0-9a-f]{64}$")
+
+# One object per request keeps memory bounded without chunk bookkeeping;
+# anything bigger than this in a logdir is misconfiguration, not data.
+_MAX_BODY = 1 << 30
+
+_RETRY_AFTER_S = "1"
+
+
+def _chaos_exit_after() -> int:
+    """The kill-service-mid-upload chaos knob (0 = off)."""
+    try:
+        return int(os.environ.get("SOFA_SERVE_EXIT_AFTER", "0"))
+    except ValueError:
+        return 0
+
+
+class _FleetServer(http.server.ThreadingHTTPServer):
+    """Server state shared across handler threads, under declared guards
+    (the SL019 contract): request counters, the in-flight write gauge
+    (backpressure), and the per-tenant object-store byte ledger (quota)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, handler, root: str, token: str,
+                 quota_mb: float = 0.0, max_inflight: int = 8):
+        super().__init__(addr, handler)
+        self.root = os.path.abspath(root)
+        self.token = token
+        self.quota_bytes = int(max(quota_mb, 0.0) * 2 ** 20)
+        self.max_inflight = max(int(max_inflight), 1)
+        self._state_guard = Guard("serve.state", protects=(
+            "stats", "inflight", "tenant_bytes", "writes_handled"))
+        self.stats: Dict[str, int] = {}
+        self.inflight = 0
+        self.tenant_bytes: Dict[str, int] = {}
+        self.writes_handled = 0
+
+    # -- counters ----------------------------------------------------------
+    def count_response(self, key: str) -> None:
+        with self._state_guard:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def stats_line(self) -> "str | None":
+        with self._state_guard:
+            stats = dict(self.stats)
+        if not stats:
+            return None
+        return ", ".join(f"{v} {k}" for k, v in sorted(stats.items()))
+
+    # -- backpressure ------------------------------------------------------
+    def write_slot(self) -> bool:
+        """Claim an in-flight write slot; False = loaded, answer 503."""
+        with self._state_guard:
+            if self.inflight >= self.max_inflight:
+                return False
+            self.inflight += 1
+            return True
+
+    def release_slot(self) -> None:
+        with self._state_guard:
+            self.inflight = max(self.inflight - 1, 0)
+
+    def chaos_tick(self) -> None:
+        """Count a write request; hard-exit at the chaos threshold — the
+        deterministic stand-in for the OOM-killer taking the service down
+        mid-upload (tools/chaos_matrix.py kill-service-mid-upload)."""
+        n = _chaos_exit_after()
+        if not n:
+            return
+        with self._state_guard:
+            self.writes_handled += 1
+            fire = self.writes_handled >= n
+        if fire:
+            os._exit(86)
+
+    # -- tenancy / quota ---------------------------------------------------
+    def tenant_root(self, tenant: str) -> str:
+        return os.path.join(self.root, TENANTS_DIR_NAME, tenant)
+
+    def tenant_store(self, tenant: str) -> ArchiveStore:
+        return ArchiveStore(self.tenant_root(tenant), create=True)
+
+    def tenant_used_bytes(self, tenant: str) -> int:
+        """The tenant's object-store size.  Walked once per tenant per
+        server lifetime (outside the guard — IO under a guard stalls
+        every handler), then maintained incrementally on each accepted
+        upload."""
+        with self._state_guard:
+            cached = self.tenant_bytes.get(tenant)
+        if cached is not None:
+            return cached
+        used = 0
+        obj_root = os.path.join(self.tenant_root(tenant), "objects")
+        for dirpath, _dirs, names in os.walk(obj_root):
+            for name in names:
+                try:
+                    used += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    continue
+        with self._state_guard:
+            self.tenant_bytes.setdefault(tenant, used)
+            return self.tenant_bytes[tenant]
+
+    def charge_tenant(self, tenant: str, n: int) -> None:
+        with self._state_guard:
+            self.tenant_bytes[tenant] = self.tenant_bytes.get(tenant, 0) + n
+
+    def auth_ok(self, header: "str | None") -> bool:
+        if not header or not header.startswith("Bearer "):
+            return False
+        return hmac.compare_digest(header[len("Bearer "):], self.token)
+
+
+class _FleetHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "sofa_tpu-serve"
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+    def _json(self, code: int, doc: dict,
+              retry_after: "str | None" = None) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", retry_after)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except OSError:
+            pass  # client went away mid-answer; nothing to salvage
+
+    def _count(self, key: str) -> None:
+        self.server.count_response(key)
+
+    def _body(self) -> "bytes | None":
+        """The request body, or None after answering an error."""
+        try:
+            n = int(self.headers.get("Content-Length") or "")
+        except ValueError:
+            self._json(411, {"error": "length_required"})
+            return None
+        if n < 0 or n > _MAX_BODY:
+            self._json(413, {"error": "too_large", "max_bytes": _MAX_BODY})
+            return None
+        data = self.rfile.read(n)
+        if len(data) != n:
+            # client hung up mid-body; it will retry — nothing landed
+            self._count("truncated_body")
+            return None
+        return data
+
+    def _route(self) -> "Tuple[str, List[str]] | None":
+        """(tenant, path segments under the tenant) for an authed /v1/
+        route; answers the error itself and returns None otherwise."""
+        parts = [p for p in self.path.split("?", 1)[0].split("/") if p]
+        if len(parts) < 2 or parts[0] != "v1":
+            self._json(404, {"error": "no_such_route"})
+            return None
+        if not self.server.auth_ok(self.headers.get("Authorization")):
+            self._count("401_unauthorized")
+            self._json(401, {"error": "unauthorized"})
+            return None
+        tenant = parts[1]
+        if not _TENANT_RE.match(tenant) or tenant in (
+                TENANTS_DIR_NAME, "..", "."):
+            self._json(400, {"error": "bad_tenant"})
+            return None
+        return tenant, parts[2:]
+
+    def _backpressure(self, tenant: str) -> bool:
+        """True when the request was answered with a 503 (mid-gc on the
+        tenant root — the derived-write-guard sentinel `sofa archive gc`
+        holds — exactly the viz server's mid-write contract)."""
+        from sofa_tpu.trace import derived_writing
+
+        if derived_writing(self.server.tenant_root(tenant)):
+            self._count("503_mid_gc")
+            self._json(503, {"error": "mid_gc"},
+                       retry_after=_RETRY_AFTER_S)
+            return True
+        return False
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — http.server handler contract
+        clean = self.path.split("?", 1)[0]
+        if clean == "/v1/ping":
+            self._count("ping")
+            self._json(200, {"ok": True, "schema": SERVICE_SCHEMA,
+                             "version": SERVICE_VERSION})
+            return
+        routed = self._route()
+        if routed is None:
+            return
+        tenant, rest = routed
+        store = ArchiveStore(self.server.tenant_root(tenant))
+        if rest == ["catalog"]:
+            try:
+                with open(catalog.catalog_path(store.root), "rb") as f:
+                    body = f.read()
+            except OSError:
+                body = b""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass  # client went away; the catalog is still on disk
+            self._count("catalog_read")
+            return
+        if len(rest) == 2 and rest[0] == "run" and store.exists:
+            doc = store.load_run(rest[1]) if _SHA_RE.match(rest[1]) else None
+            if doc is None:
+                self._json(404, {"error": "no_such_run"})
+                return
+            self._count("run_read")
+            self._json(200, doc)
+            return
+        self._json(404, {"error": "no_such_route"})
+
+    # -- POST (have / commit) ----------------------------------------------
+    def do_POST(self):  # noqa: N802 — http.server handler contract
+        routed = self._route()
+        if routed is None:
+            return
+        tenant, rest = routed
+        if rest not in (["have"], ["commit"]):
+            self._json(404, {"error": "no_such_route"})
+            return
+        if not self.server.write_slot():
+            self._count("503_loaded")
+            self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
+            return
+        try:
+            if self._backpressure(tenant):
+                return
+            self.server.chaos_tick()
+            data = self._body()
+            if data is None:
+                return
+            try:
+                doc = json.loads(data)
+            except ValueError:
+                self._json(400, {"error": "bad_json"})
+                return
+            files = doc.get("files")
+            if not isinstance(files, dict) or not files or any(
+                    not isinstance(e, dict)
+                    or not _SHA_RE.match(str(e.get("sha256", "")))
+                    for e in files.values()):
+                self._json(400, {"error": "bad_files_map"})
+                return
+            if rest == ["have"]:
+                self._have(tenant, files)
+            else:
+                self._commit(tenant, doc, files)
+        finally:
+            self.server.release_slot()
+
+    def _have(self, tenant: str, files: Dict[str, dict]) -> None:
+        """The resume point: which of the run's objects the store already
+        holds, and whether the run itself is already committed — the
+        client uploads exactly the rest, nothing twice."""
+        store = self.server.tenant_store(tenant)
+        run_id = run_content_id(files)
+        shas = {e["sha256"] for e in files.values()}
+        missing = sorted(s for s in shas if not store.has_object(s))
+        committed = any(
+            e.get("run") == run_id
+            for e in catalog.read_catalog(store.root)
+            if e.get("ev") == "ingest")
+        self._count("have")
+        self._json(200, {"run": run_id, "have": len(shas) - len(missing),
+                         "missing": missing, "committed": committed})
+
+    def _commit(self, tenant: str, doc: dict,
+                files: Dict[str, dict]) -> None:
+        """The run's commit point, mirroring a local ingest: verify every
+        referenced object landed, write the run doc, append the catalog
+        line.  Replaying a committed run is a pure no-op."""
+        store = self.server.tenant_store(tenant)
+        run_id = run_content_id(files)
+        missing = sorted({e["sha256"] for e in files.values()
+                         if not store.has_object(e["sha256"])})
+        if missing:
+            self._count("409_incomplete")
+            self._json(409, {"error": "missing_objects", "run": run_id,
+                             "missing": missing})
+            return
+        already = any(
+            e.get("run") == run_id
+            for e in catalog.read_catalog(store.root)
+            if e.get("ev") == "ingest")
+        if not already:
+            from sofa_tpu.durability import atomic_write
+
+            run_doc = {
+                "schema": RUN_SCHEMA, "version": RUN_VERSION,
+                "run": run_id, "t": round(time.time(), 3),
+                "logdir": str(doc.get("logdir", "")),
+                "hostname": str(doc.get("hostname", "")),
+                "label": str(doc.get("label", "")),
+                "tenant": tenant,
+                "files": files,
+                "features": doc.get("features") or {},
+            }
+            with atomic_write(store.run_doc_path(run_id), fsync=True) as f:
+                json.dump(run_doc, f, indent=1, sort_keys=True)
+            catalog.append_event(
+                store.root, "ingest", run=run_id,
+                logdir=str(doc.get("logdir", "")), files=len(files),
+                new_objects=0, bytes_added=0, via="service",
+                **({"label": str(doc["label"])} if doc.get("label")
+                   else {}))
+        self._count("commit" if not already else "commit_replayed")
+        self._json(200, {
+            "run": run_id, "committed": True, "new": not already,
+            "tenant": tenant,
+            "quota_used_mb": round(
+                self.server.tenant_used_bytes(tenant) / 2 ** 20, 3),
+        })
+
+    # -- PUT (one content-addressed object == one upload chunk) ------------
+    def do_PUT(self):  # noqa: N802 — http.server handler contract
+        routed = self._route()
+        if routed is None:
+            return
+        tenant, rest = routed
+        if len(rest) != 2 or rest[0] != "object" or \
+                not _SHA_RE.match(rest[1]):
+            self._json(404, {"error": "no_such_route"})
+            return
+        sha = rest[1]
+        if not self.server.write_slot():
+            self._count("503_loaded")
+            self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
+            return
+        try:
+            if self._backpressure(tenant):
+                return
+            self.server.chaos_tick()
+            store = self.server.tenant_store(tenant)
+            if store.has_object(sha):
+                # idempotent fast path: a re-sent object costs a stat —
+                # the body still has to drain for HTTP/1.1 keep-alive
+                if self._body() is None:
+                    return
+                self._count("object_dedup")
+                self._json(200, {"sha256": sha, "new": False})
+                return
+            data = self._body()
+            if data is None:
+                return
+            quota = self.server.quota_bytes
+            if quota and self.server.tenant_used_bytes(tenant) \
+                    + len(data) > quota:
+                self._count("429_quota")
+                self._json(429, {
+                    "error": "quota", "tenant": tenant,
+                    "quota_mb": round(quota / 2 ** 20, 3),
+                    "used_mb": round(
+                        self.server.tenant_used_bytes(tenant) / 2 ** 20,
+                        3)})
+                return
+            got = hashlib.sha256(data).hexdigest()
+            if got != sha:
+                # a truncated/corrupted upload (the partial@<f> fault's
+                # landing site): reject, client re-sends — the store
+                # only ever holds bytes that hash to their name
+                self._count("422_hash_mismatch")
+                self._json(422, {"error": "hash_mismatch",
+                                 "expected": sha, "got": got})
+                return
+            _, added = store.put_bytes(data)
+            if added:
+                self.server.charge_tenant(tenant, added)
+            self._count("object_stored" if added else "object_dedup")
+            self._json(200, {"sha256": sha, "new": bool(added)})
+        finally:
+            self.server.release_slot()
+
+
+def _write_fleet_marker(root: str) -> None:
+    """Initialize (or verify) the served root's marker.  An existing
+    marker is read back: serving a root created by a DIFFERENT protocol
+    version is refused — the on-disk tenant layout is the contract."""
+    from sofa_tpu.durability import atomic_write
+
+    marker = os.path.join(root, FLEET_MARKER_NAME)
+    if os.path.isfile(marker):
+        try:
+            with open(marker) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise OSError(f"unreadable {FLEET_MARKER_NAME}: {e}") from None
+        if not isinstance(doc, dict) or doc.get("schema") != SERVICE_SCHEMA:
+            raise OSError(f"{marker} is not a fleet-service root marker")
+        if doc.get("version") != SERVICE_VERSION:
+            raise OSError(
+                f"{root} was created by fleet-service protocol "
+                f"v{doc.get('version')}, this build speaks "
+                f"v{SERVICE_VERSION} — refusing to serve a layout it "
+                "might misread")
+        return
+    os.makedirs(os.path.join(root, TENANTS_DIR_NAME), exist_ok=True)
+    with atomic_write(marker, fsync=True) as f:
+        json.dump({"schema": SERVICE_SCHEMA, "version": SERVICE_VERSION,
+                   "created_unix": round(time.time(), 3)}, f)
+
+
+def resolve_token(cfg=None) -> str:
+    """The shared bearer token: ``--token``, else SOFA_SERVE_TOKEN."""
+    tok = getattr(cfg, "serve_token", "") if cfg is not None else ""
+    return tok or os.environ.get("SOFA_SERVE_TOKEN", "")
+
+
+def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
+    """``sofa serve <archive_root>`` — run the fleet ingest service.
+
+    Returns the exit code when ``serve_forever`` (0 clean shutdown, 2
+    usage error); with ``serve_forever=False`` returns the bound server
+    (tests/bench drive ``serve_forever()`` on their own thread) or None
+    on a usage error."""
+    from sofa_tpu.archive import resolve_root
+
+    root = root or resolve_root(cfg)
+    token = resolve_token(cfg)
+    if not token:
+        print_error(
+            "serve needs an auth token: --token <secret> or the "
+            "SOFA_SERVE_TOKEN env var (an unauthenticated write service "
+            "is refused, not degraded)")
+        return 2 if serve_forever else None
+    try:
+        _write_fleet_marker(root)
+    except OSError as e:
+        print_error(f"serve: cannot initialize {root}: {e}")
+        return 2 if serve_forever else None
+    quota_mb = float(getattr(cfg, "serve_quota_mb", 0.0) or 0.0)
+    max_inflight = int(getattr(cfg, "serve_max_inflight", 8) or 8)
+    bind = getattr(cfg, "serve_bind", "127.0.0.1")
+    base_port = int(getattr(cfg, "serve_port", 8044) or 0)
+    httpd = None
+    last_err = None
+    ports = [0] if base_port == 0 else range(base_port, base_port + 20)
+    for port_try in ports:
+        try:
+            httpd = _FleetServer((bind, port_try), _FleetHandler,
+                                 root=root, token=token, quota_mb=quota_mb,
+                                 max_inflight=max_inflight)
+            break
+        except OSError as e:
+            last_err = e
+            if getattr(e, "errno", None) != errno.EADDRINUSE:
+                break
+    if httpd is None:
+        print_error(f"serve: cannot bind {bind} near port {base_port}: "
+                    f"{last_err}")
+        return 2 if serve_forever else None
+    port = httpd.server_address[1]
+    from sofa_tpu.viz import _display_host
+
+    host = _display_host(bind)
+    print_progress(
+        f"fleet archive service: {root} at http://{host}:{port}/v1/ "
+        f"(tenants under {TENANTS_DIR_NAME}/; "
+        + (f"quota {quota_mb:g} MB/tenant; " if quota_mb else "")
+        + f"max {max_inflight} in-flight write(s); Ctrl-C stops)")
+    print_progress(
+        "push with: sofa agent <watch_dir> --service "
+        f"http://{host}:{port} --token <secret> (docs/FLEET.md)")
+    if not serve_forever:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        served = httpd.stats_line()
+        if served:
+            print_progress(f"serve handled: {served}")
+    return 0
+
+
+def service_url(httpd) -> str:
+    """Base URL of a bound server (tests/bench convenience)."""
+    host, port = httpd.server_address[:2]
+    if host in ("0.0.0.0", "::", ""):
+        host = "127.0.0.1"
+    return f"http://{host}:{port}"

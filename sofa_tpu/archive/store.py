@@ -324,7 +324,12 @@ def ingest_run(cfg, root: str, label: str = "",
     return summary
 
 
-_SELF_VERBS = ("archive", "regress")
+# Verbs whose manifest sections describe ARCHIVING/SHIPPING the run
+# rather than the run itself: stripped by normalization so that
+# archiving, re-archiving, or the agent stamping meta.agent/meta.serve
+# can never change the next ingest's content address ("serve" appears
+# only as a meta key, but the strip loops cover both namespaces).
+_SELF_VERBS = ("archive", "regress", "agent", "serve")
 
 
 def _normalized_manifest(logdir: str) -> Optional[bytes]:
@@ -353,6 +358,12 @@ def _normalized_manifest(logdir: str) -> Optional[bytes]:
     if isinstance(doc.get("stages"), list):
         doc["stages"] = [s for s in doc["stages"]
                          if s.get("verb") not in _SELF_VERBS]
+    # A container the strip emptied must normalize like one that never
+    # existed — "agent stamped meta.agent, then nothing" and "no agent
+    # ever ran" are the same run content.
+    for key in ("meta", "runs", "collectors", "sources", "stages"):
+        if key in doc and not doc[key]:
+            doc.pop(key)
     return json.dumps(doc, indent=1, sort_keys=True).encode()
 
 
@@ -373,7 +384,19 @@ def gc(root: str, keep: int = 0, keep_days: float = 0.0) -> dict:
 
     ``keep``: newest N ingest runs survive (0 = no count limit);
     ``keep_days``: runs ingested within the last D days survive (0 = no
-    age limit).  A run survives if EITHER rule keeps it."""
+    age limit).  A run survives if EITHER rule keeps it.
+
+    The whole sweep holds the root's ``derived_write_guard`` sentinel:
+    the fleet service (archive/service.py) answers uploads 503 +
+    Retry-After while it is up, so a push can never race gc deleting the
+    objects it just deduped against."""
+    from sofa_tpu.trace import derived_write_guard
+
+    with derived_write_guard(root):
+        return _gc_locked(root, keep=keep, keep_days=keep_days)
+
+
+def _gc_locked(root: str, keep: int, keep_days: float) -> dict:
     store = ArchiveStore(root)
     entries = catalog.read_catalog(root)
     runs = catalog.ingest_entries(entries)
@@ -669,9 +692,10 @@ def render_show(store: ArchiveStore, doc: dict) -> List[str]:
     return lines
 
 
-def sofa_archive(cfg, action: str, arg: str = "") -> int:
+def sofa_archive(cfg, action: str, arg: str = "",
+                 repair: bool = False) -> int:
     """``sofa archive <logdir> | ls | show <run> | gc [--keep N]
-    [--keep_days D]`` — the trace-database verb."""
+    [--keep_days D] | fsck [--repair]`` — the trace-database verb."""
     from sofa_tpu import telemetry
     from sofa_tpu.archive import resolve_root
 
@@ -704,6 +728,16 @@ def sofa_archive(cfg, action: str, arg: str = "") -> int:
         print_title(f"archived run {run_id[:12]}")
         print("\n".join(render_show(store, doc)))
         return 0
+    if action == "fsck":
+        # `sofa archive fsck [--repair]` — store-integrity alias of
+        # `sofa fsck <archive_root>` (agents and CI scripts read better
+        # naming the store explicitly; same exit contract 0/1/2).
+        from sofa_tpu.durability import _archive_fsck_verb
+
+        if not ArchiveStore(root).exists:
+            print_error(f"no archive at {root}")
+            return 2
+        return _archive_fsck_verb(root, repair)
     if action == "gc":
         keep = int(getattr(cfg, "archive_keep", 0) or 0)
         keep_days = float(getattr(cfg, "archive_keep_days", 0.0) or 0.0)

@@ -43,6 +43,15 @@ CLI (/root/reference/bin/sofa:328-376):
   fsck              verify the logdir's sha256 integrity ledger; --repair
                     invalidates poisoned cache/tile entries and re-derives
                     (exit 0 healthy / 1 damage / 2 no ledger)
+  serve             fleet archive service (sofa_tpu/archive/service.py):
+                    token-authenticated idempotent chunked-upload ingest
+                    over a multi-tenant archive root, with quotas and
+                    503/429 backpressure; `sofa agent` pushes into it
+  agent             per-host fleet daemon (sofa_tpu/agent.py): watch a
+                    directory for finished runs, spool them into a
+                    durable local archive, and forward to a `sofa serve`
+                    endpoint with bounded timeouts + jittered backoff;
+                    --once runs a single scan+drain pass
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -84,12 +93,14 @@ def build_parser() -> argparse.ArgumentParser:
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
         "resume", "fsck", "archive", "regress", "whatif", "artifacts",
+        "serve", "agent",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
                         "(status/resume/fsck/passes/whatif/artifacts); "
-                        "path to lint (lint); logdir or ls/show/gc "
-                        "(archive); run (regress)")
+                        "path to lint (lint); logdir or ls/show/gc/fsck "
+                        "(archive); run (regress); archive root (serve); "
+                        "watch directory (agent)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
                         "the baseline run for `regress`")
@@ -117,7 +128,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--interval", type=float, default=2.0,
                    help="`top` refresh period in seconds")
     g.add_argument("--once", action="store_true", default=False,
-                   help="`top` renders one frame and exits")
+                   help="`top` renders one frame and exits; `agent` runs "
+                        "one scan+drain pass and exits (0 = everything "
+                        "delivered, 1 = spooled but undelivered)")
 
     g = p.add_argument_group("record: host")
     g.add_argument("--perf_events")
@@ -240,6 +253,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="relative %% move a regressed/improved verdict "
                         "requires (default 10)")
 
+    g = p.add_argument_group("fleet (serve / agent)")
+    g.add_argument("--serve_bind", help="serve: bind address (default "
+                                        "127.0.0.1; 0.0.0.0 opens it)")
+    g.add_argument("--serve_port", type=int,
+                   help="serve: base port (default 8044; 0 = OS-assigned)")
+    g.add_argument("--token", dest="serve_token",
+                   help="shared bearer token for serve AND agent "
+                        "(SOFA_SERVE_TOKEN env equivalent; serve refuses "
+                        "to start without one)")
+    g.add_argument("--quota_mb", type=float, dest="serve_quota_mb",
+                   help="serve: per-tenant object-store quota in MB "
+                        "(0 = unlimited; breaches answer 429 and agents "
+                        "fall back to their spool)")
+    g.add_argument("--max_inflight", type=int, dest="serve_max_inflight",
+                   help="serve: concurrent write requests before 503 + "
+                        "Retry-After backpressure (default 8)")
+    g.add_argument("--tenant", dest="fleet_tenant",
+                   help="agent: tenant namespace to push into "
+                        "(default 'default')")
+    g.add_argument("--service", dest="agent_service",
+                   help="agent: fleet service URL, e.g. "
+                        "http://collector:8044 (SOFA_AGENT_SERVICE env; "
+                        "empty = spool-only mode)")
+    g.add_argument("--spool", dest="agent_spool",
+                   help="agent: durable spool root (SOFA_AGENT_SPOOL env; "
+                        "default ./sofa_spool)")
+    g.add_argument("--poll_s", type=float, dest="agent_poll_s",
+                   help="agent: watch-scan period in seconds (default 5)")
+    g.add_argument("--settle_s", type=float, dest="agent_settle_s",
+                   help="agent: a logdir must be quiet this long to count "
+                        "as finished (default 0.5)")
+    g.add_argument("--push_timeout_s", type=float, dest="agent_timeout_s",
+                   help="agent: per-request transport deadline (default 10)")
+    g.add_argument("--push_retries", type=int, dest="agent_retries",
+                   help="agent: per-operation retry budget (default 4)")
+    g.add_argument("--push_backoff_s", type=float, dest="agent_backoff_s",
+                   help="agent: retry backoff base, jittered (default 0.5)")
+    g.add_argument("--push_backoff_cap_s", type=float,
+                   dest="agent_backoff_cap_s",
+                   help="agent: retry backoff cap (default 30)")
+
     g = p.add_argument_group("viz")
     g.add_argument("--viz_port", type=int)
     g.add_argument("--viz_bind", help='bind address (default 127.0.0.1; '
@@ -309,6 +363,10 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
         "archive_root", "archive_label", "archive_keep", "archive_keep_days",
         "regress_rolling", "regress_pct", "regress_threshold",
+        "serve_bind", "serve_port", "serve_token", "serve_quota_mb",
+        "serve_max_inflight", "fleet_tenant", "agent_service",
+        "agent_spool", "agent_poll_s", "agent_settle_s", "agent_timeout_s",
+        "agent_retries", "agent_backoff_s", "agent_backoff_cap_s",
     ):
         if was_set(name):
             setattr(cfg, name, passed[name])
@@ -504,7 +562,17 @@ def _run(argv=None) -> int:
         if cmd == "archive":
             from sofa_tpu.archive.store import sofa_archive
             print_main_progress("SOFA archive")
-            return sofa_archive(cfg, args.usr_command, args.extra)
+            return sofa_archive(cfg, args.usr_command, args.extra,
+                                repair=args.repair)
+        if cmd == "serve":
+            from sofa_tpu.archive.service import sofa_serve
+            print_main_progress("SOFA serve")
+            return sofa_serve(cfg, root=args.usr_command or None)
+        if cmd == "agent":
+            from sofa_tpu.agent import sofa_agent
+            print_main_progress("SOFA agent")
+            return sofa_agent(cfg, watch=args.usr_command or None,
+                              once=args.once)
         if cmd == "regress":
             from sofa_tpu.archive.verdict import sofa_regress
             print_main_progress("SOFA regress")

@@ -37,9 +37,26 @@ non-lexical holder.
 from __future__ import annotations
 
 import os
+import random
 import threading
 
-__all__ = ["Guard", "debug_guards_enabled"]
+__all__ = ["Guard", "debug_guards_enabled", "jittered_backoff"]
+
+
+def jittered_backoff(attempt: int, base_s: float = 0.5,
+                     cap_s: float = 30.0, rng=random) -> float:
+    """Capped exponential backoff with jitter: the fleet-wide retry
+    policy (supervisor collector restarts, `sofa agent` push retries).
+
+    ``base_s * 2^attempt`` capped at ``cap_s``, then scaled by a random
+    factor in [0.5, 1.0] — a fleet of agents (or a host's worth of
+    collectors) that failed in lockstep must NOT retry in lockstep: the
+    synchronized retry wave is the thundering herd that keeps a barely
+    recovered service down.  The return value is always in
+    ``[min(base_s, cap_s) * 0.5, cap_s]``; pass a seeded ``rng`` for
+    deterministic tests."""
+    raw = min(base_s * (2 ** max(int(attempt), 0)), cap_s)
+    return raw * (0.5 + 0.5 * rng.random())
 
 
 def debug_guards_enabled() -> bool:

@@ -17,8 +17,11 @@ from typing import List, Optional
 
 try:  # py3.11+
     import tomllib
-except ImportError:  # pragma: no cover
-    tomllib = None
+except ImportError:  # pragma: no cover — py3.10: same parser from PyPI
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ImportError:
+        tomllib = None
 
 
 @dataclass
@@ -182,6 +185,29 @@ class SofaConfig:
     regress_pct: float = 50.0        # rolling-baseline percentile
     regress_threshold: float = 10.0  # relative % move a verdict requires
 
+    # --- fleet transport (sofa serve / sofa agent) --------------------------
+    # The resilient ingest layer between recording hosts and a served
+    # archive (sofa_tpu/archive/service.py + sofa_tpu/agent.py; see
+    # docs/FLEET.md).
+    serve_bind: str = "127.0.0.1"    # like viz: loopback unless opted open
+    serve_port: int = 8044           # 0 = OS-assigned (tests / bench)
+    serve_token: str = ""            # --token; SOFA_SERVE_TOKEN env fallback
+    serve_quota_mb: float = 0.0      # per-tenant object-store quota (0 = off)
+    serve_max_inflight: int = 8      # concurrent write requests before a
+                                     # 503 + Retry-After backpressure answer
+    fleet_tenant: str = "default"    # tenant namespace for agent pushes
+    agent_service: str = ""          # service URL (SOFA_AGENT_SERVICE env);
+                                     # empty = spool-only (air-gapped) mode
+    agent_spool: str = ""            # durable spool root (SOFA_AGENT_SPOOL
+                                     # env, else ./sofa_spool)
+    agent_poll_s: float = 5.0        # daemon scan period
+    agent_settle_s: float = 0.5      # a logdir must be quiet this long
+                                     # before it counts as finished
+    agent_timeout_s: float = 10.0    # per-request transport deadline
+    agent_retries: int = 4           # per-operation retry budget
+    agent_backoff_s: float = 0.5     # retry backoff base (jittered)
+    agent_backoff_cap_s: float = 30.0  # retry backoff cap
+
     # --- whatif (sofa_tpu/whatif/) ------------------------------------------
     whatif_apply: str = ""           # --apply: comma-joined scenario specs
                                      # (overlap:<pat> | scale:<pat>=<f|sol>
@@ -232,7 +258,8 @@ class SofaConfig:
     def from_toml(cls, path: str) -> "SofaConfig":
         """Load a config file; unknown keys are rejected loudly."""
         if tomllib is None:  # pragma: no cover
-            raise RuntimeError("tomllib unavailable; need python >= 3.11")
+            raise RuntimeError("no TOML parser: need python >= 3.11 "
+                               "(stdlib tomllib) or the tomli package")
         with open(path, "rb") as f:
             data = tomllib.load(f)
         return cls.from_dict(data)

@@ -567,6 +567,10 @@ def sofa_fsck(cfg, repair: bool = False) -> int:
         # the store instead (objects re-hash to their names, run docs'
         # references exist, crash leftovers classified).
         return _archive_fsck_verb(cfg.logdir, repair)
+    if _is_fleet_root(cfg.logdir):
+        # A served fleet root (sofa serve, docs/FLEET.md): every tenant
+        # is a full archive root — verify them all, worst verdict wins.
+        return _fleet_fsck_verb(cfg.logdir, repair)
     reap_stale_sentinel(cfg.logdir)
     report = fsck_scan(cfg.logdir)
     if report is None:
@@ -604,6 +608,38 @@ def sofa_fsck(cfg, repair: bool = False) -> int:
     print_progress(f"fsck: {report.get('checked', 0)} artifact(s) "
                    f"verified, all healthy")
     return 0
+
+
+def _is_fleet_root(path: str) -> bool:
+    from sofa_tpu.archive.service import FLEET_MARKER_NAME
+
+    return os.path.isfile(os.path.join(path, FLEET_MARKER_NAME))
+
+
+def _fleet_fsck_verb(root: str, repair: bool) -> int:
+    """fsck over a `sofa serve` root: run the archive fsck on each
+    tenant store under ``tenants/``.  Exit 0 all healthy / 1 any damage
+    / 2 no tenants to check."""
+    from sofa_tpu.archive.service import TENANTS_DIR_NAME
+    from sofa_tpu.printing import print_progress
+
+    tdir = os.path.join(root, TENANTS_DIR_NAME)
+    try:
+        tenants = sorted(
+            n for n in os.listdir(tdir)
+            if os.path.isdir(os.path.join(tdir, n)))
+    except OSError:
+        tenants = []
+    if not tenants:
+        print_progress(f"fsck: fleet root {root} has no tenants yet — "
+                       "nothing to verify")
+        return 0
+    worst = 0
+    for tenant in tenants:
+        print_progress(f"fsck: tenant {tenant}")
+        rc = _archive_fsck_verb(os.path.join(tdir, tenant), repair)
+        worst = max(worst, rc)
+    return worst
 
 
 def _archive_fsck_verb(root: str, repair: bool) -> int:

@@ -27,6 +27,24 @@ Grammar (comma-joined entries)::
                       driving the quarantine path
     when   = "start" | "stop" | "harvest" | <float>"s" (die delay)
 
+Network fault kinds (target ``service``, consumed by the fleet transport
+client in sofa_tpu/archive/client.py — the server is never faulted, so
+what these prove is the CLIENT's retry/resume/backoff contract)::
+
+    service:conn_refused[@start|@always]   connection refused
+    service:stall[@start|@always]          request exceeds its deadline
+    service:http_500[@start|@always]       server-side 5xx
+    service:partial@<fraction>             upload body truncated at the
+                                           fraction (0 < f < 1) — the
+                                           server's hash check rejects it
+
+Firing policy: by default each network fault fires ONCE PER REQUEST KEY
+(one object upload, one commit), so the first attempt fails and the
+retry path is exercised deterministically; ``@start`` fires exactly once
+for the whole plan (the session's first matching request); ``@always``
+never clears (the spool-and-forward fallback path).  ``partial`` is
+always once-per-key — the resend succeeds, proving resume-from-have-list.
+
 Zero overhead when unset: every hook first reads the module-level plan and
 returns on ``None`` — no parsing, no lookups, no env reads on the hot path.
 The plan is installed by ``sofa record`` / ``sofa preprocess`` from
@@ -46,8 +64,14 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-KINDS = ("die", "wedge", "fail", "truncate", "corrupt")
+KINDS = ("die", "wedge", "fail", "truncate", "corrupt",
+         "conn_refused", "stall", "http_500", "partial")
+#: Kinds injected into the fleet transport client (archive/client.py)
+#: rather than a collector lifecycle hook.
+NET_KINDS = ("conn_refused", "stall", "http_500", "partial")
 PHASES = ("start", "stop", "harvest")
+#: Firing policies for NET_KINDS ("" = the default once-per-request-key).
+NET_WHENS = ("start", "always")
 
 # Spec targets users think of by raw-file name map onto the internal
 # ingest-task name here.
@@ -74,6 +98,8 @@ class FaultSpec:
     kind: str
     phase: Optional[str] = None   # start|stop|harvest (fail/wedge/truncate)
     delay_s: Optional[float] = None  # die only
+    fraction: Optional[float] = None  # partial only: body cut at this point
+    when: Optional[str] = None    # NET_KINDS: start|always|None (per-key)
 
     def fires_at(self, phase: str) -> bool:
         return (self.phase or DEFAULT_PHASE.get(self.kind)) == phase
@@ -83,10 +109,16 @@ class FaultPlan:
     """Parsed fault spec, indexed by target for O(1) hook lookups."""
 
     def __init__(self, specs: List[FaultSpec]):
+        from sofa_tpu.concurrency import Guard
+
         self.specs = list(specs)
         self._by_target: Dict[str, List[FaultSpec]] = {}
         for s in self.specs:
             self._by_target.setdefault(s.target, []).append(s)
+        # Network faults are consumed (fire-once policies); the ledger is
+        # written from whatever thread runs the transport client.
+        self._fired_guard = Guard("faults.fired", protects=("_fired",))
+        self._fired: Dict[tuple, bool] = {}
 
     def find(self, target: str, kind: str,
              phase: Optional[str] = None) -> Optional[FaultSpec]:
@@ -99,6 +131,32 @@ class FaultPlan:
 
     def corrupt_for(self, source: str) -> Optional[FaultSpec]:
         return self.find(source, "corrupt")
+
+    def service_fault(self, target: str, op: str,
+                      key: str) -> Optional[FaultSpec]:
+        """Consult-and-consume: the first network-kind spec for
+        ``target`` that should fire for request ``op:key``.  ``@always``
+        specs never clear; ``@start`` specs clear after the plan's first
+        matching request; default specs clear per request key — so one
+        plan deterministically fails each upload exactly once.
+        ``partial`` only ever fires for object uploads (op ``put``): a
+        truncated control request would be a plain 400, not the
+        server-side hash rejection the kind exists to exercise."""
+        for s in self._by_target.get(target, ()):
+            if s.kind not in NET_KINDS:
+                continue
+            if s.kind == "partial" and op != "put":
+                continue
+            if s.when == "always":
+                return s
+            fkey = (s.kind, s.target,
+                    "" if s.when == "start" else f"{op}:{key}")
+            with self._fired_guard:
+                if self._fired.get(fkey):
+                    continue
+                self._fired[fkey] = True
+            return s
+        return None
 
 
 def parse(text: str) -> FaultPlan:
@@ -115,6 +173,9 @@ def parse(text: str) -> FaultPlan:
         if kind not in KINDS:
             raise ValueError(
                 f"fault entry {entry!r}: kind {kind!r} not in {KINDS}")
+        if kind in NET_KINDS:
+            specs.append(_parse_net(entry, target, kind, when))
+            continue
         phase: Optional[str] = None
         delay: Optional[float] = None
         if when:
@@ -143,6 +204,26 @@ def parse(text: str) -> FaultPlan:
         specs.append(FaultSpec(target=ALIASES.get(target, target),
                                kind=kind, phase=phase, delay_s=delay))
     return FaultPlan(specs)
+
+
+def _parse_net(entry: str, target: str, kind: str,
+               when: str) -> FaultSpec:
+    """One network-kind entry (NET_KINDS grammar in the module doc)."""
+    if kind == "partial":
+        try:
+            fraction = float(when)
+        except ValueError:
+            fraction = -1.0
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(
+                f"fault entry {entry!r}: partial needs a fraction in "
+                "(0, 1), e.g. partial@0.5")
+        return FaultSpec(target=target, kind=kind, fraction=fraction)
+    if when and when not in NET_WHENS:
+        raise ValueError(
+            f"fault entry {entry!r}: {when!r} is not a network firing "
+            f"policy {NET_WHENS} (default: once per request key)")
+    return FaultSpec(target=target, kind=kind, when=when or None)
 
 
 # --- active-plan registry ----------------------------------------------------
@@ -224,6 +305,19 @@ def arm_die(col) -> None:
     t.daemon = True
     _TIMERS.append(t)  # clear() cancels stragglers at verb teardown
     t.start()
+
+
+def maybe_service_fault(op: str, key: str = "",
+                        target: str = "service") -> Optional[FaultSpec]:
+    """Fleet-transport hook (archive/client.py): the network fault — if
+    any — to apply to this request.  ``op:key`` identifies the request
+    for the once-per-key policy (e.g. ``put:<sha>``); returns the spec
+    (the CLIENT translates it into a refused connection, a timeout, a
+    synthetic 500, or a truncated upload body) or None."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.service_fault(target, op, key)
 
 
 def maybe_truncate(col) -> None:

@@ -939,6 +939,13 @@ def sofa_clean(cfg) -> None:
                     "— left untouched; `sofa archive gc` is its only "
                     "deletion path")
                 continue
+            if os.path.isdir(path) and os.path.isfile(
+                    os.path.join(path, "sofa_fleet.json")):
+                print_warning(
+                    f"clean: {path} is a served fleet root (tenant "
+                    "archives, docs/FLEET.md) — left untouched; per-tenant "
+                    "`sofa archive gc` is its only deletion path")
+                continue
             if name in DERIVED_FILES or (
                 name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
             ):
@@ -951,8 +958,10 @@ def sofa_clean(cfg) -> None:
             print_warning(f"cannot clean {path}: {e}")
     top = os.path.normpath(cfg.logdir)
     for root, dirs, files in os.walk(cfg.logdir):
-        if os.path.normpath(root) != top and is_archive_root(root):
-            dirs[:] = []  # the archive's fsck owns its tmp leftovers
+        if os.path.normpath(root) != top and (
+                is_archive_root(root) or os.path.isfile(
+                    os.path.join(root, "sofa_fleet.json"))):
+            dirs[:] = []  # the archive/fleet fsck owns its tmp leftovers
             continue
         for name in files:
             if not name.endswith(".tmp"):

@@ -8,8 +8,10 @@ sampler thread, :meth:`Collector.alive`) and its output growth:
 
   * a collector found dead before the epilogue is recorded in the run
     manifest at detection time (``died: true``, ``deaths``, ``exit_code``)
-    and **restarted** with bounded retries and exponential backoff
-    (``--collector_restarts``, default 1; backoff 0.5s * 2^attempt).  A
+    and **restarted** with bounded retries and capped exponential backoff
+    with jitter (``--collector_restarts``, default 1; backoff
+    ``0.5s * 2^attempt`` capped at 30s, scaled by [0.5, 1.0] —
+    concurrency.jittered_backoff, the anti-thundering-herd policy).  A
     successful restart lands ``restarts: n`` in the manifest — the series
     has a gap, but the rest of the run is covered;
   * once the budget is exhausted the collector's status becomes ``died``
@@ -47,7 +49,7 @@ import time
 from typing import Dict, List
 
 from sofa_tpu import telemetry
-from sofa_tpu.concurrency import Guard
+from sofa_tpu.concurrency import Guard, jittered_backoff
 from sofa_tpu.printing import print_warning
 
 # Polls with zero output growth (while alive) before the one-time stall
@@ -55,6 +57,7 @@ from sofa_tpu.printing import print_warning
 _STALL_POLLS = 20
 
 _BACKOFF_BASE_S = 0.5
+_BACKOFF_CAP_S = 30.0
 
 
 def _poll_s() -> float:
@@ -162,7 +165,11 @@ class CollectorSupervisor:
             st["gave_up"] = True
             return
         telemetry.collector_event(col.name, **fields)
-        backoff = _BACKOFF_BASE_S * (2 ** st["restarts"])
+        # Jittered, not bare 2^n: every collector on a host (and every
+        # host in a fleet) that died to the same cause would otherwise
+        # restart at the same instant — the thundering-herd restart wave.
+        backoff = jittered_backoff(st["restarts"], _BACKOFF_BASE_S,
+                                   _BACKOFF_CAP_S)
         print_warning(f"{col.name}: died mid-run (exit {exit_code}) — "
                       f"restarting in {backoff:.1f}s")
         st["retry_at"] = time.monotonic() + backoff

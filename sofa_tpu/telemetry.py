@@ -119,7 +119,7 @@ _ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
 # Self-trace thread lanes: one per pipeline verb so the viewer shows the
 # verbs as parallel tracks of the single "sofa" process.
 _SELF_TRACE_LANES = {"record": 1, "preprocess": 2, "analyze": 3,
-                     "archive": 5, "regress": 6}
+                     "archive": 5, "regress": 6, "agent": 7}
 _OTHER_LANE = 4
 
 _WARNING_TAIL_MAX = 20
@@ -521,6 +521,16 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
                 out.append(f"analysis pass {name} failed ({why}) — its "
                            "features and artifacts are missing this run; "
                            "`sofa passes` shows its contract")
+    agent_meta = (doc.get("meta") or {}).get("agent")
+    if isinstance(agent_meta, dict):
+        push = agent_meta.get("push")
+        if isinstance(push, dict) and push.get("status") != "pushed":
+            where = agent_meta.get("service") or "the fleet service"
+            out.append(
+                f"the agent could not deliver this run to {where} "
+                f"({push.get('status')}) — it is durable in the spool "
+                f"({agent_meta.get('spool')}) and retries on the next "
+                "agent pass")
     fsck = (doc.get("meta") or {}).get("fsck")
     if isinstance(fsck, dict) and fsck.get("ok") is False:
         problems = fsck.get("problems") or {}
@@ -633,6 +643,18 @@ def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
         if n_skipped:
             line += f", {n_skipped} skipped (gated off)"
         line += " (`sofa passes` shows the DAG)"
+        lines.append(line)
+    agent_meta = (doc.get("meta") or {}).get("agent")
+    if isinstance(agent_meta, dict):
+        push = agent_meta.get("push") or {}
+        line = (f"  fleet: run {str(agent_meta.get('run') or '?')[:12]} "
+                f"{push.get('status') or 'spooled (no service)'}")
+        serve_meta = (doc.get("meta") or {}).get("serve")
+        if isinstance(serve_meta, dict):
+            line += (f" -> {serve_meta.get('url')} "
+                     f"(tenant {serve_meta.get('tenant')})")
+        elif agent_meta.get("spool"):
+            line += f" (spool {agent_meta['spool']})"
         lines.append(line)
     budget = (doc.get("meta") or {}).get("disk_budget")
     if isinstance(budget, dict):

@@ -553,7 +553,13 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # `sofa regress` verdict (sofa_tpu/archive/verdict.py)
                  "regress_verdict.json",
                  # `sofa whatif` prediction report (sofa_tpu/whatif/)
-                 "whatif_report.json"]
+                 "whatif_report.json",
+                 # fleet transport ledgers (docs/FLEET.md): the agent's
+                 # push-state and the served root's marker.  Both live
+                 # under archive-marked roots that `sofa clean` and the
+                 # digest walk already skip wholesale — registering them
+                 # keeps the artifact inventory's closure honest.
+                 "agent_state.json", "sofa_fleet.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles"]
 
@@ -568,6 +574,9 @@ DIGEST_SKIP_FILES = frozenset({
     # regenerated at will by `sofa regress` / `sofa whatif` without a
     # pipeline digest refresh
     "regress_verdict.json", "whatif_report.json",
+    # rewritten at will by `sofa agent` (archive/spool.py) without a
+    # digest refresh; lives in archive-marked roots the walk skips anyway
+    "agent_state.json",
 })
 DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",

@@ -17,6 +17,12 @@ is a production data server for the board's O(pixels) contract:
   * 503 + Retry-After while a pipeline verb is mid-write on the logdir
     (trace.derived_write_guard's sentinel): a board refresh racing
     `sofa preprocess` gets an honest retry signal, never torn JSON.
+
+The ``/archive/`` route here is the READ half of the fleet archive; its
+write-capable sibling is `sofa serve` (sofa_tpu/archive/service.py),
+which reuses this server's shape — ThreadingHTTPServer subclass with
+guard-declared shared stats, the same mid-write 503 pattern — for the
+authenticated multi-tenant ingest endpoint `sofa agent` pushes into.
 """
 
 from __future__ import annotations
@@ -275,7 +281,9 @@ def sofa_viz(cfg, serve_forever: bool = True):
         print_progress(
             f"trace archive: /archive/ (root {archive_root}; the board's "
             "Archive page diffs any two catalog runs tile-by-tile — "
-            "identical tiles compare by hash, no payload fetched)")
+            "identical tiles compare by hash, no payload fetched). "
+            "This route is read-only; `sofa serve` runs the write-capable "
+            "fleet ingest service over an archive root (docs/FLEET.md)")
     if os.path.isfile(os.path.join(cfg.logdir, SELF_TRACE_NAME)):
         print_progress(
             f"self-telemetry: /{SELF_TRACE_NAME} (Chrome-trace of sofa's "

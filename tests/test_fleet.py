@@ -1,0 +1,737 @@
+"""Fleet transport tests: `sofa serve` + `sofa agent` (docs/FLEET.md).
+
+The resilience contract, exercised deterministically through the
+network fault kinds in sofa_tpu/faults.py (target ``service``):
+idempotent re-send, resume-from-have-list under every fault kind,
+quota/auth refusals with spool fallback, SIGKILL-agent journal resume
+with zero re-sent committed objects, and the CLI exit codes of both
+verbs.  The service runs in-process on a loopback ephemeral port — no
+real network, no sleeps beyond millisecond backoffs.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sofa_tpu import durability, faults, telemetry
+from sofa_tpu.agent import discover_logdirs, logdir_ready, sofa_agent
+from sofa_tpu.archive import catalog as acat
+from sofa_tpu.archive.client import (
+    ServiceClient,
+    ServiceRejected,
+    ServiceUnavailable,
+    push_run,
+)
+from sofa_tpu.archive.service import service_url, sofa_serve
+from sofa_tpu.archive.spool import Spool
+from sofa_tpu.archive.store import ArchiveStore, archive_fsck
+from sofa_tpu.concurrency import jittered_backoff
+from sofa_tpu.config import SofaConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOKEN = "test-fleet-token"
+
+
+def _mklog(root, name="run1", files=None):
+    """A minimal finished logdir: manifest + digest ledger + payload."""
+    logdir = os.path.join(str(root), name) + "/"
+    os.makedirs(logdir, exist_ok=True)
+    payload = files or {"sofa_time.txt": "123.0\n",
+                        "report.js": f"var x = {name!r};\n",
+                        "features.csv": "name,value\nelapsed_time,1.5\n"}
+    for fname, content in payload.items():
+        with open(logdir + fname, "w") as f:
+            f.write(content)
+    tel = telemetry.begin("analyze")
+    tel.write(logdir, rc=0)
+    telemetry.end(tel)
+    durability.write_digests(logdir)
+    return logdir
+
+
+@pytest.fixture
+def service(tmp_path):
+    """An in-process fleet service on an ephemeral loopback port."""
+    cfg = SofaConfig(logdir=str(tmp_path / "unused"),
+                     serve_token=TOKEN, serve_port=0)
+    httpd = sofa_serve(cfg, root=str(tmp_path / "store"),
+                       serve_forever=False)
+    assert httpd is not None
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+def _agent_cfg(tmp_path, url, **kw):
+    kw.setdefault("serve_token", TOKEN)
+    kw.setdefault("agent_service", url)
+    kw.setdefault("agent_spool", str(tmp_path / "spool"))
+    kw.setdefault("agent_settle_s", 0.0)
+    kw.setdefault("agent_retries", 4)
+    kw.setdefault("agent_backoff_s", 0.01)
+    kw.setdefault("agent_backoff_cap_s", 0.05)
+    return SofaConfig(logdir=str(tmp_path / "unused2"), **kw)
+
+
+def _tenant_root(httpd, tenant="default"):
+    return httpd.tenant_root(tenant)
+
+
+def _server_runs(httpd, tenant="default"):
+    return acat.ingest_entries(acat.read_catalog(_tenant_root(httpd,
+                                                              tenant)))
+
+
+def _fsck_clean(root):
+    report = archive_fsck(root)
+    assert report is not None, f"no archive at {root}"
+    bad = {k: v for k, v in report.items()
+           if isinstance(v, list) and v and k != "unreferenced"}
+    assert not bad, f"store damage: {bad}"
+
+
+def _store_shas(root):
+    out = set()
+    for dirpath, _dirs, names in os.walk(os.path.join(root, "objects")):
+        out.update(n for n in names if not n.endswith(".tmp"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The upload protocol.
+# ---------------------------------------------------------------------------
+
+def test_push_lands_run_and_meta(service, tmp_path):
+    watch = tmp_path / "watch"
+    logdir = _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    runs = _server_runs(service)
+    assert len(runs) == 1
+    _fsck_clean(_tenant_root(service))
+    # the transport leg is in the manifest, schema-valid
+    doc = telemetry.load_manifest(logdir)
+    meta = doc["meta"]
+    assert meta["agent"]["push"]["status"] == "pushed"
+    assert meta["serve"]["run"] == runs[0]["run"]
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import manifest_check
+    finally:
+        sys.path.pop(0)
+    assert manifest_check.validate_manifest(doc) == []
+    assert manifest_check.validate_manifest(doc, require_healthy=True) == []
+
+
+def test_triple_push_is_idempotent(service, tmp_path):
+    """PR 7's triple-ingest proof, over the wire: re-pushing an
+    unchanged run moves zero objects and appends zero catalog lines."""
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    troot = _tenant_root(service)
+    shas = _store_shas(troot)
+    catalog_bytes = open(acat.catalog_path(troot), "rb").read()
+    for _ in range(2):
+        # force a re-push by clearing the delivered flag (the state file
+        # would otherwise skip the unchanged run entirely)
+        spool = Spool(str(tmp_path / "spool"))
+        for ent in spool._state["logdirs"].values():
+            ent["pushed"] = False
+        spool._save_state()
+        assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert _store_shas(troot) == shas
+    assert open(acat.catalog_path(troot), "rb").read() == catalog_bytes
+    assert len(_server_runs(service)) == 1
+    stats = service.stats
+    assert stats.get("object_stored", 0) == len(shas)
+    # the re-pushes short-circuit at the have-list's committed flag:
+    # one commit ever, no object re-sent, no replayed commit needed
+    assert stats.get("commit", 0) == 1
+    assert stats.get("have", 0) == 3
+    assert stats.get("object_dedup", 0) == 0
+
+
+@pytest.mark.parametrize("spec", [
+    "service:conn_refused@start",
+    "service:conn_refused",
+    "service:stall",
+    "service:http_500",
+    "service:partial@0.5",
+])
+def test_push_survives_each_fault_kind(service, tmp_path, spec):
+    """Every network fault kind: the push still lands, the store is
+    fsck-clean, and exactly one run is cataloged."""
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service), inject_faults=spec)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert len(_server_runs(service)) == 1
+    _fsck_clean(_tenant_root(service))
+    if "partial" in spec:
+        # the truncated body reached the server and was REJECTED by the
+        # hash check — the fault exercised the real verification path
+        assert service.stats.get("422_hash_mismatch", 0) >= 1
+
+
+def test_acceptance_faulted_push_is_byte_identical(service, tmp_path):
+    """The ISSUE's acceptance proof: partial@0.5 + conn_refused@start
+    injected, the run still lands; the final store is byte-identical to
+    a fault-free push, fsck exits 0, exactly one catalog line, and a
+    triple re-push creates zero new objects."""
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    faulted = _agent_cfg(
+        tmp_path, service_url(service),
+        inject_faults="service:partial@0.5,service:conn_refused@start",
+        fleet_tenant="faulted")
+    faulted.agent_spool = str(tmp_path / "spool_f")
+    clean = _agent_cfg(tmp_path, service_url(service),
+                       fleet_tenant="clean")
+    clean.agent_spool = str(tmp_path / "spool_c")
+    assert sofa_agent(faulted, watch=str(watch), once=True) == 0
+    assert sofa_agent(clean, watch=str(watch), once=True) == 0
+    ft, ct = _tenant_root(service, "faulted"), _tenant_root(service,
+                                                           "clean")
+    # byte-identical object stores
+    f_shas, c_shas = _store_shas(ft), _store_shas(ct)
+    assert f_shas == c_shas
+    for sha in f_shas:
+        a = open(ArchiveStore(ft).object_path(sha), "rb").read()
+        b = open(ArchiveStore(ct).object_path(sha), "rb").read()
+        assert a == b
+    # fsck 0 via the CLI verb, exactly one catalog line
+    from sofa_tpu.cli import main as sofa_main
+
+    assert sofa_main(["archive", "fsck", "--archive_root", ft]) == 0
+    assert len(_server_runs(service, "faulted")) == 1
+    # triple re-push: zero new objects
+    before = service.stats.get("object_stored", 0)
+    for _ in range(3):
+        spool = Spool(faulted.agent_spool)
+        for ent in spool._state["logdirs"].values():
+            ent["pushed"] = False
+        spool._save_state()
+        assert sofa_agent(faulted, watch=str(watch), once=True) == 0
+    assert service.stats.get("object_stored", 0) == before
+    assert len(_server_runs(service, "faulted")) == 1
+
+
+def test_offline_spools_then_drains(tmp_path):
+    """Service down: the run lands in the durable spool (exit 1 =
+    degraded, not lost); once the service exists, the next pass
+    delivers it."""
+    watch = tmp_path / "watch"
+    logdir = _mklog(watch)
+    cfg = _agent_cfg(tmp_path, "http://127.0.0.1:9", agent_retries=1)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 1
+    spool_store = ArchiveStore(str(tmp_path / "spool"))
+    assert spool_store.exists
+    assert len(acat.ingest_entries(
+        acat.read_catalog(spool_store.root))) == 1
+    _fsck_clean(spool_store.root)
+    doc = telemetry.load_manifest(logdir)
+    assert doc["meta"]["agent"]["push"]["status"] == "spooled"
+    assert "serve" not in doc["meta"]
+    # `sofa status` surfaces the undelivered leg
+    assert any("could not deliver" in w
+               for w in telemetry.manifest_warnings(doc))
+    lines, rc_status = telemetry.render_status(doc, logdir)
+    assert rc_status == 0  # degraded-but-durable is not a failure
+    assert any(line.strip().startswith("fleet:") for line in lines)
+    # --require-healthy flags the undelivered run
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import manifest_check
+    finally:
+        sys.path.pop(0)
+    assert manifest_check.validate_manifest(doc) == []
+    assert any("could not deliver" in p for p in
+               manifest_check.validate_manifest(doc, require_healthy=True))
+    # service comes up -> drain
+    scfg = SofaConfig(logdir=str(tmp_path / "u"), serve_token=TOKEN,
+                      serve_port=0)
+    httpd = sofa_serve(scfg, root=str(tmp_path / "store"),
+                       serve_forever=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        cfg.agent_service = service_url(httpd)
+        assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+        assert len(_server_runs(httpd)) == 1
+        _fsck_clean(_tenant_root(httpd))
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+def test_auth_reject_401(service, tmp_path):
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service), serve_token="wrong",
+                     agent_retries=1)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 1
+    assert service.stats.get("401_unauthorized", 0) >= 1
+    # nothing landed server-side; the run is safe in the spool
+    assert not os.path.isdir(_tenant_root(service))
+    assert len(acat.ingest_entries(
+        acat.read_catalog(str(tmp_path / "spool")))) == 1
+
+
+def test_quota_429_spool_fallback(tmp_path):
+    scfg = SofaConfig(logdir=str(tmp_path / "u"), serve_token=TOKEN,
+                      serve_port=0, serve_quota_mb=0.05)
+    httpd = sofa_serve(scfg, root=str(tmp_path / "store"),
+                       serve_forever=False)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        watch = tmp_path / "watch"
+        logdir = _mklog(watch, files={"sofa_time.txt": "1.0\n",
+                                      "report.js": "x" * 200_000})
+        cfg = _agent_cfg(tmp_path, service_url(httpd), agent_retries=1)
+        assert sofa_agent(cfg, watch=str(watch), once=True) == 1
+        assert httpd.stats.get("429_quota", 0) >= 1
+        assert len(_server_runs(httpd)) == 0
+        doc = telemetry.load_manifest(logdir)
+        push = doc["meta"]["agent"]["push"]
+        assert push["status"] == "rejected" and push["quota"] is True
+        # the run is durable in the spool, fsck-clean
+        _fsck_clean(str(tmp_path / "spool"))
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+def test_backpressure_503_mid_gc(service, tmp_path):
+    """A tenant root mid-gc answers 503 + Retry-After (the
+    derived-write-guard pattern); the client surfaces it as a retryable
+    ServiceUnavailable carrying the server's wait."""
+    from sofa_tpu.trace import derived_write_guard
+
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    client = ServiceClient(service_url(service), TOKEN,
+                           timeout_s=5, retries=0)
+    with derived_write_guard(_tenant_root(service)):
+        with pytest.raises(ServiceUnavailable) as exc:
+            client._attempt("POST", "/v1/default/have",
+                            json.dumps({"files": {"a": {
+                                "sha256": "0" * 64}}}).encode(),
+                            "have", "")
+        assert exc.value.status == 503
+        assert exc.value.retry_after is not None
+    assert service.stats.get("503_mid_gc", 0) >= 1
+    # guard released -> the same request goes through
+    doc = client._attempt("POST", "/v1/default/have",
+                          json.dumps({"files": {"a": {
+                              "sha256": "0" * 64}}}).encode(), "have", "")
+    assert doc["missing"] == ["0" * 64]
+
+
+def test_sigkill_agent_resumes_with_zero_resent_objects(service, tmp_path):
+    """SIGKILL the agent mid-upload; the restarted agent resumes from
+    the server's have-list and re-sends ZERO committed objects."""
+    watch = tmp_path / "watch"
+    files = {f"f{i}.csv": f"col\n{i}\n" * (i + 1) for i in range(5)}
+    files["sofa_time.txt"] = "1.0\n"
+    _mklog(watch, files=files)
+    url = service_url(service)
+    snippet = f"""
+import os, signal, sys
+sys.path.insert(0, {REPO!r})
+from sofa_tpu.archive import client as aclient
+orig = aclient.ServiceClient.put_object
+count = [0]
+def hook(self, sha, data):
+    out = orig(self, sha, data)
+    count[0] += 1
+    if count[0] >= 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return out
+aclient.ServiceClient.put_object = hook
+from sofa_tpu.agent import sofa_agent
+from sofa_tpu.config import SofaConfig
+cfg = SofaConfig(logdir={str(tmp_path / "u")!r}, serve_token={TOKEN!r},
+                 agent_service={url!r},
+                 agent_spool={str(tmp_path / "spool")!r},
+                 agent_settle_s=0.0, agent_backoff_s=0.01)
+sofa_agent(cfg, watch={str(watch)!r}, once=True)
+"""
+    r = subprocess.run([sys.executable, "-c", snippet],
+                       capture_output=True, text=True, timeout=120,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr[-500:])
+    stored_before = service.stats.get("object_stored", 0)
+    assert stored_before == 2  # exactly the pre-kill committed objects
+    assert len(_server_runs(service)) == 0  # commit never happened
+    # the spool journal recorded the begun-but-uncommitted push
+    entries = durability.read_journal(str(tmp_path / "spool"))
+    pushes = [e for e in entries if e.get("stage") == "push"]
+    assert pushes and pushes[-1]["ev"] == "begin"
+    # restart: the push completes; committed objects are NOT re-sent
+    cfg = _agent_cfg(tmp_path, url)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert len(_server_runs(service)) == 1
+    _fsck_clean(_tenant_root(service))
+    assert service.stats.get("object_dedup", 0) == 0
+    assert service.stats.get("object_stored", 0) == \
+        len(_store_shas(_tenant_root(service)))
+    state = durability.journal_state(
+        durability.read_journal(str(tmp_path / "spool")))
+    assert state["push"]["committed"]
+
+
+# ---------------------------------------------------------------------------
+# Agent behavior details.
+# ---------------------------------------------------------------------------
+
+def test_spool_only_mode_without_service(tmp_path):
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, "")
+    cfg.agent_service = ""
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert len(acat.ingest_entries(
+        acat.read_catalog(str(tmp_path / "spool")))) == 1
+
+
+def test_unfinished_logdirs_are_skipped(tmp_path):
+    from sofa_tpu.trace import derived_write_guard
+
+    watch = tmp_path / "watch"
+    logdir = _mklog(watch)
+    assert logdir_ready(logdir, settle_s=0.0)
+    # live mid-write sentinel -> not ready
+    with derived_write_guard(logdir):
+        assert not logdir_ready(logdir, settle_s=0.0)
+    # begun-but-uncommitted journal stage -> not ready
+    durability.Journal(logdir).begin("preprocess", key="k")
+    assert not logdir_ready(logdir, settle_s=0.0)
+    durability.Journal(logdir).commit("preprocess", key="k")
+    assert logdir_ready(logdir, settle_s=0.0)
+    # settle window: a just-touched manifest is not yet quiet
+    assert not logdir_ready(logdir, settle_s=3600.0)
+    # no manifest at all -> not a run
+    bare = os.path.join(str(watch), "bare")
+    os.makedirs(bare)
+    assert discover_logdirs(str(watch)) == [logdir]
+
+
+def test_agent_discovers_watch_root_itself(tmp_path):
+    logdir = _mklog(tmp_path, "selflog")
+    assert discover_logdirs(logdir) == [logdir]
+
+
+def test_agent_usage_errors(tmp_path):
+    cfg = _agent_cfg(tmp_path, "")
+    assert sofa_agent(cfg, watch=str(tmp_path / "nope"), once=True) == 2
+
+
+def test_push_state_survives_unchanged_runs(service, tmp_path):
+    """A second pass over an unchanged, already-delivered run does
+    nothing: no ingest, no push, no catalog growth."""
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    troot = _tenant_root(service)
+    catalog_bytes = open(acat.catalog_path(troot), "rb").read()
+    spool_catalog = open(acat.catalog_path(
+        str(tmp_path / "spool")), "rb").read()
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert open(acat.catalog_path(troot), "rb").read() == catalog_bytes
+    assert open(acat.catalog_path(
+        str(tmp_path / "spool")), "rb").read() == spool_catalog
+
+
+def test_orphaned_spool_runs_still_drain(service, tmp_path):
+    """The source logdir vanishing after spooling must not strand the
+    run: the spool is the surviving copy and the drain pass ships it."""
+    import shutil
+
+    watch = tmp_path / "watch"
+    logdir = _mklog(watch)
+    cfg = _agent_cfg(tmp_path, "http://127.0.0.1:9", agent_retries=0)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 1
+    shutil.rmtree(logdir)
+    cfg.agent_service = service_url(service)
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert len(_server_runs(service)) == 1
+    _fsck_clean(_tenant_root(service))
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar + backoff policy units.
+# ---------------------------------------------------------------------------
+
+def test_net_fault_grammar():
+    plan = faults.parse("service:conn_refused@start,service:partial@0.25,"
+                        "service:http_500@always,service:stall")
+    kinds = {s.kind: s for s in plan.specs}
+    assert kinds["conn_refused"].when == "start"
+    assert kinds["partial"].fraction == 0.25
+    assert kinds["http_500"].when == "always"
+    assert kinds["stall"].when is None
+    with pytest.raises(ValueError):
+        faults.parse("service:partial")  # fraction required
+    with pytest.raises(ValueError):
+        faults.parse("service:partial@1.5")
+    with pytest.raises(ValueError):
+        faults.parse("service:conn_refused@0.5")
+
+
+def test_net_fault_firing_policies():
+    plan = faults.parse("service:conn_refused@start")
+    assert plan.service_fault("service", "have", "") is not None
+    assert plan.service_fault("service", "put", "abc") is None
+
+    plan = faults.parse("service:http_500")  # once per request key
+    assert plan.service_fault("service", "put", "a") is not None
+    assert plan.service_fault("service", "put", "a") is None
+    assert plan.service_fault("service", "put", "b") is not None
+
+    plan = faults.parse("service:stall@always")
+    for _ in range(3):
+        assert plan.service_fault("service", "have", "") is not None
+
+    plan = faults.parse("service:partial@0.5")
+    assert plan.service_fault("service", "have", "") is None  # put-only
+    assert plan.service_fault("service", "put", "x") is not None
+
+
+def test_jittered_backoff_bounds():
+    """Satellite: the supervisor/agent backoff is bounded and jittered —
+    never below half the exponential floor, never above the cap, and
+    actually spread (not a constant)."""
+    import random
+
+    rng = random.Random(1234)
+    seen = set()
+    for attempt in range(10):
+        for _ in range(50):
+            d = jittered_backoff(attempt, 0.5, 30.0, rng)
+            raw = min(0.5 * 2 ** attempt, 30.0)
+            assert raw * 0.5 <= d <= raw
+            assert d <= 30.0
+            seen.add(round(d, 6))
+    assert len(seen) > 100  # jitter spreads, lockstep does not
+    # degenerate inputs stay sane
+    assert jittered_backoff(-3, 0.5, 30.0, rng) <= 0.5
+    assert jittered_backoff(100, 0.5, 30.0, rng) <= 30.0
+
+
+def test_supervisor_restart_backoff_is_jittered(monkeypatch):
+    """The collector-restart path draws from jittered_backoff (the
+    thundering-herd fix), not the old bare 2^n."""
+    from sofa_tpu import supervisor
+
+    delays = []
+    real = supervisor.jittered_backoff
+
+    def spy(attempt, base, cap, rng=None):
+        d = real(attempt, base, cap) if rng is None else real(
+            attempt, base, cap, rng)
+        delays.append((attempt, base, cap, d))
+        return d
+
+    monkeypatch.setattr(supervisor, "jittered_backoff", spy)
+
+    class _Col:
+        name = "fake"
+        proc = None
+
+        def alive(self):
+            return False
+
+        def outputs(self):
+            return []
+
+    cfg = SofaConfig(collector_restarts=3)
+    sup = supervisor.CollectorSupervisor(cfg, [_Col()])
+    sup._check(_Col())
+    assert len(delays) == 1
+    attempt, base, cap, d = delays[0]
+    assert (base, cap) == (supervisor._BACKOFF_BASE_S,
+                           supervisor._BACKOFF_CAP_S)
+    assert base * 0.5 <= d <= cap
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes.
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_exit_codes(tmp_path, monkeypatch):
+    from sofa_tpu.cli import main as sofa_main
+
+    monkeypatch.delenv("SOFA_SERVE_TOKEN", raising=False)
+    # no token -> refused, usage error
+    assert sofa_main(["serve", str(tmp_path / "store")]) == 2
+    # root path unusable (a file) -> usage error
+    bad = tmp_path / "afile"
+    bad.write_text("x")
+    assert sofa_main(["serve", str(bad), "--token", TOKEN]) == 2
+
+
+def test_agent_cli_exit_codes(service, tmp_path, monkeypatch):
+    from sofa_tpu.cli import main as sofa_main
+
+    monkeypatch.chdir(tmp_path)
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    # missing watch dir -> 2
+    assert sofa_main(["agent", str(tmp_path / "nope"), "--once"]) == 2
+    # delivered -> 0
+    assert sofa_main([
+        "agent", str(watch), "--once", "--token", TOKEN,
+        "--service", service_url(service),
+        "--spool", str(tmp_path / "spool"), "--settle_s", "0",
+        "--push_backoff_s", "0.01"]) == 0
+    # service dead -> spooled, degraded exit 1
+    watch2 = tmp_path / "watch2"
+    _mklog(watch2, "run2")
+    assert sofa_main([
+        "agent", str(watch2), "--once", "--token", TOKEN,
+        "--service", "http://127.0.0.1:9",
+        "--spool", str(tmp_path / "spool2"), "--settle_s", "0",
+        "--push_retries", "0", "--push_backoff_s", "0.01"]) == 1
+
+
+def test_archive_fsck_cli_action(tmp_path, monkeypatch):
+    from sofa_tpu.cli import main as sofa_main
+
+    monkeypatch.chdir(tmp_path)
+    # no store -> 2
+    assert sofa_main(["archive", "fsck",
+                      "--archive_root", str(tmp_path / "none")]) == 2
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, "")
+    cfg.agent_service = ""
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    spool = str(tmp_path / "spool")
+    assert sofa_main(["archive", "fsck", "--archive_root", spool]) == 0
+    # plant damage -> 1; --repair sweeps the orphan
+    with open(os.path.join(spool, "objects", "zz.tmp"), "wb") as f:
+        f.write(b"torn")
+    assert sofa_main(["archive", "fsck", "--archive_root", spool]) == 1
+    assert sofa_main(["archive", "fsck", "--archive_root", spool,
+                      "--repair"]) == 0
+
+
+def test_fleet_root_fsck_and_clean_guard(service, tmp_path, monkeypatch):
+    """`sofa fsck <fleet_root>` verifies every tenant store; a fleet
+    root nested under a logdir survives `sofa clean`."""
+    from sofa_tpu.cli import main as sofa_main
+
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    root = service.root
+    assert sofa_main(["fsck", root]) == 0
+    # damage one tenant -> worst verdict wins
+    sha = next(iter(_store_shas(_tenant_root(service))))
+    obj = ArchiveStore(_tenant_root(service)).object_path(sha)
+    with open(obj, "wb") as f:
+        f.write(b"rotted")
+    assert sofa_main(["fsck", root]) == 1
+    # clean guard: a fleet root nested under a logdir is never swept
+    from sofa_tpu.record import sofa_clean
+    import shutil
+
+    logdir = _mklog(tmp_path, "cleanlog")
+    shutil.copytree(root, os.path.join(logdir, "fleet"))
+    sofa_clean(SofaConfig(logdir=logdir))
+    assert os.path.isfile(os.path.join(logdir, "fleet",
+                                       "sofa_fleet.json"))
+    assert os.path.isfile(os.path.join(
+        logdir, "fleet", "tenants", "default", "catalog.jsonl"))
+
+
+def test_serve_refuses_foreign_marker_version(tmp_path):
+    """A root created by a different protocol version is refused, not
+    silently misread."""
+    root = tmp_path / "store"
+    root.mkdir()
+    (root / "sofa_fleet.json").write_text(json.dumps(
+        {"schema": "sofa_tpu/fleet_service", "version": 999}))
+    cfg = SofaConfig(logdir=str(tmp_path / "u"), serve_token=TOKEN,
+                     serve_port=0)
+    assert sofa_serve(cfg, root=str(root), serve_forever=False) is None
+
+
+# ---------------------------------------------------------------------------
+# Service protocol details.
+# ---------------------------------------------------------------------------
+
+def test_service_rejects_bad_uploads(service, tmp_path):
+    client = ServiceClient(service_url(service), TOKEN, timeout_s=5,
+                           retries=0, backoff_s=0.01)
+    assert client.ping()["ok"] is True
+    # hash mismatch -> retryable 422, nothing stored
+    sha = "a" * 64
+    with pytest.raises(ServiceUnavailable) as exc:
+        client.put_object(sha, b"not those bytes")
+    assert exc.value.status == 422
+    assert not ArchiveStore(_tenant_root(service)).has_object(sha)
+    # bad tenant name -> typed refusal
+    bad = ServiceClient(service_url(service), TOKEN, tenant="../evil",
+                        timeout_s=5, retries=0)
+    with pytest.raises((ServiceRejected, ServiceUnavailable)):
+        bad.have({"a": {"sha256": "0" * 64}})
+    # commit with missing objects -> 409 carried as ServiceIncomplete,
+    # which push_run resolves (exercised indirectly by every fault test)
+    import hashlib
+
+    blob = b"real bytes"
+    real = hashlib.sha256(blob).hexdigest()
+    doc = {"files": {"f.csv": {"sha256": real, "bytes": len(blob),
+                               "kind": "derived"}}}
+    from sofa_tpu.archive.client import ServiceIncomplete
+
+    with pytest.raises(ServiceIncomplete):
+        client.commit(doc)
+    assert client.put_object(real, blob)["new"] is True
+    ack = client.commit(doc)
+    assert ack["committed"] is True and ack["new"] is True
+    # replayed commit: no-op
+    assert client.commit(doc)["new"] is False
+
+
+def test_service_catalog_and_run_read(service, tmp_path):
+    watch = tmp_path / "watch"
+    _mklog(watch)
+    cfg = _agent_cfg(tmp_path, service_url(service))
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    run_id = _server_runs(service)[0]["run"]
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{service_url(service)}/v1/default/run/{run_id}")
+    req.add_header("Authorization", f"Bearer {TOKEN}")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        doc = json.loads(resp.read())
+    assert doc["run"] == run_id and doc["tenant"] == "default"
+    req = urllib.request.Request(
+        f"{service_url(service)}/v1/default/catalog")
+    req.add_header("Authorization", f"Bearer {TOKEN}")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        lines = [json.loads(s) for s in resp.read().splitlines() if s]
+    assert any(e.get("run") == run_id for e in lines)

@@ -101,6 +101,12 @@ def test_fresh_venv_install_and_record(tmp_path):
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=sysconfig.get_paths()["purelib"])
+    if not (venv / "bin" / "pip").is_file():
+        # an ensurepip-less interpreter creates a pip-less venv: the
+        # install story cannot be exercised here at all — explicit skip,
+        # never a misleading FAIL (the matrix tool's degradation ladder
+        # covers climbing past this on hosts that have a host pip)
+        pytest.skip("venv created without pip (ensurepip unavailable)")
     pip = str(venv / "bin" / "pip")
     r = _run([pip, "install", "--no-deps", "--no-build-isolation",
               "--quiet", str(src)], env=env)

@@ -95,6 +95,19 @@ from sofa_tpu.preprocess import sofa_preprocess
 sofa_preprocess(SofaConfig(logdir=logdir))
 """
 
+# Fleet cells (sofa_tpu/archive/service.py + sofa_tpu/agent.py): the
+# service child binds an ephemeral port and prints its URL; the parent
+# parses it.  SOFA_SERVE_EXIT_AFTER makes the child hard-exit at the n-th
+# write request — the kill-service-mid-upload chaos.
+_SERVE_SNIPPET = """
+import sys
+sys.path.insert(0, sys.argv[3])
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.archive.service import sofa_serve
+cfg = SofaConfig(logdir=sys.argv[1], serve_token="chaos", serve_port=0)
+sys.exit(sofa_serve(cfg, root=sys.argv[2]) or 0)
+"""
+
 # Kill-mid-archive: SIGKILL during the object-store copy loop of
 # `sofa archive`, then prove `sofa resume` replays the ingest and both
 # the store and the logdir come out fsck-clean and catalog-consistent.
@@ -405,6 +418,191 @@ def _run_whatif_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _start_service(workdir: str, store_root: str,
+                   env_extra: "dict | None" = None):
+    """Launch a fleet-service child on an ephemeral port; returns
+    (proc, url).  Raises on a child that never prints its URL."""
+    import re
+    import time
+
+    repo = os.path.dirname(_TOOLS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _SERVE_SNIPPET,
+         os.path.join(workdir, "unused"), store_root, repo],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    deadline = time.monotonic() + 30.0
+    url = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"at http://[^:/]+:(\d+)/v1/", line)
+        if m:
+            url = f"http://127.0.0.1:{m.group(1)}"
+            break
+    if url is None:
+        proc.kill()
+        raise RuntimeError("service child never printed its URL")
+    return proc, url
+
+
+def _fleet_agent_cfg(logdir: str, url: str, spool: str):
+    return SofaConfig(logdir=logdir, serve_token="chaos",
+                      agent_service=url, agent_spool=spool,
+                      agent_settle_s=0.0, agent_retries=2,
+                      agent_backoff_s=0.05, agent_backoff_cap_s=0.2)
+
+
+def _fleet_store_problems(store_root: str, want_runs: int = 1) -> List[str]:
+    """fsck + catalog assertions over the default tenant's store."""
+    from sofa_tpu.archive import catalog as acat
+    from sofa_tpu.archive.store import archive_fsck
+
+    problems: List[str] = []
+    troot = os.path.join(store_root, "tenants", "default")
+    report = archive_fsck(troot)
+    if report is None:
+        return [f"no archive store at {troot}"]
+    for verdict in ("corrupt", "missing", "orphaned", "uncataloged"):
+        if report.get(verdict):
+            problems.append(f"store fsck: {len(report[verdict])} "
+                            f"{verdict}: {report[verdict][:3]}")
+    runs = acat.ingest_entries(acat.read_catalog(troot))
+    if len(runs) != want_runs:
+        problems.append(f"catalog holds {len(runs)} run(s), expected "
+                        f"{want_runs}")
+    return problems
+
+
+def _run_service_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """kill-service-mid-upload: the service hard-exits partway through
+    the agent's push (SOFA_SERVE_EXIT_AFTER); the agent degrades to its
+    spool, a restarted service receives the retry, and the final store
+    is fsck-clean with exactly one cataloged run."""
+    from sofa_tpu.agent import sofa_agent
+
+    logdir = os.path.join(workdir, "kill-service") + "/"
+    store = os.path.join(workdir, "kill-service-store")
+    spool = os.path.join(workdir, "kill-service-spool")
+    for path in (logdir, store, spool):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    # phase 1: service dies at its 4th write request, mid-upload
+    proc, url = _start_service(workdir, store,
+                               {"SOFA_SERVE_EXIT_AFTER": "4"})
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+    finally:
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            problems.append("chaos service outlived its exit-after knob")
+    if rc != 1:
+        problems.append(f"agent --once rc={rc} against a dying service "
+                        "(expected 1: spooled, not delivered)")
+    if proc.returncode != 86:
+        problems.append(f"service child exited rc={proc.returncode} "
+                        "(expected the chaos hard-exit 86)")
+    # the run is safe in the spool either way
+    from sofa_tpu.archive import catalog as acat
+    from sofa_tpu.archive.store import archive_fsck
+
+    spool_runs = acat.ingest_entries(acat.read_catalog(spool))
+    if len(spool_runs) != 1:
+        problems.append(f"spool holds {len(spool_runs)} run(s) after the "
+                        "service death, expected 1")
+    spool_report = archive_fsck(spool) or {}
+    for verdict in ("corrupt", "missing", "uncataloged"):
+        if spool_report.get(verdict):
+            problems.append(f"spool fsck: {verdict}: "
+                            f"{spool_report[verdict][:3]}")
+    # phase 2: service returns; the agent retry lands the run
+    proc, url = _start_service(workdir, store)
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+        if rc != 0:
+            problems.append(f"agent retry rc={rc} (expected 0)")
+        problems += _fleet_store_problems(store)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        problems.append("no run_manifest.json after the push")
+    else:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+        serve_meta = (doc.get("meta") or {}).get("serve")
+        if not isinstance(serve_meta, dict):
+            problems.append("meta.serve missing after a delivered push")
+    return problems
+
+
+def _run_agent_spool_cell(workdir: str, synth: str, mc) -> List[str]:
+    """agent-offline-spool-then-drain: with no service reachable the
+    agent spools (durable, fsck-clean, exit 1); when the service
+    appears, the drain pass delivers the identical run."""
+    from sofa_tpu.agent import sofa_agent
+
+    logdir = os.path.join(workdir, "agent-offline") + "/"
+    store = os.path.join(workdir, "agent-offline-store")
+    spool = os.path.join(workdir, "agent-offline-spool")
+    for path in (logdir, store, spool):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    # offline: nothing listens on the URL at all
+    cfg = _fleet_agent_cfg(logdir, "http://127.0.0.1:9", spool)
+    cfg.agent_retries = 0
+    rc = sofa_agent(cfg, watch=logdir, once=True)
+    if rc != 1:
+        problems.append(f"agent --once rc={rc} offline (expected 1)")
+    from sofa_tpu.archive import catalog as acat
+    from sofa_tpu.archive.store import archive_fsck
+
+    spool_runs = acat.ingest_entries(acat.read_catalog(spool))
+    if len(spool_runs) != 1:
+        problems.append(f"spool holds {len(spool_runs)} run(s) offline, "
+                        "expected 1")
+    spool_report = archive_fsck(spool) or {}
+    for verdict in ("corrupt", "missing", "orphaned", "uncataloged"):
+        if spool_report.get(verdict):
+            problems.append(f"spool fsck: {verdict}: "
+                            f"{spool_report[verdict][:3]}")
+    # the service appears -> drain delivers the same run id
+    proc, url = _start_service(workdir, store)
+    try:
+        cfg = _fleet_agent_cfg(logdir, url, spool)
+        rc = sofa_agent(cfg, watch=logdir, once=True)
+        if rc != 0:
+            problems.append(f"agent drain rc={rc} (expected 0)")
+        problems += _fleet_store_problems(store)
+        troot = os.path.join(store, "tenants", "default")
+        server_runs = acat.ingest_entries(acat.read_catalog(troot))
+        if spool_runs and server_runs and \
+                server_runs[0].get("run") != spool_runs[0].get("run"):
+            problems.append("delivered run id differs from the spooled "
+                            "run id — the drain did not ship the same "
+                            "content")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -412,10 +610,12 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 3
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 5
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
-                + [("kill-mid-archive", None), ("whatif-degraded", None)])
+                + [("kill-mid-archive", None), ("whatif-degraded", None),
+                   ("kill-service-mid-upload", None),
+                   ("agent-offline-spool-then-drain", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -468,6 +668,19 @@ def main(argv=None) -> int:
           "quarantine, then sofa whatif)")
     for p in problems:
         print(f"{' ' * width}    - {p}")
+    for name, cell in (("kill-service-mid-upload", _run_service_kill_cell),
+                       ("agent-offline-spool-then-drain",
+                        _run_agent_spool_cell)):
+        try:
+            problems = cell(workdir, synth, mc)
+        except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+            problems = ["crashed:\n" + traceback.format_exc()]
+        status = "PASS" if not problems else "FAIL"
+        failures += bool(problems)
+        print(f"{name.ljust(width)}  {status}  (sofa serve + sofa agent, "
+              "sofa_tpu/archive/service.py)")
+        for p in problems:
+            print(f"{' ' * width}    - {p}")
     print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "
           "survived with a valid manifest + report")
     return 1 if failures else 0

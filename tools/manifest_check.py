@@ -31,7 +31,7 @@ from sofa_tpu.telemetry import (  # noqa: E402
 )
 
 _KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress",
-                "whatif")
+                "whatif", "agent")
 _VERDICTS = ("regressed", "improved", "noise")
 # Version pins per schema id: sofa-lint SL018 verifies these literals
 # agree with the writers' *_VERSION constants and the schema registry
@@ -335,6 +335,54 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             if not isinstance(whatif.get("report"), str):
                 probs.append("meta.whatif.report: missing report filename")
 
+    # meta.agent / meta.serve (written by `sofa agent`, sofa_tpu/agent.py
+    # — the fleet transport leg, docs/FLEET.md): the spool/push record
+    # and, once the service acks the commit, the serve-side acceptance.
+    agent = (doc.get("meta") or {}).get("agent")
+    if agent is not None:
+        if not isinstance(agent, dict):
+            probs.append("meta.agent: not an object")
+        else:
+            if not isinstance(agent.get("spool"), str):
+                probs.append("meta.agent.spool: missing spool root")
+            run = agent.get("run")
+            if not (isinstance(run, str) and len(run) == 64):
+                probs.append("meta.agent.run: not a 64-hex run id")
+            svc = agent.get("service")
+            if svc is not None and not isinstance(svc, str):
+                probs.append("meta.agent.service: not a string or null")
+            push = agent.get("push")
+            if push is not None:
+                if not isinstance(push, dict) or push.get("status") not in (
+                        "pushed", "spooled", "rejected"):
+                    probs.append("meta.agent.push.status: not in "
+                                 "('pushed', 'spooled', 'rejected')")
+                else:
+                    for key in ("attempts",):
+                        v = push.get(key)
+                        if not isinstance(v, int) or isinstance(v, bool) \
+                                or v < 0:
+                            probs.append(f"meta.agent.push.{key}: missing "
+                                         "or not a non-negative int")
+                    if not _is_num(push.get("wall_s")) \
+                            or push.get("wall_s", 0) < 0:
+                        probs.append("meta.agent.push.wall_s: missing or "
+                                     "negative")
+    serve = (doc.get("meta") or {}).get("serve")
+    if serve is not None:
+        if not isinstance(serve, dict):
+            probs.append("meta.serve: not an object")
+        else:
+            for key in ("url", "tenant"):
+                if not isinstance(serve.get(key), str) or not serve[key]:
+                    probs.append(f"meta.serve.{key}: missing or empty")
+            run = serve.get("run")
+            if not (isinstance(run, str) and len(run) == 64):
+                probs.append("meta.serve.run: not a 64-hex run id")
+            if not _is_num(serve.get("committed_unix")):
+                probs.append("meta.serve.committed_unix: missing or not "
+                             "a number")
+
     regress = (doc.get("meta") or {}).get("regress")
     if regress is not None:
         if not isinstance(regress, dict) or \
@@ -379,6 +427,12 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 probs.append(f"unhealthy: analysis pass {name} failed"
                              + (f" ({ent['error']})"
                                 if ent.get("error") else ""))
+        if isinstance(agent, dict) and \
+                isinstance(agent.get("push"), dict) and \
+                agent["push"].get("status") != "pushed":
+            probs.append("unhealthy: the agent could not deliver this "
+                         f"run ({agent['push'].get('status')}) — it is "
+                         "spooled locally, not in the fleet archive")
         if isinstance(whatif, dict) and \
                 whatif.get("verdict") == "uncalibrated":
             probs.append("unhealthy: the what-if identity gate is "
