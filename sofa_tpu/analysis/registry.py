@@ -311,6 +311,32 @@ def resolve_schedule(specs: List[PassSpec],
     return waves
 
 
+def select_for_dirty(cfg, dirty_frames) -> set:
+    """The incremental re-run window: every enabled pass whose declared
+    ``reads_frames`` touches a dirty frame, closed transitively over the
+    declared dependency graph (feature reads + ``after`` edges) — a pass
+    consuming a re-run pass's features re-runs too, even though its own
+    frames are clean.  Derived from the SAME declarations the scheduler
+    and sofa-lint SL010-SL013 enforce, so what lints clean is what
+    re-runs correctly."""
+    dirty = set(dirty_frames)
+    specs = [s for s in registered() if s.enabled(cfg)]
+    deps = pass_dependencies(specs)
+    consumers: Dict[str, set] = {s.name: set() for s in specs}
+    for name, producers in deps.items():
+        for p in producers:
+            consumers.setdefault(p, set()).add(name)
+    selected = {s.name for s in specs if set(s.reads_frames) & dirty}
+    frontier = list(selected)
+    while frontier:
+        name = frontier.pop()
+        for c in consumers.get(name, ()):
+            if c not in selected:
+                selected.add(c)
+                frontier.append(c)
+    return selected
+
+
 # --- deterministic feature views --------------------------------------------
 
 class _PassFeatures:
@@ -362,13 +388,19 @@ class _PassFeatures:
 # --- execution --------------------------------------------------------------
 
 def run_passes(frames, cfg, features: Features, tel=None,
-               jobs: Optional[int] = None):
+               jobs: Optional[int] = None, select=None):
     """Execute every registered pass under the declared schedule.
 
     Returns ``(report, series)``: the ``meta.passes`` ledger dict and the
     board series produced by series-providing passes (canonical order).
     One crashing pass degrades to a warning + sticky ``failed`` status;
-    everything else runs."""
+    everything else runs.
+
+    ``select`` (a set of pass names, or None for all) is the incremental
+    window `sofa live` derives from the declared contracts: enabled
+    passes outside it are reported ``skipped`` (reason: inputs
+    unchanged) and never run — their previous features were already
+    injected into ``features`` by the caller."""
     from sofa_tpu import pool, telemetry
 
     specs = registered()
@@ -380,6 +412,14 @@ def run_passes(frames, cfg, features: Features, tel=None,
             report[s.name] = {
                 "status": "skipped", "origin": s.origin,
                 "skip_reason": "/".join(s.enabled_when) + " off",
+            }
+    if select is not None:
+        deselected = [s for s in enabled if s.name not in select]
+        enabled = [s for s in enabled if s.name in select]
+        for s in deselected:
+            report[s.name] = {
+                "status": "skipped", "origin": s.origin,
+                "skip_reason": "inputs unchanged (live incremental)",
             }
     waves = resolve_schedule(enabled)
     buffers: Dict[str, Features] = {}

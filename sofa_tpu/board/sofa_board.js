@@ -687,3 +687,66 @@ function drawBars(canvas, labels, values, color) {
     ctx.fillText(fmt(values[i]), left + (W - left - 60) * (values[i] / max) + 4, y + barH * 0.7);
   });
 }
+
+/* ---------- live polling (`sofa live`, docs/LIVE.md) ---------- */
+/* Polls run_manifest.json's meta.live stamp (rewritten atomically every
+ * live epoch) and refetches report.js when the epoch advances, so the
+ * timeline grows while the job runs.  Mid-epoch reads always see the
+ * last committed generation (every live write is tmp+rename atomic);
+ * polling stops on its own once the stream drains (active: false) or
+ * the logdir carries no live section at all. */
+function initLivePoll(onUpdate, intervalMs) {
+  let epoch = null;
+  let stopped = false;
+  const tick = async () => {
+    if (stopped) return;
+    try {
+      const resp = await fetch("run_manifest.json", { cache: "no-cache" });
+      if (!resp.ok) return;
+      const doc = await resp.json();
+      const live = (doc.meta || {}).live;
+      if (!live) { stopped = epoch !== null; return; }
+      if (!live.active) {
+        if (epoch !== null && live.epoch !== epoch) {
+          await refetch(live);  // the drain's final converged artifacts
+        }
+        stopped = true;
+        return;
+      }
+      if (live.epoch === epoch) return;
+      await refetch(live);
+    } catch (e) {
+      /* a poll racing an epoch retries on the next tick */
+    }
+  };
+  const refetch = async (live) => {
+    const rep = await fetch("report.js", { cache: "no-cache" });
+    if (!rep.ok) return;
+    const text = await rep.text();
+    const payload = JSON.parse(
+      text.slice(text.indexOf("=") + 1).trim().replace(/;+$/, ""));
+    epoch = live.epoch;
+    onUpdate(payload, live);
+  };
+  const timer = setInterval(() => {
+    if (stopped) { clearInterval(timer); return; }
+    tick();
+  }, intervalMs || 3000);
+  tick();
+  return timer;
+}
+
+function liveStatusText(live) {
+  if (!live) return "";
+  const srcs = live.sources || {};
+  let streaming = 0, stalled = 0;
+  for (const k in srcs) {
+    if (srcs[k].status === "streaming") streaming++;
+    if (srcs[k].status === "stalled") stalled++;
+  }
+  let txt = "LIVE epoch " + live.epoch + " · " + streaming + " streaming";
+  if (stalled) txt += " · " + stalled + " STALLED";
+  if (typeof live.watermark_s === "number")
+    txt += " · watermark " + fmt(live.watermark_s) + "s";
+  return txt;
+}

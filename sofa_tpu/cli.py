@@ -52,6 +52,14 @@ CLI (/root/reference/bin/sofa:328-376):
                     durable local archive, and forward to a `sofa serve`
                     endpoint with bounded timeouts + jittered backoff;
                     --once runs a single scan+drain pass
+  live              crash-tolerant streaming profiling (sofa_tpu/live.py):
+                    epoch loop tailing every raw source from a per-source
+                    byte offset in the fsync'd _live_offsets.json ledger —
+                    torn tails back off to the last whole record, committed
+                    chunks never re-parse, only dirty tiles rebuild, and
+                    registry passes re-run incrementally on the dirty
+                    window; --drain converges byte-identical to a batch
+                    preprocess+analyze (docs/LIVE.md)
   clean             remove derived files, keep raw collector output
   setup             host-enablement doctor (sysctls, tool caps) — replaces
                     the reference's empower.py / enable_strace_perf_pcm.py
@@ -93,11 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
         "resume", "fsck", "archive", "regress", "whatif", "artifacts",
-        "serve", "agent",
+        "serve", "agent", "live",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
-                        "(status/resume/fsck/passes/whatif/artifacts); "
+                        "(status/resume/fsck/passes/whatif/artifacts/live); "
                         "path to lint (lint); logdir or ls/show/gc/fsck "
                         "(archive); run (regress); archive root (serve); "
                         "watch directory (agent)")
@@ -223,6 +231,23 @@ def build_parser() -> argparse.ArgumentParser:
     g = p.add_argument_group("diff")
     g.add_argument("--base_logdir")
     g.add_argument("--match_logdir")
+
+    g = p.add_argument_group("live")
+    g.add_argument("--live_interval_s", type=float,
+                   help="live: seconds between streaming epochs "
+                        "(default 2)")
+    g.add_argument("--live_epochs", type=int,
+                   help="live: run exactly N epochs then exit "
+                        "(0 = until interrupted)")
+    g.add_argument("--live_stall_s", type=float,
+                   help="live: a source that stops growing for this long "
+                        "while siblings stream degrades to `stalled` "
+                        "(default 30; 0 = never)")
+    g.add_argument("--drain", action="store_true", default=False,
+                   help="live: after the epoch loop ends (or immediately "
+                        "with --live_epochs 0), run a full batch "
+                        "preprocess+analyze so every artifact converges "
+                        "byte-identical to a never-interrupted batch run")
 
     g = p.add_argument_group("fsck")
     g.add_argument("--repair", action="store_true", default=False,
@@ -363,6 +388,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
         "archive_root", "archive_label", "archive_keep", "archive_keep_days",
         "regress_rolling", "regress_pct", "regress_threshold",
+        "live_interval_s", "live_epochs", "live_stall_s",
         "serve_bind", "serve_port", "serve_token", "serve_quota_mb",
         "serve_max_inflight", "fleet_tenant", "agent_service",
         "agent_spool", "agent_poll_s", "agent_settle_s", "agent_timeout_s",
@@ -536,12 +562,16 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
-        if cmd in ("status", "resume", "fsck", "passes", "whatif"):
+        if cmd in ("status", "resume", "fsck", "passes", "whatif", "live"):
             if args.usr_command and "logdir" not in vars(args):
                 # `sofa status sofalog/` reads more naturally than
                 # --logdir for a logdir-only verb; an explicit flag wins.
                 cfg.logdir = args.usr_command
                 cfg.__post_init__()
+            if cmd == "live":
+                from sofa_tpu.live import sofa_live
+                print_main_progress("SOFA live")
+                return sofa_live(cfg, drain=args.drain)
             if cmd == "status":
                 from sofa_tpu.telemetry import sofa_status
                 return sofa_status(cfg)

@@ -208,6 +208,16 @@ class SofaConfig:
     agent_backoff_s: float = 0.5     # retry backoff base (jittered)
     agent_backoff_cap_s: float = 30.0  # retry backoff cap
 
+    # --- live streaming (sofa_tpu/live.py) ----------------------------------
+    live_interval_s: float = 2.0     # epoch poll period between live ticks
+    live_epochs: int = 0             # --live_epochs: run exactly N epochs
+                                     # then exit (0 = until interrupted);
+                                     # tests/bench drive finite loops
+    live_stall_s: float = 30.0       # a source that stops growing for this
+                                     # long while siblings keep streaming
+                                     # degrades to `stalled` in meta.live
+                                     # (0 = never flag)
+
     # --- whatif (sofa_tpu/whatif/) ------------------------------------------
     whatif_apply: str = ""           # --apply: comma-joined scenario specs
                                      # (overlap:<pat> | scale:<pat>=<f|sol>

@@ -723,8 +723,13 @@ def sofa_resume(cfg) -> int:
     wi = state.get("whatif")
     need_wi = wi is not None and (not wi["committed"] or need_pre
                                   or need_an)
+    lv = state.get("live")
+    # A committed live epoch whose key no longer matches just means the
+    # job appended more raw bytes — the next tick's business, not a
+    # replay.  Only an epoch that begun and never committed replays.
+    need_lv = lv is not None and not lv["committed"]
 
-    if not (need_pre or need_an or need_ar or need_wi):
+    if not (need_pre or need_an or need_ar or need_wi or need_lv):
         print_progress("resume: every journaled stage is committed and "
                        "matches the raw files — nothing to replay")
         return 0
@@ -770,5 +775,15 @@ def sofa_resume(cfg) -> int:
         print_progress("resume: replaying whatif "
                        f"(--apply {cfg.whatif_apply or '<identity>'})")
         sofa_whatif(cfg)
+    if need_lv:
+        # Replay = run exactly one live epoch: committed chunks load from
+        # the chunk cache, the uncommitted tail re-tails from the offset
+        # ledger's last fsync'd state, and every derived artifact
+        # refreshes atomically (sofa_tpu/live.py).
+        from sofa_tpu.live import sofa_live
+
+        print_progress("resume: replaying the interrupted live epoch "
+                       "(committed chunks load from the chunk cache)")
+        sofa_live(cfg, epochs=1)
     print_progress("resume: journal replay complete")
     return 0

@@ -38,6 +38,23 @@ what these prove is the CLIENT's retry/resume/backoff contract)::
                                            fraction (0 < f < 1) — the
                                            server's hash check rejects it
 
+Stream-source fault kinds (target = a tailable ingest source, consumed by
+the `sofa live` tailer in sofa_tpu/live.py — docs/LIVE.md failure matrix)::
+
+    <source>:tail_truncate[@<epoch>]   the tail read sees only half of the
+                                       new bytes (a partial flush)
+    <source>:tail_torn[@<epoch>]       the tail read ends mid-record — the
+                                       torn-tail backoff must leave the
+                                       partial record unconsumed
+    <source>:rotate[@<epoch>]          the source reads as rotated (head
+                                       signature mismatch): offsets reset
+                                       and the file re-ingests from zero
+    <source>:stall[@<epoch>|@always]   the source reports no growth this
+                                       epoch, driving stalled detection
+
+Stream faults fire at exactly the declared 1-based epoch ordinal
+(default 1); ``@always`` never clears.
+
 Firing policy: by default each network fault fires ONCE PER REQUEST KEY
 (one object upload, one commit), so the first attempt fails and the
 retry path is exercised deterministically; ``@start`` fires exactly once
@@ -65,10 +82,16 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 KINDS = ("die", "wedge", "fail", "truncate", "corrupt",
-         "conn_refused", "stall", "http_500", "partial")
+         "conn_refused", "stall", "http_500", "partial",
+         "tail_truncate", "tail_torn", "rotate")
 #: Kinds injected into the fleet transport client (archive/client.py)
 #: rather than a collector lifecycle hook.
 NET_KINDS = ("conn_refused", "stall", "http_500", "partial")
+#: Kinds injected into the `sofa live` tailer (sofa_tpu/live.py) against a
+#: streaming ingest source.  ``stall`` is shared vocabulary with NET_KINDS:
+#: against the ``service`` target it is a transport stall, against a source
+#: it freezes that source's tail for the epoch (docs/LIVE.md).
+STREAM_KINDS = ("tail_truncate", "tail_torn", "rotate", "stall")
 PHASES = ("start", "stop", "harvest")
 #: Firing policies for NET_KINDS ("" = the default once-per-request-key).
 NET_WHENS = ("start", "always")
@@ -99,7 +122,9 @@ class FaultSpec:
     phase: Optional[str] = None   # start|stop|harvest (fail/wedge/truncate)
     delay_s: Optional[float] = None  # die only
     fraction: Optional[float] = None  # partial only: body cut at this point
-    when: Optional[str] = None    # NET_KINDS: start|always|None (per-key)
+    when: Optional[str] = None    # NET_KINDS: start|always|None (per-key);
+                                  # STREAM_KINDS: always|None (one epoch)
+    epoch: Optional[int] = None   # STREAM_KINDS: 1-based live epoch ordinal
 
     def fires_at(self, phase: str) -> bool:
         return (self.phase or DEFAULT_PHASE.get(self.kind)) == phase
@@ -131,6 +156,19 @@ class FaultPlan:
 
     def corrupt_for(self, source: str) -> Optional[FaultSpec]:
         return self.find(source, "corrupt")
+
+    def stream_fault(self, source: str, epoch: int) -> Optional[FaultSpec]:
+        """The stream-source fault — if any — to apply to ``source`` in
+        live epoch ``epoch`` (1-based).  Default firing is exactly the
+        declared epoch ordinal (``@<n>``, default 1) so every torn-tail /
+        rotation / stall path is deterministically reproducible;
+        ``@always`` never clears (a permanently wedged source)."""
+        for s in self._by_target.get(source, ()):
+            if s.kind not in STREAM_KINDS:
+                continue
+            if s.when == "always" or (s.epoch or 1) == epoch:
+                return s
+        return None
 
     def service_fault(self, target: str, op: str,
                       key: str) -> Optional[FaultSpec]:
@@ -173,8 +211,14 @@ def parse(text: str) -> FaultPlan:
         if kind not in KINDS:
             raise ValueError(
                 f"fault entry {entry!r}: kind {kind!r} not in {KINDS}")
-        if kind in NET_KINDS:
+        if kind in NET_KINDS and (target == "service"
+                                  or kind not in STREAM_KINDS):
+            # `stall` is in both vocabularies: the `service` target picks
+            # the transport kind, any other target is a stream source.
             specs.append(_parse_net(entry, target, kind, when))
+            continue
+        if kind in STREAM_KINDS:
+            specs.append(_parse_stream(entry, target, kind, when))
             continue
         phase: Optional[str] = None
         delay: Optional[float] = None
@@ -204,6 +248,27 @@ def parse(text: str) -> FaultPlan:
         specs.append(FaultSpec(target=ALIASES.get(target, target),
                                kind=kind, phase=phase, delay_s=delay))
     return FaultPlan(specs)
+
+
+def _parse_stream(entry: str, target: str, kind: str,
+                  when: str) -> FaultSpec:
+    """One stream-source entry: ``<source>:<kind>[@<epoch>|@always]``.
+    The ordinal names the 1-based live epoch the fault fires in (default
+    1 — the first tail after the plan installs)."""
+    target = ALIASES.get(target, target)
+    if not when:
+        return FaultSpec(target=target, kind=kind)
+    if when == "always":
+        return FaultSpec(target=target, kind=kind, when="always")
+    try:
+        epoch = int(when)
+    except ValueError:
+        epoch = 0
+    if epoch < 1:
+        raise ValueError(
+            f"fault entry {entry!r}: stream kinds take a 1-based epoch "
+            "ordinal (e.g. tail_torn@2) or 'always'")
+    return FaultSpec(target=target, kind=kind, epoch=epoch)
 
 
 def _parse_net(entry: str, target: str, kind: str,
@@ -318,6 +383,18 @@ def maybe_service_fault(op: str, key: str = "",
     if plan is None:
         return None
     return plan.service_fault(target, op, key)
+
+
+def maybe_stream_fault(source: str, epoch: int) -> Optional[FaultSpec]:
+    """Live-tailer hook (sofa_tpu/live.py): the stream fault — if any —
+    to apply to ``source`` in epoch ``epoch``.  The TAILER consumes the
+    spec (truncating its read window, forcing the rotation path, or
+    freezing the source) so every offset-resume and torn-tail branch is
+    reachable on demand; returns the spec or None."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.stream_fault(source, epoch)
 
 
 def maybe_truncate(col) -> None:

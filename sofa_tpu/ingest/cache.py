@@ -174,6 +174,11 @@ class IngestCache:
             "stored_bytes": dict(self.stored_bytes),
         }
 
+    def chunks(self) -> "ChunkStore":
+        """The chunk-granular sibling store `sofa live` tails into (same
+        enablement/bypass policy as the whole-source cache)."""
+        return ChunkStore(self.root, enabled=self.enabled)
+
     def store(self, source: str, key: dict,
               frames: Dict[str, pd.DataFrame],
               meta: "dict | None" = None) -> None:
@@ -213,3 +218,102 @@ class IngestCache:
                 json.dump(doc, f)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Chunk-granular cache — the `sofa live` re-keying of this cache.
+#
+# The whole-source keys above sign (size, mtime): correct for batch runs,
+# but a GROWING raw file flips its key on every append and the whole file
+# reparses.  `sofa live` (sofa_tpu/live.py) therefore keys at chunk
+# granularity: each committed [start, end) byte range of a tailable source
+# parses exactly ONCE, lands here as a parquet frame, and every later
+# epoch (and every crash replay) LOADS it instead of reparsing — the
+# "committed chunks are never re-parsed" contract, proven by the
+# loads/parses ledger the live manifest carries.
+# ---------------------------------------------------------------------------
+
+CHUNK_DIR_NAME = "_live_chunks"
+
+
+class ChunkStore:
+    """Per-logdir chunk frames under ``_ingest_cache/_live_chunks/``.
+
+    Chunk files are atomic (tmp+rename) and named by their byte range, so
+    a replayed epoch overwrites its own half-written chunk
+    deterministically; the offset ledger (live.OffsetLedger) is the
+    commit point — a chunk file without a ledger entry is simply
+    re-derived."""
+
+    def __init__(self, root: str, enabled: bool = True):
+        self.root = os.path.join(root, CHUNK_DIR_NAME)
+        self.enabled = enabled
+        self.loads: Dict[str, int] = {}
+
+    def _path(self, source: str, start: int, end: int, ext: str) -> str:
+        return os.path.join(self.root, source,
+                            f"{int(start):012d}-{int(end):012d}{ext}")
+
+    def store(self, source: str, start: int, end: int,
+              df: pd.DataFrame) -> bool:
+        """Persist one chunk's parsed frame; best-effort like the
+        whole-source store (an unwritable logdir degrades to reparsing
+        that chunk on the next epoch, never a failed tick)."""
+        if not self.enabled:
+            return False
+        pq = self._path(source, start, end, ".parquet")
+        pk = self._path(source, start, end, ".pkl")
+        try:
+            os.makedirs(os.path.dirname(pq), exist_ok=True)
+            try:
+                df.to_parquet(pq + ".tmp", index=False)
+                os.replace(pq + ".tmp", pq)
+                if os.path.isfile(pk):
+                    os.unlink(pk)
+            except Exception as e:  # noqa: BLE001 — no pyarrow: pickle fallback
+                print_info(f"live chunk cache: parquet store of "
+                           f"{source}[{start}:{end}] failed ({e}); "
+                           "using pickle")
+                df.to_pickle(pk + ".tmp")
+                os.replace(pk + ".tmp", pk)
+            return True
+        except OSError:
+            return False
+
+    def load(self, source: str, start: int,
+             end: int) -> "Optional[pd.DataFrame]":
+        """A committed chunk's frame, or None (→ the caller reparses the
+        byte range; any unreadable chunk degrades the same way)."""
+        if not self.enabled:
+            return None
+        from sofa_tpu.trace import _conform
+
+        pq = self._path(source, start, end, ".parquet")
+        pk = self._path(source, start, end, ".pkl")
+        try:
+            if os.path.isfile(pq):
+                df = _conform(pd.read_parquet(pq))
+            elif os.path.isfile(pk):
+                df = _conform(pd.read_pickle(pk))
+            else:
+                return None
+        except Exception as e:  # noqa: BLE001 — a corrupt chunk is a miss
+            print_warning(f"live chunk cache: unreadable chunk "
+                          f"{source}[{start}:{end}] ({e}); reparsing")
+            return None
+        self.loads[source] = self.loads.get(source, 0) + 1
+        return df
+
+    def discard(self, source: str, start: int, end: int) -> None:
+        """Remove one chunk's files (compaction superseded them)."""
+        for ext in (".parquet", ".pkl"):
+            try:
+                os.unlink(self._path(source, start, end, ext))
+            except OSError:
+                pass
+
+    def drop(self, source: str) -> None:
+        """Forget every chunk of a source (rotation, fsck repair)."""
+        import shutil
+
+        shutil.rmtree(os.path.join(self.root, source), ignore_errors=True)

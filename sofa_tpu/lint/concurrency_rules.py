@@ -243,7 +243,19 @@ class _FileModel:
                         self.handler_classes.add(child.name)
                 elif isinstance(child, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
-                    nf = f"{func}.{child.name}" if func else child.name
+                    # Methods qualify by CLASS as well as enclosing
+                    # function: two classes with a same-named method
+                    # (every pair of __init__s) must not alias in
+                    # functions/class_of, or spawn sites in one class
+                    # get attributed to the other and SL023's join
+                    # matching breaks (found when supervisor.py grew a
+                    # second class).
+                    if func:
+                        nf = f"{func}.{child.name}"
+                    elif cls:
+                        nf = f"{cls}.{child.name}"
+                    else:
+                        nf = child.name
                     self.functions[nf] = child
                     self.class_of[nf] = cls
                     if cls and not func:

@@ -299,7 +299,10 @@ _RAW_SUFFIXES = (".xplane.pb",)
 # the ingest/preprocess pipeline itself, and the live dashboard (top tails
 # files mid-recording — there is nothing cached to serve yet).
 _RAW_ALLOWED = ("ingest/", "collectors/", "record.py", "preprocess.py",
-                "api.py", "top.py", "telemetry.py", "faults.py")
+                "api.py", "top.py", "telemetry.py", "faults.py",
+                # the live tailer IS an ingest layer: it reads raw byte
+                # ranges and commits them into the chunk cache
+                "live.py")
 
 
 class RawArtifactBypass(Rule):
@@ -380,7 +383,7 @@ _DERIVED_WRITER_FILES = (
     "trace.py", "telemetry.py", "tiles.py", "preprocess.py", "analyze.py",
     "ingest/cache.py", "ingest/pcap.py", "export_folded.py",
     "export_perfetto.py", "export_static.py", "analysis/", "ml/",
-    "durability.py", "archive/", "whatif/",
+    "durability.py", "archive/", "whatif/", "live.py",
 )
 
 _OPEN_FNS = frozenset({"open", "io.open", "gzip.open", "bz2.open",

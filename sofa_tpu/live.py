@@ -1,0 +1,709 @@
+"""``sofa live`` — crash-tolerant streaming profiling with resumable ingest.
+
+Every other verb is batch: nothing is visible until record finishes and
+analyze writes report.js.  This verb turns the pipeline into an epoch
+loop over a GROWING logdir — each tick tails every raw collector file
+from a per-source byte offset, folds only the new whole records in, and
+refreshes the board's artifacts, so the timeline and ``[sol]``/
+``[whatif]`` hints update while the job runs ("Enhancing Performance
+Insight at Scale", PAPERS.md: always-on streaming diagnostics).
+
+Robustness is the spine, not a feature (docs/LIVE.md failure matrix):
+
+* **Offset ledger** — ``<logdir>/_live_offsets.json`` (schema
+  ``sofa_tpu/live_offsets`` v1) is the epoch's commit point: per-source
+  committed byte offsets, chunk table, head signature, and stall clocks,
+  written fsync'd tmp+rename LAST in the epoch.  A SIGKILL at any instant
+  leaves either the old ledger (the epoch replays, byte-identically) or
+  the new one (the epoch committed) — never a half-state.
+* **Torn tails** — the tailer consumes new bytes only up to the last
+  whole record (``\\n`` boundary, the ``_journal.jsonl`` torn-tail
+  discipline applied to collector outputs); a partially flushed final
+  record waits for the next tick.  Garbage is never parsed.
+* **Chunk-granular cache** — each committed ``[start, end)`` byte range
+  parses exactly once (ingest/cache.ChunkStore); later epochs and crash
+  replays LOAD the stored frame.  The ``chunks_parsed``/``chunks_loaded``
+  counters in ``meta.live`` are the no-reparse proof.
+* **Rotation** — a shrunken file or changed head signature (and the
+  injected ``<source>:rotate`` fault) resets the source to byte 0 and
+  drops its chunks; the other sources keep streaming.
+* **Stalled sources** — a source that stops growing past
+  ``--live_stall_s`` while siblings stream degrades to ``stalled`` in
+  ``meta.live`` (supervisor.GrowthWatermark — the watchdog's
+  output-stall discipline); ``manifest_check --require-healthy`` treats
+  it as unhealthy.
+* **Convergence** — ``sofa live --drain`` (or a plain batch
+  ``sofa preprocess`` + ``analyze``) over the final logdir produces
+  output byte-identical to a never-interrupted batch run: live tile
+  indexes carry no batch content key, so the drain rebuilds them from
+  scratch through the exact batch path.
+
+Derived writes inside an epoch are all atomic (tmp+rename), so the viz
+server serves the last committed generation mid-epoch instead of 503ing
+for the whole run — the ``derived_write_guard`` sentinel is for batch
+verbs whose CSVs stream non-atomically.
+
+Incrementality is contract-driven: registry passes re-run only when
+their declared ``reads_frames`` (or a feature they read, transitively)
+touches a frame that changed this epoch (analysis/registry.
+select_for_dirty); tile pyramids rebuild only the tiles whose window
+intersects the dirty suffix (tiles.build_tiles_live).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from sofa_tpu import faults, pool
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.printing import print_progress, print_warning
+
+OFFSETS_NAME = "_live_offsets.json"
+OFFSETS_SCHEMA = "sofa_tpu/live_offsets"
+OFFSETS_VERSION = 1
+
+#: Bytes of the file head signed per source: a different head under the
+#: same path is a rotated file, not an append.
+_HEAD_SIG_BYTES = 256
+
+#: Committed chunks per source before they compact into one (a pure
+#: load+store merge — no reparse), bounding the per-epoch concat fan-in
+#: the way journal compaction bounds replay length.
+CHUNK_COMPACT_COUNT = 64
+
+#: Per-source live statuses surfaced in ``meta.live.sources``.
+LIVE_SOURCE_STATUSES = ("streaming", "idle", "stalled", "rotated",
+                        "torn", "absent")
+
+
+def _tail_parsers(cfg: SofaConfig):
+    """The tailable-source table: (source, raw file, chunk parser).
+
+    Only parsers whose output is a pure per-record function of the input
+    text qualify — parse(whole file) must equal concat(parse(chunk_i))
+    at record boundaries, which is what makes the chunk cache sound.
+    Delta/stateful parsers (mpstat's jiffy differencing, vmstat's tick
+    counter, blktrace's D→C pairing, perf's MHz interpolation, pcap,
+    xplane) stay on the whole-source content-keyed rescan path instead —
+    their files either are tiny samplers or rewrite history anyway."""
+    from sofa_tpu.ingest import procfs, strace_parse
+    from sofa_tpu.ingest.tpumon_parse import parse_tpumon
+
+    def p_strace(text, tb):
+        return strace_parse.parse_strace(text, time_base=tb,
+                                         min_time=cfg.strace_min_time)
+
+    def p_pystacks(text, tb):
+        return strace_parse.parse_pystacks(text, time_base=tb)
+
+    def p_tpumon(text, tb):
+        return parse_tpumon(text, tb)
+
+    def p_cpuinfo(text, tb):
+        return procfs.parse_cpuinfo(text, time_base=tb)
+
+    return [
+        ("strace", "strace.txt", p_strace),
+        ("pystacks", "pystacks.txt", p_pystacks),
+        ("tpumon", "tpumon.txt", p_tpumon),
+        ("cpuinfo", "cpuinfo.txt", p_cpuinfo),
+    ]
+
+
+#: Source names the chunk tailer owns (everything else reaches frames
+#: through preprocess._run_ingest's content-keyed rescan path).
+TAILABLE_SOURCES = ("strace", "pystacks", "tpumon", "cpuinfo")
+
+
+# ---------------------------------------------------------------------------
+# The offset ledger.
+# ---------------------------------------------------------------------------
+
+class OffsetLedger:
+    """The fsync'd per-source byte/record offset ledger — THE commit
+    point of a live epoch.  Everything in it is re-derivable from the
+    raw files; losing it costs a reparse, never data."""
+
+    def __init__(self, logdir: str):
+        self.path = os.path.join(logdir, OFFSETS_NAME)
+        self.doc: dict = {
+            "schema": OFFSETS_SCHEMA, "version": OFFSETS_VERSION,
+            "epoch": 0, "updated_unix": 0.0, "time_base": None,
+            "watermark_s": None, "sources": {}, "growth": {},
+            "features_rows": 0,
+        }
+
+    @classmethod
+    def load(cls, logdir: str) -> "OffsetLedger":
+        ledger = cls(logdir)
+        try:
+            with open(ledger.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return ledger
+        if not isinstance(doc, dict) or doc.get("schema") != OFFSETS_SCHEMA \
+                or doc.get("version") != OFFSETS_VERSION:
+            print_warning(f"live: {OFFSETS_NAME} is not a v{OFFSETS_VERSION}"
+                          " offset ledger — starting from byte 0")
+            return ledger
+        ledger.doc.update(doc)
+        return ledger
+
+    def source(self, name: str) -> dict:
+        return self.doc["sources"].setdefault(
+            name, {"offset": 0, "chunks": [], "head_sha": None,
+                   "events": 0})
+
+    def reset_source(self, name: str) -> dict:
+        self.doc["sources"][name] = {"offset": 0, "chunks": [],
+                                     "head_sha": None, "events": 0}
+        return self.doc["sources"][name]
+
+    def commit(self) -> None:
+        from sofa_tpu.durability import atomic_write
+
+        self.doc["updated_unix"] = round(time.time(), 3)
+        try:
+            with atomic_write(self.path, fsync=True) as f:
+                json.dump(self.doc, f, indent=1, sort_keys=True)
+        except OSError as e:
+            print_warning(f"live: cannot write {self.path}: {e} — the "
+                          "next epoch re-tails this one's bytes")
+
+
+# ---------------------------------------------------------------------------
+# The tailer.
+# ---------------------------------------------------------------------------
+
+def _head_sig(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha1(f.read(_HEAD_SIG_BYTES)).hexdigest()
+    except OSError:
+        return None
+
+
+def _read_range(path: str, start: int, end: int) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            f.seek(start)
+            return f.read(max(end - start, 0))
+    except OSError:
+        return None
+
+
+def whole_records(buf: bytes) -> bytes:
+    """Torn-tail backoff: the prefix of ``buf`` ending at the last
+    newline — a partially flushed final record is never parsed (the
+    ``_journal.jsonl`` discipline applied to collector outputs)."""
+    idx = buf.rfind(b"\n")
+    return buf[:idx + 1] if idx >= 0 else b""
+
+
+class _TailOutcome:
+    """One source's epoch result: its assembled frame + the meta.live
+    row + whether anything changed."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.frame: Optional[pd.DataFrame] = None
+        self.dirty = False
+        self.info: dict = {"status": "idle", "offset": 0, "lag_bytes": 0,
+                           "chunks": 0, "chunks_parsed": 0,
+                           "chunks_loaded": 0, "events": 0}
+
+
+def _tail_source(cfg: SofaConfig, ledger: OffsetLedger, chunks,
+                 source: str, raw: str, parser, time_base: float,
+                 epoch: int, watermark) -> _TailOutcome:
+    """One epoch's tail of one source: detect rotation, back off the torn
+    tail, parse exactly the new whole records, and assemble the source's
+    cumulative frame from committed chunk frames (loads, not parses)."""
+    from sofa_tpu.trace import _conform, empty_frame
+
+    out = _TailOutcome(source)
+    path = cfg.path(raw)
+    entry = ledger.source(source)
+    spec = faults.maybe_stream_fault(source, epoch)
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = -1
+    if size < 0 and not entry["chunks"]:
+        out.info["status"] = "absent"
+        out.frame = empty_frame()
+        return out
+
+    rotated = False
+    if size >= 0:
+        head = _head_sig(path)
+        if spec is not None and spec.kind == "rotate":
+            rotated = True
+        elif size < entry["offset"]:
+            rotated = True  # the file shrank: this is not the same stream
+        elif entry["head_sha"] and head and entry["head_sha"] != head \
+                and entry["offset"] > 0:
+            rotated = True  # same name, different bytes at the head
+        if rotated:
+            print_warning(f"live: {raw} rotated — re-ingesting {source} "
+                          "from byte 0 (committed chunks dropped)")
+            chunks.drop(source)
+            entry = ledger.reset_source(source)
+            entry["head_sha"] = head
+            out.info["status"] = "rotated"
+            out.dirty = True
+        elif entry["head_sha"] is None and head is not None:
+            entry["head_sha"] = head
+
+    stalled_fault = spec is not None and spec.kind == "stall"
+    start = int(entry["offset"])
+    end = size if size >= 0 else start
+    if stalled_fault:
+        end = start  # the source freezes this epoch, deterministically
+    elif spec is not None and spec.kind == "tail_truncate":
+        end = start + (end - start) // 2
+    new_rows = 0
+    if end > start:
+        buf = _read_range(path, start, end)
+        if buf:
+            if spec is not None and spec.kind == "tail_torn":
+                buf = buf[:-min(7, len(buf))]  # cut mid-record
+            consumed = whole_records(buf)
+            if consumed:
+                t0 = time.perf_counter()
+                try:
+                    df = parser(consumed.decode("utf-8",
+                                                errors="replace"),
+                                time_base)
+                except Exception as e:  # noqa: BLE001 — per-source degradation, like batch ingest
+                    print_warning(f"live: {source} chunk parse failed "
+                                  f"({e}) — the chunk stays unconsumed")
+                    df = None
+                if df is not None:
+                    cend = start + len(consumed)
+                    chunks.store(source, start, cend, df)
+                    entry["chunks"].append([start, cend, int(len(df))])
+                    entry["offset"] = cend
+                    entry["events"] = int(entry.get("events", 0)
+                                          + len(df))
+                    new_rows = len(df)
+                    out.dirty = True
+                    out.info["chunks_parsed"] += 1
+                    out.info["parse_wall_s"] = round(
+                        time.perf_counter() - t0, 6)
+            elif buf:
+                out.info["status"] = "torn"
+
+    # assemble the cumulative frame: committed chunks LOAD, never parse
+    parts: List[pd.DataFrame] = []
+    for s, e, _rows in entry["chunks"]:
+        df = chunks.load(source, s, e)
+        if df is None:
+            # unreadable/missing chunk: re-derive exactly that byte range
+            rbuf = _read_range(path, s, e)
+            if rbuf is None:
+                continue  # rotated away mid-assembly: drop the range
+            try:
+                df = parser(rbuf.decode("utf-8", errors="replace"),
+                            time_base)
+            except Exception as e2:  # noqa: BLE001 — per-source degradation
+                print_warning(f"live: {source} chunk re-derive failed "
+                              f"({e2})")
+                continue
+            chunks.store(source, s, e, df)
+            out.info["chunks_parsed"] += 1
+        else:
+            out.info["chunks_loaded"] += 1
+        if len(df):
+            parts.append(df)
+    # the freshly parsed chunk was stored AND reloaded above through the
+    # same table — no special-casing, and the replay path is the hot path
+    if len(entry["chunks"]) > CHUNK_COMPACT_COUNT and parts:
+        # compact: one merged chunk replaces the table (pure load+store,
+        # no reparse — the journal-compaction discipline)
+        merged = pd.concat(parts, ignore_index=True)
+        s0 = int(entry["chunks"][0][0])
+        e1 = int(entry["chunks"][-1][1])
+        if chunks.store(source, s0, e1, merged):
+            for s, e, _r in entry["chunks"]:
+                if not (s == s0 and e == e1):
+                    chunks.discard(source, s, e)
+            entry["chunks"] = [[s0, e1, int(len(merged))]]
+    frame = (pd.concat(parts, ignore_index=True) if parts
+             else empty_frame())
+    out.frame = _conform(frame)
+    out.info["events"] = int(len(out.frame))
+    out.info["offset"] = int(entry["offset"])
+    out.info["chunks"] = len(entry["chunks"])
+    out.info["lag_bytes"] = int(max(size - entry["offset"], 0)) \
+        if size >= 0 else 0
+    if out.info["status"] in ("idle",):
+        if new_rows:
+            out.info["status"] = "streaming"
+            watermark.update(source, max(size, 0), time.time())
+        else:
+            # an injected stall freezes the size the clock sees, so the
+            # stall window elapses deterministically even if the file
+            # keeps growing underneath
+            wm_size = int(entry["offset"]) if stalled_fault \
+                else max(size, 0)
+            grown = watermark.update(source, wm_size, time.time())
+            out.info["status"] = ("stalled" if grown == "stalled"
+                                  else "idle")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The epoch.
+# ---------------------------------------------------------------------------
+
+def _inject_previous_features(cfg: SofaConfig, features, selected) -> int:
+    """Seed ``features`` with the previous epoch's rows for every enabled
+    pass OUTSIDE the incremental window (its inputs are unchanged, so its
+    features are still true).  Rows whose name matches a SELECTED pass's
+    provides pattern are left out — the re-run recomputes them."""
+    from fnmatch import fnmatchcase
+
+    from sofa_tpu.analysis import registry
+
+    path = cfg.path("features.csv")
+    if not os.path.isfile(path):
+        return 0
+    try:
+        prev = pd.read_csv(path)
+    except Exception as e:  # noqa: BLE001 — a torn table seeds nothing
+        print_warning(f"live: cannot read previous features.csv ({e})")
+        return 0
+    specs = [s for s in registry.registered() if s.enabled(cfg)]
+    kept_pats = [p for s in specs if s.name not in selected
+                 for p in s.provides_features]
+    fresh_pats = [p for s in specs if s.name in selected
+                  for p in s.provides_features]
+    n = 0
+    for name, value in zip(prev.get("name", []), prev.get("value", [])):
+        name = str(name)
+        if any(fnmatchcase(name, p) for p in fresh_pats):
+            continue
+        if any(fnmatchcase(name, p) for p in kept_pats):
+            try:
+                features.add(name, float(value))
+                n += 1
+            except (TypeError, ValueError):
+                continue
+    return n
+
+
+def _write_frame_atomic(df: pd.DataFrame, base_path: str) -> None:
+    """Atomic CSV frame write: unlike batch preprocess (which streams
+    CSVs under the derived_write_guard sentinel), live epochs must leave
+    every artifact readable mid-epoch — the board serves the last
+    committed generation instead of 503ing."""
+    from sofa_tpu.durability import atomic_replace
+    from sofa_tpu.trace import write_csv
+
+    with atomic_replace(base_path + ".csv") as tmp:
+        write_csv(df, tmp)
+    try:  # a stale parquet from an earlier batch run must not shadow it
+        os.unlink(base_path + ".parquet")
+    except OSError:
+        pass
+
+
+def _run_epoch(cfg: SofaConfig, ledger: OffsetLedger) -> dict:
+    """One live tick.  Returns the ``meta.live`` document it recorded."""
+    from sofa_tpu import durability, telemetry
+    from sofa_tpu.analysis import advice, registry
+    from sofa_tpu.analysis.features import Features
+    from sofa_tpu.analyze import stage_board
+    from sofa_tpu.durability import atomic_write
+    from sofa_tpu.ingest.cache import (CACHE_DIR_NAME, IngestCache,
+                                       raw_files_present)
+    from sofa_tpu.preprocess import (_XPLANE_FRAMES, _ingest_tasks,
+                                     _run_ingest, assemble_frames,
+                                     build_series, read_misc,
+                                     read_time_base)
+    from sofa_tpu.supervisor import GrowthWatermark
+    from sofa_tpu.trace import reap_stale_sentinel
+
+    reap_stale_sentinel(cfg.logdir)
+    epoch = int(ledger.doc["epoch"]) + 1
+    first = ledger.doc["epoch"] == 0
+    tel = telemetry.begin("live")
+    journal = durability.Journal(cfg.logdir)
+    journal.begin("live", key=durability.logdir_raw_key(cfg.logdir),
+                  epoch=epoch)
+    try:
+        time_base = read_time_base(cfg)
+        cfg.time_base = time_base
+        if ledger.doc.get("time_base") is not None \
+                and ledger.doc["time_base"] != time_base:
+            print_warning("live: sofa_time.txt changed — committed chunks "
+                          "were parsed against the old time base; "
+                          "re-ingesting from byte 0")
+            chunks0 = IngestCache(cfg.path(CACHE_DIR_NAME),
+                                  enabled=cfg.ingest_cache).chunks()
+            for name in list(ledger.doc["sources"]):
+                chunks0.drop(name)
+                ledger.reset_source(name)
+        ledger.doc["time_base"] = time_base
+        jobs = pool.cfg_jobs(cfg)
+        tel.set_meta(pool={"jobs": jobs, "cpu_count": os.cpu_count() or 1})
+        offset = cfg.cpu_time_offset_ms / 1e3
+        tpu_off = cfg.tpu_time_offset_ms / 1e3
+        cache = IngestCache(cfg.path(CACHE_DIR_NAME),
+                            enabled=cfg.ingest_cache)
+        chunks = cache.chunks()
+        watermark = GrowthWatermark.from_doc(cfg.live_stall_s,
+                                             ledger.doc.get("growth"))
+
+        # --- tail the chunkable sources -------------------------------
+        dirty_frames: set = set()
+        live_sources: Dict[str, dict] = {}
+        tail_frames: Dict[str, pd.DataFrame] = {}
+        with tel.span("tail", cat="stage"):
+            for source, raw, parser in _tail_parsers(cfg):
+                o = _tail_source(cfg, ledger, chunks, source, raw,
+                                 parser, time_base, epoch, watermark)
+                df = o.frame
+                if offset and not df.empty:
+                    df = df.copy()
+                    df["timestamp"] = df["timestamp"] + offset
+                tail_frames[source] = df
+                live_sources[source] = o.info
+                if o.dirty:
+                    dirty_frames.add(source)
+                tel.source_event(
+                    source,
+                    status=("parsed" if o.info["chunks_parsed"]
+                            else ("cached" if o.info["events"]
+                                  else "empty")),
+                    cache=("miss" if o.info["chunks_parsed"] else
+                           ("hit" if o.info["chunks_loaded"]
+                            else "bypass" if not cache.enabled
+                            else "hit")),
+                    wall_s=o.info.get("parse_wall_s", 0.0),
+                    events=o.info["events"])
+        # `stalled` means wedged while SIBLINGS stream — when every tail
+        # is quiet the job is simply done/idle, not degraded
+        if not any(i["status"] == "streaming"
+                   for i in live_sources.values()):
+            for i in live_sources.values():
+                if i["status"] == "stalled":
+                    i["status"] = "idle"
+        ledger.doc["growth"] = watermark.to_doc()
+
+        # --- rescan the stateful remainder through the batch cache ----
+        rescan = [t.name for t in _ingest_tasks(cfg, time_base, jobs)
+                  if t.name not in TAILABLE_SOURCES]
+        with tel.span("ingest", cat="stage"):
+            tasks, results, cache = _run_ingest(cfg, time_base, jobs,
+                                                tel, only=set(rescan))
+        frames, tpu_meta = assemble_frames(tasks, results, offset,
+                                           tpu_off)
+        from sofa_tpu.ingest.cache import make_key
+
+        for t in tasks:
+            keyed = raw_files_present(make_key(t.name, t.raw_paths,
+                                               t.params))
+            if t.name not in cache.hits and (keyed or not cache.enabled):
+                dirty_frames.update(t.frame_names)
+        frames.update(tail_frames)
+        if first:
+            dirty_frames = set(frames)
+
+        # --- refresh derived artifacts (all writes atomic) ------------
+        meta_live: dict = {
+            "active": True, "epoch": epoch,
+            "updated_unix": round(time.time(), 3),
+            "interval_s": cfg.live_interval_s,
+            "sources": live_sources,
+        }
+        marks = [float(df["timestamp"].max())
+                 for name, df in tail_frames.items() if len(df)]
+        meta_live["watermark_s"] = round(min(marks), 6) if marks else None
+        ledger.doc["watermark_s"] = meta_live["watermark_s"]
+        if dirty_frames:
+            with tel.span("write_frames", cat="stage"):
+                to_write = sorted(n for n in dirty_frames
+                                  if n in frames and n != "cpuinfo")
+                pool.thread_map(
+                    lambda n: _write_frame_atomic(frames[n], cfg.path(n)),
+                    to_write, jobs)
+            series = build_series(cfg, frames)
+            tiles_manifest = None
+            tile_stats = {}
+            if cfg.enable_tiles:
+                from sofa_tpu import tiles
+
+                with tel.span("tiles", cat="stage"):
+                    try:
+                        tiles_manifest, tile_stats = tiles.build_tiles_live(
+                            cfg, series, jobs=jobs, tel=tel)
+                    except Exception as e:  # noqa: BLE001 — tiles are an enhancement, never fatal
+                        print_warning(f"live: tile refresh failed ({e}); "
+                                      "the board serves the overview only")
+            meta_live["tiles"] = {
+                "rebuilt": int(tile_stats.get("rebuilt", 0)),
+                "kept": int(tile_stats.get("kept", 0)),
+                "full_rebuilds": int(tile_stats.get("full_rebuilds", 0)),
+            }
+
+            # incremental analysis on the dirty window
+            registry.load_builtin_passes()
+            features = Features()
+            misc = read_misc(cfg)
+            features.add("elapsed_time",
+                         float(misc.get("elapsed_time", 0) or 0))
+            select = None
+            if not first:
+                select = registry.select_for_dirty(cfg, dirty_frames)
+                _inject_previous_features(cfg, features, select)
+            with tel.span("passes", cat="stage"):
+                pass_report, extra_series = registry.run_passes(
+                    frames, cfg, features, tel=tel, select=select)
+            tel.set_meta(passes=pass_report)
+            statuses = [e.get("status")
+                        for e in pass_report["passes"].values()]
+            meta_live["passes"] = {
+                "ran": statuses.count("ok") + statuses.count("failed"),
+                "skipped_clean": sum(
+                    1 for e in pass_report["passes"].values()
+                    if "unchanged" in str(e.get("skip_reason", ""))),
+            }
+            with atomic_write(cfg.path("features.csv")) as f:
+                features.to_frame().to_csv(f, index=False)
+
+            with tel.span("report_js", cat="stage"):
+                meta = {
+                    "elapsed_time": float(misc.get("elapsed_time", 0)
+                                          or 0),
+                    "time_base": time_base,
+                    "tpu_meta": tpu_meta,
+                    "logdir": cfg.logdir,
+                    "live": {"epoch": epoch, "active": True},
+                }
+                if tiles_manifest is not None:
+                    meta["tiles"] = tiles_manifest
+                from sofa_tpu.trace import series_to_report_js
+
+                series_to_report_js(series + list(extra_series),
+                                    cfg.path("report.js"),
+                                    cfg.viz_downsample_to, meta)
+            if tpu_meta:
+                with atomic_write(cfg.path("tpu_meta.json")) as f:
+                    json.dump(tpu_meta, f, indent=1)
+            with tel.span("hints", cat="stage"):
+                advice.hint_report(features, cfg)
+            if first:
+                stage_board(cfg)
+        else:
+            meta_live["tiles"] = {"rebuilt": 0, "kept": 0,
+                                  "full_rebuilds": 0}
+            meta_live["passes"] = {"ran": 0, "skipped_clean": 0}
+
+        meta_live["chunks_parsed"] = sum(
+            s.get("chunks_parsed", 0) for s in live_sources.values())
+        meta_live["chunks_loaded"] = sum(
+            s.get("chunks_loaded", 0) for s in live_sources.values())
+        tel.set_meta(live=meta_live, ingest_cache=cache.stats())
+        ledger.doc["epoch"] = epoch
+        ledger.commit()
+        tel.write(cfg.logdir, rc=0, cfg=cfg)
+        if dirty_frames:
+            with tel.span("digests", cat="stage"):
+                durability.write_digests(cfg.logdir)
+        journal.commit("live", key=durability.logdir_raw_key(cfg.logdir),
+                       epoch=epoch)
+        n_streaming = sum(1 for s in live_sources.values()
+                          if s["status"] == "streaming")
+        print_progress(
+            f"live epoch {epoch}: {n_streaming} source(s) streaming, "
+            f"{meta_live['chunks_parsed']} chunk(s) parsed, "
+            f"{meta_live['chunks_loaded']} loaded, tiles "
+            f"{meta_live['tiles']['rebuilt']} rebuilt / "
+            f"{meta_live['tiles']['kept']} kept, passes "
+            f"{meta_live['passes']['ran']} ran / "
+            f"{meta_live['passes']['skipped_clean']} clean")
+        return meta_live
+    finally:
+        telemetry.end(tel)
+
+
+# ---------------------------------------------------------------------------
+# The verb.
+# ---------------------------------------------------------------------------
+
+def _drain(cfg: SofaConfig) -> int:
+    """Converge the logdir to the exact batch output: a full
+    ``preprocess`` + ``analyze`` (live tile indexes carry no batch key,
+    so every pyramid rebuilds through the batch path), then mark
+    ``meta.live`` inactive."""
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.durability import _patch_manifest
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.telemetry import load_manifest
+
+    print_progress("live: draining — full batch preprocess+analyze for "
+                   "byte-identical convergence")
+    frames = sofa_preprocess(cfg)
+    sofa_analyze(cfg, frames=frames)
+    doc = load_manifest(cfg.logdir) or {}
+    live_meta = dict(((doc.get("meta") or {}).get("live")) or {})
+    if live_meta:
+        # mark the stream drained; a logdir with no live section (e.g.
+        # cleaned back to raw before the drain) has nothing to mark
+        live_meta["active"] = False
+        live_meta["drained"] = True
+        _patch_manifest(cfg.logdir, meta={"live": live_meta})
+    return 0
+
+
+def sofa_live(cfg: SofaConfig, epochs: "int | None" = None,
+              drain: bool = False) -> int:
+    """``sofa live <logdir> [--live_epochs N] [--drain]`` — the epoch
+    loop.  Exit 0 on a clean run/drain, 1 when the final epoch left a
+    stalled source (degraded, stated), 2 on a missing logdir (raised as
+    a usage error)."""
+    from sofa_tpu.printing import SofaUserError
+
+    if not os.path.isdir(cfg.logdir):
+        raise SofaUserError(
+            f"logdir {cfg.logdir} does not exist — point `sofa live` at "
+            "a recording (or a directory collectors are writing into)")
+    n = cfg.live_epochs if epochs is None else int(epochs)
+    if drain and n == 0:
+        # `sofa live <logdir> --drain` with no epoch budget is the
+        # after-the-job convergence verb: no loop, straight to batch.
+        return _drain(cfg)
+    faults.install_from(cfg)
+    last: dict = {}
+    try:
+        ledger = OffsetLedger.load(cfg.logdir)
+        i = 0
+        while n == 0 or i < n:
+            i += 1
+            last = _run_epoch(cfg, ledger)
+            if n == 0 or i < n:
+                time.sleep(max(cfg.live_interval_s, 0.0))
+    except KeyboardInterrupt:
+        print_progress("live: interrupted — the offset ledger holds the "
+                       "committed state; `sofa live` resumes from it")
+    finally:
+        faults.clear()
+    if drain:
+        return _drain(cfg)
+    stalled = sorted(name for name, s in (last.get("sources") or {}).items()
+                     if s.get("status") == "stalled")
+    if stalled:
+        print_warning("live: stalled source(s) at exit: "
+                      + ", ".join(stalled)
+                      + " — their series end early; the other sources "
+                      "kept streaming")
+        return 1
+    return 0

@@ -303,11 +303,17 @@ def _frame_rows(frames: Dict[str, pd.DataFrame]) -> int:
     return int(sum(len(df) for df in frames.values() if df is not None))
 
 
-def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None):
+def _run_ingest(cfg: SofaConfig, time_base: float, jobs: int, tel=None,
+                only=None):
     """Cache-or-parse every source -> (tasks, {name: (frames, meta, error)},
     cache).  ``tel`` (a telemetry.Telemetry) receives one ingest-stats event
-    per source: status, cache outcome, parse/load wall time, event count."""
+    per source: status, cache outcome, parse/load wall time, event count.
+    ``only`` restricts to a subset of source names — `sofa live` routes
+    its chunk-tailed sources elsewhere and runs just the rescan remainder
+    through this (content-keyed cached) path."""
     tasks = _ingest_tasks(cfg, time_base, jobs)
+    if only is not None:
+        tasks = [t for t in tasks if t.name in only]
     cache = IngestCache(cfg.path(CACHE_DIR_NAME), enabled=cfg.ingest_cache)
     keys = {t.name: make_key(t.name, t.raw_paths, t.params) for t in tasks}
     plan = faults.active()
@@ -425,6 +431,37 @@ def _quarantine_source(cfg: SofaConfig, name: str, err: CorruptRawError,
                   "is empty this run")
 
 
+def assemble_frames(tasks, results, offset: float = 0.0,
+                    tpu_off: float = 0.0) -> tuple:
+    """Ingest results -> (frames dict in declared task order, tpu_meta).
+
+    Applies the manual clock offsets AFTER cache/parse (so changing an
+    offset never invalidates a cache entry) and backfills the device
+    frames every downstream consumer expects.  Shared by the batch body
+    below and the `sofa live` epoch loop (sofa_tpu/live.py)."""
+    frames: Dict[str, pd.DataFrame] = {}
+    tpu_meta: Dict[str, Dict[str, float]] = {}
+    for t in tasks:
+        task_frames, meta, err = results[t.name]
+        if err is not None and not isinstance(err, CorruptRawError):
+            # quarantined sources already warned with the destination
+            print_warning(f"preprocess {t.name}: {err}")
+        shift = tpu_off if t.name == "xplane" else offset
+        for fname in t.frame_names:
+            df = task_frames.get(fname)
+            if df is None:
+                df = empty_frame()
+            if shift and not df.empty:
+                df["timestamp"] = df["timestamp"] + shift
+            frames[fname] = df
+        if meta:
+            tpu_meta = meta
+    for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
+                "tpusteps", "customtrace"):
+        frames.setdefault(key, empty_frame())
+    return frames, tpu_meta
+
+
 def sofa_preprocess(cfg: SofaConfig) -> Dict[str, pd.DataFrame]:
     from sofa_tpu import durability, telemetry
     from sofa_tpu.trace import reap_stale_sentinel
@@ -471,26 +508,7 @@ def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
 
     with tel.span("ingest", cat="stage"):
         tasks, results, cache = _run_ingest(cfg, time_base, jobs, tel)
-        frames: Dict[str, pd.DataFrame] = {}
-        tpu_meta: Dict[str, Dict[str, float]] = {}
-        for t in tasks:
-            task_frames, meta, err = results[t.name]
-            if err is not None and not isinstance(err, CorruptRawError):
-                # quarantined sources already warned with the destination
-                print_warning(f"preprocess {t.name}: {err}")
-            shift = tpu_off if t.name == "xplane" else offset
-            for fname in t.frame_names:
-                df = task_frames.get(fname)
-                if df is None:
-                    df = empty_frame()
-                if shift and not df.empty:
-                    df["timestamp"] = df["timestamp"] + shift
-                frames[fname] = df
-            if meta:
-                tpu_meta = meta
-        for key in ("tputrace", "tpumodules", "hosttrace", "tpuutil",
-                    "tpusteps", "customtrace"):
-            frames.setdefault(key, empty_frame())
+        frames, tpu_meta = assemble_frames(tasks, results, offset, tpu_off)
 
     # --- write frames -----------------------------------------------------
     # Everything below writes derived artifacts that are NOT individually

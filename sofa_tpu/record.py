@@ -946,6 +946,16 @@ def sofa_clean(cfg) -> None:
                     "archives, docs/FLEET.md) — left untouched; per-tenant "
                     "`sofa archive gc` is its only deletion path")
                 continue
+            if name == "perf.script" and not os.path.isfile(
+                    cfg.path("perf.data")):
+                # perf.script is registered derived because the cputrace
+                # ingest regenerates it from perf.data — but on a logdir
+                # holding only the pre-converted text (a capture copied
+                # off-host, or a harness without the perf binary) it IS
+                # the raw evidence: sweeping it would permanently lose
+                # the cputrace series on every later replay (the
+                # kill-mid-preprocess resume defect PR 12 flagged).
+                continue
             if name in DERIVED_FILES or (
                 name not in RAW_FILES and name.endswith(DERIVED_SUFFIXES)
             ):

@@ -302,3 +302,40 @@ class CollectorSupervisor:
                                      for st in self._state.values()),
                 "truncated": sorted(set(self._truncated)),
             }
+
+
+class GrowthWatermark:
+    """Per-key byte-growth tracker shared by the record-side watchdog
+    discipline above and the `sofa live` tailer (sofa_tpu/live.py):
+    ``update(key, nbytes, now)`` returns ``"grew"`` when the size moved,
+    ``"quiet"`` inside the stall window, and ``"stalled"`` once the key
+    has sat unchanged past ``stall_s`` — the one-time degradation signal
+    a wedged-but-alive source earns while its siblings keep streaming."""
+
+    def __init__(self, stall_s: float):
+        self.stall_s = max(float(stall_s), 0.0)
+        self._last: dict = {}
+
+    def update(self, key: str, nbytes: int, now: float) -> str:
+        size, since = self._last.get(key, (None, now))
+        if size != nbytes:
+            self._last[key] = (nbytes, now)
+            return "grew"
+        self._last[key] = (size, since)
+        if self.stall_s and now - since > self.stall_s:
+            return "stalled"
+        return "quiet"
+
+    def to_doc(self) -> dict:
+        """Ledger-serializable state (the live offset ledger persists it
+        so a restarted `sofa live` keeps the stall clocks)."""
+        return {k: [v[0], round(v[1], 3)] for k, v in self._last.items()}
+
+    @classmethod
+    def from_doc(cls, stall_s: float, doc) -> "GrowthWatermark":
+        wm = cls(stall_s)
+        if isinstance(doc, dict):
+            for k, v in doc.items():
+                if isinstance(v, list) and len(v) == 2:
+                    wm._last[k] = (v[0], float(v[1]))
+        return wm

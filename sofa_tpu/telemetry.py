@@ -119,7 +119,7 @@ _ENV_KEYS = ("SOFA_JOBS", "SOFA_LOG_LEVEL", "SOFA_PREPROCESS_POOL",
 # Self-trace thread lanes: one per pipeline verb so the viewer shows the
 # verbs as parallel tracks of the single "sofa" process.
 _SELF_TRACE_LANES = {"record": 1, "preprocess": 2, "analyze": 3,
-                     "archive": 5, "regress": 6, "agent": 7}
+                     "archive": 5, "regress": 6, "agent": 7, "live": 8}
 _OTHER_LANE = 4
 
 _WARNING_TAIL_MAX = 20
@@ -521,6 +521,14 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
                 out.append(f"analysis pass {name} failed ({why}) — its "
                            "features and artifacts are missing this run; "
                            "`sofa passes` shows its contract")
+    live_meta = (doc.get("meta") or {}).get("live")
+    if isinstance(live_meta, dict):
+        for name, ent in sorted((live_meta.get("sources") or {}).items()):
+            if isinstance(ent, dict) and ent.get("status") == "stalled":
+                out.append(f"live source {name} stalled — it stopped "
+                           "growing while the other sources kept "
+                           "streaming; its series end early (the stream "
+                           "degrades per-source, docs/LIVE.md)")
     agent_meta = (doc.get("meta") or {}).get("agent")
     if isinstance(agent_meta, dict):
         push = agent_meta.get("push")
@@ -633,16 +641,40 @@ def render_status(doc: dict, logdir: str) -> "tuple[List[str], int]":
         ledger = passes["passes"]
         n_failed = sum(1 for e in ledger.values()
                        if e.get("status") == "failed")
+        n_clean = sum(1 for e in ledger.values()
+                      if e.get("status") == "skipped"
+                      and "unchanged" in str(e.get("skip_reason", "")))
         n_skipped = sum(1 for e in ledger.values()
-                        if e.get("status") == "skipped")
+                        if e.get("status") == "skipped") - n_clean
         line = (f"  analysis passes: {len(ledger)} registered, "
-                f"{len(ledger) - n_failed - n_skipped} ok")
+                f"{len(ledger) - n_failed - n_skipped - n_clean} ok")
         if n_failed:
             line += f", {n_failed} FAILED"
             rc = 1
+        if n_clean:
+            line += f", {n_clean} clean (live incremental)"
         if n_skipped:
             line += f", {n_skipped} skipped (gated off)"
         line += " (`sofa passes` shows the DAG)"
+        lines.append(line)
+    live_meta = (doc.get("meta") or {}).get("live")
+    if isinstance(live_meta, dict):
+        srcs = live_meta.get("sources") or {}
+        n_stream = sum(1 for e in srcs.values()
+                       if isinstance(e, dict)
+                       and e.get("status") == "streaming")
+        n_stall = sum(1 for e in srcs.values()
+                      if isinstance(e, dict)
+                      and e.get("status") == "stalled")
+        line = (f"  live: epoch {live_meta.get('epoch')} "
+                f"{'active' if live_meta.get('active') else 'drained'}, "
+                f"{n_stream} source(s) streaming")
+        if n_stall:
+            line += f", {n_stall} STALLED"
+            rc = 1
+        wm = live_meta.get("watermark_s")
+        if isinstance(wm, (int, float)):
+            line += f", watermark {wm:.3f}s"
         lines.append(line)
     agent_meta = (doc.get("meta") or {}).get("agent")
     if isinstance(agent_meta, dict):

@@ -115,12 +115,17 @@ def _series_key(df: pd.DataFrame, ycol: str, params: dict) -> str:
     return h.hexdigest()
 
 
-def _levels_for(xs: np.ndarray, cap: int) -> int:
+def _levels_for(xs: np.ndarray, cap: int, x0: "float | None" = None,
+                width: "float | None" = None) -> int:
     """Smallest depth whose leaf tiles all hold <= TILE_RAW_MAX events
-    (xs sorted ascending), bounded by ``cap``."""
+    (xs sorted ascending), bounded by ``cap``.  ``x0``/``width`` pin the
+    pyramid domain (the live build's fixed horizon); default is the data
+    extent."""
     n = len(xs)
-    x0, x1 = float(xs[0]), float(xs[-1])
-    width = (x1 - x0) or 1e-9
+    if x0 is None:
+        x0 = float(xs[0])
+    if width is None:
+        width = (float(xs[-1]) - x0) or 1e-9
     level = 0
     while level < cap - 1:
         nt = 1 << level
@@ -191,11 +196,23 @@ X_SCALE, Y_SCALE, D_SCALE = 1e-7, 1e-6, 1e-9
 
 
 def _build_pyramid(sdir: str, xs, ys, ds, names: pd.Series,
-                   levels: int) -> dict:
-    """Write every tile of one series under ``sdir``; returns stats."""
+                   levels: int, x0: "float | None" = None,
+                   width: "float | None" = None,
+                   dirty_from: "float | None" = None,
+                   stats: "dict | None" = None) -> dict:
+    """Write every tile of one series under ``sdir``; returns stats.
+
+    ``x0``/``width`` pin the domain (live builds use a fixed power-of-two
+    horizon so the tile grid never shifts under appends); ``dirty_from``
+    is the incremental floor — occupied tiles whose window ends at or
+    before it are KEPT on disk untouched instead of rewritten (the
+    append-mostly contract), counted into ``stats['kept']`` vs
+    ``stats['wrote']``."""
     n = len(xs)
-    x0, x1 = float(xs[0]), float(xs[-1])
-    width = (x1 - x0) or 1e-9
+    if x0 is None:
+        x0 = float(xs[0])
+    if width is None:
+        width = (float(xs[-1]) - x0) or 1e-9
     # names intern ONCE per series: tiles (and report.js) ship a local
     # string table + small int codes — symbol/HLO-op names repeat heavily,
     # so this is most of the payload win over per-point strings
@@ -206,6 +223,8 @@ def _build_pyramid(sdir: str, xs, ys, ds, names: pd.Series,
     di = np.round(ds / D_SCALE).astype(np.int64)
     n_tiles = 0
     n_bytes = 0
+    kept = 0
+    total_wrote = 0
     per_level: List[int] = []
     for level in range(levels):
         nt = 1 << level
@@ -220,12 +239,24 @@ def _build_pyramid(sdir: str, xs, ys, ds, names: pd.Series,
         if not leaf and counts.max() > TILE_RAW_MAX:
             env = _level_envelope(xs, ys, x0, width, nt)
         wrote = 0
+        occupied = 0
         for i in range(nt):
             a, b = int(bounds[i]), int(bounds[i + 1])
             if a == b:
                 continue  # sparse pyramid: empty windows get no file
+            occupied += 1
             tx0 = x0 + width * i / nt
             tw = width / nt
+            if dirty_from is not None and tx0 + tw <= dirty_from:
+                # clean tile: every event in its window was already
+                # committed by an earlier epoch — keep the file as is
+                kept += 1
+                try:
+                    n_bytes += os.path.getsize(
+                        os.path.join(ldir, f"{i}.json.gz"))
+                except OSError:
+                    pass
+                continue
             exact = leaf or (b - a) <= TILE_RAW_MAX
             doc = {
                 "level": level, "n": i,
@@ -260,9 +291,14 @@ def _build_pyramid(sdir: str, xs, ys, ds, names: pd.Series,
             n_bytes += _write_tile(
                 os.path.join(ldir, f"{i}.json.gz"), doc)
             wrote += 1
-        per_level.append(wrote)
-        n_tiles += wrote
-    return {"levels": levels, "x0": round(x0, 9), "x1": round(x1, 9),
+        per_level.append(occupied)
+        n_tiles += occupied
+        total_wrote += wrote
+    if stats is not None:
+        stats["wrote"] = total_wrote
+        stats["kept"] = kept
+    return {"levels": levels, "x0": round(x0, 9),
+            "x1": round(x0 + width, 9),
             "count": int(n), "tiles": per_level,
             "tile_count": n_tiles, "bytes": n_bytes}
 
@@ -449,3 +485,167 @@ def read_tile(logdir: str, series_path: str, level: int,
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+# ---------------------------------------------------------------------------
+# Live incremental builds (`sofa live`, sofa_tpu/live.py).
+#
+# The batch build above is content-keyed at SERIES granularity: any data
+# change rebuilds the whole pyramid.  A live epoch appends a few thousand
+# events to multi-hundred-thousand-event series, so the live build pins
+# the tile grid to a fixed power-of-two horizon anchored at the series'
+# first event — appends land in the grid's right-hand windows, leaves are
+# append-mostly, and only the tiles whose window intersects the dirty
+# suffix rebuild.  The per-series live index (same tile_index.json file,
+# a ``live`` section instead of the batch ``key``) records the domain,
+# depth, committed row count, and a sha over the committed prefix: a
+# mid-series change (a rescan source rewriting history) fails the prefix
+# check and falls back to a full rebuild — never a silently wrong tile.
+# A later batch build (`sofa live --drain`, or plain preprocess) sees no
+# ``key`` and rebuilds from scratch, converging byte-identically to a
+# never-interrupted batch run.
+# ---------------------------------------------------------------------------
+
+#: The live horizon is the smallest power-of-two multiple of this many
+#: seconds that covers PAD x the observed span — appends rarely outgrow
+#: it, and outgrowing it just re-anchors (one full rebuild, amortized
+#: O(log n) over a run's life).
+LIVE_HORIZON_BASE_S = 1.0
+LIVE_HORIZON_PAD = 2.0
+
+
+def _live_horizon(span: float) -> float:
+    width = LIVE_HORIZON_BASE_S
+    target = max(span, 1e-3) * LIVE_HORIZON_PAD
+    while width < target:
+        width *= 2.0
+    return width
+
+
+def _prefix_sha(xs, ys, ds, names: pd.Series, rows: int) -> str:
+    """sha1 over the first ``rows`` sorted events — the committed-prefix
+    identity the incremental path trusts before keeping old tiles."""
+    h = hashlib.sha1()
+    for a in (xs, ys, ds):
+        h.update(np.ascontiguousarray(a[:rows]).tobytes())
+    h.update(pd.util.hash_pandas_object(names.iloc[:rows], index=False)
+             .to_numpy().tobytes())
+    return h.hexdigest()
+
+
+def build_tiles_live(cfg, series, jobs: "int | None" = None,
+                     tel=None) -> "tuple[dict, dict]":
+    """Incremental pyramid refresh for a live epoch.
+
+    Returns ``(manifest, stats)`` — the same meta.tiles manifest shape as
+    :func:`build_tiles` plus a stats dict proving the dirty-tile-only
+    contract: ``rebuilt`` (tiles written this epoch), ``kept`` (occupied
+    tiles left untouched), ``unchanged_series`` (skipped wholesale) and
+    ``full_rebuilds`` (re-anchor / prefix-mismatch / depth growth)."""
+    from sofa_tpu import pool
+    from sofa_tpu.durability import atomic_write
+    from sofa_tpu.printing import print_warning
+
+    jobs = jobs if jobs else pool.cfg_jobs(cfg)
+    levels_flag = int(getattr(cfg, "tile_levels", 0) or 0)
+    cap = levels_flag if levels_flag > 0 else MAX_LEVELS
+    params = _tile_params(cap)
+    root = cfg.path(TILES_DIR_NAME)
+    overview_max = int(getattr(cfg, "viz_downsample_to", 10000))
+    work = [s for s in series if len(s.data) > overview_max]
+
+    def build_one(s) -> "tuple | None":
+        try:
+            dname = series_dir_name(s.name)
+            sdir = os.path.join(root, dname)
+            index_path = os.path.join(sdir, TILE_INDEX_NAME)
+            try:
+                with open(index_path) as f:
+                    index = json.load(f)
+            except (OSError, ValueError):
+                index = None
+            live = (index or {}).get("live") \
+                if isinstance(index, dict) else None
+            xs, ys, ds, names = _series_arrays(s)
+            n = len(xs)
+            mode = "full"
+            dx0, dwidth, levels = float(xs[0]), None, None
+            if isinstance(live, dict) and live.get("params") == params:
+                dx0 = float(live["x0"])
+                dwidth = float(live["width"])
+                levels = int(live["levels"])
+                rows = int(live.get("rows", 0))
+                if 0 < rows <= n and float(xs[0]) >= dx0 \
+                        and float(xs[-1]) < dx0 + dwidth \
+                        and _prefix_sha(xs, ys, ds, names, rows) \
+                        == live.get("prefix_sha"):
+                    if rows == n:
+                        mode = "unchanged"
+                    elif _levels_for(xs, cap, dx0, dwidth) <= levels:
+                        mode = "append"
+                        dirty_from = float(xs[rows])
+                    # deeper pyramid needed: fall through to a full
+                    # rebuild at the new depth (counts as re-anchor)
+            if mode == "unchanged":
+                entry = dict((index.get("entry") or {}))
+                entry["path"] = dname
+                return s.name, entry, {"kept": entry.get("tile_count", 0),
+                                       "wrote": 0, "unchanged": True}
+            if mode == "full":
+                dx0 = float(xs[0])
+                dwidth = _live_horizon(float(xs[-1]) - dx0)
+                levels = _levels_for(xs, cap, dx0, dwidth)
+                dirty_from = None
+                if os.path.isdir(sdir):
+                    shutil.rmtree(sdir, ignore_errors=True)
+            os.makedirs(sdir, exist_ok=True)
+            stats: dict = {}
+            entry = _build_pyramid(sdir, xs, ys, ds, names, levels,
+                                   x0=dx0, width=dwidth,
+                                   dirty_from=dirty_from, stats=stats)
+            live_doc = {
+                "x0": dx0, "width": dwidth, "levels": levels,
+                "rows": n,
+                "prefix_sha": _prefix_sha(xs, ys, ds, names, n),
+                "params": params,
+            }
+            # The index is the pyramid's commit point, exactly like the
+            # batch build: fsync'd, written LAST.  No batch ``key`` on
+            # purpose — a later batch build must rebuild from scratch.
+            with atomic_write(index_path, fsync=True) as f:
+                json.dump({"live": live_doc, "entry": entry}, f)
+            entry = dict(entry)
+            entry["path"] = dname
+            stats["full"] = mode == "full"
+            return s.name, entry, stats
+        except Exception as e:  # noqa: BLE001 — per-series degradation
+            print_warning(f"tiles: cannot live-build pyramid for "
+                          f"{s.name}: {e}")
+            return None
+
+    built = [r for r in pool.thread_map(build_one, work, jobs)
+             if r is not None]
+    manifest: Dict[str, object] = {
+        "dir": TILES_DIR_NAME,
+        "version": TILES_VERSION,
+        "raw_max": TILE_RAW_MAX,
+        "series": {name: entry for name, entry, _st in built},
+    }
+    stats = {
+        "series": len(built),
+        "rebuilt": sum(st.get("wrote", 0) for _n, _e, st in built),
+        "kept": sum(st.get("kept", 0) for _n, _e, st in built),
+        "unchanged_series": sum(1 for _n, _e, st in built
+                                if st.get("unchanged")),
+        "full_rebuilds": sum(1 for _n, _e, st in built if st.get("full")),
+    }
+    if tel is not None:
+        tel.set_meta(tiles={
+            "series": len(built),
+            "cached": stats["unchanged_series"],
+            "tile_count": int(sum(e.get("tile_count", 0)
+                                  for _n, e, _s in built)),
+            "bytes": int(sum(e.get("bytes", 0) for _n, e, _s in built)),
+            "levels_cap": cap,
+        })
+    return manifest, stats

@@ -547,6 +547,9 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # durability layer (sofa_tpu/durability.py): crash journal
                  # + sha256 integrity ledger sidecar
                  "_journal.jsonl", "_digests.json",
+                 # `sofa live` per-source offset ledger (sofa_tpu/live.py):
+                 # fsync'd commit point of the streaming ingest
+                 "_live_offsets.json",
                  # container-id breadcrumb docker publishes for record's
                  # process scoping — scratch, not evidence
                  "docker.cid",
@@ -577,6 +580,9 @@ DIGEST_SKIP_FILES = frozenset({
     # rewritten at will by `sofa agent` (archive/spool.py) without a
     # digest refresh; lives in archive-marked roots the walk skips anyway
     "agent_state.json",
+    # rewritten every `sofa live` epoch (it IS the epoch's commit
+    # point); digesting it would turn each tick into fsck damage
+    "_live_offsets.json",
 })
 DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",

@@ -17,6 +17,10 @@ is a production data server for the board's O(pixels) contract:
   * 503 + Retry-After while a pipeline verb is mid-write on the logdir
     (trace.derived_write_guard's sentinel): a board refresh racing
     `sofa preprocess` gets an honest retry signal, never torn JSON.
+    `sofa live` epochs never raise that sentinel — every live write is
+    tmp+rename atomic, so mid-epoch reads serve the last committed
+    generation instead of 503ing for the whole run (docs/LIVE.md), and
+    the board polls ``meta.live`` to grow the timeline as epochs land.
 
 The ``/archive/`` route here is the READ half of the fleet archive; its
 write-capable sibling is `sofa serve` (sofa_tpu/archive/service.py),
@@ -284,6 +288,15 @@ def sofa_viz(cfg, serve_forever: bool = True):
             "identical tiles compare by hash, no payload fetched). "
             "This route is read-only; `sofa serve` runs the write-capable "
             "fleet ingest service over an archive root (docs/FLEET.md)")
+    from sofa_tpu.live import OFFSETS_NAME
+
+    if os.path.isfile(os.path.join(cfg.logdir, OFFSETS_NAME)):
+        print_progress(
+            "live stream: this logdir is (or was) fed by `sofa live` — "
+            "every live write is atomic, so data requests serve the last "
+            "committed epoch mid-write (no 503), and the board polls "
+            "meta.live to grow the timeline while the job runs "
+            "(docs/LIVE.md)")
     if os.path.isfile(os.path.join(cfg.logdir, SELF_TRACE_NAME)):
         print_progress(
             f"self-telemetry: /{SELF_TRACE_NAME} (Chrome-trace of sofa's "

@@ -95,6 +95,31 @@ from sofa_tpu.preprocess import sofa_preprocess
 sofa_preprocess(SofaConfig(logdir=logdir))
 """
 
+# Kill-mid-live-epoch: SIGKILL `sofa live` inside an epoch's tile
+# refresh — with a torn-tail fault injected on the same tick — then
+# prove `sofa resume` + `sofa live --drain` converge to artifacts
+# byte-identical to an uninterrupted batch run over the final logdir
+# (sofa_tpu/live.py's acceptance contract).
+_LIVE_KILL_SNIPPET = """
+import os, signal, sys
+sys.path.insert(0, sys.argv[3])
+logdir, n = sys.argv[1], int(sys.argv[2])
+from sofa_tpu import tiles
+count = [0]
+orig = tiles._write_tile
+def hook(*a, **kw):
+    count[0] += 1
+    if count[0] >= n:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return orig(*a, **kw)
+tiles._write_tile = hook
+from sofa_tpu.config import SofaConfig
+from sofa_tpu.live import sofa_live
+cfg = SofaConfig(logdir=logdir, live_interval_s=0.0,
+                 inject_faults=os.environ.get("CHAOS_LIVE_FAULTS", ""))
+sofa_live(cfg, epochs=1)
+"""
+
 # Fleet cells (sofa_tpu/archive/service.py + sofa_tpu/agent.py): the
 # service child binds an ephemeral port and prints its URL; the parent
 # parses it.  SOFA_SERVE_EXIT_AFTER makes the child hard-exit at the n-th
@@ -418,6 +443,161 @@ def _run_whatif_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _split_tail(path: str, fraction: float = 0.5) -> bytes:
+    """Truncate a line-oriented raw file to its first ``fraction`` of
+    lines (a mid-recording snapshot); returns the removed tail bytes so
+    the caller can append them later, byte-identically."""
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.splitlines(keepends=True)
+    keep = len(lines) // 2 if fraction == 0.5 else int(len(lines) * fraction)
+    with open(path, "wb") as f:
+        f.write(b"".join(lines[:keep]))
+    return b"".join(lines[keep:])
+
+
+def _live_control(logdir: str) -> dict:
+    """Batch preprocess+analyze over the CURRENT raw state -> the
+    byte-identity targets, then `sofa clean` back to raw-only."""
+    from sofa_tpu.analyze import sofa_analyze
+    from sofa_tpu.record import sofa_clean
+
+    cfg = SofaConfig(logdir=logdir)
+    sofa_analyze(cfg, frames=sofa_preprocess(cfg))
+    want = {}
+    for rel in ("report.js", "features.csv"):
+        with open(cfg.path(rel), "rb") as f:
+            want[rel] = f.read()
+    sofa_clean(cfg)
+    return want
+
+
+def _live_converged_problems(logdir: str, want: dict, mc) -> List[str]:
+    """Drain the live logdir and assert byte-identity + health."""
+    from sofa_tpu.durability import sofa_fsck
+    from sofa_tpu.live import sofa_live
+
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    rc = sofa_live(cfg, epochs=0, drain=True)
+    if rc != 0:
+        problems.append(f"sofa live --drain rc={rc}")
+    for rel, want_bytes in want.items():
+        try:
+            with open(cfg.path(rel), "rb") as f:
+                got = f.read()
+            if got != want_bytes:
+                problems.append(
+                    f"{rel} after drain differs from the batch control "
+                    f"({len(got)} vs {len(want_bytes)} bytes)")
+        except OSError as e:
+            problems.append(f"no {rel} after drain: {e}")
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        problems.append("no run_manifest.json after drain")
+    else:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+        live_meta = (doc.get("meta") or {}).get("live")
+        if live_meta is not None and live_meta.get("active") is not False:
+            # absent is fine too (a drain over a cleaned logdir has no
+            # live state left to mark)
+            problems.append("meta.live.active not cleared by the drain")
+    if sofa_fsck(cfg) != 0:
+        problems.append("sofa fsck nonzero on the drained logdir")
+    return problems
+
+
+def _run_live_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """kill-mid-live-epoch: live epoch over half the tail, append the
+    rest, SIGKILL the second epoch mid-tile-write with a torn-tail fault
+    active, `sofa resume` the interrupted epoch, then drain — artifacts
+    must converge byte-identical to a never-interrupted batch run."""
+    import random
+
+    from sofa_tpu.durability import sofa_resume
+    from sofa_tpu.live import sofa_live
+
+    logdir = os.path.join(workdir, "kill-mid-live-epoch") + "/"
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    want = _live_control(logdir)
+
+    cfg = SofaConfig(logdir=logdir, live_interval_s=0.0)
+    tail = _split_tail(cfg.path("tpumon.txt"))
+    rc = sofa_live(cfg, epochs=1)
+    if rc != 0:
+        problems.append(f"live epoch 1 rc={rc}")
+    with open(cfg.path("tpumon.txt"), "ab") as f:
+        f.write(tail)
+
+    n = random.randint(1, 4)
+    root = os.path.dirname(_TOOLS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHAOS_LIVE_FAULTS="tpumon:tail_torn@2")
+    r = subprocess.run(
+        [sys.executable, "-c", _LIVE_KILL_SNIPPET, logdir, str(n), root],
+        capture_output=True, text=True, timeout=600, env=env)
+    if r.returncode != -9:
+        return problems + [f"crash child exited rc={r.returncode} "
+                           f"(expected SIGKILL -9 after tile #{n}); "
+                           f"stderr tail: {r.stderr.strip()[-200:]}"]
+    rc = sofa_resume(cfg)
+    if rc != 0:
+        problems.append(f"sofa resume rc={rc}")
+    return problems + _live_converged_problems(logdir, want, mc)
+
+
+def _run_live_rotate_cell(workdir: str, synth: str, mc) -> List[str]:
+    """source-rotate-mid-tail: after a live epoch committed offsets into
+    tpumon.txt, the file is rotated (new stream from byte 0).  The next
+    epoch must detect it (`rotated` in meta.live), drop the stale
+    chunks, re-ingest from zero, and still drain byte-identical to a
+    batch run over the rotated state."""
+    from sofa_tpu.live import OFFSETS_NAME, sofa_live
+
+    logdir = os.path.join(workdir, "source-rotate-mid-tail") + "/"
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    cfg = SofaConfig(logdir=logdir, live_interval_s=0.0)
+
+    # rotation target: drop the first 60% of samples, as a restarting
+    # collector would; the control is batch over this FINAL state
+    with open(cfg.path("tpumon.txt"), "rb") as f:
+        rotated_to = b"".join(f.read().splitlines(keepends=True)[6000:])
+    import json as _json
+
+    rc = sofa_live(cfg, epochs=1)  # commits offsets over the full file
+    if rc != 0:
+        problems.append(f"live epoch 1 rc={rc}")
+    with open(cfg.path("tpumon.txt"), "wb") as f:
+        f.write(rotated_to)
+    rc = sofa_live(cfg, epochs=1)
+    if rc != 0:
+        problems.append(f"live epoch 2 rc={rc} after rotation")
+    doc = telemetry.load_manifest(logdir) or {}
+    src = (((doc.get("meta") or {}).get("live") or {})
+           .get("sources") or {}).get("tpumon") or {}
+    if src.get("status") != "rotated":
+        problems.append(f"tpumon status {src.get('status')!r} after "
+                        "rotation (expected 'rotated')")
+    try:
+        with open(cfg.path(OFFSETS_NAME)) as f:
+            led = _json.load(f)
+        if led["sources"]["tpumon"]["offset"] != len(rotated_to):
+            problems.append("offset ledger did not re-ingest the rotated "
+                            "file from byte 0")
+    except (OSError, ValueError, KeyError) as e:
+        problems.append(f"unreadable offset ledger: {e}")
+    problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+
+    # control AFTER the live run (same logdir discipline as the kill
+    # cells): batch over the rotated state, clean, compare via drain
+    want = _live_control(logdir)
+    return problems + _live_converged_problems(logdir, want, mc)
+
+
 def _start_service(workdir: str, store_root: str,
                    env_extra: "dict | None" = None):
     """Launch a fleet-service child on an ephemeral port; returns
@@ -610,12 +790,14 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 5
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 7
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
                    ("kill-service-mid-upload", None),
-                   ("agent-offline-spool-then-drain", None)])
+                   ("agent-offline-spool-then-drain", None),
+                   ("kill-mid-live-epoch", None),
+                   ("source-rotate-mid-tail", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -679,6 +861,18 @@ def main(argv=None) -> int:
         failures += bool(problems)
         print(f"{name.ljust(width)}  {status}  (sofa serve + sofa agent, "
               "sofa_tpu/archive/service.py)")
+        for p in problems:
+            print(f"{' ' * width}    - {p}")
+    for name, cell in (("kill-mid-live-epoch", _run_live_kill_cell),
+                       ("source-rotate-mid-tail", _run_live_rotate_cell)):
+        try:
+            problems = cell(workdir, synth, mc)
+        except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+            problems = ["crashed:\n" + traceback.format_exc()]
+        status = "PASS" if not problems else "FAIL"
+        failures += bool(problems)
+        print(f"{name.ljust(width)}  {status}  (sofa live streaming "
+              "epochs, sofa_tpu/live.py)")
         for p in problems:
             print(f"{' ' * width}    - {p}")
     print(f"chaos matrix: {n_cells - failures}/{n_cells} cells "

@@ -31,7 +31,7 @@ from sofa_tpu.telemetry import (  # noqa: E402
 )
 
 _KNOWN_VERBS = ("record", "preprocess", "analyze", "archive", "regress",
-                "whatif", "agent")
+                "whatif", "agent", "live")
 _VERDICTS = ("regressed", "improved", "noise")
 # Version pins per schema id: sofa-lint SL018 verifies these literals
 # agree with the writers' *_VERSION constants and the schema registry
@@ -45,6 +45,16 @@ _INVENTORY_VERSION = 1
 _WHATIF_CALIBRATION = ("calibrated", "uncalibrated")
 _WHATIF_SCENARIO_STATUSES = ("parsed", "unknown")
 _WHATIF_ATTRIBUTION_STATUSES = ("applied", "no_match", "unknown")
+# `sofa live` per-source statuses (sofa_tpu/live.py LIVE_SOURCE_STATUSES;
+# keep the vocabularies in sync) + the watermark staleness gate.
+_LIVE_SOURCE_STATUSES = ("streaming", "idle", "stalled", "rotated",
+                        "torn", "absent")
+_LIVE_STALE_S = 600.0
+# The live offset ledger beside the manifest (sofa_tpu/live.py writes
+# it fsync'd every epoch; checking a logdir validates it too).
+_LIVE_OFFSETS_NAME = "_live_offsets.json"
+_LIVE_OFFSETS_SCHEMA = "sofa_tpu/live_offsets"
+_LIVE_OFFSETS_VERSION = 1
 
 
 def _is_num(v) -> bool:
@@ -383,6 +393,62 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 probs.append("meta.serve.committed_unix: missing or not "
                              "a number")
 
+    # meta.live (written every `sofa live` epoch, sofa_tpu/live.py): the
+    # streaming-freshness manifest the board polls — epoch seq,
+    # per-source offsets/lag/status, watermark, no-reparse counters.
+    live = (doc.get("meta") or {}).get("live")
+    if live is not None:
+        if not isinstance(live, dict):
+            probs.append("meta.live: not an object")
+            live = None
+        else:
+            if not isinstance(live.get("active"), bool):
+                probs.append("meta.live.active: missing or not a bool")
+            ep = live.get("epoch")
+            if not isinstance(ep, int) or isinstance(ep, bool) or ep < 1:
+                probs.append("meta.live.epoch: missing or not a "
+                             "positive int")
+            if not _is_num(live.get("updated_unix")):
+                probs.append("meta.live.updated_unix: missing or not a "
+                             "number")
+            wm = live.get("watermark_s")
+            if wm is not None and not _is_num(wm):
+                probs.append("meta.live.watermark_s: not a number or "
+                             "null")
+            for key in ("chunks_parsed", "chunks_loaded"):
+                v = live.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.live.{key}: missing or not a "
+                                 "non-negative int")
+            lsources = live.get("sources")
+            if not isinstance(lsources, dict):
+                probs.append("meta.live.sources: missing per-source map")
+                lsources = {}
+            for name, ent in sorted(lsources.items()):
+                where = f"meta.live.sources.{name}"
+                if not isinstance(ent, dict):
+                    probs.append(f"{where}: not an object")
+                    continue
+                if ent.get("status") not in _LIVE_SOURCE_STATUSES:
+                    probs.append(f"{where}.status: {ent.get('status')!r} "
+                                 f"not in {_LIVE_SOURCE_STATUSES}")
+                for key in ("offset", "lag_bytes", "chunks",
+                            "chunks_parsed", "chunks_loaded", "events"):
+                    v = ent.get(key)
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or v < 0:
+                        probs.append(f"{where}.{key}: missing or not a "
+                                     "non-negative int")
+            ltiles = live.get("tiles")
+            if ltiles is not None and (
+                    not isinstance(ltiles, dict) or any(
+                        not isinstance(ltiles.get(k), int)
+                        or isinstance(ltiles.get(k), bool)
+                        or ltiles.get(k) < 0
+                        for k in ("rebuilt", "kept", "full_rebuilds"))):
+                probs.append("meta.live.tiles: needs non-negative "
+                             "rebuilt/kept/full_rebuilds ints")
+
     regress = (doc.get("meta") or {}).get("regress")
     if regress is not None:
         if not isinstance(regress, dict) or \
@@ -438,6 +504,23 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             probs.append("unhealthy: the what-if identity gate is "
                          "uncalibrated — the replay model does not "
                          "reproduce this run's measured step times")
+        if isinstance(live, dict):
+            for name, ent in sorted((live.get("sources") or {}).items()):
+                if isinstance(ent, dict) and \
+                        ent.get("status") == "stalled":
+                    probs.append(f"unhealthy: live source {name} stalled "
+                                 "— it stopped growing while siblings "
+                                 "kept streaming")
+            import time as _time
+
+            upd = live.get("updated_unix")
+            if live.get("active") and _is_num(upd) and \
+                    _time.time() - upd > _LIVE_STALE_S:
+                probs.append("unhealthy: meta.live says the stream is "
+                             "active but its watermark is stale "
+                             f"(last epoch {_time.time() - upd:.0f}s ago "
+                             f"> {_LIVE_STALE_S:.0f}s) — the live loop "
+                             "died without draining")
         for verb, run in runs.items():
             if isinstance(run, dict) and (run.get("counters") or {}).get(
                     "errors"):
@@ -633,12 +716,79 @@ def validate_inventory(doc, require_healthy: bool = False) -> List[str]:
     return probs
 
 
+def validate_live_offsets(doc) -> List[str]:
+    """Schema problems in a ``_live_offsets.json`` ledger
+    (sofa_tpu/live.py OffsetLedger) — the fsync'd commit point of every
+    `sofa live` epoch."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["offset ledger is not a JSON object"]
+    if doc.get("schema") != _LIVE_OFFSETS_SCHEMA:
+        probs.append(f"schema: expected {_LIVE_OFFSETS_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _LIVE_OFFSETS_VERSION:
+        probs.append(f"version: expected {_LIVE_OFFSETS_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    ep = doc.get("epoch")
+    if not isinstance(ep, int) or isinstance(ep, bool) or ep < 0:
+        probs.append("epoch: missing or not a non-negative int")
+    sources = doc.get("sources")
+    if not isinstance(sources, dict):
+        probs.append("sources: missing per-source map")
+        sources = {}
+    for name, ent in sorted(sources.items()):
+        where = f"sources.{name}"
+        if not isinstance(ent, dict):
+            probs.append(f"{where}: not an object")
+            continue
+        off = ent.get("offset")
+        if not isinstance(off, int) or isinstance(off, bool) or off < 0:
+            probs.append(f"{where}.offset: missing or not a "
+                         "non-negative int")
+        chunks = ent.get("chunks")
+        if not isinstance(chunks, list) or any(
+                not (isinstance(c, list) and len(c) == 3
+                     and all(isinstance(v, int) for v in c))
+                for c in chunks):
+            probs.append(f"{where}.chunks: not a list of "
+                         "[start, end, rows] triples")
+            continue
+        prev_end = None
+        for c in chunks:
+            if c[0] >= c[1]:
+                probs.append(f"{where}.chunks: empty/inverted range {c}")
+            if prev_end is not None and c[0] != prev_end:
+                probs.append(f"{where}.chunks: gap/overlap at {c} "
+                             f"(previous chunk ended at {prev_end})")
+            prev_end = c[1]
+        if chunks and isinstance(off, int) and chunks[-1][1] != off:
+            probs.append(f"{where}: offset {off} disagrees with the "
+                         f"last chunk end {chunks[-1][1]}")
+    return probs
+
+
+def _check_live_offsets(logdir: str) -> List[str]:
+    path = os.path.join(logdir, _LIVE_OFFSETS_NAME)
+    if not os.path.isfile(path):
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{_LIVE_OFFSETS_NAME}: unreadable ({e})"]
+    return [f"{_LIVE_OFFSETS_NAME}: {p}"
+            for p in validate_live_offsets(doc)]
+
+
 def check_path(path: str, require_healthy: bool = False) -> int:
     """0 valid / 1 invalid / 2 missing; problems go to stderr.  A path
     that is (or holds only) a ``regress_verdict.json`` /
     ``whatif_report.json``, or whose document carries one of their
-    schemas, is validated as that document instead."""
+    schemas, is validated as that document instead.  A logdir whose
+    `sofa live` offset ledger is present gets that validated too."""
+    live_probs: List[str] = []
     if os.path.isdir(path):
+        live_probs = _check_live_offsets(path)
         mpath = os.path.join(path, MANIFEST_NAME)
         if not os.path.isfile(mpath):
             for alt in ("regress_verdict.json", "whatif_report.json"):
@@ -680,7 +830,8 @@ def check_path(path: str, require_healthy: bool = False) -> int:
             print(f"manifest_check: OK ({path}; verdict: "
                   f"{doc.get('verdict')})")
         return 1 if probs else 0
-    probs = validate_manifest(doc, require_healthy=require_healthy)
+    probs = validate_manifest(doc, require_healthy=require_healthy) \
+        + live_probs
     for p in probs:
         print(f"manifest_check: {p}", file=sys.stderr)
     if not probs:
