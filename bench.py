@@ -382,13 +382,19 @@ except Exception as e:
 # analyze-path evidence (sofa_tpu/analysis/registry.py): wall time of the
 # full registry-scheduled pass run over the preprocessed logdir, plus the
 # meta.passes ledger's health counts — a failed pass is visible in the
-# bench trajectory even when the timing looks fine.
+# bench trajectory even when the timing looks fine.  analyze_peak_rss_mb
+# rides the same run: this subprocess's high-water RSS right after the
+# projection-pushdown analyze (sofa_tpu/frames.py) — the out-of-core
+# memory bound's trajectory number.
 try:
     from sofa_tpu.analyze import sofa_analyze
     from sofa_tpu.telemetry import load_manifest
     t0 = time.perf_counter()
     sofa_analyze(cfg)
     out["analyze_wall_time_s"] = round(time.perf_counter() - t0, 3)
+    import resource
+    out["analyze_peak_rss_mb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
     doc = load_manifest(cfg.logdir) or {{}}
     ledger = ((doc.get("meta") or {{}}).get("passes") or {{}}).get(
         "passes") or {{}}
@@ -397,6 +403,17 @@ try:
         1 for e in ledger.values() if e.get("status") == "failed")
 except Exception as e:
     out["analyze_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# frame-store evidence (sofa_tpu/frames.py): full deserialization wall
+# time of every frame through the interchange format this build defaults
+# to (the chunked columnar store), the number tools/frame_bench.py
+# breaks down against the CSV path and a projected load.
+try:
+    from sofa_tpu.analyze import load_frames
+    t0 = time.perf_counter()
+    load_frames(cfg)
+    out["frame_load_wall_time_s"] = round(time.perf_counter() - t0, 3)
+except Exception as e:
+    out["frame_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # live-streaming evidence (sofa_tpu/live.py): an INCREMENTAL epoch over
 # a tail-append — epoch 1 ingests half the tpumon tail on a side copy of
 # the raw collector files, the rest is appended, and epoch 2 (the timed
@@ -531,6 +548,8 @@ print(json.dumps(out))
                     "viz_evidence_error", "fsck_ok", "resume_wall_time_s",
                     "durability_evidence_error", "analyze_wall_time_s",
                     "analyze_pass_count", "analyze_failed_passes",
+                    "analyze_peak_rss_mb", "frame_load_wall_time_s",
+                    "frame_evidence_error",
                     "analyze_evidence_error", "whatif_identity_error_pct",
                     "whatif_evidence_error", "fleet_push_wall_time_s",
                     "fleet_evidence_error", "live_epoch_wall_time_s",
@@ -678,7 +697,8 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "resume_wall_time_s", "report_js_bytes",
                      "analyze_wall_time_s", "whatif_identity_error_pct",
                      "fleet_push_wall_time_s", "live_epoch_wall_time_s",
-                     "live_lag_events")
+                     "live_lag_events", "frame_load_wall_time_s",
+                     "analyze_peak_rss_mb")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

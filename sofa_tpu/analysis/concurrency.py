@@ -40,7 +40,11 @@ def _window_series(df, name_filter, t0, t1, window, value_col="event"):
 @analysis_pass(
     name="concurrency_breakdown", order=230,
     reads_frames=("mpstat", "tpuutil", "netbandwidth"),
-    reads_columns=("timestamp", "deviceId", "name"),
+    # "event" rides through the _window_series helper's value_col default
+    # — the projection loader materializes exactly this set, so the
+    # declaration must name every column the body reaches, helpers
+    # included (the first dishonest declaration the pushdown path found).
+    reads_columns=("timestamp", "deviceId", "name", "event"),
     provides_features=("elapsed_*_ratio", "breakdown_windows",
                        "breakdown_elapsed", "corr_tpu_*"),
     provides_artifacts=("performance.csv",),

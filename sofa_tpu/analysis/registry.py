@@ -400,9 +400,22 @@ def run_passes(frames, cfg, features: Features, tel=None,
     window `sofa live` derives from the declared contracts: enabled
     passes outside it are reported ``skipped`` (reason: inputs
     unchanged) and never run — their previous features were already
-    injected into ``features`` by the caller."""
-    from sofa_tpu import pool, telemetry
+    injected into ``features`` by the caller.
 
+    ``frames`` values may be lazy :class:`sofa_tpu.frames.FrameHandle`
+    objects (the columnar store's projection-pushdown path): each pass
+    then receives exactly its declared ``reads_frames`` materialized to
+    its declared ``reads_columns`` slice, materialized on pass entry and
+    dropped on exit, so peak RSS is bounded by the largest concurrent
+    footprint (frames.ProjectionPool).  An undeclared frame keeps its
+    handle, so a contract-violating read fails loudly inside that pass's
+    fault isolation instead of silently seeing stale or empty data.
+    Eager DataFrame inputs (preprocess passthrough, cluster merges) pass
+    through untouched."""
+    from sofa_tpu import pool, telemetry
+    from sofa_tpu.frames import ProjectionPool
+
+    proj = ProjectionPool(frames)
     specs = registered()
     jobs = pool.cfg_jobs(cfg) if jobs is None else max(1, int(jobs))
     enabled = [s for s in specs if s.enabled(cfg)]
@@ -437,7 +450,9 @@ def run_passes(frames, cfg, features: Features, tel=None,
                 else telemetry.maybe_span(spec.name, cat="analyze"))
         try:
             with span:
-                out = spec.fn(frames, cfg, view)
+                out = spec.fn(proj.for_pass(spec.reads_frames,
+                                            spec.reads_columns),
+                              cfg, view)
             if spec.provides_series and out:
                 series_by_pass[spec.name] = list(out)
             entry["status"] = "ok"
@@ -520,6 +535,20 @@ def sofa_passes(cfg) -> int:
             print(f"  reads frames:   {', '.join(spec.reads_frames)}")
         if spec.reads_columns:
             print(f"  reads columns:  {', '.join(spec.reads_columns)}")
+        if spec.reads_frames:
+            # Column footprint: what fraction of the 22-column schema the
+            # projection-pushdown loader maps for this pass.  An
+            # undeclared (full-frame) footprint is the thing to fix —
+            # it forfeits the out-of-core memory bound (docs/FRAMES.md).
+            from sofa_tpu.trace import COLUMNS
+
+            if spec.reads_columns:
+                print(f"  column footprint: {len(spec.reads_columns)}"
+                      f"/{len(COLUMNS)}")
+            else:
+                print(f"  column footprint: {len(COLUMNS)}/{len(COLUMNS)} "
+                      "(FULL FRAME — declare reads_columns to enable "
+                      "projection)")
         if spec.reads_features:
             print(f"  reads features: {', '.join(spec.reads_features)}")
         if spec.provides_features:

@@ -46,7 +46,11 @@ def tpu_profile(frames, cfg, features: Features) -> None:
     df = roi_clip(df, cfg)
     if df.empty:
         return
-    sync = df[df["category"] == 0]
+    # category != 0 rows are rare (reserved tag): skip the row-mask COPY
+    # when everything qualifies — at 10^7 events the mask copy alone is
+    # ~2 GB, and it is pure waste on the overwhelmingly common trace
+    sel = df["category"].to_numpy() == 0
+    sync = df if sel.all() else df[sel]
     features.add("tpu_devices", df["deviceId"].nunique())
     features.add("tpu_ops", len(sync))
 
@@ -89,9 +93,12 @@ def tpu_profile(frames, cfg, features: Features) -> None:
         print(top.head(10).to_string())
 
     # Per-category breakdown (convolution / fusion / all-reduce / ...).
-    cat = sync.assign(
-        cat=sync["hlo_category"].where(sync["hlo_category"] != "", "uncategorized")
-    ).groupby("cat")["duration"].sum().sort_values(ascending=False)
+    # Group by a standalone key series instead of .assign(): assign
+    # copies the whole frame just to add one column.
+    cat_key = sync["hlo_category"].where(sync["hlo_category"] != "",
+                                         "uncategorized").rename("cat")
+    cat = sync.groupby(cat_key)["duration"].sum() \
+        .sort_values(ascending=False)
     for name, value in cat.items():
         features.add(f"hlo_time_{_slug(name)}", float(value))
     cat.to_csv(cfg.path("tpu_categories.csv"))

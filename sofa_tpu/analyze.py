@@ -37,7 +37,9 @@ def load_frames(cfg: SofaConfig,
     never chart.  Reads overlap on a thread pool (width = the shared --jobs
     setting, sofa_tpu/pool.py) — the arrow CSV and parquet decoders release
     the GIL, so the 15 small frames hide behind the one pod-scale
-    tputrace."""
+    tputrace.  Frames with a committed ``_frames/`` chunk store load from
+    it (full-fidelity columnar); everything else reads the parquet/CSV
+    shims unchanged."""
     from sofa_tpu import pool
     from sofa_tpu.trace import read_frame
 
@@ -45,7 +47,7 @@ def load_frames(cfg: SofaConfig,
 
     def load_one(name: str) -> pd.DataFrame:
         try:
-            df = read_frame(cfg.path(name))  # .parquet preferred, else .csv
+            df = read_frame(cfg.path(name))  # chunks > .parquet > .csv
         except Exception as e:  # noqa: BLE001
             print_warning(f"analyze: cannot read {cfg.path(name)}: {e}")
             df = empty_frame()
@@ -53,6 +55,31 @@ def load_frames(cfg: SofaConfig,
 
     loaded = pool.thread_map(load_one, names, pool.cfg_jobs(cfg))
     return dict(zip(names, loaded))
+
+
+def open_frames(cfg: SofaConfig,
+                only: "List[str] | None" = None) -> Dict[str, object]:
+    """Projection-pushdown frame loading: frames backed by a columnar
+    chunk store open as lazy :class:`sofa_tpu.frames.FrameHandle`
+    objects — no row data materializes until a consumer asks, and the
+    pass registry then asks for exactly each pass's declared
+    ``reads_columns`` slice (analysis/registry.run_passes).  Frames
+    without a store fall back to the eager :func:`load_frames` read, so
+    a foreign CSV logdir analyzes exactly as before."""
+    from sofa_tpu import frames as framestore
+
+    names = list(only if only is not None else CSV_SOURCES)
+    out: Dict[str, object] = {}
+    eager = []
+    for name in names:
+        handle = framestore.open_frame(cfg.logdir, name)
+        if handle is not None:
+            out[name] = handle
+        else:
+            eager.append(name)
+    if eager:
+        out.update(load_frames(cfg, only=eager))
+    return {name: out[name] for name in names}
 
 
 # Frames whose deviceId column is a device/host ordinal that must rebase
@@ -188,7 +215,11 @@ def sofa_analyze(cfg: SofaConfig, frames: Dict[str, pd.DataFrame] | None = None)
 def _analyze_body(cfg: SofaConfig, frames, tel) -> Features:
     if frames is None:
         with tel.span("load_frames", cat="stage"):
-            frames = load_frames(cfg)
+            # Lazy open: columnar-backed frames stay on disk until a
+            # pass materializes its declared column slice, which bounds
+            # analyze's peak RSS by the declared footprints instead of
+            # the full 22-column frames (docs/FRAMES.md).
+            frames = open_frames(cfg)
     features = Features()
     misc = read_misc(cfg)
     features.add("elapsed_time", float(misc.get("elapsed_time", 0) or 0))

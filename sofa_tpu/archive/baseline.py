@@ -58,10 +58,11 @@ def percentile(xs: List[float], pct: float) -> float:
 # Feature polarity: which direction is a regression?
 # ---------------------------------------------------------------------------
 
-# Higher is worse: durations, latencies, skew, overhead, model error.
+# Higher is worse: durations, latencies, skew, overhead, model error,
+# peak memory (the out-of-core frame store's analyze_peak_rss_mb).
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
-    r"|_idle|_error_pct$)")
+    r"|_idle|_error_pct$|_rss_mb$)")
 # Lower is worse: rates and utilization.
 _WORSE_LOW = re.compile(
     r"(bandwidth|_gbps|per_sec|throughput|flops|images_per_sec|_util$)")

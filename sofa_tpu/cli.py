@@ -209,8 +209,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the timeline tile pyramid (board serves the "
                         "downsampled overview only; deep zoom loses "
                         "event fidelity)")
-    g.add_argument("--trace_format", choices=["csv", "parquet"],
-                   help="columnar parquet keeps pod-scale op traces small")
+    g.add_argument("--trace_format", choices=["csv", "parquet", "columnar"],
+                   help="frame interchange format (default columnar: the "
+                        "chunked memory-mapped _frames/ store, "
+                        "docs/FRAMES.md; SOFA_TRACE_FORMAT env equivalent; "
+                        "csv retained for foreign-logdir compat)")
     g.add_argument("--network_filters", help="comma-joined ip filters")
     g.add_argument("--cpu_filters", help="comma-joined keyword:color specs")
     g.add_argument("--tpu_filters", help="comma-joined keyword:color specs")

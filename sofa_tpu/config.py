@@ -144,7 +144,12 @@ class SofaConfig:
                                      # alignment is wrong and re-recording is
                                      # not an option (VERDICT r2 missing #3)
     viz_downsample_to: int = 10000   # max points per _viz series
-    trace_format: str = "csv"        # csv | parquet (columnar, for big traces)
+    trace_format: str = ""           # csv | parquet | columnar; "" = auto:
+                                     # SOFA_TRACE_FORMAT env, else columnar
+                                     # (the chunked _frames/ store,
+                                     # docs/FRAMES.md) — resolution lives in
+                                     # trace.resolve_trace_format so the
+                                     # policy exists in exactly one place
     network_filters: List[str] = field(default_factory=list)
     # Level-of-detail timeline tiles (sofa_tpu/tiles.py): per-series
     # min/max+density pyramid under <logdir>/_tiles/ so deep zoom regains

@@ -1,0 +1,378 @@
+"""Out-of-core chunked columnar frame store — ``<logdir>/_frames/``.
+
+Every frame used to travel between pipeline stages as one row-wise CSV,
+fully materialized in RAM on both ends.  That dies at fleet scale: a
+multi-day trace carries 10^8+ events (vs pod_synth's ~10^5), CSV parse
+dominates cold ingest, and every analysis pass pays for all 22 schema
+columns even when its declared contract reads three.  This module is the
+replacement interchange format — the scaling refactor ROADMAP.md names:
+
+    <logdir>/_frames/<name>/NNNNNN.arrow     one column chunk (Arrow IPC
+                                             file format, uncompressed —
+                                             memory-mappable)
+    <logdir>/_frames/<name>/frame_index.json the frame's manifest (schema
+                                             ``sofa_tpu/frame_index`` v1):
+                                             columns, row count, and the
+                                             per-chunk row/time ranges +
+                                             content hashes
+
+Contracts:
+
+* **Schema pinned by trace.COLUMNS** — a chunk store always carries
+  exactly the unified schema, in canonical order, with ``_conform``'s
+  dtypes; SL004's schema guard keeps its teeth because the store never
+  invents columns.
+* **Projection pushdown** — :meth:`FrameHandle.read` materializes only
+  the requested columns: Arrow IPC chunks are memory-mapped and the
+  unrequested column buffers are never touched (the registry feeds each
+  analysis pass exactly its declared ``reads_columns`` slice this way).
+* **Predicate pushdown** — the index signs each chunk's
+  ``[t_min, t_max]`` timestamp range, so a ``time_range`` read skips
+  whole chunks before any row lands in pandas.  The filter is on the
+  ``timestamp`` column (closed interval); callers that need
+  duration-overlap semantics widen the range by their max duration
+  first (trace.roi_clip stays the row-level authority).
+* **Content-keyed incremental writes** — chunk boundaries are fixed row
+  multiples, and each chunk signs its rows with a content hash: a
+  re-write of the same frame is a no-op, and an *append* (the `sofa
+  live` epoch case) rewrites only the final partial chunk plus the new
+  tail — committed chunks are never rewritten, the tile pyramid's
+  append-mostly discipline applied to the frames themselves.
+* **Crash safety** — chunk files land via durability.atomic_replace and
+  the index is written LAST, fsync'd (the tile_index.json discipline):
+  a SIGKILL mid-write leaves the previous committed generation fully
+  readable, never a torn frame.
+* **Fallback matrix** (docs/FRAMES.md) — no pyarrow degrades the whole
+  columnar format to the CSV path at the verb level
+  (:func:`columnar_available`); a single frame whose arrow conversion
+  fails degrades to CSV for that frame only (trace.write_frame); a
+  foreign logdir with no ``_frames/`` reads through the legacy
+  parquet/CSV shims unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from sofa_tpu.concurrency import Guard
+from sofa_tpu.printing import print_warning
+
+FRAMES_DIR_NAME = "_frames"
+FRAME_INDEX_NAME = "frame_index.json"
+FRAME_INDEX_SCHEMA = "sofa_tpu/frame_index"
+FRAME_INDEX_VERSION = 1
+
+#: Rows per column chunk.  Sized so a chunk of the widest frames is a few
+#: MiB of arrow buffers (cheap to rewrite as the live tail chunk) while a
+#: 10^8-event trace stays in the low thousands of chunks.
+CHUNK_ROWS = 1 << 16
+
+
+def columnar_available() -> bool:
+    """Whether the columnar store can operate here (pyarrow present).
+    The verb-level fallback gate: preprocess/live degrade
+    ``trace_format=columnar`` to ``csv`` when this is False."""
+    try:
+        import pyarrow.feather  # noqa: F401
+
+        return True
+    except Exception:  # sofa-lint: disable=SL002 — availability probe: False IS the routed answer; every caller states the csv fallback it picks
+        return False
+
+
+def frame_dir(logdir: str, name: str) -> str:
+    return os.path.join(logdir, FRAMES_DIR_NAME, name)
+
+
+def _chunk_file(i: int) -> str:
+    return f"{i:06d}.arrow"
+
+
+def _row_hashes(df: pd.DataFrame) -> np.ndarray:
+    """Per-row content hashes, position-independent — deterministic
+    across processes (pd.util.hash_pandas_object uses a fixed key, the
+    tile-key discipline), so --jobs 1 / --jobs 4 and repeated runs agree
+    on what is reusable.  Computed ONCE per frame; each chunk's sha is a
+    slice of this array, so the content keying costs O(rows) total, not
+    O(rows x chunks)."""
+    return pd.util.hash_pandas_object(df, index=False).to_numpy()
+
+
+def _chunk_sha(row_hashes: np.ndarray) -> str:
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(row_hashes).tobytes())
+    return h.hexdigest()
+
+
+def _conformed(df: pd.DataFrame) -> pd.DataFrame:
+    from sofa_tpu.trace import COLUMNS, _conform
+
+    if list(df.columns) == COLUMNS:
+        return df
+    if all(c in df.columns for c in COLUMNS):
+        return df[COLUMNS]
+    return _conform(df.copy())
+
+
+def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
+                       chunk_rows: "int | None" = None) -> dict:
+    """Write (or incrementally refresh) one frame's chunk store; returns
+    the committed index document.
+
+    Chunks are cut at fixed ``chunk_rows`` boundaries and reused by
+    content hash: an unchanged frame rewrites nothing, and an append
+    rewrites only the last partial chunk + the new tail.  The index is
+    the commit point — written last, fsync'd, atomic."""
+    import pyarrow as pa
+    import pyarrow.feather as feather
+
+    from sofa_tpu.durability import atomic_replace, atomic_write
+
+    df = _conformed(df)
+    rows = int(len(df))
+    step = int(chunk_rows or CHUNK_ROWS)
+    # joined inline (= frame_dir) so the artifact-flow lint (SL014) sees
+    # the _frames registry fragment on the writer's path expression
+    sdir = os.path.join(logdir, FRAMES_DIR_NAME, name)
+    os.makedirs(sdir, exist_ok=True)
+    index_path = os.path.join(sdir, FRAME_INDEX_NAME)
+    prev = _load_index(index_path)
+    prev_chunks = (prev or {}).get("chunks") or []
+    reusable = prev is not None and prev.get("chunk_rows") == step
+
+    chunks: List[dict] = []
+    wrote = 0
+    reused = 0
+    n_bytes = 0
+    row_hashes = _row_hashes(df) if rows else np.empty(0, dtype=np.uint64)
+    ts_all = (df["timestamp"].to_numpy(dtype=float) if rows
+              else np.empty(0))
+    # one pandas -> arrow conversion for the whole frame; per-chunk
+    # writes are zero-copy table slices (converting per chunk would copy
+    # every iloc slice and dominate the write stage)
+    table_all = (pa.Table.from_pandas(df, preserve_index=False)
+                 if rows else None)
+    for i, a in enumerate(range(0, rows, step)):
+        b = min(a + step, rows)
+        sha = _chunk_sha(row_hashes[a:b])
+        fname = _chunk_file(i)
+        path = os.path.join(sdir, fname)
+        old = prev_chunks[i] if reusable and i < len(prev_chunks) else None
+        if old is not None and old.get("sha") == sha \
+                and old.get("rows") == b - a and os.path.isfile(path):
+            entry = dict(old)
+            reused += 1
+        else:
+            with atomic_replace(path) as tmp:
+                feather.write_feather(table_all.slice(a, b - a), tmp,
+                                      compression="uncompressed")
+            ts = ts_all[a:b]
+            entry = {
+                "file": fname, "rows": int(b - a), "sha": sha,
+                "t_min": float(np.nanmin(ts)) if len(ts) else 0.0,
+                "t_max": float(np.nanmax(ts)) if len(ts) else 0.0,
+            }
+            wrote += 1
+        try:
+            n_bytes += os.path.getsize(path)
+        except OSError:
+            pass
+        chunks.append(entry)
+    # stale chunk files past the new count must not shadow a shrink
+    for i in range(len(chunks), len(prev_chunks)):
+        try:
+            os.unlink(os.path.join(sdir, _chunk_file(i)))
+        except OSError:
+            pass
+
+    from sofa_tpu.trace import COLUMNS
+
+    doc = {
+        "schema": FRAME_INDEX_SCHEMA, "version": FRAME_INDEX_VERSION,
+        "name": name, "columns": list(COLUMNS), "rows": rows,
+        "chunk_rows": step, "format": "arrow", "chunks": chunks,
+    }
+    # No wall-clock stamp on purpose: the index is a pure function of the
+    # frame, so repeated writes (and `sofa resume` replays) are
+    # byte-identical — the equivalence tests' foundation.
+    with atomic_write(index_path, fsync=True) as f:
+        json.dump(doc, f, sort_keys=True)
+    doc["_stats"] = {"wrote": wrote, "reused": reused, "bytes": n_bytes}
+    return doc
+
+
+def _load_index(index_path: str) -> Optional[dict]:
+    try:
+        with open(index_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != FRAME_INDEX_SCHEMA \
+            or doc.get("version") != FRAME_INDEX_VERSION:
+        return None
+    return doc
+
+
+def delete_frame_store(logdir: str, name: str) -> None:
+    """Remove one frame's chunk store (a csv/parquet-mode rewrite must
+    not leave a stale higher-priority store shadowing fresh data)."""
+    sdir = frame_dir(logdir, name)
+    if os.path.isdir(sdir):
+        shutil.rmtree(sdir, ignore_errors=True)
+
+
+def frame_store_names(logdir: str) -> List[str]:
+    """Names of every frame with a committed chunk store in the logdir."""
+    root = os.path.join(logdir, FRAMES_DIR_NAME)
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return []
+    return [n for n in entries
+            if os.path.isfile(os.path.join(root, n, FRAME_INDEX_NAME))]
+
+
+class FrameHandle:
+    """A lazily-read columnar frame: column projection + time-range
+    pushdown over memory-mapped Arrow IPC chunks.
+
+    The handle itself holds no row data — ``read`` materializes exactly
+    the requested column slices, which is what bounds an analysis pass's
+    peak RSS to its declared footprint instead of the full 22-column
+    frame."""
+
+    def __init__(self, sdir: str, index: dict):
+        self._sdir = sdir
+        self.index = index
+        self.name = index.get("name") or os.path.basename(sdir)
+        self.columns: List[str] = list(index.get("columns") or [])
+        self.rows = int(index.get("rows") or 0)
+        # one handle may serve several pass workers on the --jobs pool
+        self._guard = Guard("frames.handle_stats",
+                            protects=("chunks_read",))
+        #: chunks materialized by reads on this handle — the pushdown
+        #: proof the tests assert on (skipped chunks never count).
+        self.chunks_read = 0
+
+    def __len__(self) -> int:
+        return self.rows
+
+    def _select_chunks(self, time_range) -> List[dict]:
+        chunks = self.index.get("chunks") or []
+        if time_range is None:
+            return list(chunks)
+        a, b = float(time_range[0]), float(time_range[1])
+        return [c for c in chunks
+                if c.get("t_max", 0.0) >= a and c.get("t_min", 0.0) <= b]
+
+    def read(self, columns=None, time_range=None) -> pd.DataFrame:
+        """Materialize the frame (or a column/time slice of it).
+
+        ``columns`` preserves the requested order, silently dropping
+        names the store does not carry (the ``narrow`` contract: exotic
+        callers keep working).  ``time_range=(a, b)`` keeps rows whose
+        ``timestamp`` lies in the closed interval, reading only the
+        chunks whose signed range overlaps."""
+        import pyarrow as pa
+        import pyarrow.feather as feather
+
+        from sofa_tpu.trace import empty_frame
+
+        cols = None
+        if columns is not None:
+            cols = [c for c in columns if c in self.columns]
+        want = cols if cols is not None else self.columns
+        need_ts = time_range is not None and "timestamp" not in want
+        read_cols = (want + ["timestamp"]) if need_ts else want
+        chunks = self._select_chunks(time_range)
+        if not chunks or not self.rows:
+            base = empty_frame()
+            return base[want] if want else base
+        tables = []
+        for c in chunks:
+            path = os.path.join(self._sdir, c["file"])
+            tables.append(feather.read_table(path, columns=read_cols,
+                                             memory_map=True))
+        with self._guard:
+            self.chunks_read += len(tables)
+        table = pa.concat_tables(tables)
+        # reorder: feather returns file order, the caller asked for
+        # projection order
+        table = table.select(read_cols)
+        df = table.to_pandas()
+        if time_range is not None:
+            a, b = float(time_range[0]), float(time_range[1])
+            ts = df["timestamp"].to_numpy()
+            df = df[(ts >= a) & (ts <= b)]
+            if need_ts:
+                df = df.drop(columns=["timestamp"])
+            df = df.reset_index(drop=True)
+        return df
+
+
+def open_frame(logdir: str, name: str) -> Optional[FrameHandle]:
+    """Open a frame's chunk store lazily, or None when the logdir has no
+    committed store for it (callers fall back to the parquet/CSV shims).
+    A store that exists but cannot be served (no pyarrow, foreign index
+    version) degrades to None with a warning — the CSV fallback may be a
+    downsampled viz copy, and silence would hide that."""
+    sdir = frame_dir(logdir, name)
+    index = _load_index(os.path.join(sdir, FRAME_INDEX_NAME))
+    if index is None:
+        return None
+    if not columnar_available():
+        print_warning(
+            f"frames: {name} has a columnar store but pyarrow is missing "
+            "— falling back to the CSV copy (which may be downsampled)")
+        return None
+    return FrameHandle(sdir, index)
+
+
+def materialize(value, columns=None) -> pd.DataFrame:
+    """A DataFrame from either a FrameHandle (projected read) or an
+    already-eager frame (returned untouched — the zero-risk batch and
+    cluster paths never change shape)."""
+    if isinstance(value, FrameHandle):
+        return value.read(columns=columns)
+    return value
+
+
+class ProjectionPool:
+    """Per-run projection materializer for the analysis-pass registry.
+
+    Deliberately cache-free: each pass materializes its declared slice on
+    entry and drops it on exit, so analyze's peak RSS is bounded by the
+    LARGEST footprint among concurrently running passes — not the sum of
+    every distinct footprint a run ever touches (caching them would
+    quietly rebuild the full-frame working set the out-of-core store
+    exists to avoid).  Re-reads are memory-mapped chunk loads: the page
+    cache, not this class, is the share point."""
+
+    def __init__(self, frames: Dict[str, object]):
+        self.frames = frames
+        self.lazy = any(isinstance(v, FrameHandle)
+                        for v in frames.values())
+
+    def for_pass(self, reads_frames, reads_columns) -> Dict[str, object]:
+        """The frames mapping one pass receives: declared frames are
+        materialized to exactly the declared column slice; undeclared
+        frames keep their lazy handle, so an undeclared (contract-
+        violating) read fails loudly inside that pass's fault isolation
+        instead of silently seeing empty data."""
+        if not self.lazy:
+            return self.frames
+        out: Dict[str, object] = {}
+        for name, v in self.frames.items():
+            if isinstance(v, FrameHandle) and name in reads_frames:
+                out[name] = v.read(
+                    columns=list(reads_columns) if reads_columns else None)
+            else:
+                out[name] = v
+        return out

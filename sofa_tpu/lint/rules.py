@@ -384,6 +384,9 @@ _DERIVED_WRITER_FILES = (
     "ingest/cache.py", "ingest/pcap.py", "export_folded.py",
     "export_perfetto.py", "export_static.py", "analysis/", "ml/",
     "durability.py", "archive/", "whatif/", "live.py",
+    # the chunked columnar frame store: chunk files + frame_index.json
+    # are derived artifacts, every byte atomic (docs/FRAMES.md)
+    "frames.py",
 )
 
 _OPEN_FNS = frozenset({"open", "io.open", "gzip.open", "bz2.open",

@@ -399,17 +399,39 @@ def _inject_previous_features(cfg: SofaConfig, features, selected) -> int:
     return n
 
 
-def _write_frame_atomic(df: pd.DataFrame, base_path: str) -> None:
-    """Atomic CSV frame write: unlike batch preprocess (which streams
-    CSVs under the derived_write_guard sentinel), live epochs must leave
-    every artifact readable mid-epoch — the board serves the last
-    committed generation instead of 503ing."""
-    from sofa_tpu.durability import atomic_replace
-    from sofa_tpu.trace import write_csv
+def _write_frame_atomic(df: pd.DataFrame, base_path: str,
+                        cfg: "SofaConfig | None" = None,
+                        fmt: str = "csv") -> None:
+    """Atomic frame write for a live epoch — every artifact must stay
+    readable mid-epoch (the board serves the last committed generation
+    instead of 503ing, so no derived_write_guard on this path).
 
+    ``columnar`` (the default format) APPENDS: the chunk store's
+    content-keyed fixed boundaries mean an epoch's tail growth rewrites
+    only the final partial chunk plus the new tail — committed column
+    chunks are never rewritten (the tile append-mostly discipline
+    applied to the frames themselves, docs/FRAMES.md) — and the
+    downsampled board CSV refreshes beside it, exactly like a batch
+    columnar preprocess.  CSV mode keeps the legacy whole-file
+    rewrite."""
+    from sofa_tpu.durability import atomic_replace
+    from sofa_tpu.trace import downsample, write_csv, write_frame
+
+    if fmt == "columnar":
+        write_frame(df, base_path, "columnar")
+        viz_max = int(getattr(cfg, "viz_downsample_to", 10000))
+        with atomic_replace(base_path + ".csv") as tmp:
+            write_csv(downsample(df, viz_max), tmp)
+        return
     with atomic_replace(base_path + ".csv") as tmp:
         write_csv(df, tmp)
-    try:  # a stale parquet from an earlier batch run must not shadow it
+    # stale higher-priority stores from an earlier columnar/parquet run
+    # must not shadow the fresh csv
+    from sofa_tpu import frames as framestore
+
+    logdir, name = os.path.split(base_path)
+    framestore.delete_frame_store(logdir or ".", name)
+    try:
         os.unlink(base_path + ".parquet")
     except OSError:
         pass
@@ -529,11 +551,15 @@ def _run_epoch(cfg: SofaConfig, ledger: OffsetLedger) -> dict:
         meta_live["watermark_s"] = round(min(marks), 6) if marks else None
         ledger.doc["watermark_s"] = meta_live["watermark_s"]
         if dirty_frames:
+            from sofa_tpu.trace import resolve_trace_format
+
+            fmt = resolve_trace_format(cfg)
             with tel.span("write_frames", cat="stage"):
                 to_write = sorted(n for n in dirty_frames
                                   if n in frames and n != "cpuinfo")
                 pool.thread_map(
-                    lambda n: _write_frame_atomic(frames[n], cfg.path(n)),
+                    lambda n: _write_frame_atomic(frames[n], cfg.path(n),
+                                                  cfg=cfg, fmt=fmt),
                     to_write, jobs)
             series = build_series(cfg, frames)
             tiles_manifest = None

@@ -61,6 +61,14 @@ _SERIES_STYLE = {
 _XPLANE_FRAMES = ("tputrace", "tpumodules", "hosttrace", "tpusteps",
                   "customtrace")
 
+# Every column build_series (and therefore the tile builder) touches:
+# y/x/duration plus the name/phase/category/device filters.  Lazy
+# columnar frames materialize exactly this slice for the viz path — a
+# tile pyramid never needs op_path/module/source/groups, which dominate
+# a pod-scale frame's bytes.
+VIZ_COLUMNS = ("timestamp", "event", "duration", "deviceId", "name",
+               "hlo_category", "phase")
+
 # Corrupt raw inputs are moved here (never deleted: the bytes are evidence).
 # Listed in record.DERIVED_DIRS so `sofa clean` removes it.
 QUARANTINE_DIR_NAME = "_quarantine"
@@ -520,34 +528,67 @@ def _preprocess_body(cfg: SofaConfig, tel) -> Dict[str, pd.DataFrame]:
     with derived_write_guard(cfg.logdir):
         t0 = time.perf_counter()
         t0_unix = time.time()
-        trace_format = cfg.trace_format
-        if trace_format == "parquet":
-            try:
-                import pyarrow  # noqa: F401 — pandas' default parquet engine
-            except ImportError:
-                print_warning("trace_format=parquet needs pyarrow "
-                              "(pip install 'sofa-tpu[parquet]'); "
-                              "falling back to csv")
-                trace_format = "csv"
+        from sofa_tpu.trace import resolve_trace_format
+
+        trace_format = resolve_trace_format(cfg)
 
         def _write_one(item):
             name, df = item
-            write_frame(df, cfg.path(name), trace_format)
-            if trace_format == "parquet":
+            stats = None
+            if trace_format == "columnar":
+                # Chunked columnar store (sofa_tpu/frames.py): the frame
+                # lands as content-keyed Arrow IPC column chunks — a warm
+                # re-run rewrites nothing, a live append rewrites only
+                # the tail chunk.  A frame arrow refuses degrades to a
+                # full-fidelity CSV for that frame alone.
+                from sofa_tpu import frames as framestore
+
+                try:
+                    doc = framestore.write_frame_chunks(df, cfg.logdir,
+                                                        name)
+                    stats = doc.get("_stats")
+                    try:
+                        os.unlink(cfg.path(f"{name}.parquet"))
+                    except OSError:
+                        pass
+                except Exception as e:  # noqa: BLE001 — per-frame degradation to CSV
+                    print_warning(f"preprocess: columnar store of {name} "
+                                  f"failed ({e}); writing {name}.csv")
+                    framestore.delete_frame_store(cfg.logdir, name)
+                    write_frame(df, cfg.path(name), "csv")
+                    return name, stats
+            else:
+                write_frame(df, cfg.path(name), trace_format)
+            if trace_format in ("parquet", "columnar"):
                 # The board's detail pages fetch <name>.csv; keep a
-                # downsampled viz copy beside the full-fidelity parquet
-                # (analyze prefers the parquet — trace.read_frame).
-                # write_csv directly: the csv mode of write_frame would
-                # unlink the parquet just written.
+                # downsampled viz copy beside the full-fidelity columnar
+                # data (analyze prefers the chunk store / parquet —
+                # trace.read_frame).  write_csv directly: the csv mode
+                # of write_frame would delete the store just written.
                 write_csv(downsample(df, cfg.viz_downsample_to),
                           cfg.path(f"{name}.csv"))
+            return name, stats
 
         to_write = [(n, df) for n, df in frames.items() if n != "cpuinfo"]
         n_csv = len(to_write)
         # Frames are independent files and the pyarrow CSV/parquet writers
         # release the GIL, so the thread pool overlaps the pod-scale
         # tputrace write with the fifteen small ones.
-        pool.thread_map(_write_one, to_write, jobs)
+        wrote = pool.thread_map(_write_one, to_write, jobs)
+        if trace_format == "columnar":
+            stats = [s for _n, s in wrote if s]
+            tel.set_meta(frames={
+                "format": trace_format, "dir": "_frames",
+                "frames": len(stats),
+                "chunks": int(sum(s["wrote"] + s["reused"]
+                                  for s in stats)),
+                "reused": int(sum(s["reused"] for s in stats)),
+                "bytes": int(sum(s["bytes"] for s in stats)),
+            })
+        else:
+            tel.set_meta(frames={"format": trace_format, "dir": "",
+                                 "frames": n_csv, "chunks": 0,
+                                 "reused": 0, "bytes": 0})
         tel.add_span("write_frames", "stage", t0_unix,
                      time.perf_counter() - t0,
                      frames=n_csv, format=trace_format)

@@ -440,8 +440,16 @@ def ensure_tiles(cfg, frames=None, series=None, tel=None,
     if series is None:
         if frames is None:
             return None
-        from sofa_tpu.preprocess import build_series
+        from sofa_tpu.frames import materialize
+        from sofa_tpu.preprocess import VIZ_COLUMNS, build_series
 
+        # Chunk-built tiles: lazy columnar frames materialize only the
+        # viz column slice — the pyramid is a function of (x, y, d,
+        # name) + the series filters, so the full-width frame never
+        # exists in RAM on this path (docs/FRAMES.md).  Eager frames
+        # pass through untouched.
+        frames = {name: materialize(v, list(VIZ_COLUMNS))
+                  for name, v in frames.items()}
         series = build_series(cfg, frames)
     manifest = build_tiles(cfg, series, tel=tel, prune=prune)
     try:

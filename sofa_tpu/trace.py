@@ -130,7 +130,12 @@ def narrow(df: pd.DataFrame, cols) -> pd.DataFrame:
     (op_path, module, ...) dominate that copy.  A frame missing any of the
     requested columns passes through unchanged (exotic callers keep the
     old behavior; the pass then fails loudly on the absent column only if
-    it genuinely needs it)."""
+    it genuinely needs it).  An identity projection returns the frame
+    itself — the registry's pushdown loader already hands passes exactly
+    their declared slice, and re-selecting the same columns would copy
+    every block for nothing (2 GB on a 10^7-event frame)."""
+    if list(df.columns) == list(cols):
+        return df
     if all(c in df.columns for c in cols):
         return df[list(cols)]
     return df
@@ -337,23 +342,109 @@ def read_csv(path: str) -> pd.DataFrame:
     return _conform(df)
 
 
+#: Interchange formats `--trace_format` selects (docs/FRAMES.md).
+TRACE_FORMATS = ("csv", "parquet", "columnar")
+
+
+def resolve_trace_format(cfg) -> str:
+    """The format this run actually writes: the explicit config value,
+    else the ``SOFA_TRACE_FORMAT`` env, else ``columnar`` — degraded to
+    ``csv`` (with a warning) when the chosen columnar/parquet engine is
+    unavailable, so a pyarrow-less host still produces full-fidelity
+    frames through the legacy CSV path."""
+    from sofa_tpu.printing import print_warning
+
+    fmt = getattr(cfg, "trace_format", "") \
+        or os.environ.get("SOFA_TRACE_FORMAT", "") or "columnar"
+    if fmt not in TRACE_FORMATS:
+        print_warning(f"trace_format {fmt!r} is not one of "
+                      f"{'/'.join(TRACE_FORMATS)}; using columnar")
+        fmt = "columnar"
+    if fmt == "columnar":
+        from sofa_tpu.frames import columnar_available
+
+        if not columnar_available():
+            print_warning("trace_format=columnar needs pyarrow "
+                          "(pip install 'sofa-tpu[parquet]'); "
+                          "falling back to csv")
+            fmt = "csv"
+    elif fmt == "parquet":
+        try:
+            import pyarrow  # noqa: F401 — pandas' default parquet engine
+        except ImportError:
+            print_warning("trace_format=parquet needs pyarrow "
+                          "(pip install 'sofa-tpu[parquet]'); "
+                          "falling back to csv")
+            fmt = "csv"
+    return fmt
+
+
+def write_frame_chunks(df: pd.DataFrame, base_path: str) -> dict:
+    """Write a frame into the chunked columnar store
+    (``<logdir>/_frames/<name>/`` — sofa_tpu/frames.py); returns the
+    committed frame_index document.  Content-keyed per chunk: an
+    unchanged frame rewrites nothing and an append rewrites only the
+    tail chunk."""
+    from sofa_tpu import frames as framestore
+
+    logdir, name = os.path.split(base_path)
+    return framestore.write_frame_chunks(df, logdir or ".", name)
+
+
+def open_frame(base_path: str):
+    """Lazy :class:`sofa_tpu.frames.FrameHandle` over ``base_path``'s
+    chunk store (column projection + time-range pushdown), or None when
+    the logdir has no committed store for it."""
+    from sofa_tpu import frames as framestore
+
+    logdir, name = os.path.split(base_path)
+    return framestore.open_frame(logdir or ".", name)
+
+
 def write_frame(df: pd.DataFrame, base_path: str, fmt: str = "csv") -> str:
     """Write a unified-schema frame as <base_path>.<fmt>; returns the path.
 
-    Parquet keeps big HLO-op traces columnar and ~5-10x smaller than CSV
-    (the reference's CSV-everywhere contract does not survive pod-scale
-    traces — SURVEY §7 "trace volume").
+    ``columnar`` (the default interchange format, docs/FRAMES.md) lands
+    the frame as memory-mappable Arrow IPC column chunks under
+    ``<logdir>/_frames/<name>/``; ``parquet`` keeps the single-file
+    columnar mode; CSV remains for foreign-logdir compat.  Each mode
+    removes the other modes' stale higher-priority artifacts so a format
+    switch can never serve yesterday's bytes (read order is chunks >
+    parquet > csv), and every write is atomic (SL009).
     """
     import os
 
+    from sofa_tpu import frames as framestore
+    from sofa_tpu.durability import atomic_replace
+
+    logdir, name = os.path.split(base_path)
+    if fmt == "columnar":
+        try:
+            framestore.write_frame_chunks(df, logdir or ".", name)
+        except Exception as e:  # noqa: BLE001 — per-frame degradation to CSV
+            from sofa_tpu.printing import print_warning
+
+            print_warning(f"frames: columnar store of {name} failed "
+                          f"({e}); writing {name}.csv instead")
+            framestore.delete_frame_store(logdir or ".", name)
+            return write_frame(df, base_path, "csv")
+        try:
+            os.unlink(base_path + ".parquet")
+        except OSError:
+            pass
+        return os.path.join(framestore.frame_dir(logdir or ".", name),
+                            framestore.FRAME_INDEX_NAME)
     if fmt == "parquet":
         path = base_path + ".parquet"
-        df.to_parquet(path, index=False)
+        with atomic_replace(path) as tmp:
+            df.to_parquet(tmp, index=False)
+        framestore.delete_frame_store(logdir or ".", name)
     else:
         path = base_path + ".csv"
         write_csv(df, path)
-        # read_frame prefers .parquet; a stale one from an earlier
-        # parquet-mode run must not shadow this fresh csv.
+        # read_frame prefers chunks, then .parquet; stale ones from an
+        # earlier columnar/parquet run must not shadow this fresh csv.
+        framestore.delete_frame_store(logdir or ".", name)
         try:
             os.unlink(base_path + ".parquet")
         except OSError:
@@ -361,15 +452,27 @@ def write_frame(df: pd.DataFrame, base_path: str, fmt: str = "csv") -> str:
     return path
 
 
-def read_frame(base_path: str) -> Optional[pd.DataFrame]:
-    """Read <base_path>.parquet if present, else <base_path>.csv, else None."""
+def read_frame(base_path: str,
+               columns: "Optional[List[str]]" = None) -> Optional[pd.DataFrame]:
+    """Read a frame: the ``_frames/`` chunk store if committed, else
+    <base_path>.parquet, else <base_path>.csv, else None.  ``columns``
+    is a projection hint — pushed down into the columnar chunk reader
+    (unrequested column buffers are never mapped); the parquet/CSV
+    shims read everything and project after."""
     import os
 
+    handle = open_frame(base_path)
+    if handle is not None:
+        return handle.read(columns=columns)
     if os.path.isfile(base_path + ".parquet"):
-        return _conform(pd.read_parquet(base_path + ".parquet"))
-    if os.path.isfile(base_path + ".csv"):
-        return read_csv(base_path + ".csv")
-    return None
+        df = _conform(pd.read_parquet(base_path + ".parquet"))
+    elif os.path.isfile(base_path + ".csv"):
+        df = read_csv(base_path + ".csv")
+    else:
+        return None
+    if columns is not None:
+        return narrow(df, [c for c in columns if c in df.columns])
+    return df
 
 
 def downsample(df: pd.DataFrame, max_points: int,
@@ -564,7 +667,11 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # keeps the artifact inventory's closure honest.
                  "agent_state.json", "sofa_fleet.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
-                "_tiles"]
+                "_tiles",
+                # chunked columnar frame store (sofa_tpu/frames.py): the
+                # default interchange format's home — regenerated by any
+                # preprocess/live run, swept by `sofa clean`
+                "_frames"]
 
 # Never digested (the fsck ledger's skip-list): the ledgers themselves —
 # they change on every write, including fsck's own — live sentinels, and
@@ -586,6 +693,12 @@ DIGEST_SKIP_FILES = frozenset({
 })
 DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
+    # the columnar frame store: chunk files are content-keyed by their
+    # frame_index.json (rewritten incrementally by every `sofa live`
+    # epoch without a pipeline digest refresh); integrity is the index's
+    # sha-per-chunk job, so digesting the chunks would turn each live
+    # tick into fsck damage
+    "_frames",
 })
 
 

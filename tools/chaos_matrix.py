@@ -10,10 +10,12 @@ demand instead of waiting for production to exercise it for us.
 
 On top of the collector-fault matrix, the **kill-sofa-itself cells**
 (sofa_tpu/durability.py's acceptance proof) SIGKILL the preprocess
-process at a random point — once mid frame-write, once mid tile build —
-and assert that `sofa resume` completes the run with a ``report.js``
-byte-identical to an uninterrupted run on the same logdir, a
-schema-valid manifest, and `sofa fsck` exit 0.
+process at a random point — once mid CSV frame-write, once mid tile
+build, once mid columnar-chunk write (sofa_tpu/frames.py: chunks on
+disk, the frame_index.json commit point absent) — and assert that
+`sofa resume` completes the run with a ``report.js`` byte-identical to
+an uninterrupted run on the same logdir, a schema-valid manifest, and
+`sofa fsck` exit 0.
 
     python tools/chaos_matrix.py [workdir]
 
@@ -71,13 +73,17 @@ _RAW_OVERLAY = ("perf.script", "strace.txt", "pystacks.txt", "mpstat.txt",
 KILL_CELLS = [
     ("kill-mid-preprocess", "frames"),
     ("kill-mid-tiles", "tiles"),
+    # mid-write of the chunked columnar store (sofa_tpu/frames.py): some
+    # column chunks on disk, the frame_index.json commit point not yet
+    # written — resume must converge byte-identically and fsck 0
+    ("kill-mid-frame-write", "frame_chunks"),
 ]
 
 _KILL_SNIPPET = """
 import os, signal, sys
 sys.path.insert(0, sys.argv[4])
 logdir, point, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
-from sofa_tpu import tiles, trace
+from sofa_tpu import frames as framestore, tiles, trace
 count = [0]
 def arm(orig):
     def hook(*a, **kw):
@@ -88,6 +94,8 @@ def arm(orig):
     return hook
 if point == "tiles":
     tiles._write_tile = arm(tiles._write_tile)
+elif point == "frame_chunks":
+    framestore._chunk_sha = arm(framestore._chunk_sha)
 else:
     trace.write_csv = arm(trace.write_csv)
 from sofa_tpu.config import SofaConfig

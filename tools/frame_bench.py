@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Frame interchange-format benchmark: CSV vs columnar vs projected load.
+
+Builds a pod_synth ``--raw`` logdir, preprocesses it once per trace
+format, and prints the table the out-of-core frame store (docs/FRAMES.md)
+is accountable to:
+
+* **write** — the write_frames stage wall time from the run manifest
+  (the part of cold preprocess the interchange format owns), plus the
+  whole cold preprocess wall for context;
+* **full load** — deserializing every frame back (`analyze.load_frames`),
+  the cost a standalone `sofa analyze` pays up front on the CSV path;
+* **projected load** — the columnar store's projection-pushdown read of
+  a typical pass footprint (timestamp/duration/deviceId/name) plus a
+  time-range slice, which the CSV path cannot do at all;
+* **bytes on disk** per format.
+
+Usage::
+
+    python tools/frame_bench.py [workdir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+#: A typical declared pass footprint (sol_roofline-ish): what the
+#: registry's projection pushdown actually maps for most passes.
+PROJECTION = ["timestamp", "duration", "deviceId", "name"]
+
+
+def _synth(workdir: str) -> str:
+    logdir = os.path.join(workdir, "synth") + "/"
+    subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "pod_synth.py"),
+         logdir, "--raw"],
+        check=True, capture_output=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    return logdir
+
+
+def _du(path: str) -> int:
+    total = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
+
+
+def _frame_bytes(cfg, fmt: str) -> int:
+    from sofa_tpu.analyze import CSV_SOURCES
+    from sofa_tpu.frames import FRAMES_DIR_NAME
+
+    if fmt == "columnar":
+        return _du(cfg.path(FRAMES_DIR_NAME))
+    total = 0
+    for name in CSV_SOURCES:
+        for ext in ((".parquet",) if fmt == "parquet" else (".csv",)):
+            try:
+                total += os.path.getsize(cfg.path(name + ext))
+            except OSError:
+                pass
+    return total
+
+
+def bench_format(raw_logdir: str, workdir: str, fmt: str) -> dict:
+    from sofa_tpu.analyze import load_frames
+    from sofa_tpu.config import SofaConfig
+    from sofa_tpu.preprocess import sofa_preprocess
+    from sofa_tpu.telemetry import load_manifest
+
+    logdir = os.path.join(workdir, f"fmt-{fmt}") + "/"
+    shutil.copytree(raw_logdir, logdir)
+    cfg = SofaConfig(logdir=logdir, trace_format=fmt)
+    t0 = time.perf_counter()
+    sofa_preprocess(cfg)
+    cold = time.perf_counter() - t0
+    doc = load_manifest(logdir) or {}
+    stage = next((s for s in doc.get("stages", [])
+                  if s.get("verb") == "preprocess"
+                  and s.get("name") == "write_frames"), {})
+    t0 = time.perf_counter()
+    frames = load_frames(cfg)
+    full_load = time.perf_counter() - t0
+    rows = sum(len(df) for df in frames.values())
+    del frames
+
+    out = {
+        "format": fmt,
+        "preprocess_cold_s": round(cold, 3),
+        "write_frames_s": round(float(stage.get("dur_s", 0.0)), 3),
+        "full_load_s": round(full_load, 3),
+        "rows": rows,
+        "frame_bytes": _frame_bytes(cfg, fmt),
+    }
+    if fmt == "columnar":
+        from sofa_tpu import frames as framestore
+
+        t0 = time.perf_counter()
+        chunks_read = 0
+        for name in framestore.frame_store_names(logdir):
+            handle = framestore.open_frame(logdir, name)
+            handle.read(columns=PROJECTION)
+            chunks_read += handle.chunks_read
+        out["projected_load_s"] = round(time.perf_counter() - t0, 3)
+        # time-range pushdown: the middle 10 % of the biggest frame
+        big = max(framestore.frame_store_names(logdir),
+                  key=lambda n: framestore.open_frame(logdir, n).rows)
+        handle = framestore.open_frame(logdir, big)
+        spans = [(c["t_min"], c["t_max"])
+                 for c in handle.index["chunks"]]
+        if spans:
+            lo = min(a for a, _b in spans)
+            hi = max(b for _a, b in spans)
+            mid = lo + (hi - lo) * 0.45, lo + (hi - lo) * 0.55
+            t0 = time.perf_counter()
+            handle.read(columns=PROJECTION, time_range=mid)
+            out["range_load_s"] = round(time.perf_counter() - t0, 4)
+            out["range_chunks_read"] = handle.chunks_read
+            out["chunks_total"] = len(handle.index["chunks"])
+    return out
+
+
+def main() -> int:
+    workdir = (sys.argv[1] if len(sys.argv) > 1
+               else tempfile.mkdtemp(prefix="sofa_frame_bench_"))
+    os.makedirs(workdir, exist_ok=True)
+    raw = _synth(workdir)
+    results = [bench_format(raw, workdir, fmt)
+               for fmt in ("csv", "parquet", "columnar")]
+    cols = ("format", "preprocess_cold_s", "write_frames_s", "full_load_s",
+            "projected_load_s", "frame_bytes")
+    print("\n== frame interchange formats (pod_synth --raw,",
+          f"{results[0]['rows']} rows) ==")
+    print("  ".join(f"{c:>18}" for c in cols))
+    for r in results:
+        print("  ".join(f"{r.get(c, '-')!s:>18}" for c in cols))
+    col = results[-1]
+    if "range_chunks_read" in col:
+        print(f"\ncolumnar time-range pushdown: middle-10% slice read "
+              f"{col['range_chunks_read']}/{col['chunks_total']} chunk(s) "
+              f"in {col['range_load_s']}s")
+    csv_row = results[0]
+    print(f"\ncold preprocess: csv {csv_row['preprocess_cold_s']}s -> "
+          f"columnar {col['preprocess_cold_s']}s; full load: "
+          f"csv {csv_row['full_load_s']}s -> columnar "
+          f"{col['full_load_s']}s -> projected "
+          f"{col.get('projected_load_s', '-')}s")
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

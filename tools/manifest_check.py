@@ -56,6 +56,12 @@ _LIVE_OFFSETS_NAME = "_live_offsets.json"
 _LIVE_OFFSETS_SCHEMA = "sofa_tpu/live_offsets"
 _LIVE_OFFSETS_VERSION = 1
 
+_FRAMES_DIR_NAME = "_frames"
+_FRAME_INDEX_NAME = "frame_index.json"
+_FRAME_INDEX_SCHEMA = "sofa_tpu/frame_index"
+_FRAME_INDEX_VERSION = 1
+_FRAME_FORMATS = ("csv", "parquet", "columnar")
+
 
 def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -392,6 +398,29 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             if not _is_num(serve.get("committed_unix")):
                 probs.append("meta.serve.committed_unix: missing or not "
                              "a number")
+
+    # meta.frames (written by preprocess, sofa_tpu/frames.py +
+    # preprocess.py): which interchange format the run's frames landed
+    # in, and — for the chunked columnar store — the chunk/reuse/byte
+    # accounting that proves the content-keyed incremental writes.
+    fmeta = (doc.get("meta") or {}).get("frames")
+    if fmeta is not None:
+        if not isinstance(fmeta, dict):
+            probs.append("meta.frames: not an object")
+        else:
+            if fmeta.get("format") not in _FRAME_FORMATS:
+                probs.append(f"meta.frames.format: "
+                             f"{fmeta.get('format')!r} not in "
+                             f"{_FRAME_FORMATS}")
+            for key in ("frames", "chunks", "reused", "bytes"):
+                v = fmeta.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.frames.{key}: missing or not a "
+                                 "non-negative int")
+            if isinstance(fmeta.get("chunks"), int) \
+                    and isinstance(fmeta.get("reused"), int) \
+                    and fmeta.get("reused", 0) > fmeta.get("chunks", 0):
+                probs.append("meta.frames: reused exceeds chunks")
 
     # meta.live (written every `sofa live` epoch, sofa_tpu/live.py): the
     # streaming-freshness manifest the board polls — epoch seq,
@@ -767,6 +796,85 @@ def validate_live_offsets(doc) -> List[str]:
     return probs
 
 
+def validate_frame_index(doc) -> List[str]:
+    """Schema problems in a ``_frames/<name>/frame_index.json`` manifest
+    (sofa_tpu/frames.py) — the commit point of one frame's chunked
+    columnar store."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["frame index is not a JSON object"]
+    if doc.get("schema") != _FRAME_INDEX_SCHEMA:
+        probs.append(f"schema: expected {_FRAME_INDEX_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _FRAME_INDEX_VERSION:
+        probs.append(f"version: expected {_FRAME_INDEX_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not isinstance(doc.get("name"), str) or not doc.get("name"):
+        probs.append("name: missing or empty")
+    cols = doc.get("columns")
+    if not isinstance(cols, list) or not cols \
+            or not all(isinstance(c, str) for c in cols):
+        probs.append("columns: missing or not a list of column names")
+    rows = doc.get("rows")
+    if not isinstance(rows, int) or isinstance(rows, bool) or rows < 0:
+        probs.append("rows: missing or not a non-negative int")
+    step = doc.get("chunk_rows")
+    if not isinstance(step, int) or isinstance(step, bool) or step < 1:
+        probs.append("chunk_rows: missing or not a positive int")
+    if doc.get("format") != "arrow":
+        probs.append(f"format: expected 'arrow', got {doc.get('format')!r}")
+    chunks = doc.get("chunks")
+    if not isinstance(chunks, list):
+        probs.append("chunks: not a list")
+        chunks = []
+    total = 0
+    for i, c in enumerate(chunks):
+        if not isinstance(c, dict) or not isinstance(c.get("file"), str) \
+                or not isinstance(c.get("sha"), str) \
+                or not isinstance(c.get("rows"), int) \
+                or isinstance(c.get("rows"), bool) or c.get("rows") < 1 \
+                or not _is_num(c.get("t_min")) \
+                or not _is_num(c.get("t_max")):
+            probs.append(f"chunks[{i}]: needs file, sha, positive rows, "
+                         "and numeric t_min/t_max")
+            continue
+        total += c["rows"]
+        if isinstance(step, int) and step >= 1:
+            if i < len(chunks) - 1 and c["rows"] != step:
+                probs.append(f"chunks[{i}].rows: {c['rows']} — every "
+                             f"non-final chunk must hold exactly "
+                             f"chunk_rows ({step}) rows")
+    if chunks and isinstance(rows, int) and total != rows:
+        probs.append(f"rows: {rows} disagrees with the chunk-table sum "
+                     f"{total}")
+    return probs
+
+
+def _check_frame_indexes(logdir: str) -> List[str]:
+    """Validate every committed frame_index.json under a logdir's
+    ``_frames/`` store (missing store = nothing to check: the CSV
+    path)."""
+    root = os.path.join(logdir, _FRAMES_DIR_NAME)
+    probs: List[str] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for name in names:
+        path = os.path.join(root, name, _FRAME_INDEX_NAME)
+        if not os.path.isfile(path):
+            continue
+        where = f"{_FRAMES_DIR_NAME}/{name}/{_FRAME_INDEX_NAME}"
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            probs.append(f"{where}: unreadable ({e})")
+            continue
+        probs.extend(f"{where}: {p}" for p in validate_frame_index(doc))
+    return probs
+
+
 def _check_live_offsets(logdir: str) -> List[str]:
     path = os.path.join(logdir, _LIVE_OFFSETS_NAME)
     if not os.path.isfile(path):
@@ -788,7 +896,7 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     `sofa live` offset ledger is present gets that validated too."""
     live_probs: List[str] = []
     if os.path.isdir(path):
-        live_probs = _check_live_offsets(path)
+        live_probs = _check_live_offsets(path) + _check_frame_indexes(path)
         mpath = os.path.join(path, MANIFEST_NAME)
         if not os.path.isfile(mpath):
             for alt in ("regress_verdict.json", "whatif_report.json"):
