@@ -486,7 +486,18 @@ def fsck_scan(logdir: str, digests: "dict | None" = None) -> Optional[dict]:
                 report["orphaned"].append(rel)
             elif parts and parts[0] == "_tiles" and rel not in files:
                 report["orphaned"].append(rel)
-    report["checked"] = len(files)
+    # The columnar frame store is digest-skipped (a live epoch rewrites
+    # the tail chunk without a pipeline digest refresh), so the ledger
+    # cannot vouch for it — re-hash each committed chunk against its
+    # index-signed sha instead (frames.verify_frame_store).
+    from sofa_tpu import frames as framestore
+
+    n_frames = 0
+    for fname in framestore.frame_store_names(logdir):
+        n_frames += 1
+        report["corrupt"].extend(framestore.verify_frame_store(logdir,
+                                                               fname))
+    report["checked"] = len(files) + n_frames
     return report
 
 
@@ -508,9 +519,13 @@ def _fsck_repair(cfg, report: dict) -> None:
     cache = IngestCache(cfg.path(CACHE_DIR_NAME))
     raw_damage: List[str] = []
     tile_series: set = set()
+    frame_stores: set = set()
     for rel in damaged:
         if rel.startswith("_tiles/"):
             tile_series.add(rel.split("/")[1])
+            continue
+        if rel.startswith("_frames/"):
+            frame_stores.add(rel.split("/")[1])
             continue
         src = _RAW_TO_SOURCE.get(rel) or (
             "xplane" if rel.startswith("xprof/") else None)
@@ -520,6 +535,13 @@ def _fsck_repair(cfg, report: dict) -> None:
     for series in sorted(tile_series):
         shutil.rmtree(os.path.join(logdir, TILES_DIR_NAME, series),
                       ignore_errors=True)
+    # a damaged chunk store must go wholesale: the rewrite is
+    # content-keyed, and a chunk whose index sha still matches the fresh
+    # frame would be REUSED — damaged bytes and all — if left in place
+    from sofa_tpu import frames as framestore
+
+    for fname in sorted(frame_stores):
+        framestore.delete_frame_store(logdir, fname)
     for rel in report.get("orphaned") or []:
         try:
             os.unlink(os.path.join(logdir, rel))

@@ -173,11 +173,15 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
             with atomic_replace(path) as tmp:
                 feather.write_feather(table_all.slice(a, b - a), tmp,
                                       compression="uncompressed")
+            # NaN timestamps are ignored for the range; an all-NaN chunk
+            # signs null bounds (NaN is not valid JSON, and NaN compares
+            # would silently drop the chunk from every time_range read)
             ts = ts_all[a:b]
+            finite = ts[~np.isnan(ts)] if len(ts) else ts
             entry = {
                 "file": fname, "rows": int(b - a), "sha": sha,
-                "t_min": float(np.nanmin(ts)) if len(ts) else 0.0,
-                "t_max": float(np.nanmax(ts)) if len(ts) else 0.0,
+                "t_min": float(finite.min()) if len(finite) else None,
+                "t_max": float(finite.max()) if len(finite) else None,
             }
             wrote += 1
         try:
@@ -185,12 +189,6 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
         except OSError:
             pass
         chunks.append(entry)
-    # stale chunk files past the new count must not shadow a shrink
-    for i in range(len(chunks), len(prev_chunks)):
-        try:
-            os.unlink(os.path.join(sdir, _chunk_file(i)))
-        except OSError:
-            pass
 
     from sofa_tpu.trace import COLUMNS
 
@@ -204,6 +202,15 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
     # byte-identical — the equivalence tests' foundation.
     with atomic_write(index_path, fsync=True) as f:
         json.dump(doc, f, sort_keys=True)
+    # stale chunk files past the new count must not shadow a shrink —
+    # unlinked only AFTER the index commit: a kill before the commit must
+    # leave the previous generation (which still references them) fully
+    # readable
+    for i in range(len(chunks), len(prev_chunks)):
+        try:
+            os.unlink(os.path.join(sdir, _chunk_file(i)))
+        except OSError:
+            pass
     doc["_stats"] = {"wrote": wrote, "reused": reused, "bytes": n_bytes}
     return doc
 
@@ -239,6 +246,47 @@ def frame_store_names(logdir: str) -> List[str]:
             if os.path.isfile(os.path.join(root, n, FRAME_INDEX_NAME))]
 
 
+def verify_frame_store(logdir: str, name: str) -> List[str]:
+    """Re-hash one frame's committed chunks against the index's signed
+    per-chunk shas; returns logdir-relative paths of damaged chunk files
+    (missing, short, or content-mismatched).
+
+    The chunk files live in trace.DIGEST_SKIP_DIRS (a live epoch rewrites
+    the tail chunk without a pipeline digest refresh), so the digest
+    ledger cannot vouch for them — this check is where the index's
+    "sha-per-chunk is the integrity job" claim is actually enforced.
+    `sofa fsck` folds the result into its corrupt verdict.  A tail chunk
+    carrying MORE rows than its committed entry is healthy (an in-flight
+    live append; readers truncate to the signed count), and only the
+    committed prefix is hashed."""
+    if not columnar_available():
+        return []  # nothing can read the chunks here; the CSV path rules
+    import pyarrow.feather as feather
+
+    sdir = frame_dir(logdir, name)
+    index = _load_index(os.path.join(sdir, FRAME_INDEX_NAME))
+    if index is None:
+        return []
+    bad: List[str] = []
+    for c in index.get("chunks") or []:
+        rel = "/".join([FRAMES_DIR_NAME, name, c["file"]])
+        path = os.path.join(sdir, c["file"])
+        rows = int(c.get("rows") or 0)
+        try:
+            tbl = feather.read_table(path, memory_map=True)
+        except Exception as e:  # noqa: BLE001 — unreadable == damaged
+            print_warning(f"frames: chunk {rel} is unreadable ({e})")
+            bad.append(rel)
+            continue
+        if tbl.num_rows < rows:
+            bad.append(rel)
+            continue
+        df = tbl.slice(0, rows).to_pandas()
+        if _chunk_sha(_row_hashes(df)) != c.get("sha"):
+            bad.append(rel)
+    return bad
+
+
 class FrameHandle:
     """A lazily-read columnar frame: column projection + time-range
     pushdown over memory-mapped Arrow IPC chunks.
@@ -269,8 +317,16 @@ class FrameHandle:
         if time_range is None:
             return list(chunks)
         a, b = float(time_range[0]), float(time_range[1])
-        return [c for c in chunks
-                if c.get("t_max", 0.0) >= a and c.get("t_min", 0.0) <= b]
+
+        def overlaps(c: dict) -> bool:
+            lo, hi = c.get("t_min"), c.get("t_max")
+            if lo is None or hi is None:
+                # unsigned range (all-NaN timestamps): conservatively
+                # included — the row-level filter is the authority
+                return True
+            return hi >= a and lo <= b
+
+        return [c for c in chunks if overlaps(c)]
 
     def read(self, columns=None, time_range=None) -> pd.DataFrame:
         """Materialize the frame (or a column/time slice of it).
@@ -298,8 +354,16 @@ class FrameHandle:
         tables = []
         for c in chunks:
             path = os.path.join(self._sdir, c["file"])
-            tables.append(feather.read_table(path, columns=read_cols,
-                                             memory_map=True))
+            tbl = feather.read_table(path, columns=read_cols,
+                                     memory_map=True)
+            # the index is the commit point: a live append epoch (or a
+            # kill between the tail-chunk replace and the index write)
+            # can leave the tail file with MORE rows than the committed
+            # entry — truncate to the signed count so index.rows always
+            # agrees with what read() returns
+            if tbl.num_rows != int(c.get("rows") or 0):
+                tbl = tbl.slice(0, int(c.get("rows") or 0))
+            tables.append(tbl)
         with self._guard:
             self.chunks_read += len(tables)
         table = pa.concat_tables(tables)

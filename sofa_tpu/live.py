@@ -418,7 +418,13 @@ def _write_frame_atomic(df: pd.DataFrame, base_path: str,
     from sofa_tpu.trace import downsample, write_csv, write_frame
 
     if fmt == "columnar":
-        write_frame(df, base_path, "columnar")
+        if write_frame(df, base_path, "columnar").endswith(".csv"):
+            # the columnar write degraded per-frame to a FULL-fidelity
+            # CSV at base_path+".csv" — overwriting it with the
+            # downsampled viz copy would silently make lossy data the
+            # frame's only artifact (preprocess._write_one's early
+            # return, mirrored)
+            return
         viz_max = int(getattr(cfg, "viz_downsample_to", 10000))
         with atomic_replace(base_path + ".csv") as tmp:
             write_csv(downsample(df, viz_max), tmp)

@@ -133,7 +133,15 @@ def narrow(df: pd.DataFrame, cols) -> pd.DataFrame:
     it genuinely needs it).  An identity projection returns the frame
     itself — the registry's pushdown loader already hands passes exactly
     their declared slice, and re-selecting the same columns would copy
-    every block for nothing (2 GB on a 10^7-event frame)."""
+    every block for nothing (2 GB on a 10^7-event frame).
+
+    ALIASING CONTRACT: the result may therefore BE the input frame, not
+    a copy — callers must treat it as read-only (mask-filter / groupby /
+    derive into new objects, never assign columns in place).  On the
+    eager CSV/parquet fallback the input is the shared entry in the
+    run's frames dict, and an in-place mutation would leak into every
+    later pass; the registry's pushdown path is immune only because each
+    pass already receives a privately materialized slice."""
     if list(df.columns) == list(cols):
         return df
     if all(c in df.columns for c in cols):
@@ -695,9 +703,10 @@ DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
     # the columnar frame store: chunk files are content-keyed by their
     # frame_index.json (rewritten incrementally by every `sofa live`
-    # epoch without a pipeline digest refresh); integrity is the index's
-    # sha-per-chunk job, so digesting the chunks would turn each live
-    # tick into fsck damage
+    # epoch without a pipeline digest refresh), so digesting the chunks
+    # would turn each live tick into fsck damage.  Integrity is the
+    # index's sha-per-chunk job instead, enforced by fsck re-hashing
+    # every committed chunk through frames.verify_frame_store
     "_frames",
 })
 

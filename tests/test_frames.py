@@ -184,6 +184,115 @@ def test_shrink_drops_stale_tail_chunks(tmp_path):
     assert len(handle.read()) == 300
 
 
+def test_shrink_crash_before_index_commit_keeps_prev_generation(
+        tmp_path, monkeypatch):
+    """Stale tail chunks are unlinked only AFTER the index commit: a kill
+    between the chunk writes and the index write must leave the previous
+    committed generation fully readable (its files still on disk)."""
+    import sofa_tpu.durability as durability
+
+    d = str(tmp_path) + "/"
+    full = _frame(1024)
+    framestore.write_frame_chunks(full, d, "t", chunk_rows=256)
+
+    def boom(*a, **k):
+        raise OSError("simulated kill before the index commit")
+
+    monkeypatch.setattr(durability, "atomic_write", boom)
+    with pytest.raises(OSError):
+        # a pure shrink to a chunk-aligned prefix: chunks 0-1 reused,
+        # 2-3 stale — the only writes left are the unlinks + the index
+        framestore.write_frame_chunks(full.iloc[:512], d, "t",
+                                      chunk_rows=256)
+    monkeypatch.undo()
+    handle = framestore.open_frame(d, "t")
+    assert handle.rows == 1024
+    pd.testing.assert_frame_equal(handle.read(), full)
+
+
+def test_reader_truncates_uncommitted_tail_rows(tmp_path):
+    """The index is the commit point for ROWS too: a tail chunk file
+    grown past its committed entry (an in-flight live append, or a kill
+    between the tail-chunk replace and the index write) must not leak
+    uncommitted rows — index.rows always agrees with what read returns."""
+    import pyarrow as pa
+    import pyarrow.feather as feather
+
+    d = str(tmp_path) + "/"
+    full = _frame(500)
+    framestore.write_frame_chunks(full.iloc[:300], d, "t", chunk_rows=256)
+    tail = os.path.join(framestore.frame_dir(d, "t"), "000001.arrow")
+    feather.write_feather(
+        pa.Table.from_pandas(full.iloc[256:], preserve_index=False),
+        tail, compression="uncompressed")
+    handle = framestore.open_frame(d, "t")
+    assert handle.rows == 300
+    got = handle.read()
+    assert len(got) == 300
+    pd.testing.assert_frame_equal(
+        got, full.iloc[:300].reset_index(drop=True))
+
+
+def test_all_nan_timestamp_chunk_signs_null_bounds(tmp_path):
+    """All-NaN timestamps sign null (not the non-JSON NaN token) bounds,
+    and an unsigned range is conservatively INCLUDED in time_range reads
+    — the row-level filter stays the authority."""
+    import numpy as np
+
+    d = str(tmp_path) + "/"
+    df = _frame(100)
+    df["timestamp"] = np.nan
+    doc = framestore.write_frame_chunks(df, d, "t", chunk_rows=64)
+    with open(os.path.join(framestore.frame_dir(d, "t"),
+                           framestore.FRAME_INDEX_NAME)) as f:
+        raw = f.read()
+    json.loads(raw, parse_constant=lambda tok: pytest.fail(
+        f"non-standard JSON token {tok} in frame_index.json"))
+    assert all(c["t_min"] is None and c["t_max"] is None
+               for c in doc["chunks"])
+    mc = _mc()
+    assert mc.validate_frame_index(
+        {k: v for k, v in doc.items() if k != "_stats"}) == []
+    handle = framestore.open_frame(d, "t")
+    got = handle.read(time_range=(0.0, 1.0))
+    assert handle.chunks_read == 2  # unsigned chunks were not skipped
+    assert len(got) == 0            # ...but NaN rows fail the row filter
+    assert len(handle.read()) == 100
+
+
+def test_verify_frame_store_and_fsck_repair(tmp_path):
+    """_frames is digest-skipped, so fsck re-hashes every committed
+    chunk against its index-signed sha instead; silent rot is a corrupt
+    verdict and --repair drops the store wholesale (the content-keyed
+    rewrite must never reuse damaged bytes)."""
+    import pyarrow as pa
+    import pyarrow.feather as feather
+
+    from sofa_tpu.durability import sofa_fsck
+    from sofa_tpu.preprocess import sofa_preprocess
+
+    log = seed_raw_logdir(tmp_path)
+    cfg = SofaConfig(logdir=log)
+    sofa_preprocess(cfg)
+    for name in framestore.frame_store_names(log):
+        assert framestore.verify_frame_store(log, name) == []
+    assert sofa_fsck(cfg) == 0
+    name = "tpumon"  # a store that actually carries chunks
+    handle = framestore.open_frame(log, name)
+    c = handle.index["chunks"][0]
+    rot = handle.read().iloc[:c["rows"]].copy()
+    rot["payload"] = rot["payload"] + 1  # same shape, different bytes
+    feather.write_feather(
+        pa.Table.from_pandas(rot, preserve_index=False),
+        os.path.join(framestore.frame_dir(log, name), c["file"]),
+        compression="uncompressed")
+    rel = f"{framestore.FRAMES_DIR_NAME}/{name}/{c['file']}"
+    assert framestore.verify_frame_store(log, name) == [rel]
+    assert sofa_fsck(cfg) == 1
+    assert sofa_fsck(cfg, repair=True) == 0
+    assert framestore.verify_frame_store(log, name) == []
+
+
 def test_open_frame_absent_and_foreign_version(tmp_path):
     d = str(tmp_path) + "/"
     assert framestore.open_frame(d, "ghost") is None
@@ -447,6 +556,28 @@ def test_live_epoch_writes_chunk_store_and_drain_converges(tmp_path):
     assert sofa_live(cfg, epochs=1) == 0
     h2 = framestore.open_frame(log, "tpumon")
     pd.testing.assert_frame_equal(h2.read(), batch)
+
+
+def test_live_columnar_degrade_keeps_full_fidelity_csv(tmp_path,
+                                                       monkeypatch):
+    """When the per-frame columnar write degrades to CSV, the live
+    writer must NOT overwrite that full-fidelity CSV with the
+    downsampled viz copy — the degraded CSV is the frame's only
+    artifact (preprocess._write_one's early return, mirrored)."""
+    from sofa_tpu.live import _write_frame_atomic
+
+    d = str(tmp_path) + "/"
+    df = _frame(500)
+
+    def refuse(*a, **k):
+        raise RuntimeError("simulated arrow conversion failure")
+
+    monkeypatch.setattr(framestore, "write_frame_chunks", refuse)
+    cfg = SofaConfig(logdir=d, viz_downsample_to=10)
+    _write_frame_atomic(df, d + "t", cfg, fmt="columnar")
+    assert framestore.open_frame(d, "t") is None
+    got = read_frame(d + "t")
+    assert len(got) == 500  # full fidelity, not the 10-row viz copy
 
 
 # --- frame_index schema contract --------------------------------------------

@@ -427,8 +427,15 @@ def _run_whatif_cell(workdir: str, synth: str, mc) -> List[str]:
     # the synth harness has no raw xplane — restore the device frames so
     # the replay calibrates against real step spans (as it would on a
     # capture whose xplane ingest succeeded while the pcap rotted).
+    # Preprocess also committed (empty) columnar stores for them, and
+    # read_frame prefers chunks over csv — drop the stores so the
+    # restored CSVs are authoritative, as trace.write_frame's csv mode
+    # would.
+    from sofa_tpu import frames as framestore
+
     for fname in ("tpusteps.csv", "tputrace.csv"):
         shutil.copy(synth + fname, cfg.path(fname))
+        framestore.delete_frame_store(logdir, fname[:-len(".csv")])
     rc = sofa_whatif(cfg)
     if rc not in (0, 1):
         problems.append(f"sofa whatif rc={rc} on a degraded trace "

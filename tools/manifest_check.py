@@ -829,14 +829,18 @@ def validate_frame_index(doc) -> List[str]:
         chunks = []
     total = 0
     for i, c in enumerate(chunks):
+        # t_min/t_max are null (together) when every timestamp in the
+        # chunk is NaN — readers then include the chunk conservatively
+        t_ok = ((_is_num(c.get("t_min")) and _is_num(c.get("t_max")))
+                or (c.get("t_min") is None and c.get("t_max") is None)) \
+            if isinstance(c, dict) else False
         if not isinstance(c, dict) or not isinstance(c.get("file"), str) \
                 or not isinstance(c.get("sha"), str) \
                 or not isinstance(c.get("rows"), int) \
                 or isinstance(c.get("rows"), bool) or c.get("rows") < 1 \
-                or not _is_num(c.get("t_min")) \
-                or not _is_num(c.get("t_max")):
+                or not t_ok:
             probs.append(f"chunks[{i}]: needs file, sha, positive rows, "
-                         "and numeric t_min/t_max")
+                         "and numeric (or paired-null) t_min/t_max")
             continue
         total += c["rows"]
         if isinstance(step, int) and step >= 1:
