@@ -499,6 +499,48 @@ try:
         _sh.rmtree(_fw, ignore_errors=True)
 except Exception as e:
     out["fleet_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# catalog-index evidence (sofa_tpu/archive/index.py): the fleet query
+# path's steady-state numbers on a synthetic fleet archive —
+# catalog_index_refresh_wall_time_s is the SUFFIX-ONLY refresh after one
+# appended ingest (the per-ingest commit-point cost) and
+# fleet_query_wall_time_s is the indexed sol-distance worst-offender
+# ranking (the board's /v1/query).  The index answer is asserted equal
+# to the linear scan before either number is emitted — a fast wrong
+# answer is not evidence.  tools/catalog_bench.py prints the full
+# 50k-run scan-vs-index table; needs no hardware, so both ride
+# dead-tunnel rounds.
+try:
+    sys.path.insert(0, os.path.join({root!r}, "tools"))
+    from catalog_bench import synthesize as _cat_synth
+    from sofa_tpu.archive import catalog as _acat
+    from sofa_tpu.archive import index as _aindex
+    from sofa_tpu.archive.store import ArchiveStore as _AStore
+    _cw = _tf.mkdtemp(prefix="sofa_catidx_")
+    _croot = os.path.join(_cw, "archive")
+    _cat_synth(_croot, 400)
+    _aindex.refresh(_croot)
+    _run = "e" * 64
+    with open(os.path.join(_croot, "runs", _run + ".json"), "w") as f:
+        json.dump({{"run": _run, "hostname": "hostX", "t": 1.8e9,
+                   "features": {{"elapsed_time": 1.0,
+                                "tpu0_sol_distance": 3.3}}}}, f)
+    _acat.append_event(_croot, "ingest", run=_run, logdir="/x",
+                       files=1, new_objects=1, bytes_added=10)
+    t0 = time.perf_counter()
+    _inc = _aindex.refresh(_croot)
+    out["catalog_index_refresh_wall_time_s"] = round(
+        time.perf_counter() - t0, 4)
+    if _inc is None or _inc["_stats"]["full"]:
+        out["catalog_evidence_error"] = "suffix refresh fell to full"
+    t0 = time.perf_counter()
+    _oi = _aindex.offenders(_croot, limit=20)
+    out["fleet_query_wall_time_s"] = round(time.perf_counter() - t0, 4)
+    _os2 = _aindex.offenders_scan(_AStore(_croot), limit=20)
+    if _oi != _os2:
+        out["catalog_evidence_error"] = "index != scan ranking"
+    _sh.rmtree(_cw, ignore_errors=True)
+except Exception as e:
+    out["catalog_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # durability evidence (sofa_tpu/durability.py): fsck over the healthy
 # logdir, then drop the preprocess commit marker (a crash one instruction
 # before the commit) and time `sofa resume` — the number proves committed
@@ -553,7 +595,9 @@ print(json.dumps(out))
                     "analyze_evidence_error", "whatif_identity_error_pct",
                     "whatif_evidence_error", "fleet_push_wall_time_s",
                     "fleet_evidence_error", "live_epoch_wall_time_s",
-                    "live_lag_events", "live_evidence_error"):
+                    "live_lag_events", "live_evidence_error",
+                    "catalog_index_refresh_wall_time_s",
+                    "fleet_query_wall_time_s", "catalog_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
@@ -579,6 +623,13 @@ print(json.dumps(out))
                  f"{out['live_epoch_wall_time_s']}s, drained "
                  f"{out.get('live_lag_events')} lagged event(s) "
                  "(tail-append, zero committed chunks reparsed)")
+        if "fleet_query_wall_time_s" in out:
+            _log(f"bench: catalog index suffix refresh "
+                 f"{out.get('catalog_index_refresh_wall_time_s')}s, "
+                 f"indexed sol-rank query "
+                 f"{out['fleet_query_wall_time_s']}s "
+                 "(scan-identical, tools/catalog_bench.py has the "
+                 "50k table)")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.
@@ -698,7 +749,9 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "analyze_wall_time_s", "whatif_identity_error_pct",
                      "fleet_push_wall_time_s", "live_epoch_wall_time_s",
                      "live_lag_events", "frame_load_wall_time_s",
-                     "analyze_peak_rss_mb")
+                     "analyze_peak_rss_mb",
+                     "catalog_index_refresh_wall_time_s",
+                     "fleet_query_wall_time_s")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

@@ -59,10 +59,12 @@ def percentile(xs: List[float], pct: float) -> float:
 # ---------------------------------------------------------------------------
 
 # Higher is worse: durations, latencies, skew, overhead, model error,
-# peak memory (the out-of-core frame store's analyze_peak_rss_mb).
+# peak memory (the out-of-core frame store's analyze_peak_rss_mb), and
+# speed-of-light distance (sol_roofline: how far measured kernels sit
+# from the hardware's attainable peak — the fleet board's ranking key).
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
-    r"|_idle|_error_pct$|_rss_mb$)")
+    r"|_idle|_error_pct$|_rss_mb$|_sol_distance$)")
 # Lower is worse: rates and utilization.
 _WORSE_LOW = re.compile(
     r"(bandwidth|_gbps|per_sec|throughput|flops|images_per_sec|_util$)")
@@ -89,9 +91,24 @@ def rolling_samples(store, rolling: int,
                     ) -> Dict[str, List[float]]:
     """Per-feature sample lists from the newest ``rolling`` archived runs
     (catalog order, the run under test excluded so it cannot vouch for
-    itself)."""
+    itself).
+
+    Index-fed when the archive carries a CURRENT columnar index
+    (archive/index.py — same selection rules, zero run-doc opens and no
+    catalog re-parse, proven verdict-identical by
+    tests/test_archive_index.py); falls back to the linear catalog scan
+    otherwise.  ``SOFA_ARCHIVE_INDEX=0`` forces the scan."""
+    import os
+
     from sofa_tpu.archive import catalog
 
+    if os.environ.get("SOFA_ARCHIVE_INDEX", "1") != "0":
+        from sofa_tpu.archive import index as aindex
+
+        hit = aindex.rolling_samples(store.root, rolling,
+                                     exclude_run=exclude_run)
+        if hit is not None:
+            return hit
     entries = catalog.ingest_entries(catalog.read_catalog(store.root))
     out: Dict[str, List[float]] = {}
     taken = 0

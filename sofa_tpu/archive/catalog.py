@@ -20,6 +20,7 @@ stays append-only (`sofa archive gc` is the only compaction path).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -27,9 +28,47 @@ from typing import Dict, List, Optional
 
 from sofa_tpu.archive import CATALOG_NAME
 
+#: Rewrite-generation sidecar (`catalog.gen`): bumped by every
+#: :func:`rewrite` so the columnar catalog index (archive/index.py) can
+#: detect a gc compaction DETERMINISTICALLY — a compaction that happens
+#: to keep the head bytes and grow the file back past the index's
+#: committed offset would otherwise be invisible to the size/head-
+#: signature checks alone.
+GEN_NAME = "catalog.gen"
+
+#: Bytes of the catalog head signed into the index commit: a different
+#: head under the same path is a rewritten ledger, not an append (the
+#: `sofa live` rotation discipline applied to the catalog).
+HEAD_SIG_BYTES = 256
+
 
 def catalog_path(root: str) -> str:
     return os.path.join(root, CATALOG_NAME)
+
+
+def generation(root: str) -> int:
+    """The catalog's rewrite generation (0 until the first rewrite)."""
+    try:
+        with open(os.path.join(root, GEN_NAME)) as f:
+            doc = json.load(f)
+        return int(doc.get("gen", 0))
+    except (OSError, ValueError, TypeError):
+        return 0
+
+
+def head_sig(root: str, length: "int | None" = None) -> str:
+    """sha1 over the catalog's first ``min(HEAD_SIG_BYTES, length)``
+    bytes (whole head when ``length`` is None).  The columnar index signs
+    exactly its committed prefix's head, so an append past a short
+    catalog never masquerades as a rewrite — and a rewrite under the
+    same size never masquerades as an append."""
+    n = HEAD_SIG_BYTES if length is None else min(HEAD_SIG_BYTES,
+                                                  max(int(length), 0))
+    try:
+        with open(catalog_path(root), "rb") as f:
+            return hashlib.sha1(f.read(n)).hexdigest()
+    except OSError:
+        return hashlib.sha1(b"").hexdigest()
 
 
 def append_event(root: str, ev: str, **fields) -> dict:
@@ -85,9 +124,20 @@ def bench_entries(entries: List[dict],
 
 def rewrite(root: str, entries: List[dict]) -> None:
     """Atomically replace the catalog (gc's compaction path — the ONLY
-    writer that is not an append)."""
-    from sofa_tpu.durability import atomic_write
+    writer that is not an append).
 
-    with atomic_write(catalog_path(root), fsync=True) as f:
-        for e in entries:
-            f.write(json.dumps(e, separators=(",", ":")) + "\n")
+    Holds the root's ``derived_write_guard`` for the replace (reentrant:
+    `sofa archive gc` already holds it around the whole sweep, a direct
+    caller gets its own) so a reader mid-``read_catalog`` — or the fleet
+    service answering ``/v1/catalog`` — sees the 503/mid-write signal
+    instead of racing the swap, and bumps the rewrite generation so the
+    columnar index (archive/index.py) invalidates deterministically."""
+    from sofa_tpu.durability import atomic_write
+    from sofa_tpu.trace import derived_write_guard
+
+    with derived_write_guard(root):
+        with atomic_write(catalog_path(root), fsync=True) as f:
+            for e in entries:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        with atomic_write(os.path.join(root, GEN_NAME), fsync=True) as f:
+            json.dump({"gen": generation(root) + 1}, f)

@@ -82,6 +82,15 @@ _MAX_BODY = 1 << 30
 
 _RETRY_AFTER_S = "1"
 
+#: CORS grant on the read-only query route (the fleet board is served by
+#: `sofa viz` on another origin).  Writes carry no CORS headers at all —
+#: browsers cannot be made into upload agents.
+_CORS_HEADERS = (
+    ("Access-Control-Allow-Origin", "*"),
+    ("Access-Control-Allow-Headers", "Authorization, If-None-Match"),
+    ("Access-Control-Allow-Methods", "GET, OPTIONS"),
+)
+
 
 def _chaos_exit_after() -> int:
     """The kill-service-mid-upload chaos knob (0 = off)."""
@@ -198,18 +207,24 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
     def _json(self, code: int, doc: dict,
-              retry_after: "str | None" = None) -> None:
+              retry_after: "str | None" = None,
+              extra_headers: "List[tuple] | None" = None) -> None:
         body = json.dumps(doc).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if retry_after is not None:
             self.send_header("Retry-After", retry_after)
+        for key, value in extra_headers or ():
+            self.send_header(key, value)
         self.end_headers()
         try:
             self.wfile.write(body)
         except OSError:
-            pass  # client went away mid-answer; nothing to salvage
+            # client went away mid-answer — nothing to salvage, but the
+            # operator sees the churn in the shutdown stats line (the
+            # SL002 discipline: routed, never silently swallowed)
+            self._count("client_disconnect")
 
     def _count(self, key: str) -> None:
         self.server.count_response(key)
@@ -231,17 +246,28 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return None
         return data
 
-    def _route(self) -> "Tuple[str, List[str]] | None":
+    def _route(self, allow_token_param: bool = False
+               ) -> "Tuple[str, List[str]] | None":
         """(tenant, path segments under the tenant) for an authed /v1/
-        route; answers the error itself and returns None otherwise."""
+        route; answers the error itself and returns None otherwise.
+        ``allow_token_param`` additionally accepts ``?token=`` (the
+        read-only query route the fleet board polls cross-origin — a
+        browser page cannot always attach an Authorization header)."""
         parts = [p for p in self.path.split("?", 1)[0].split("/") if p]
         if len(parts) < 2 or parts[0] != "v1":
             self._json(404, {"error": "no_such_route"})
             return None
         if not self.server.auth_ok(self.headers.get("Authorization")):
-            self._count("401_unauthorized")
-            self._json(401, {"error": "unauthorized"})
-            return None
+            tok = None
+            if allow_token_param:
+                import urllib.parse
+
+                qs = urllib.parse.parse_qs(self.path.partition("?")[2])
+                tok = (qs.get("token") or [None])[0]
+            if not (tok and hmac.compare_digest(tok, self.server.token)):
+                self._count("401_unauthorized")
+                self._json(401, {"error": "unauthorized"})
+                return None
         tenant = parts[1]
         if not _TENANT_RE.match(tenant) or tenant in (
                 TENANTS_DIR_NAME, "..", "."):
@@ -270,26 +296,16 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(200, {"ok": True, "schema": SERVICE_SCHEMA,
                              "version": SERVICE_VERSION})
             return
-        routed = self._route()
+        routed = self._route(allow_token_param=clean.endswith("/query"))
         if routed is None:
             return
         tenant, rest = routed
         store = ArchiveStore(self.server.tenant_root(tenant))
         if rest == ["catalog"]:
-            try:
-                with open(catalog.catalog_path(store.root), "rb") as f:
-                    body = f.read()
-            except OSError:
-                body = b""
-            self.send_response(200)
-            self.send_header("Content-Type", "application/jsonl")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            try:
-                self.wfile.write(body)
-            except OSError:
-                pass  # client went away; the catalog is still on disk
-            self._count("catalog_read")
+            self._catalog(tenant, store)
+            return
+        if rest == ["query"]:
+            self._query(tenant, store)
             return
         if len(rest) == 2 and rest[0] == "run" and store.exists:
             doc = store.load_run(rest[1]) if _SHA_RE.match(rest[1]) else None
@@ -300,6 +316,120 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(200, doc)
             return
         self._json(404, {"error": "no_such_route"})
+
+    def do_OPTIONS(self):  # noqa: N802 — CORS preflight for the board
+        # The fleet board (board/fleet.html, served by `sofa viz` on a
+        # DIFFERENT origin) polls /v1/<tenant>/query with a bearer
+        # token; the browser preflights that.  Preflights carry no
+        # credentials by design, so this answers unauthenticated — it
+        # grants nothing but the right to ASK.
+        if not self.path.startswith("/v1/"):
+            self._json(404, {"error": "no_such_route"})
+            return
+        self.send_response(204)
+        for key, value in _CORS_HEADERS:
+            self.send_header(key, value)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _catalog_etag(self, store: ArchiveStore) -> "Tuple[str, int]":
+        """(ETag, byte size) keyed on the catalog's size+mtime — the
+        fallback-mode key (no index needed): any append or rewrite moves
+        it, so a 304 is always safe."""
+        try:
+            st = os.stat(catalog.catalog_path(store.root))
+            return f'"cat-{st.st_size:x}-{st.st_mtime_ns:x}"', st.st_size
+        except OSError:
+            return '"cat-0-0"', 0
+
+    def _catalog(self, tenant: str, store: ArchiveStore) -> None:
+        """Stream the raw catalog (the board's legacy whole-file path —
+        /v1/query supersedes it for the fleet board): Content-Length +
+        ETag on size+mtime, 304 on If-None-Match, 503 while the tenant
+        root is mid-gc (the rewrite now holds the write guard), and a
+        client hanging up mid-stream is counted, not swallowed."""
+        if self._backpressure(tenant):
+            return
+        etag, size = self._catalog_etag(store)
+        if self.headers.get("If-None-Match") == etag:
+            self._count("304_catalog")
+            self.send_response(304)
+            self.send_header("ETag", etag)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Content-Length", str(size))
+        self.send_header("ETag", etag)
+        self.end_headers()
+        remaining = size
+        try:
+            with open(catalog.catalog_path(store.root), "rb") as f:
+                while remaining > 0:
+                    chunk = f.read(min(remaining, 1 << 16))
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+                    remaining -= len(chunk)
+        except OSError:
+            # mid-stream disconnect (or a vanished catalog): the bytes
+            # already promised cannot be completed — count it so the
+            # operator sees the churn (SL002: routed, never silent)
+            self._count("client_disconnect")
+            return
+        self._count("catalog_read")
+
+    def _query(self, tenant: str, store: ArchiveStore) -> None:
+        """``GET /v1/<tenant>/query`` — the indexed fleet query endpoint
+        (docs/FLEET.md): filter/sort/limit/since over runs and features,
+        ETag keyed on the index COMMIT SHA (fallback: catalog
+        size+mtime), offset/limit pagination.  Read-only: consumes no
+        write slot and answers regardless of quota state — a tenant that
+        cannot upload can still ask what the fleet looks like."""
+        import urllib.parse
+
+        from sofa_tpu.archive import index as aindex
+
+        if self._backpressure(tenant):
+            return
+        qs = urllib.parse.parse_qs(self.path.partition("?")[2])
+
+        def one(key, default=None):
+            return (qs.get(key) or [default])[0]
+
+        kind = one("kind", "runs")
+        if kind not in ("runs", "features"):
+            self._json(400, {"error": "bad_kind",
+                             "kinds": ["runs", "features"]})
+            return
+        try:
+            since = float(one("since")) if one("since") else None
+            limit = int(one("limit") or aindex.QUERY_DEFAULT_LIMIT)
+            offset = int(one("offset") or 0)
+        except ValueError:
+            self._json(400, {"error": "bad_params"})
+            return
+        doc = aindex.query(store.root, kind=kind, host=one("host"),
+                           label=one("label"), since=since,
+                           feature=one("feature"), limit=limit,
+                           offset=offset)
+        if doc.get("commit_sha"):
+            etag = f'"idx-{doc["commit_sha"]}"'
+        else:
+            etag, _size = self._catalog_etag(store)
+        headers = [("ETag", etag)] + list(_CORS_HEADERS)
+        if self.headers.get("If-None-Match") == etag:
+            self._count("304_query")
+            self.send_response(304)
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            return
+        self._count(f"query_{doc.get('source', '?')}")
+        self._json(200, {"schema": SERVICE_SCHEMA,
+                         "version": SERVICE_VERSION,
+                         "tenant": tenant, **doc},
+                   extra_headers=headers)
 
     # -- POST (have / commit) ----------------------------------------------
     def do_POST(self):  # noqa: N802 — http.server handler contract
@@ -395,6 +525,13 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 new_objects=0, bytes_added=0, via="service",
                 **({"label": str(doc["label"])} if doc.get("label")
                    else {}))
+            # serve's commit point = index refresh point, like a local
+            # ingest: the suffix parse folds this one catalog line in so
+            # the next /v1/query is index-fed (failure degrades to the
+            # scan path with a warning, never a failed commit)
+            from sofa_tpu.archive import index as aindex
+
+            aindex.refresh_after_ingest(store.root)
         self._count("commit" if not already else "commit_replayed")
         self._json(200, {
             "run": run_id, "committed": True, "new": not already,

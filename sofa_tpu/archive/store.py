@@ -53,10 +53,13 @@ _HASH_CHUNK = 1 << 20
 # (object bytes no longer hash to its name), ``missing`` (a run doc
 # references an absent object), ``orphaned`` (``*.tmp`` leftovers of an
 # interrupted write), ``uncataloged`` (a run doc the catalog never
-# committed — recoverable: --repair re-appends its ingest line).
+# committed — recoverable: --repair re-appends its ingest line),
+# ``index`` (a columnar-index chunk whose bytes stopped matching its
+# index-signed sha — pure derived state: --repair drops + rebuilds it).
 # ``unreferenced`` objects (no surviving run points at them) are reported
 # but are NOT damage: they are what `sofa archive gc` exists to sweep.
-ARCHIVE_FSCK_VERDICTS = ("corrupt", "missing", "orphaned", "uncataloged")
+ARCHIVE_FSCK_VERDICTS = ("corrupt", "missing", "orphaned", "uncataloged",
+                         "index")
 
 
 class ArchiveStore:
@@ -310,11 +313,25 @@ def ingest_run(cfg, root: str, label: str = "",
                              files=len(files), new_objects=new_objects,
                              bytes_added=bytes_added,
                              **({"label": label} if label else {}))
+    # Ingest commit point = index refresh point (archive/index.py): the
+    # suffix-only parse folds exactly this ingest's catalog line in.  It
+    # runs INSIDE the journaled archive stage, so a kill mid-refresh
+    # leaves the stage uncommitted and `sofa resume` replays ingest +
+    # refresh to the identical bytes (the commit doc carries no clock).
+    from sofa_tpu import pool
+    from sofa_tpu.archive import index as aindex
+
+    with maybe_span("archive_index", cat="stage"):
+        idx = aindex.refresh_after_ingest(root, jobs=pool.cfg_jobs(cfg))
     journal.commit("archive", key=durability.logdir_raw_key(logdir),
                    run=run_id)
     summary = {"run": run_id, "files": len(files),
                "new_objects": new_objects, "bytes_added": bytes_added,
                "wall_s": round(time.perf_counter() - t0, 3)}
+    if idx is not None:
+        summary["index"] = {"runs": idx.get("runs"),
+                            "events": idx.get("events"),
+                            **(idx.get("_stats") or {})}
     if tel is not None:
         tel.set_meta(archive={**summary, "root": os.path.abspath(root)})
     print_progress(
@@ -448,6 +465,12 @@ def _gc_locked(root: str, keep: int, keep_days: float) -> dict:
     summary = {"dropped_runs": len(dropped), "swept_objects": swept,
                "freed_bytes": freed}
     catalog.append_event(root, "gc", **summary)
+    # The rewrite bumped the catalog generation, deterministically
+    # invalidating the columnar index — rebuild it at this commit point
+    # so the next query is index-fed instead of paying a full scan.
+    from sofa_tpu.archive import index as aindex
+
+    aindex.refresh_after_ingest(root)
     print_progress(
         f"archive gc: dropped {len(dropped)} run(s), swept {swept} "
         f"object(s), freed {freed / 2**20:.2f} MiB")
@@ -505,6 +528,13 @@ def archive_fsck(root: str, repair: bool = False) -> Optional[dict]:
             if name.endswith(".tmp"):
                 report["orphaned"].append(os.path.relpath(
                     os.path.join(dirpath, name), root).replace(os.sep, "/"))
+    # The columnar catalog index (archive/index.py) is digest-less pure
+    # derived state — integrity is its per-chunk index-signed shas, and
+    # THIS is where that claim is enforced (the frames.verify_frame_store
+    # discipline applied to the archive).
+    from sofa_tpu.archive import index as aindex
+
+    report["index"] = aindex.verify(root)
     report["checked"] = checked
     if repair:
         _archive_repair(store, report)
@@ -589,6 +619,22 @@ def _archive_repair(store: ArchiveStore, report: Dict[str, list]) -> None:
             report["orphaned"].remove(rel)
         except OSError as e:
             print_warning(f"archive fsck: cannot sweep {rel}: {e}")
+    if report.get("index"):
+        # pure derived state: drop the damaged index wholesale and
+        # rebuild from the catalog + run docs (reusing a chunk whose
+        # signed sha still matched would keep rotted bytes alive — the
+        # frame-store repair rule)
+        from sofa_tpu.archive import index as aindex
+
+        aindex.drop(root)
+        rebuilt = aindex.refresh_after_ingest(root)
+        still = aindex.verify(root)
+        if rebuilt is not None and not still:
+            report["index"] = []
+            print_progress("archive fsck: dropped the damaged columnar "
+                           "index and rebuilt it from the catalog")
+        else:
+            report["index"] = still or report["index"]
 
 
 # ---------------------------------------------------------------------------
@@ -646,12 +692,85 @@ def _fmt_mib(n) -> str:
     return f"{(n or 0) / 2**20:.2f}MiB"
 
 
-def render_ls(root: str) -> List[str]:
-    entries = catalog.read_catalog(root)
-    runs = catalog.ingest_entries(entries)
-    bench = catalog.bench_entries(entries)
-    lines = [f"archive: {root} — {len(runs)} run(s), "
-             f"{len(bench)} bench event(s)"]
+def _parse_since(spec: str) -> Optional[float]:
+    """``--since`` → unix-time cutoff: a plain number is an absolute
+    timestamp; ``<N>d``/``<N>h``/``<N>m`` are relative to now.  None (and
+    a warning) on an unparsable spec — a bad filter must not silently
+    show everything as if it matched."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    unit = {"d": 86400.0, "h": 3600.0, "m": 60.0}.get(spec[-1].lower())
+    try:
+        if unit is not None:
+            return time.time() - float(spec[:-1]) * unit
+        return float(spec)
+    except ValueError:
+        print_warning(f"archive ls: cannot parse --since {spec!r} "
+                      "(want a unix timestamp, or e.g. 7d / 12h / 30m) "
+                      "— the filter is ignored")
+        return None
+
+
+def _ls_runs(root: str, cfg=None):
+    """(filtered runs, total runs, bench count, source) for `ls` — the
+    index-fed fast path when a CURRENT index exists (SOFA_ARCHIVE_INDEX=0
+    opts out), else the linear scan; BOTH apply the one filter contract
+    (index.filter_runs — the tail read applies the same predicates
+    vectorized) and feed the one renderer, so the output is
+    byte-identical either way (proven by test_archive_index.py)."""
+    from sofa_tpu.archive import index as aindex
+
+    host = getattr(cfg, "archive_host", "") or None
+    label = getattr(cfg, "archive_label", "") or None
+    since = _parse_since(getattr(cfg, "archive_since", "") or "")
+    limit = int(getattr(cfg, "archive_limit", 0) or 0) or None
+
+    if limit:
+        # newest-N: O(result) — only the tail chunks that hold the
+        # answer are read, the totals come from the commit manifest
+        tail = aindex.run_entries_tail(root, limit, host=host,
+                                       label=label, since=since)
+        if tail is not None:
+            runs, total, bench_count = tail
+            return runs, total, bench_count, "index"
+    runs_all = aindex.run_entries(root)
+    bench_count = None
+    if runs_all is not None:
+        bench_count = int((aindex.load_commit(root) or {})
+                          .get("bench_events") or 0)
+    host_of = None
+    source = "index"
+    if runs_all is None:
+        entries = catalog.read_catalog(root)
+        runs_all = catalog.ingest_entries(entries)
+        bench_count = len(catalog.bench_entries(entries))
+        source = "scan"
+        store = ArchiveStore(root)
+
+        def host_of(run_id):
+            # the O(fleet)-doc-opens cost the index deletes: only paid
+            # when --host filters on the scan path
+            return str((store.load_run(run_id) or {})
+                       .get("hostname") or "")
+
+    runs = aindex.filter_runs(runs_all, host=host, label=label,
+                              since=since, limit=limit, host_of=host_of)
+    return runs, len(runs_all), bench_count, source
+
+
+def render_ls(root: str, runs: "List[dict] | None" = None,
+              total_runs: "int | None" = None,
+              bench_count: "int | None" = None) -> List[str]:
+    if runs is None:
+        entries = catalog.read_catalog(root)
+        runs = catalog.ingest_entries(entries)
+        bench_count = len(catalog.bench_entries(entries))
+        total_runs = len(runs)
+    shown = (f"{len(runs)} run(s)" if len(runs) == total_runs
+             else f"{len(runs)} of {total_runs} run(s)")
+    lines = [f"archive: {root} — {shown}, "
+             f"{bench_count} bench event(s)"]
     rows = [["RUN", "WHEN", "FILES", "ADDED", "LOGDIR"]]
     for e in runs:
         when = time.strftime("%Y-%m-%d %H:%M",
@@ -710,7 +829,9 @@ def sofa_archive(cfg, action: str, arg: str = "",
             print_error(f"no archive at {root} — `sofa archive <logdir>` "
                         "creates one")
             return 2
-        print("\n".join(render_ls(root)))
+        runs, total, bench_count, _source = _ls_runs(root, cfg)
+        print("\n".join(render_ls(root, runs, total_runs=total,
+                                  bench_count=bench_count)))
         return 0
     if action == "show":
         store = ArchiveStore(root)

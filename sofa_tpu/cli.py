@@ -270,6 +270,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="archive gc: keep the newest N runs")
     g.add_argument("--keep_days", type=float, dest="archive_keep_days",
                    help="archive gc: keep runs ingested within D days")
+    g.add_argument("--limit", type=int, dest="archive_limit",
+                   help="archive ls: show only the newest N runs "
+                        "(index-fed when the columnar catalog index is "
+                        "current — docs/ARCHIVE.md)")
+    g.add_argument("--since", dest="archive_since",
+                   help="archive ls: only runs ingested since (a unix "
+                        "timestamp, or relative like 7d / 12h / 30m)")
+    g.add_argument("--host", dest="archive_host",
+                   help="archive ls: only runs ingested from this host")
     g.add_argument("--rolling", type=int, dest="regress_rolling",
                    help="regress: compare against a rolling baseline over "
                         "the newest N archived runs instead of a second "
@@ -390,6 +399,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "hint_server", "iterations_from",
         "base_logdir", "match_logdir", "viz_port", "viz_bind", "plugins",
         "archive_root", "archive_label", "archive_keep", "archive_keep_days",
+        "archive_limit", "archive_since", "archive_host",
         "regress_rolling", "regress_pct", "regress_threshold",
         "live_interval_s", "live_epochs", "live_stall_s",
         "serve_bind", "serve_port", "serve_token", "serve_quota_mb",

@@ -182,9 +182,14 @@ class SofaConfig:
     # --- archive / regress (sofa_tpu/archive/) ------------------------------
     archive_root: str = ""           # --archive_root; empty = SOFA_ARCHIVE_ROOT
                                      # env, else ./sofa_archive
-    archive_label: str = ""          # --label tag on `sofa archive <logdir>`
+    archive_label: str = ""          # --label tag on `sofa archive <logdir>`;
+                                     # also the `archive ls --label` filter
     archive_keep: int = 0            # `sofa archive gc --keep N`
     archive_keep_days: float = 0.0   # `sofa archive gc --keep_days D`
+    archive_limit: int = 0           # `archive ls --limit N` newest runs
+                                     # (0 = all)
+    archive_since: str = ""          # `archive ls --since <unix|7d|12h|30m>`
+    archive_host: str = ""           # `archive ls --host <hostname>` filter
     regress_rolling: int = 0         # `sofa regress --rolling N` catalog
                                      # baseline (0 = pairwise only)
     regress_pct: float = 50.0        # rolling-baseline percentile

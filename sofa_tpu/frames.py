@@ -130,17 +130,35 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
     content hash: an unchanged frame rewrites nothing, and an append
     rewrites only the last partial chunk + the new tail.  The index is
     the commit point — written last, fsync'd, atomic."""
+    # joined inline (= frame_dir) so the artifact-flow lint (SL014) sees
+    # the _frames registry fragment on the writer's path expression
+    sdir = os.path.join(logdir, FRAMES_DIR_NAME, name)
+    from sofa_tpu.trace import COLUMNS
+
+    return write_chunk_store(_conformed(df), sdir, name,
+                             columns=list(COLUMNS),
+                             chunk_rows=chunk_rows)
+
+
+def write_chunk_store(df: pd.DataFrame, sdir: str, name: str,
+                      columns: "List[str] | None" = None,
+                      chunk_rows: "int | None" = None,
+                      time_column: str = "timestamp") -> dict:
+    """The chunk-store writer, generalized: ``columns`` pins the schema
+    the index signs (default: the frame's own column order — the archive
+    index's catalog/features families ride this with their own schemas,
+    write_frame_chunks pins trace.COLUMNS).  Same contracts as the frame
+    store: content-keyed fixed-boundary chunks, atomic chunk files, the
+    fsync'd index written LAST as the commit point."""
     import pyarrow as pa
     import pyarrow.feather as feather
 
     from sofa_tpu.durability import atomic_replace, atomic_write
 
-    df = _conformed(df)
+    if columns is not None and list(df.columns) != list(columns):
+        df = df[list(columns)]
     rows = int(len(df))
     step = int(chunk_rows or CHUNK_ROWS)
-    # joined inline (= frame_dir) so the artifact-flow lint (SL014) sees
-    # the _frames registry fragment on the writer's path expression
-    sdir = os.path.join(logdir, FRAMES_DIR_NAME, name)
     os.makedirs(sdir, exist_ok=True)
     index_path = os.path.join(sdir, FRAME_INDEX_NAME)
     prev = _load_index(index_path)
@@ -152,8 +170,8 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
     reused = 0
     n_bytes = 0
     row_hashes = _row_hashes(df) if rows else np.empty(0, dtype=np.uint64)
-    ts_all = (df["timestamp"].to_numpy(dtype=float) if rows
-              else np.empty(0))
+    ts_all = (df[time_column].to_numpy(dtype=float)
+              if rows and time_column in df.columns else np.empty(0))
     # one pandas -> arrow conversion for the whole frame; per-chunk
     # writes are zero-copy table slices (converting per chunk would copy
     # every iloc slice and dominate the write stage)
@@ -190,11 +208,12 @@ def write_frame_chunks(df: pd.DataFrame, logdir: str, name: str,
             pass
         chunks.append(entry)
 
-    from sofa_tpu.trace import COLUMNS
-
     doc = {
         "schema": FRAME_INDEX_SCHEMA, "version": FRAME_INDEX_VERSION,
-        "name": name, "columns": list(COLUMNS), "rows": rows,
+        "name": name,
+        "columns": list(columns) if columns is not None
+        else [str(c) for c in df.columns],
+        "rows": rows,
         "chunk_rows": step, "format": "arrow", "chunks": chunks,
     }
     # No wall-clock stamp on purpose: the index is a pure function of the
@@ -259,29 +278,39 @@ def verify_frame_store(logdir: str, name: str) -> List[str]:
     carrying MORE rows than its committed entry is healthy (an in-flight
     live append; readers truncate to the signed count), and only the
     committed prefix is hashed."""
+    return verify_chunk_store(frame_dir(logdir, name),
+                              "/".join([FRAMES_DIR_NAME, name]))
+
+
+def verify_chunk_store(sdir: str, rel_prefix: str) -> List[str]:
+    """The chunk-store integrity check, generalized over any committed
+    store (frame stores AND the archive index's column families): re-hash
+    every committed chunk against its index-signed sha; returns
+    ``<rel_prefix>/<file>`` paths of damaged chunks."""
     if not columnar_available():
         return []  # nothing can read the chunks here; the CSV path rules
     import pyarrow.feather as feather
 
-    sdir = frame_dir(logdir, name)
     index = _load_index(os.path.join(sdir, FRAME_INDEX_NAME))
     if index is None:
         return []
     bad: List[str] = []
     for c in index.get("chunks") or []:
-        rel = "/".join([FRAMES_DIR_NAME, name, c["file"]])
+        rel = "/".join([rel_prefix, c["file"]])
         path = os.path.join(sdir, c["file"])
         rows = int(c.get("rows") or 0)
         try:
             tbl = feather.read_table(path, memory_map=True)
+            if tbl.num_rows < rows:
+                bad.append(rel)
+                continue
+            # to_pandas is inside the try on purpose: rot in a string
+            # buffer surfaces as a decode error HERE, not at read_table
+            df = tbl.slice(0, rows).to_pandas()
         except Exception as e:  # noqa: BLE001 — unreadable == damaged
             print_warning(f"frames: chunk {rel} is unreadable ({e})")
             bad.append(rel)
             continue
-        if tbl.num_rows < rows:
-            bad.append(rel)
-            continue
-        df = tbl.slice(0, rows).to_pandas()
         if _chunk_sha(_row_hashes(df)) != c.get("sha"):
             bad.append(rel)
     return bad
@@ -328,6 +357,50 @@ class FrameHandle:
 
         return [c for c in chunks if overlaps(c)]
 
+    def read_chunk(self, i: int, columns=None) -> pd.DataFrame:
+        """Materialize ONE committed chunk (projected), truncated to its
+        index-signed row count — the tail-read primitive the archive
+        index's newest-N queries use to touch O(result) chunks instead
+        of the whole store."""
+        return self.read_chunk_table(i, columns).to_pandas()
+
+    def read_chunk_table(self, i: int, columns=None):
+        """One committed chunk as a pyarrow Table (projected, truncated
+        to the signed row count) — stays in Arrow so the caller can
+        filter with vectorized compute kernels BEFORE paying the
+        python-object materialization of ``to_pandas``."""
+        import pyarrow.feather as feather
+
+        c = (self.index.get("chunks") or [])[i]
+        cols = None
+        if columns is not None:
+            cols = [x for x in columns if x in self.columns]
+        tbl = feather.read_table(os.path.join(self._sdir, c["file"]),
+                                 columns=cols, memory_map=True)
+        if tbl.num_rows != int(c.get("rows") or 0):
+            tbl = tbl.slice(0, int(c.get("rows") or 0))
+        with self._guard:
+            self.chunks_read += 1
+        if cols is not None:
+            tbl = tbl.select(cols)
+        return tbl
+
+    def read_table(self, columns=None):
+        """The whole committed frame as one pyarrow Table (projected,
+        each chunk truncated to its signed rows) — the Arrow-native read
+        for consumers whose filters run as compute kernels."""
+        import pyarrow as pa
+
+        chunks = self.index.get("chunks") or []
+        if not chunks:
+            cols = ([c for c in columns if c in self.columns]
+                    if columns is not None else self.columns)
+            return pa.table({c: pa.array([], type=pa.null())
+                             for c in cols}) if cols else pa.table({})
+        tables = [self.read_chunk_table(i, columns)
+                  for i in range(len(chunks))]
+        return pa.concat_tables(tables)
+
     def read(self, columns=None, time_range=None) -> pd.DataFrame:
         """Materialize the frame (or a column/time slice of it).
 
@@ -349,8 +422,12 @@ class FrameHandle:
         read_cols = (want + ["timestamp"]) if need_ts else want
         chunks = self._select_chunks(time_range)
         if not chunks or not self.rows:
-            base = empty_frame()
-            return base[want] if want else base
+            from sofa_tpu.trace import COLUMNS
+
+            if self.columns == list(COLUMNS):
+                base = empty_frame()  # the unified schema, exact dtypes
+                return base[want] if want else base
+            return pd.DataFrame(columns=want or self.columns)
         tables = []
         for c in chunks:
             path = os.path.join(self._sdir, c["file"])
@@ -395,6 +472,17 @@ def open_frame(logdir: str, name: str) -> Optional[FrameHandle]:
         print_warning(
             f"frames: {name} has a columnar store but pyarrow is missing "
             "— falling back to the CSV copy (which may be downsampled)")
+        return None
+    return FrameHandle(sdir, index)
+
+
+def open_chunk_store(sdir: str) -> Optional[FrameHandle]:
+    """Open any committed chunk store by directory (the archive index's
+    column families use this — no logdir/frame naming assumed).  None
+    when there is no committed index or pyarrow cannot serve it; callers
+    fall back to their linear-scan path."""
+    index = _load_index(os.path.join(sdir, FRAME_INDEX_NAME))
+    if index is None or not columnar_available():
         return None
     return FrameHandle(sdir, index)
 

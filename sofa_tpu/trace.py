@@ -673,13 +673,33 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # under archive-marked roots that `sofa clean` and the
                  # digest walk already skip wholesale — registering them
                  # keeps the artifact inventory's closure honest.
-                 "agent_state.json", "sofa_fleet.json"]
+                 "agent_state.json", "sofa_fleet.json",
+                 # chunk-store commit manifest (sofa_tpu/frames.py
+                 # write_chunk_store): lives under _frames/<name>/ and
+                 # _index/<family>/ — both swept wholesale via
+                 # DERIVED_DIRS; registered by name because the shared
+                 # writer takes its store directory as a parameter
+                 "frame_index.json",
+                 # archive catalog index (sofa_tpu/archive/index.py):
+                 # the fsync'd-last commit manifest of the columnar
+                 # catalog index and the rewrite-generation sidecar
+                 # `catalog.rewrite` bumps so gc compaction invalidates
+                 # the index deterministically.  Both live in archive-
+                 # marked roots the sweep/digest walks skip wholesale —
+                 # registered for inventory closure, like the fleet
+                 # ledgers above.
+                 "index_commit.json", "catalog.gen"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles",
                 # chunked columnar frame store (sofa_tpu/frames.py): the
                 # default interchange format's home — regenerated by any
                 # preprocess/live run, swept by `sofa clean`
-                "_frames"]
+                "_frames",
+                # archive catalog index (sofa_tpu/archive/index.py): pure
+                # derived state under an archive root — `sofa archive
+                # fsck --repair` drops + rebuilds it; registered for the
+                # same closure reason as the fleet ledgers
+                "_index"]
 
 # Never digested (the fsck ledger's skip-list): the ledgers themselves —
 # they change on every write, including fsck's own — live sentinels, and
@@ -789,21 +809,37 @@ def reap_stale_sentinel(logdir: str) -> bool:
 
 
 class derived_write_guard:
-    """Context manager a writer holds across non-atomic derived writes."""
+    """Context manager a writer holds across non-atomic derived writes.
+
+    Reentrant per process: an inner guard on a root the SAME pid already
+    holds (archive gc holding the guard while ``catalog.rewrite`` takes
+    it again) neither rewrites nor removes the sentinel — the outermost
+    holder owns its lifetime, so nesting can never drop protection
+    mid-write."""
 
     def __init__(self, logdir: str):
         self._path = os.path.join(logdir, WRITING_SENTINEL)
+        self._owned = False
 
     def __enter__(self):
+        try:
+            with open(self._path) as f:
+                if f.read().strip() == str(os.getpid()):
+                    return self  # nested: the outer guard owns the sentinel
+        except (OSError, ValueError):
+            pass
         try:
             os.makedirs(os.path.dirname(self._path), exist_ok=True)
             with open(self._path, "w") as f:  # sofa-lint: disable=SL009 — the sentinel IS the mid-write signal; an atomic rename would defeat its purpose
                 f.write(str(os.getpid()))
+            self._owned = True
         except OSError:
             pass  # best-effort: an unwritable logdir fails later, loudly
         return self
 
     def __exit__(self, *exc):
+        if not self._owned:
+            return False
         try:
             os.unlink(self._path)
         except OSError:

@@ -288,6 +288,15 @@ def sofa_viz(cfg, serve_forever: bool = True):
             "identical tiles compare by hash, no payload fetched). "
             "This route is read-only; `sofa serve` runs the write-capable "
             "fleet ingest service over an archive root (docs/FLEET.md)")
+        from sofa_tpu.archive import index as aindex
+
+        if aindex.is_current(archive_root):
+            print_progress(
+                "fleet board: /fleet.html ranks the archive's worst "
+                "speed-of-light-distance offenders — index-fed from the "
+                "columnar catalog index (archive ls / regress --rolling "
+                "read the same index; docs/ARCHIVE.md). Point it at a "
+                "`sofa serve` /v1/query endpoint for the live fleet view")
     from sofa_tpu.live import OFFSETS_NAME
 
     if os.path.isfile(os.path.join(cfg.logdir, OFFSETS_NAME)):

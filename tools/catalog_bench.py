@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""Scan-vs-index wall-time table for the archive's fleet queries.
+
+Synthesizes an N-run archive (catalog.jsonl + one ``runs/<id>.json`` doc
+per run, realistic file maps and feature vectors, default 50 000 runs),
+builds the columnar catalog index (sofa_tpu/archive/index.py), and times
+the three fleet queries both ways:
+
+  ls          ``archive ls --limit 20`` — newest-20 run listing
+  rolling     the `sofa regress --rolling 20` baseline window
+  rank        the fleet board's ``tpu*_sol_distance`` worst-offender
+              ranking (the O(fleet)-doc-opens query)
+
+Each query's results are asserted IDENTICAL between the scan and index
+paths before a single number prints — a fast wrong answer is not a
+result.  Also reports the cold index build, the suffix-only refresh
+after an append, and the warm no-op refresh (0 bytes parsed).
+
+bench.py carries the same pair every round as
+``catalog_index_refresh_wall_time_s`` / ``fleet_query_wall_time_s`` on
+success AND dead-tunnel paths (archived, ``_wall`` polarity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def synthesize(root: str, n_runs: int, n_hosts: int = 200) -> None:
+    """An N-run archive shaped like a real fleet: per-run docs with a
+    ~20-entry file map and a ~12-feature vector (4 per-device sol
+    distances), plus one catalog ingest line each.  Written with plain
+    buffered IO — this is a synthetic corpus, not a durability test."""
+    from sofa_tpu.archive.store import ArchiveStore
+
+    ArchiveStore(root, create=True)
+    rdir = os.path.join(root, "runs")
+    lines = []
+    file_map = {f"f{j:02d}.csv": {"sha256": f"{j:064x}", "bytes": 1000 + j,
+                                  "kind": "derived"} for j in range(20)}
+    for i in range(n_runs):
+        run = f"{i:064x}"
+        t = 1_700_000_000.0 + i
+        host = f"host{i % n_hosts}"
+        label = "nightly" if i % 3 else "release"
+        feats = {
+            "elapsed_time": 120.0 + (i % 613) * 0.01,
+            "step_time_mean": 0.05 + (i % 101) * 1e-4,
+            "preprocess_wall_time_s": 2.5 + (i % 47) * 0.01,
+            "host_busy_ratio": 0.4,
+            "tpu_comm_ratio": 0.2,
+            "images_per_sec": 900.0 - (i % 211),
+            "whatif_identity_error_pct": 0.8,
+            "swarm_count": 12.0,
+            "tpu0_sol_distance": 2.0 + (i % 97) * 0.1,
+            "tpu1_sol_distance": 2.1 + (i % 89) * 0.1,
+            "tpu2_sol_distance": 1.9 + (i % 83) * 0.1,
+            "tpu3_sol_distance": 2.2 + (i % 79) * 0.1,
+        }
+        doc = {"schema": "sofa_tpu/archive_run", "version": 1,
+               "run": run, "t": t, "hostname": host, "label": label,
+               "logdir": f"/fleet/{host}/job{i}", "files": file_map,
+               "features": feats}
+        with open(os.path.join(rdir, run + ".json"), "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        lines.append(json.dumps(
+            {"ev": "ingest", "t": t, "run": run,
+             "logdir": doc["logdir"], "files": len(file_map),
+             "new_objects": 3, "bytes_added": 4096, "label": label},
+            separators=(",", ":")))
+    from sofa_tpu.archive import catalog
+
+    with open(catalog.catalog_path(root), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--runs", type=int, default=50_000,
+                   help="synthetic catalog size (default 50000)")
+    p.add_argument("--window", type=int, default=20,
+                   help="rolling-baseline window (default 20)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="ls / rank result size (default 20)")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the synthetic archive root")
+    args = p.parse_args(argv)
+
+    os.environ.pop("SOFA_ARCHIVE_INDEX", None)
+    from sofa_tpu.archive import baseline, catalog
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.archive.store import (ArchiveStore, _ls_runs,
+                                        render_ls)
+    from sofa_tpu.config import SofaConfig
+
+    workdir = tempfile.mkdtemp(prefix="sofa_catbench_")
+    root = os.path.join(workdir, "archive")
+    print(f"synthesizing {args.runs} runs under {root} ...")
+    t0 = time.perf_counter()
+    synthesize(root, args.runs)
+    print(f"  synthesized in {time.perf_counter() - t0:.1f}s")
+    store = ArchiveStore(root)
+
+    t0 = time.perf_counter()
+    commit = aindex.refresh(root)
+    t_build = time.perf_counter() - t0
+    assert commit is not None, "pyarrow missing — nothing to benchmark"
+    print(f"  index build (full): {t_build:.2f}s "
+          f"({commit['events']} events, {commit['features_rows']} "
+          f"feature rows, {commit['_stats']['chunks_wrote']} chunks)")
+
+    cfg = SofaConfig(logdir="unused", archive_root=root,
+                     archive_limit=args.limit)
+
+    def timed(fn, reps=3):
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best, out
+
+    rows = [["query", "scan", "index", "speedup"]]
+
+    # --- ls --limit -------------------------------------------------------
+    def ls():
+        runs, total, bench_n, _src = _ls_runs(root, cfg)
+        return "\n".join(render_ls(root, runs, total_runs=total,
+                                   bench_count=bench_n))
+
+    t_ls_idx, out_idx = timed(ls)
+    os.environ["SOFA_ARCHIVE_INDEX"] = "0"
+    t_ls_scan, out_scan = timed(ls, reps=1)
+    os.environ.pop("SOFA_ARCHIVE_INDEX")
+    assert out_idx == out_scan, "ls output differs between index and scan"
+    rows.append(["ls --limit %d" % args.limit, f"{t_ls_scan:.3f}s",
+                 f"{t_ls_idx * 1000:.1f}ms",
+                 f"{t_ls_scan / t_ls_idx:.0f}x"])
+
+    # --- rolling baseline window ------------------------------------------
+    t_rb_idx, s_idx = timed(
+        lambda: aindex.rolling_samples(root, args.window))
+    os.environ["SOFA_ARCHIVE_INDEX"] = "0"
+    t_rb_scan, s_scan = timed(
+        lambda: baseline.rolling_samples(store, args.window), reps=1)
+    os.environ.pop("SOFA_ARCHIVE_INDEX")
+    assert s_idx == s_scan, "rolling samples differ between index and scan"
+    rows.append(["rolling baseline (N=%d)" % args.window,
+                 f"{t_rb_scan:.3f}s", f"{t_rb_idx * 1000:.1f}ms",
+                 f"{t_rb_scan / t_rb_idx:.0f}x"])
+
+    # --- sol-distance ranking ---------------------------------------------
+    t_rk_idx, o_idx = timed(
+        lambda: aindex.offenders(root, limit=args.limit))
+    t_rk_scan, o_scan = timed(
+        lambda: aindex.offenders_scan(store, limit=args.limit), reps=1)
+    assert o_idx == o_scan, "offender ranking differs between index/scan"
+    rows.append(["sol-distance rank (top %d)" % args.limit,
+                 f"{t_rk_scan:.3f}s", f"{t_rk_idx * 1000:.1f}ms",
+                 f"{t_rk_scan / t_rk_idx:.0f}x"])
+
+    # --- refresh costs ----------------------------------------------------
+    t0 = time.perf_counter()
+    warm = aindex.refresh(root)
+    t_warm = time.perf_counter() - t0
+    assert warm["_stats"]["parsed_bytes"] == 0, "warm refresh parsed bytes"
+    assert warm["_stats"]["chunks_wrote"] == 0, "warm refresh wrote chunks"
+    # one appended ingest: the suffix-only refresh
+    run = "f" * 64
+    with open(os.path.join(root, "runs", run + ".json"), "w") as f:
+        json.dump({"run": run, "hostname": "hostX", "t": 1.8e9,
+                   "features": {"elapsed_time": 1.0}}, f)
+    catalog.append_event(root, "ingest", run=run, logdir="/fleet/x",
+                         files=1, new_objects=1, bytes_added=10)
+    t0 = time.perf_counter()
+    inc = aindex.refresh(root)
+    t_inc = time.perf_counter() - t0
+    assert not inc["_stats"]["full"], "append triggered a full rebuild"
+    assert inc["_stats"]["new_events"] == 1
+
+    from sofa_tpu.telemetry import _table
+
+    print()
+    print("\n".join(_table(rows)))
+    print()
+    print(f"index build (cold, {args.runs} runs): {t_build:.2f}s")
+    print(f"suffix refresh (1 appended ingest):   "
+          f"{t_inc * 1000:.1f}ms ({inc['_stats']['parsed_bytes']} bytes "
+          "parsed — the appended line only)")
+    print(f"warm refresh (unchanged catalog):     "
+          f"{t_warm * 1000:.2f}ms (0 bytes parsed, 0 chunks written)")
+    if args.keep:
+        print(f"kept: {root}")
+    else:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

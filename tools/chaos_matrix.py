@@ -350,6 +350,84 @@ def _run_archive_kill_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_index_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """SIGKILL `sofa archive` between the catalog index's chunk-store
+    writes (SOFA_INDEX_EXIT_AFTER, sofa_tpu/archive/index.py), then
+    prove `sofa resume` replays the journaled ingest + refresh and the
+    recovered index answers IDENTICALLY to a never-interrupted rebuild:
+    byte-identical index_commit.json (it carries no clock by design),
+    equal query answers, archive fsck 0."""
+    import shutil as sh
+
+    from sofa_tpu.archive import catalog as acat
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.archive.store import ArchiveStore, archive_fsck
+    from sofa_tpu.durability import sofa_resume
+
+    logdir = os.path.join(workdir, "kill-mid-index") + "/"
+    root = os.path.join(workdir, "kill-mid-index-store")
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    sofa_preprocess(cfg)
+
+    repo = os.path.dirname(_TOOLS)
+    env = dict(os.environ, SOFA_INDEX_EXIT_AFTER="2")
+    env.pop("_SOFA_INDEX_WRITES", None)
+    snippet = (
+        "import sys\n"
+        "sys.path.insert(0, sys.argv[3])\n"
+        "from sofa_tpu.config import SofaConfig\n"
+        "from sofa_tpu.archive.store import ingest_run\n"
+        "ingest_run(SofaConfig(logdir=sys.argv[1]), sys.argv[2])\n")
+    r = subprocess.run([sys.executable, "-c", snippet, logdir, root,
+                        repo], capture_output=True, text=True,
+                       timeout=600, env=env)
+    if r.returncode != 87:
+        return problems + [f"crash child exited rc={r.returncode} "
+                           "(expected the index chaos knob's hard-exit "
+                           "87 between chunk-store writes); stderr "
+                           f"tail: {r.stderr.strip()[-200:]}"]
+    if aindex.is_current(root):
+        problems.append("interrupted refresh left a CURRENT index — "
+                        "the commit should not have landed")
+    rc = sofa_resume(cfg)
+    if rc != 0:
+        problems.append(f"sofa resume rc={rc}")
+    if not aindex.is_current(root):
+        problems.append("index not current after resume")
+    report = archive_fsck(root)
+    for verdict in ("corrupt", "missing", "orphaned", "uncataloged",
+                    "index"):
+        if (report or {}).get(verdict):
+            problems.append(f"archive fsck: {len(report[verdict])} "
+                            f"{verdict} after resume")
+    # never-interrupted twin: rebuild from scratch beside it — the
+    # commit docs must be byte-identical and the answers equal
+    twin = root + "-twin"
+    shutil.rmtree(twin, ignore_errors=True)
+    sh.copytree(root, twin)
+    aindex.drop(twin)
+    aindex.refresh(twin)
+    a = open(aindex.commit_path(root), "rb").read()
+    b = open(aindex.commit_path(twin), "rb").read()
+    if a != b:
+        problems.append("recovered index_commit.json differs from a "
+                        "never-interrupted rebuild")
+    if aindex.run_entries(root) != aindex.run_entries(twin):
+        problems.append("recovered run entries differ from rebuild")
+    if aindex.offenders(root, "*", 50) != aindex.offenders(twin, "*", 50):
+        problems.append("recovered offender ranking differs from rebuild")
+    runs = acat.ingest_entries(acat.read_catalog(root))
+    if len(runs) != 1:
+        problems.append(f"catalog holds {len(runs)} run(s), expected 1")
+    elif ArchiveStore(root).load_run(runs[0]["run"]) is None:
+        problems.append("cataloged run doc unreadable")
+    return problems
+
+
 def _run_crash_pass_cell(workdir: str, synth: str, mc) -> List[str]:
     """Register a deliberately crashing analysis pass, then run the full
     analyze: the registry executor must degrade it to a sticky ``failed``
@@ -805,14 +883,15 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 7
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 8
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
                    ("kill-service-mid-upload", None),
                    ("agent-offline-spool-then-drain", None),
                    ("kill-mid-live-epoch", None),
-                   ("source-rotate-mid-tail", None)])
+                   ("source-rotate-mid-tail", None),
+                   ("kill-mid-index-refresh", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -843,6 +922,16 @@ def main(argv=None) -> int:
     failures += bool(problems)
     print(f"{'kill-mid-archive'.ljust(width)}  {status}  (SIGKILL during "
           "archive ingest, then sofa resume)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_index_kill_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'kill-mid-index-refresh'.ljust(width)}  {status}  (SIGKILL "
+          "between index chunk-store writes, then sofa resume)")
     for p in problems:
         print(f"{' ' * width}    - {p}")
     try:

@@ -62,6 +62,16 @@ _FRAME_INDEX_SCHEMA = "sofa_tpu/frame_index"
 _FRAME_INDEX_VERSION = 1
 _FRAME_FORMATS = ("csv", "parquet", "columnar")
 
+# The archive's columnar catalog index (sofa_tpu/archive/index.py):
+# checking an archive root validates its commit manifest + the three
+# column families' frame indexes.
+_ARCHIVE_MARKER_NAME = "sofa_archive.json"
+_ARCHIVE_INDEX_DIR = "_index"
+_ARCHIVE_INDEX_COMMIT = "index_commit.json"
+_ARCHIVE_INDEX_SCHEMA = "sofa_tpu/archive_index"
+_ARCHIVE_INDEX_VERSION = 1
+_ARCHIVE_INDEX_FAMILIES = ("catalog", "runs", "features")
+
 
 def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -879,6 +889,84 @@ def _check_frame_indexes(logdir: str) -> List[str]:
     return probs
 
 
+def validate_index_commit(doc) -> List[str]:
+    """Schema problems in an archive's ``_index/index_commit.json``
+    (sofa_tpu/archive/index.py) — the fsync'd-last commit point of the
+    columnar catalog index."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["index commit is not a JSON object"]
+    if doc.get("schema") != _ARCHIVE_INDEX_SCHEMA:
+        probs.append(f"schema: expected {_ARCHIVE_INDEX_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _ARCHIVE_INDEX_VERSION:
+        probs.append(f"version: expected {_ARCHIVE_INDEX_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    for key in ("catalog_offset", "catalog_gen", "events",
+                "ingest_events", "bench_events", "runs",
+                "features_rows"):
+        v = doc.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key}: missing or not a non-negative int")
+    if not isinstance(doc.get("catalog_head_sha"), str):
+        probs.append("catalog_head_sha: missing")
+    if not isinstance(doc.get("commit_sha"), str) \
+            or not doc.get("commit_sha"):
+        probs.append("commit_sha: missing (the /v1/query ETag key)")
+    fams = doc.get("families")
+    if not isinstance(fams, dict) \
+            or sorted(fams) != sorted(_ARCHIVE_INDEX_FAMILIES):
+        probs.append("families: expected exactly "
+                     f"{sorted(_ARCHIVE_INDEX_FAMILIES)}, got "
+                     f"{sorted(fams) if isinstance(fams, dict) else fams}")
+        fams = {}
+    for name, ent in sorted(fams.items()):
+        if not isinstance(ent, dict) \
+                or not isinstance(ent.get("rows"), int) \
+                or isinstance(ent.get("rows"), bool) \
+                or not isinstance(ent.get("chunks"), int) \
+                or isinstance(ent.get("chunks"), bool):
+            probs.append(f"families.{name}: needs int rows + chunks")
+    return probs
+
+
+def _check_archive_index(root: str) -> List[str]:
+    """Validate an archive root's columnar index: the commit manifest
+    plus each family's frame_index.json.  No index at all is healthy
+    (queries scan); a HALF-index is not."""
+    idir = os.path.join(root, _ARCHIVE_INDEX_DIR)
+    cpath = os.path.join(idir, _ARCHIVE_INDEX_COMMIT)
+    if not os.path.isdir(idir):
+        return []
+    where = f"{_ARCHIVE_INDEX_DIR}/{_ARCHIVE_INDEX_COMMIT}"
+    try:
+        with open(cpath) as f:
+            doc = json.load(f)
+    except OSError:
+        return [f"{where}: missing (an _index/ dir with no commit — "
+                "`sofa archive fsck --repair` rebuilds)"]
+    except ValueError as e:
+        return [f"{where}: not JSON: {e}"]
+    probs = [f"{where}: {p}" for p in validate_index_commit(doc)]
+    for family in _ARCHIVE_INDEX_FAMILIES:
+        fpath = os.path.join(idir, family, _FRAME_INDEX_NAME)
+        fwhere = f"{_ARCHIVE_INDEX_DIR}/{family}/{_FRAME_INDEX_NAME}"
+        try:
+            with open(fpath) as f:
+                fdoc = json.load(f)
+        except (OSError, ValueError) as e:
+            probs.append(f"{fwhere}: unreadable ({e})")
+            continue
+        probs.extend(f"{fwhere}: {p}" for p in validate_frame_index(fdoc))
+        want = ((doc.get("families") or {}).get(family) or {}) \
+            if isinstance(doc, dict) else {}
+        if isinstance(want.get("rows"), int) \
+                and fdoc.get("rows") != want["rows"]:
+            probs.append(f"{fwhere}: rows {fdoc.get('rows')} disagrees "
+                         f"with the commit manifest ({want['rows']})")
+    return probs
+
+
 def _check_live_offsets(logdir: str) -> List[str]:
     path = os.path.join(logdir, _LIVE_OFFSETS_NAME)
     if not os.path.isfile(path):
@@ -899,6 +987,19 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     schemas, is validated as that document instead.  A logdir whose
     `sofa live` offset ledger is present gets that validated too."""
     live_probs: List[str] = []
+    if os.path.isdir(path) and os.path.isfile(
+            os.path.join(path, _ARCHIVE_MARKER_NAME)):
+        # an archive root: the document to validate is its columnar
+        # catalog index (absent index = healthy, queries scan)
+        probs = _check_archive_index(path)
+        for p in probs:
+            print(f"manifest_check: archive index: {p}", file=sys.stderr)
+        if not probs:
+            has = os.path.isfile(os.path.join(
+                path, _ARCHIVE_INDEX_DIR, _ARCHIVE_INDEX_COMMIT))
+            print(f"manifest_check: OK ({path}; archive index: "
+                  f"{'committed' if has else 'absent (scan mode)'})")
+        return 1 if probs else 0
     if os.path.isdir(path):
         live_probs = _check_live_offsets(path) + _check_frame_indexes(path)
         mpath = os.path.join(path, MANIFEST_NAME)
