@@ -499,6 +499,31 @@ try:
         _sh.rmtree(_fw, ignore_errors=True)
 except Exception as e:
     out["fleet_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# fleet tier evidence (sofa_tpu/archive/tier.py + tools/fleet_load.py):
+# a seconds-scale smoke fleet — a forked 2-worker pool on loopback under
+# concurrent synthetic agents + query pollers — lands the tier's p50/p99
+# push/query latency and saturation throughput.  Needs no hardware, so
+# the scaling tier's numbers ride dead-tunnel rounds too.
+try:
+    import subprocess as _sp
+    _r = _sp.run(
+        [sys.executable, os.path.join({root!r}, "tools", "fleet_load.py"),
+         "--smoke", "--workers", "2"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if _r.returncode != 0:
+        _tail = (_r.stderr.strip().splitlines() or ["?"])[-1]
+        out["fleet_load_evidence_error"] = f"rc={{_r.returncode}}: " \
+            f"{{_tail}}"[:160]
+    else:
+        _fl = json.loads(_r.stdout.strip().splitlines()[-1])
+        for _k in ("fleet_push_p50_ms", "fleet_push_p99_ms",
+                   "fleet_query_p50_ms", "fleet_query_p99_ms",
+                   "fleet_saturation_rps"):
+            if _k in _fl.get("metrics", {{}}):
+                out[_k] = _fl["metrics"][_k]
+except Exception as e:
+    out["fleet_load_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # catalog-index evidence (sofa_tpu/archive/index.py): the fleet query
 # path's steady-state numbers on a synthetic fleet archive —
 # catalog_index_refresh_wall_time_s is the SUFFIX-ONLY refresh after one
@@ -594,7 +619,10 @@ print(json.dumps(out))
                     "frame_evidence_error",
                     "analyze_evidence_error", "whatif_identity_error_pct",
                     "whatif_evidence_error", "fleet_push_wall_time_s",
-                    "fleet_evidence_error", "live_epoch_wall_time_s",
+                    "fleet_evidence_error", "fleet_push_p50_ms",
+                    "fleet_push_p99_ms", "fleet_query_p50_ms",
+                    "fleet_query_p99_ms", "fleet_saturation_rps",
+                    "fleet_load_evidence_error", "live_epoch_wall_time_s",
                     "live_lag_events", "live_evidence_error",
                     "catalog_index_refresh_wall_time_s",
                     "fleet_query_wall_time_s", "catalog_evidence_error"):
@@ -618,6 +646,12 @@ print(json.dumps(out))
             _log(f"bench: fleet push wall "
                  f"{out['fleet_push_wall_time_s']}s (loopback serve + "
                  "agent spool-and-push of the pod_synth logdir)")
+        if "fleet_saturation_rps" in out:
+            _log(f"bench: fleet tier smoke "
+                 f"{out['fleet_saturation_rps']} pushes/s, push p99 "
+                 f"{out.get('fleet_push_p99_ms')} ms, query p99 "
+                 f"{out.get('fleet_query_p99_ms')} ms (2-worker pool, "
+                 "tools/fleet_load.py --smoke)")
         if "live_epoch_wall_time_s" in out:
             _log(f"bench: live incremental epoch "
                  f"{out['live_epoch_wall_time_s']}s, drained "
@@ -751,7 +785,9 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "live_lag_events", "frame_load_wall_time_s",
                      "analyze_peak_rss_mb",
                      "catalog_index_refresh_wall_time_s",
-                     "fleet_query_wall_time_s")
+                     "fleet_query_wall_time_s", "fleet_push_p50_ms",
+                     "fleet_push_p99_ms", "fleet_query_p50_ms",
+                     "fleet_query_p99_ms", "fleet_saturation_rps")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

@@ -178,6 +178,13 @@ def _process_logdir(cfg, spool, client, logdir: str,
                     "quota_used_mb": ack.get("quota_used_mb"),
                     "committed_unix": round(time.time(), 3),
                 })
+                if isinstance(ack.get("tier"), dict):
+                    # the scaled tier stamps which worker committed the
+                    # run and how deep its ingest queue sat — the
+                    # manifest's record of the placement decision
+                    # (validated by tools/manifest_check.py)
+                    tel.set_meta(tier={**ack["tier"],
+                                       "url": client.base})
             else:
                 tick.failed += 1
         tel.set_meta(agent=meta_agent)

@@ -59,15 +59,18 @@ def percentile(xs: List[float], pct: float) -> float:
 # ---------------------------------------------------------------------------
 
 # Higher is worse: durations, latencies, skew, overhead, model error,
-# peak memory (the out-of-core frame store's analyze_peak_rss_mb), and
+# peak memory (the out-of-core frame store's analyze_peak_rss_mb),
 # speed-of-light distance (sol_roofline: how far measured kernels sit
-# from the hardware's attainable peak — the fleet board's ranking key).
+# from the hardware's attainable peak — the fleet board's ranking key),
+# and millisecond latencies (the fleet tier's push/query p50/p99).
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
-    r"|_idle|_error_pct$|_rss_mb$|_sol_distance$)")
-# Lower is worse: rates and utilization.
+    r"|_idle|_error_pct$|_rss_mb$|_sol_distance$|_ms$)")
+# Lower is worse: rates and utilization (including the fleet tier's
+# saturation throughput, fleet_saturation_rps).
 _WORSE_LOW = re.compile(
-    r"(bandwidth|_gbps|per_sec|throughput|flops|images_per_sec|_util$)")
+    r"(bandwidth|_gbps|per_sec|throughput|flops|images_per_sec|_util$"
+    r"|_rps$)")
 
 
 def polarity(name: str) -> int:

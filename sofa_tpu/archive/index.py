@@ -75,6 +75,7 @@ import shutil
 from typing import Callable, Dict, List, Optional
 
 from sofa_tpu.archive import catalog
+from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_warning
 
 INDEX_DIR_NAME = "_index"
@@ -149,6 +150,24 @@ def enabled() -> bool:
         and available()
 
 
+#: Roots whose committed index is authoritative BY FIAT — read replicas
+#: (archive/tier.py) serve pulled immutable commits with no local
+#: catalog to check against, so ``is_current`` trusts the commit as-is.
+#: Process-local; a replica pins each tenant root after its first pull.
+_PINNED_ROOTS: set = set()
+_PINNED_GUARD = Guard("archive_index.pins", protects=("_PINNED_ROOTS",))
+
+
+def pin_root(root: str) -> None:
+    with _PINNED_GUARD:
+        _PINNED_ROOTS.add(os.path.abspath(root))
+
+
+def unpin_root(root: str) -> None:
+    with _PINNED_GUARD:
+        _PINNED_ROOTS.discard(os.path.abspath(root))
+
+
 def load_commit(root: str) -> Optional[dict]:
     """The committed index manifest, or None when there is no readable
     v1 commit (readers then fall back to the linear scan)."""
@@ -174,6 +193,10 @@ def is_current(root: str, commit: "dict | None" = None) -> bool:
     commit = commit if commit is not None else load_commit(root)
     if commit is None:
         return False
+    if os.path.abspath(root) in _PINNED_ROOTS:
+        # a replica root: the pulled commit IS the truth — there is no
+        # local catalog for it to be current against
+        return True
     offset = int(commit.get("catalog_offset") or 0)
     try:
         size = os.path.getsize(catalog.catalog_path(root))

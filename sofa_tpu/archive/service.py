@@ -52,13 +52,8 @@ import re
 import time
 from typing import Dict, List, Optional, Tuple
 
-from sofa_tpu.archive import catalog
-from sofa_tpu.archive.store import (
-    RUN_SCHEMA,
-    RUN_VERSION,
-    ArchiveStore,
-    run_content_id,
-)
+from sofa_tpu.archive import catalog, tier
+from sofa_tpu.archive.store import ArchiveStore, run_content_id
 from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_error, print_progress, print_warning
 
@@ -109,18 +104,100 @@ class _FleetServer(http.server.ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, handler, root: str, token: str,
-                 quota_mb: float = 0.0, max_inflight: int = 8):
+                 quota_mb: float = 0.0, max_inflight: int = 8,
+                 worker: int = 0, workers: int = 1,
+                 reuse_port: bool = False, role: str = "primary",
+                 generation: int = 0):
+        # consumed by server_bind(), which super().__init__ invokes —
+        # set BEFORE the bind happens
+        self.reuse_port = bool(reuse_port)
         super().__init__(addr, handler)
         self.root = os.path.abspath(root)
         self.token = token
         self.quota_bytes = int(max(quota_mb, 0.0) * 2 ** 20)
         self.max_inflight = max(int(max_inflight), 1)
+        self.worker = int(worker)
+        self.workers = max(int(workers), 1)
+        self.role = role
+        self.generation = int(generation)
+        self.replica = None  # ReplicaPuller when role == "replica"
+        # Emulated storage latency (ms) slept per object/commit write
+        # WHILE HOLDING the write slot — tools/fleet_load.py capacity
+        # benchmarking.  A dev box's page cache makes every write
+        # CPU-cheap, which hides the regime the tier actually scales:
+        # storage-bound writes behind per-worker admission control.
+        try:
+            self.io_ms = float(os.environ.get("SOFA_TIER_IO_MS", "0") or 0)
+        except ValueError:
+            self.io_ms = 0.0
         self._state_guard = Guard("serve.state", protects=(
-            "stats", "inflight", "tenant_bytes", "writes_handled"))
+            "stats", "inflight", "tenant_bytes", "writes_handled",
+            "drainer", "replica"))
         self.stats: Dict[str, int] = {}
         self.inflight = 0
         self.tenant_bytes: Dict[str, int] = {}
         self.writes_handled = 0
+        self._appenders: Dict[str, "tier.WalAppender"] = {}
+        self.drainer = None
+        if role == "primary":
+            self.drainer = tier.Drainer(self.root, worker=self.worker,
+                                        workers=self.workers)
+            self.drainer.start()
+
+    def server_bind(self):
+        """SO_REUSEPORT before bind: every pool worker listens on the
+        SAME public port and the kernel load-balances accepts — no
+        front door, no proxy hop (tier mode; docs/FLEET.md)."""
+        if self.reuse_port:
+            import socket as _socket
+
+            self.socket.setsockopt(_socket.SOL_SOCKET,
+                                   _socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+    def server_close(self):
+        # Detach under the guard, stop outside it: .stop() joins worker
+        # threads, and a join under a held guard stalls every handler.
+        with self._state_guard:
+            drainer, self.drainer = self.drainer, None
+            replica, self.replica = self.replica, None
+        if drainer is not None:
+            drainer.stop()
+        if replica is not None:
+            replica.stop()
+        super().server_close()
+
+    # -- the write-ahead ingest queue --------------------------------------
+    def tier_append(self, tenant: str, record: dict) -> "Tuple[str, int]":
+        """Durably append a commit record to THIS worker's WAL file for
+        the tenant (single-writer: no cross-process coordination)."""
+        with self._state_guard:
+            app = self._appenders.get(tenant)
+            if app is None:
+                app = tier.WalAppender(self.tenant_root(tenant),
+                                       self.worker)
+                self._appenders[tenant] = app
+        name, end = app.append(record)
+        if self.drainer is not None and \
+                tier.ring_owner(tenant, self.workers) == self.worker:
+            self.drainer.kick()
+        return name, end
+
+    def tier_wait_applied(self, tenant: str, name: str, end: int) -> bool:
+        """The commit-ack wait.  On the tenant's OWNER the ack keeps
+        read-your-writes: block (condvar + in-memory offsets, no file
+        I/O) until the drainer applied the record — single-worker
+        service and the dispatcher's tenant-affine routing always land
+        here.  On a non-owner (SO_REUSEPORT spreads connections by
+        kernel hash) the fsync'd WAL line IS the commit point: the
+        record cannot be lost, ``have``/commit dedup already count
+        WAL-pending runs, and the owner applies within its poll — so
+        ack at durability instead of cross-process polling (a waiter
+        re-parsing the shared state file per poll melts the tier)."""
+        if self.drainer is not None and \
+                tier.ring_owner(tenant, self.workers) == self.worker:
+            return self.drainer.wait_local(tenant, name, end)
+        return True
 
     # -- counters ----------------------------------------------------------
     def count_response(self, key: str) -> None:
@@ -135,13 +212,23 @@ class _FleetServer(http.server.ThreadingHTTPServer):
         return ", ".join(f"{v} {k}" for k, v in sorted(stats.items()))
 
     # -- backpressure ------------------------------------------------------
-    def write_slot(self) -> bool:
-        """Claim an in-flight write slot; False = loaded, answer 503."""
-        with self._state_guard:
-            if self.inflight >= self.max_inflight:
+    def write_slot(self, wait_s: float = 0.5) -> bool:
+        """Claim an in-flight write slot; False = loaded, answer 503.
+
+        Waits up to ``wait_s`` for a slot before giving up: an immediate
+        503 turns every briefly-loaded moment into a client retry storm
+        (each blocked agent hammering ~20 cheap requests/s), which costs
+        far more CPU than parking the handler thread here.  The poll is
+        GIL-friendly — blocked threads sleep, they don't spin."""
+        deadline = time.monotonic() + wait_s
+        while True:
+            with self._state_guard:
+                if self.inflight < self.max_inflight:
+                    self.inflight += 1
+                    return True
+            if time.monotonic() >= deadline:
                 return False
-            self.inflight += 1
-            return True
+            time.sleep(0.005)
 
     def release_slot(self) -> None:
         with self._state_guard:
@@ -151,6 +238,13 @@ class _FleetServer(http.server.ThreadingHTTPServer):
         """Count a write request; hard-exit at the chaos threshold — the
         deterministic stand-in for the OOM-killer taking the service down
         mid-upload (tools/chaos_matrix.py kill-service-mid-upload)."""
+        from sofa_tpu import faults
+
+        if faults.maybe_worker_die(self.worker + 1, self.generation):
+            # the worker_die@<n> fault: THIS pool worker drops dead
+            # mid-request — the dispatcher/client retries onto a
+            # sibling, the supervisor respawns us at generation+1
+            os._exit(89)
         n = _chaos_exit_after()
         if not n:
             return
@@ -200,6 +294,11 @@ class _FleetServer(http.server.ThreadingHTTPServer):
 
 class _FleetHandler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # status/header and body land in separate writes; without NODELAY
+    # Nagle queues the second behind the peer's delayed ACK and every
+    # response eats a ~40 ms stall — the fleet tier lives on small
+    # keep-alive round trips, so turn it off
+    disable_nagle_algorithm = True
     server_version = "sofa_tpu-serve"
 
     def log_message(self, fmt, *args):  # noqa: A003
@@ -210,6 +309,12 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
               retry_after: "str | None" = None,
               extra_headers: "List[tuple] | None" = None) -> None:
         body = json.dumps(doc).encode()
+        if code >= 400 and self.command in ("POST", "PUT"):
+            # an error answered before the request body was consumed
+            # would leave those bytes in the socket and desync the
+            # keep-alive stream (the next request line parses as
+            # garbage -> 400); close instead, the client reconnects
+            self.close_connection = True
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -270,10 +375,20 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 return None
         tenant = parts[1]
         if not _TENANT_RE.match(tenant) or tenant in (
-                TENANTS_DIR_NAME, "..", "."):
+                TENANTS_DIR_NAME, "tier", "..", "."):
             self._json(400, {"error": "bad_tenant"})
             return None
         return tenant, parts[2:]
+
+    def _read_only(self) -> bool:
+        """True when a write was refused because this is a replica (403:
+        replicas serve queries off pulled commits — they never own a
+        tenant's write path, so accepting an upload would fork history)."""
+        if self.server.role != "replica":
+            return False
+        self._count("403_read_only")
+        self._json(403, {"error": "read_only_replica"})
+        return True
 
     def _backpressure(self, tenant: str) -> bool:
         """True when the request was answered with a 503 (mid-gc on the
@@ -296,6 +411,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(200, {"ok": True, "schema": SERVICE_SCHEMA,
                              "version": SERVICE_VERSION})
             return
+        if clean == "/v1/tier":
+            if not self.server.auth_ok(
+                    self.headers.get("Authorization")):
+                self._count("401_unauthorized")
+                self._json(401, {"error": "unauthorized"})
+                return
+            self._tier()
+            return
         routed = self._route(allow_token_param=clean.endswith("/query"))
         if routed is None:
             return
@@ -306,6 +429,9 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return
         if rest == ["query"]:
             self._query(tenant, store)
+            return
+        if rest and rest[0] == "index":
+            self._index_file(tenant, rest[1:])
             return
         if len(rest) == 2 and rest[0] == "run" and store.exists:
             doc = store.load_run(rest[1]) if _SHA_RE.match(rest[1]) else None
@@ -409,6 +535,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         except ValueError:
             self._json(400, {"error": "bad_params"})
             return
+        if self.server.role == "replica" and \
+                aindex.load_commit(store.root) is None:
+            # nothing pulled yet — honesty over an empty 200: the
+            # replica is warming, the client should come back
+            self._count("503_replica_warming")
+            self._json(503, {"error": "replica_warming"},
+                       retry_after=_RETRY_AFTER_S)
+            return
         doc = aindex.query(store.root, kind=kind, host=one("host"),
                            label=one("label"), since=since,
                            feature=one("feature"), limit=limit,
@@ -418,6 +552,20 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         else:
             etag, _size = self._catalog_etag(store)
         headers = [("ETag", etag)] + list(_CORS_HEADERS)
+        if self.server.role == "replica":
+            # the honest-staleness contract: a replica names the commit
+            # it answered from and, when the upstream has moved on,
+            # SAYS SO instead of passing the answer off as current
+            headers.append(("X-Sofa-Replica", "1"))
+            served = doc.get("commit_sha") or ""
+            headers.append(("X-Sofa-Replica-Commit", served))
+            rst = (self.server.replica.state().get(tenant)
+                   if self.server.replica is not None else None) or {}
+            upstream = rst.get("upstream") or ""
+            if upstream and upstream != served:
+                self._count("stale_replica_query")
+                headers.append(("X-Sofa-Replica-Stale", "1"))
+                headers.append(("X-Sofa-Replica-Behind", upstream))
         if self.headers.get("If-None-Match") == etag:
             self._count("304_query")
             self.send_response(304)
@@ -431,6 +579,71 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                          "tenant": tenant, **doc},
                    extra_headers=headers)
 
+    def _tier(self) -> None:
+        """``GET /v1/tier`` — the live topology document: role, worker
+        identity, and per-tenant ring owner / WAL depth / index commit
+        sha (the `sofa status --fleet` feed).  Computed from disk, so
+        ANY pool worker answers identically up to its own ordinal."""
+        self._count("tier_read")
+        doc = tier.tier_doc(
+            self.server.root, self.server.worker, self.server.workers,
+            self.server.role, self.server.reuse_port,
+            replica_state=(self.server.replica.state()
+                           if self.server.replica is not None else None))
+        # worker-LOCAL saturation signal (each worker answers for itself
+        # only — sample repeatedly to see the whole pool)
+        doc["inflight"] = self.server.inflight
+        doc["max_inflight"] = self.server.max_inflight
+        self._json(200, doc)
+
+    _INDEX_FILE_RE = re.compile(r"^(\d{6}\.arrow|frame_index\.json)$")
+
+    def _index_file(self, tenant: str, rest: List[str]) -> None:
+        """``GET /v1/<t>/index/commit`` and
+        ``/v1/<t>/index/<family>/<chunk>`` — the replica pull feed.
+        Immutable commits make this trivial: the commit sha IS the ETag
+        (an unchanged commit costs one 304), and chunk files are
+        content-keyed so a puller fetches only what actually changed."""
+        from sofa_tpu.archive import index as aindex
+
+        troot = self.server.tenant_root(tenant)
+        if rest == ["commit"]:
+            commit = aindex.load_commit(troot)
+            if commit is None:
+                self._json(404, {"error": "no_index"})
+                return
+            etag = f'"idx-{commit.get("commit_sha") or ""}"'
+            if self.headers.get("If-None-Match") == etag:
+                self._count("304_index_commit")
+                self.send_response(304)
+                self.send_header("ETag", etag)
+                self.end_headers()
+                return
+            self._count("index_commit_read")
+            self._json(200, commit, extra_headers=[("ETag", etag)])
+            return
+        if len(rest) == 2 and rest[0] in aindex.FAMILIES and \
+                self._INDEX_FILE_RE.match(rest[1]):
+            path = os.path.join(aindex.family_dir(troot, rest[0]),
+                                rest[1])
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                self._json(404, {"error": "no_such_chunk"})
+                return
+            self._count("index_chunk_read")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            try:
+                self.wfile.write(data)
+            except OSError:
+                self._count("client_disconnect")
+            return
+        self._json(404, {"error": "no_such_route"})
+
     # -- POST (have / commit) ----------------------------------------------
     def do_POST(self):  # noqa: N802 — http.server handler contract
         routed = self._route()
@@ -440,10 +653,13 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         if rest not in (["have"], ["commit"]):
             self._json(404, {"error": "no_such_route"})
             return
+        if self._read_only():
+            return
         if not self.server.write_slot():
             self._count("503_loaded")
             self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
             return
+        self._holds_slot = True
         try:
             if self._backpressure(tenant):
                 return
@@ -468,6 +684,16 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             else:
                 self._commit(tenant, doc, files)
         finally:
+            self._drop_slot()
+
+    def _drop_slot(self) -> None:
+        """Release this request's write slot exactly once.  _commit drops
+        it early, before waiting on the drainer apply: the slot bounds
+        concurrent STORAGE writes, and a handler parked on an in-memory
+        condvar isn't writing — holding on would let a drainer backlog
+        starve the admission budget."""
+        if getattr(self, "_holds_slot", False):
+            self._holds_slot = False
             self.server.release_slot()
 
     def _have(self, tenant: str, files: Dict[str, dict]) -> None:
@@ -481,16 +707,24 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         committed = any(
             e.get("run") == run_id
             for e in catalog.read_catalog(store.root)
-            if e.get("ev") == "ingest")
+            if e.get("ev") == "ingest") or \
+            run_id in tier.wal_pending_runs(store.root)
         self._count("have")
         self._json(200, {"run": run_id, "have": len(shas) - len(missing),
                          "missing": missing, "committed": committed})
 
     def _commit(self, tenant: str, doc: dict,
                 files: Dict[str, dict]) -> None:
-        """The run's commit point, mirroring a local ingest: verify every
-        referenced object landed, write the run doc, append the catalog
-        line.  Replaying a committed run is a pure no-op."""
+        """The run's commit point, now write-ahead: verify every
+        referenced object landed, append ONE fsync'd WAL record, and
+        answer once the owning worker's drainer has applied it (run doc
+        + catalog line — read your writes).  The index refresh the old
+        inline path paid per-commit (the PR-15 bottleneck) happens
+        asynchronously behind the drainer: the ack's latency is
+        independent of index size.  Replaying a committed run is a pure
+        no-op."""
+        if self.server.io_ms:
+            time.sleep(self.server.io_ms / 1000.0)  # emulated storage
         store = self.server.tenant_store(tenant)
         run_id = run_content_id(files)
         missing = sorted({e["sha256"] for e in files.values()
@@ -503,13 +737,11 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         already = any(
             e.get("run") == run_id
             for e in catalog.read_catalog(store.root)
-            if e.get("ev") == "ingest")
+            if e.get("ev") == "ingest") or \
+            run_id in tier.wal_pending_runs(store.root)
         if not already:
-            from sofa_tpu.durability import atomic_write
-
-            run_doc = {
-                "schema": RUN_SCHEMA, "version": RUN_VERSION,
-                "run": run_id, "t": round(time.time(), 3),
+            rec = {
+                "run": run_id,
                 "logdir": str(doc.get("logdir", "")),
                 "hostname": str(doc.get("hostname", "")),
                 "label": str(doc.get("label", "")),
@@ -517,27 +749,28 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 "files": files,
                 "features": doc.get("features") or {},
             }
-            with atomic_write(store.run_doc_path(run_id), fsync=True) as f:
-                json.dump(run_doc, f, indent=1, sort_keys=True)
-            catalog.append_event(
-                store.root, "ingest", run=run_id,
-                logdir=str(doc.get("logdir", "")), files=len(files),
-                new_objects=0, bytes_added=0, via="service",
-                **({"label": str(doc["label"])} if doc.get("label")
-                   else {}))
-            # serve's commit point = index refresh point, like a local
-            # ingest: the suffix parse folds this one catalog line in so
-            # the next /v1/query is index-fed (failure degrades to the
-            # scan path with a warning, never a failed commit)
-            from sofa_tpu.archive import index as aindex
-
-            aindex.refresh_after_ingest(store.root)
+            name, end = self.server.tier_append(tenant, rec)
+            self._drop_slot()  # WAL record durable; the wait is in-memory
+            if not self.server.tier_wait_applied(tenant, name, end):
+                # durably queued but the owner's drainer is backlogged
+                # (or mid-respawn): the record CANNOT be lost, but the
+                # read-your-writes promise can't be kept yet — tell the
+                # client when to come back (a replayed commit no-ops)
+                self._count("503_wal_backlog")
+                self._json(503, {"error": "wal_backlog", "run": run_id},
+                           retry_after=_RETRY_AFTER_S)
+                return
         self._count("commit" if not already else "commit_replayed")
         self._json(200, {
             "run": run_id, "committed": True, "new": not already,
             "tenant": tenant,
             "quota_used_mb": round(
                 self.server.tenant_used_bytes(tenant) / 2 ** 20, 3),
+            "tier": {"schema": tier.TIER_SCHEMA,
+                     "version": tier.TIER_VERSION,
+                     "worker": self.server.worker,
+                     "workers": self.server.workers,
+                     "wal_depth": tier.wal_depth(store.root)},
         })
 
     # -- PUT (one content-addressed object == one upload chunk) ------------
@@ -551,6 +784,8 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(404, {"error": "no_such_route"})
             return
         sha = rest[1]
+        if self._read_only():
+            return
         if not self.server.write_slot():
             self._count("503_loaded")
             self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
@@ -582,6 +817,8 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                         self.server.tenant_used_bytes(tenant) / 2 ** 20,
                         3)})
                 return
+            if self.server.io_ms:
+                time.sleep(self.server.io_ms / 1000.0)  # emulated storage
             got = hashlib.sha256(data).hexdigest()
             if got != sha:
                 # a truncated/corrupted upload (the partial@<f> fault's
@@ -660,6 +897,19 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
     max_inflight = int(getattr(cfg, "serve_max_inflight", 8) or 8)
     bind = getattr(cfg, "serve_bind", "127.0.0.1")
     base_port = int(getattr(cfg, "serve_port", 8044) or 0)
+    replica_of = (getattr(cfg, "serve_replica_of", "") or "").rstrip("/")
+    workers = max(int(getattr(cfg, "serve_workers", 1) or 1), 1)
+    if replica_of and workers > 1:
+        print_error("serve: --workers scales the PRIMARY; a replica is "
+                    "one read-only process (run several replicas "
+                    "instead) — pick one of --workers / --replica-of")
+        return 2 if serve_forever else None
+    if replica_of:
+        return _serve_replica(root, token, replica_of, bind, base_port,
+                              max_inflight, serve_forever)
+    if workers > 1:
+        return _serve_pool(root, token, bind, base_port, quota_mb,
+                           max_inflight, workers, serve_forever)
     httpd = None
     last_err = None
     ports = [0] if base_port == 0 else range(base_port, base_port + 20)
@@ -689,6 +939,102 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
     print_progress(
         "push with: sofa agent <watch_dir> --service "
         f"http://{host}:{port} --token <secret> (docs/FLEET.md)")
+    if not serve_forever:
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        served = httpd.stats_line()
+        if served:
+            print_progress(f"serve handled: {served}")
+    return 0
+
+
+def _serve_pool(root: str, token: str, bind: str, base_port: int,
+                quota_mb: float, max_inflight: int, workers: int,
+                serve_forever: bool):
+    """``sofa serve --workers N`` — the sharded worker pool.  Returns a
+    running :class:`tier.TierHandle` when ``serve_forever=False``."""
+    handle = tier.start_pool(root, token, bind, base_port, quota_mb,
+                             max_inflight, workers)
+    if handle is None:
+        return 2 if serve_forever else None
+    from sofa_tpu.viz import _display_host
+
+    host = _display_host(bind)
+    mode = "SO_REUSEPORT" if handle.reuse else "dispatcher"
+    print_progress(
+        f"fleet archive service: {root} at http://{host}:{handle.port}"
+        f"/v1/ (tenants under {TENANTS_DIR_NAME}/; {workers} workers "
+        f"via {mode}; tenants consistent-hash-sharded; "
+        + (f"quota {quota_mb:g} MB/tenant; " if quota_mb else "")
+        + f"max {max_inflight} in-flight write(s)/worker; Ctrl-C stops)")
+    print_progress(
+        "push with: sofa agent <watch_dir> --service "
+        f"http://{host}:{handle.port} --token <secret> (docs/FLEET.md)")
+    if not serve_forever:
+        return handle
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.stop()
+    return 0
+
+
+def _serve_replica(root: str, token: str, upstream: str, bind: str,
+                   base_port: int, max_inflight: int,
+                   serve_forever: bool):
+    """``sofa serve --replica-of <url>`` — a read-only query replica
+    pulling immutable index commits from its upstream primary."""
+    from sofa_tpu.archive import index as aindex
+
+    httpd = None
+    last_err = None
+    ports = [0] if base_port == 0 else range(base_port, base_port + 20)
+    for port_try in ports:
+        try:
+            httpd = _FleetServer((bind, port_try), _FleetHandler,
+                                 root=root, token=token, quota_mb=0.0,
+                                 max_inflight=max_inflight,
+                                 role="replica")
+            break
+        except OSError as e:
+            last_err = e
+            if getattr(e, "errno", None) != errno.EADDRINUSE:
+                break
+    if httpd is None:
+        print_error(f"serve: cannot bind {bind} near port {base_port}: "
+                    f"{last_err}")
+        return 2 if serve_forever else None
+    # tenants pulled by an earlier life of this replica serve at once
+    tdir = os.path.join(root, TENANTS_DIR_NAME)
+    try:
+        names = sorted(os.listdir(tdir))
+    except OSError:
+        names = []
+    for tenant in names:
+        troot = os.path.join(tdir, tenant)
+        if aindex.load_commit(troot) is not None:
+            aindex.pin_root(troot)
+    puller = tier.ReplicaPuller(root, upstream, token)
+    with httpd._state_guard:
+        httpd.replica = puller
+    puller.pull_once()  # best effort — the poll thread keeps trying
+    puller.start()
+    port = httpd.server_address[1]
+    from sofa_tpu.viz import _display_host
+
+    host = _display_host(bind)
+    print_progress(
+        f"fleet archive replica: {root} at http://{host}:{port}/v1/ "
+        f"(replica of {upstream}; read-only /v1/query off pulled index "
+        "commits; Ctrl-C stops)")
     if not serve_forever:
         return httpd
     try:

@@ -72,13 +72,28 @@ class ArchiveStore:
             self._init_root()
 
     def _init_root(self) -> None:
-        from sofa_tpu.durability import atomic_write
-
         os.makedirs(os.path.join(self.root, OBJECTS_DIR_NAME), exist_ok=True)
         os.makedirs(os.path.join(self.root, RUNS_DIR_NAME), exist_ok=True)
-        with atomic_write(self.marker_path, fsync=True) as f:
+        import threading
+
+        # writer-unique stage + first-writer-wins rename: pool workers
+        # (and their handler threads) creating the same tenant root
+        # concurrently must not tear each other's marker — every loser's
+        # marker said the same thing anyway
+        stage = (f"{self.marker_path}.{os.getpid()}"
+                 f".{threading.get_ident()}.tmp")
+        with open(stage, "w") as f:  # sofa-lint: disable=SL009 — writer-unique stage renamed below; atomic_write's fixed .tmp name is exactly the cross-process race being avoided
             json.dump({"schema": ARCHIVE_SCHEMA, "version": ARCHIVE_VERSION,
                        "created_unix": round(time.time(), 3)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            if os.path.isfile(self.marker_path):
+                os.unlink(stage)
+            else:
+                os.replace(stage, self.marker_path)
+        except OSError:
+            pass
 
     @property
     def exists(self) -> bool:
@@ -126,16 +141,23 @@ class ArchiveStore:
         return sha, size
 
     def put_bytes(self, blob: bytes) -> Tuple[str, int]:
-        """Store an in-memory blob; returns (sha256, bytes_added)."""
+        """Store an in-memory blob; returns (sha256, bytes_added).
+
+        Staged under a pid-unique ``.tmp`` (fsck still classifies it as
+        an orphan, never damage): two pool workers receiving the SAME
+        object concurrently (tier mode) each stage privately and the
+        renames converge on identical bytes — no fixed-name collision."""
         sha = hashlib.sha256(blob).hexdigest()
         dest = self.object_path(sha)
         if os.path.isfile(dest):
             return sha, 0
-        from sofa_tpu.durability import atomic_write
-
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        with atomic_write(dest, "wb") as f:
+        stage = f"{dest}.{os.getpid()}.tmp"
+        with open(stage, "wb") as f:  # sofa-lint: disable=SL009 — pid-unique stage renamed below; atomic_write's fixed .tmp name would collide across pool workers storing the same object
             f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(stage, dest)
         return sha, len(blob)
 
     def read_object(self, sha: str) -> Optional[bytes]:
@@ -346,7 +368,7 @@ def ingest_run(cfg, root: str, label: str = "",
 # archiving, re-archiving, or the agent stamping meta.agent/meta.serve
 # can never change the next ingest's content address ("serve" appears
 # only as a meta key, but the strip loops cover both namespaces).
-_SELF_VERBS = ("archive", "regress", "agent", "serve")
+_SELF_VERBS = ("archive", "regress", "agent", "serve", "tier")
 
 
 def _normalized_manifest(logdir: str) -> Optional[bytes]:

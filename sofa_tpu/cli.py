@@ -306,6 +306,18 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--max_inflight", type=int, dest="serve_max_inflight",
                    help="serve: concurrent write requests before 503 + "
                         "Retry-After backpressure (default 8)")
+    g.add_argument("--workers", type=int, dest="serve_workers",
+                   help="serve: pool worker processes sharing the port "
+                        "via SO_REUSEPORT (dispatcher fallback); tenants "
+                        "consistent-hash-sharded across them (default 1)")
+    g.add_argument("--replica-of", "--replica_of", dest="serve_replica_of",
+                   metavar="URL",
+                   help="serve: run as a read-only query replica of this "
+                        "primary — pulls immutable index commits, serves "
+                        "/v1/query with honest staleness headers")
+    g.add_argument("--fleet", dest="status_fleet", metavar="URL",
+                   help="status: render the live tier topology from this "
+                        "service's /v1/tier endpoint instead of a logdir")
     g.add_argument("--tenant", dest="fleet_tenant",
                    help="agent: tenant namespace to push into "
                         "(default 'default')")
@@ -403,7 +415,8 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "regress_rolling", "regress_pct", "regress_threshold",
         "live_interval_s", "live_epochs", "live_stall_s",
         "serve_bind", "serve_port", "serve_token", "serve_quota_mb",
-        "serve_max_inflight", "fleet_tenant", "agent_service",
+        "serve_max_inflight", "serve_workers", "serve_replica_of",
+        "status_fleet", "fleet_tenant", "agent_service",
         "agent_spool", "agent_poll_s", "agent_settle_s", "agent_timeout_s",
         "agent_retries", "agent_backoff_s", "agent_backoff_cap_s",
     ):
@@ -575,6 +588,12 @@ def _run(argv=None) -> int:
             print_main_progress("SOFA viz")
             sofa_viz(cfg)
             return 0
+        if cmd == "status" and getattr(cfg, "status_fleet", ""):
+            # the tier topology lives on the service, not in a logdir —
+            # no manifest load, no logdir resolution
+            from sofa_tpu.archive.tier import sofa_fleet_status
+            print_main_progress("SOFA status")
+            return sofa_fleet_status(cfg)
         if cmd in ("status", "resume", "fsck", "passes", "whatif", "live"):
             if args.usr_command and "logdir" not in vars(args):
                 # `sofa status sofalog/` reads more naturally than

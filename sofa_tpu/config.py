@@ -205,6 +205,13 @@ class SofaConfig:
     serve_quota_mb: float = 0.0      # per-tenant object-store quota (0 = off)
     serve_max_inflight: int = 8      # concurrent write requests before a
                                      # 503 + Retry-After backpressure answer
+    serve_workers: int = 1           # --workers: pool processes sharing the
+                                     # port (SO_REUSEPORT; dispatcher
+                                     # fallback), tenants hash-sharded
+    serve_replica_of: str = ""       # --replica-of: run as a read-only
+                                     # query replica of this primary URL
+    status_fleet: str = ""           # status --fleet: render /v1/tier
+                                     # topology from this service URL
     fleet_tenant: str = "default"    # tenant namespace for agent pushes
     agent_service: str = ""          # service URL (SOFA_AGENT_SERVICE env);
                                      # empty = spool-only (air-gapped) mode

@@ -38,6 +38,21 @@ what these prove is the CLIENT's retry/resume/backoff contract)::
                                            fraction (0 < f < 1) — the
                                            server's hash check rejects it
 
+Tier fault kinds (target ``service``, consumed by the SERVER side of the
+scaled tier — archive/tier.py/service.py — never by the client)::
+
+    service:worker_die@<n>     pool worker <n> (1-based ordinal) hard-
+                               exits on its next write request; the
+                               dispatcher/client retries onto a sibling
+                               and the supervisor respawns it (fires only
+                               at spawn generation 0 — a respawned worker
+                               does not die again)
+    service:replica_stale      the replica's puller pins itself at its
+                               current commit while still learning the
+                               upstream sha — /v1/query keeps answering,
+                               with the honest X-Sofa-Replica-Stale /
+                               X-Sofa-Replica-Behind headers
+
 Stream-source fault kinds (target = a tailable ingest source, consumed by
 the `sofa live` tailer in sofa_tpu/live.py — docs/LIVE.md failure matrix)::
 
@@ -83,10 +98,17 @@ from typing import Dict, List, Optional
 
 KINDS = ("die", "wedge", "fail", "truncate", "corrupt",
          "conn_refused", "stall", "http_500", "partial",
+         "worker_die", "replica_stale",
          "tail_truncate", "tail_torn", "rotate")
 #: Kinds injected into the fleet transport client (archive/client.py)
 #: rather than a collector lifecycle hook.
-NET_KINDS = ("conn_refused", "stall", "http_500", "partial")
+NET_KINDS = ("conn_refused", "stall", "http_500", "partial",
+             "worker_die", "replica_stale")
+#: The NET_KINDS subset consumed by the scaled tier's SERVER side
+#: (archive/tier.py, archive/service.py) — the transport client skips
+#: these entirely: a worker dying or a replica lagging is the tier's
+#: failure to absorb, not the client's to simulate.
+TIER_KINDS = ("worker_die", "replica_stale")
 #: Kinds injected into the `sofa live` tailer (sofa_tpu/live.py) against a
 #: streaming ingest source.  ``stall`` is shared vocabulary with NET_KINDS:
 #: against the ``service`` target it is a transport stall, against a source
@@ -181,7 +203,7 @@ class FaultPlan:
         truncated control request would be a plain 400, not the
         server-side hash rejection the kind exists to exercise."""
         for s in self._by_target.get(target, ()):
-            if s.kind not in NET_KINDS:
+            if s.kind not in NET_KINDS or s.kind in TIER_KINDS:
                 continue
             if s.kind == "partial" and op != "put":
                 continue
@@ -195,6 +217,30 @@ class FaultPlan:
                 self._fired[fkey] = True
             return s
         return None
+
+    def tier_worker_die(self, ordinal: int, generation: int) -> bool:
+        """Consult-and-consume for ``worker_die@<n>``: True exactly once,
+        for pool worker ``ordinal`` (1-based) at spawn generation 0 — a
+        respawned worker (generation > 0) never re-fires even though the
+        fork-inherited plan still lists the spec."""
+        if generation != 0:
+            return False
+        for s in self._by_target.get("service", ()):
+            if s.kind != "worker_die" or (s.epoch or 1) != ordinal:
+                continue
+            fkey = ("worker_die", ordinal)
+            with self._fired_guard:
+                if self._fired.get(fkey):
+                    continue
+                self._fired[fkey] = True
+            return True
+        return False
+
+    def tier_replica_stale(self) -> bool:
+        """Whether a ``replica_stale`` spec is active (never consumed —
+        the replica stays pinned until the plan clears)."""
+        return any(s.kind == "replica_stale"
+                   for s in self._by_target.get("service", ()))
 
 
 def parse(text: str) -> FaultPlan:
@@ -274,6 +320,24 @@ def _parse_stream(entry: str, target: str, kind: str,
 def _parse_net(entry: str, target: str, kind: str,
                when: str) -> FaultSpec:
     """One network-kind entry (NET_KINDS grammar in the module doc)."""
+    if kind == "worker_die":
+        if not when:
+            return FaultSpec(target=target, kind=kind, epoch=1)
+        try:
+            ordinal = int(when)
+        except ValueError:
+            ordinal = 0
+        if ordinal < 1:
+            raise ValueError(
+                f"fault entry {entry!r}: worker_die takes a 1-based "
+                "pool-worker ordinal (e.g. worker_die@2)")
+        return FaultSpec(target=target, kind=kind, epoch=ordinal)
+    if kind == "replica_stale":
+        if when and when != "always":
+            raise ValueError(
+                f"fault entry {entry!r}: replica_stale takes no firing "
+                "policy (it holds until the plan clears)")
+        return FaultSpec(target=target, kind=kind, when="always")
     if kind == "partial":
         try:
             fraction = float(when)
@@ -383,6 +447,26 @@ def maybe_service_fault(op: str, key: str = "",
     if plan is None:
         return None
     return plan.service_fault(target, op, key)
+
+
+def maybe_worker_die(ordinal: int, generation: int = 0) -> bool:
+    """Scaled-tier hook (archive/service.py chaos_tick): True when pool
+    worker ``ordinal`` (1-based) should hard-exit NOW — the
+    ``worker_die@<n>`` cell.  Fires once, and only at spawn generation 0:
+    the supervisor's respawn must come back healthy."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.tier_worker_die(ordinal, generation)
+
+
+def maybe_replica_stale() -> bool:
+    """Scaled-tier hook (archive/tier.py puller): True while a
+    ``replica_stale`` spec pins the replica at its current commit."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.tier_replica_stale()
 
 
 def maybe_stream_fault(source: str, epoch: int) -> Optional[FaultSpec]:

@@ -133,11 +133,13 @@ sofa_live(cfg, epochs=1)
 # parses it.  SOFA_SERVE_EXIT_AFTER makes the child hard-exit at the n-th
 # write request — the kill-service-mid-upload chaos.
 _SERVE_SNIPPET = """
-import sys
+import os, sys
 sys.path.insert(0, sys.argv[3])
 from sofa_tpu.config import SofaConfig
 from sofa_tpu.archive.service import sofa_serve
-cfg = SofaConfig(logdir=sys.argv[1], serve_token="chaos", serve_port=0)
+cfg = SofaConfig(logdir=sys.argv[1], serve_token="chaos", serve_port=0,
+                 serve_workers=int(os.environ.get(
+                     "SOFA_CHAOS_SERVE_WORKERS", "1")))
 sys.exit(sofa_serve(cfg, root=sys.argv[2]) or 0)
 """
 
@@ -876,6 +878,81 @@ def _run_agent_spool_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_worker_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """kill-worker-mid-wal-drain: a 2-worker pool's owning drainer
+    hard-exits (88) between the run-doc write and the catalog append —
+    the widest replay window.  The supervisor respawns it (disarming the
+    one-shot knob) and the WAL replay must converge: depth 0, exactly one
+    catalog line, fsck-clean.  The push itself survives on WAL
+    durability — the agent never loses the run."""
+    import json as _json
+    import signal
+    import time
+    import urllib.request
+
+    from sofa_tpu.agent import sofa_agent
+
+    logdir = os.path.join(workdir, "kill-worker") + "/"
+    store = os.path.join(workdir, "kill-worker-store")
+    spool = os.path.join(workdir, "kill-worker-spool")
+    for path in (logdir, store, spool):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    proc, url = _start_service(workdir, store,
+                               {"SOFA_CHAOS_SERVE_WORKERS": "2",
+                                "SOFA_WAL_EXIT_AFTER": "1"})
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+        if rc != 0:
+            # the commit connection died with the worker: the run is in
+            # the spool — one drain pass must deliver (WAL replay makes
+            # the re-push a committed no-op)
+            time.sleep(1.0)
+            rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                            watch=logdir, once=True)
+            if rc != 0:
+                problems.append(f"agent drain rc={rc} after the worker "
+                                "respawn (expected 0)")
+        # replay proof: WAL depth for the default tenant returns to 0
+        req = urllib.request.Request(
+            f"{url}/v1/tier", headers={"Authorization": "Bearer chaos"})
+        deadline = time.monotonic() + 30.0
+        drained = False
+        while time.monotonic() < deadline and not drained:
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    doc = _json.loads(resp.read())
+                drained = bool(doc.get("tenants")) and all(
+                    t.get("wal_depth") == 0 for t in doc["tenants"])
+            except OSError:
+                pass
+            if not drained:
+                time.sleep(0.2)
+        if not drained:
+            problems.append("WAL depth never returned to 0 after the "
+                            "worker respawn")
+        problems += _fleet_store_problems(store)
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate(timeout=10)
+    if "exited 88" not in (out or ""):
+        problems.append("no worker death observed: the pool never logged "
+                        "the chaos exit-88 respawn")
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        problems.append("no run_manifest.json after the push")
+    else:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -883,12 +960,13 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 8
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 9
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
                    ("kill-service-mid-upload", None),
                    ("agent-offline-spool-then-drain", None),
+                   ("kill-worker-mid-wal-drain", None),
                    ("kill-mid-live-epoch", None),
                    ("source-rotate-mid-tail", None),
                    ("kill-mid-index-refresh", None)])
@@ -956,7 +1034,9 @@ def main(argv=None) -> int:
         print(f"{' ' * width}    - {p}")
     for name, cell in (("kill-service-mid-upload", _run_service_kill_cell),
                        ("agent-offline-spool-then-drain",
-                        _run_agent_spool_cell)):
+                        _run_agent_spool_cell),
+                       ("kill-worker-mid-wal-drain",
+                        _run_worker_kill_cell)):
         try:
             problems = cell(workdir, synth, mc)
         except Exception:  # noqa: BLE001 — a crashed cell is a failed cell

@@ -72,6 +72,12 @@ _ARCHIVE_INDEX_SCHEMA = "sofa_tpu/archive_index"
 _ARCHIVE_INDEX_VERSION = 1
 _ARCHIVE_INDEX_FAMILIES = ("catalog", "runs", "features")
 
+# The scaled-tier commit stamp (sofa_tpu/archive/tier.py TIER_SCHEMA):
+# which pool worker committed the run, out of how many, at what queue
+# depth — written into meta.tier by `sofa agent` from the commit ack.
+_TIER_SCHEMA = "sofa_tpu/fleet_tier"
+_TIER_VERSION = 1
+
 
 def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -408,6 +414,36 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             if not _is_num(serve.get("committed_unix")):
                 probs.append("meta.serve.committed_unix: missing or not "
                              "a number")
+
+    # meta.tier (stamped by `sofa agent` from the scaled tier's commit
+    # ack, sofa_tpu/archive/tier.py): the placement record — which pool
+    # worker committed the run and the WAL depth it saw.
+    tier = (doc.get("meta") or {}).get("tier")
+    if tier is not None:
+        if not isinstance(tier, dict):
+            probs.append("meta.tier: not an object")
+        else:
+            if tier.get("schema") != _TIER_SCHEMA:
+                probs.append(f"meta.tier.schema: expected "
+                             f"{_TIER_SCHEMA!r}, got {tier.get('schema')!r}")
+            if tier.get("version") != _TIER_VERSION:
+                probs.append(f"meta.tier.version: expected "
+                             f"{_TIER_VERSION}, got {tier.get('version')!r}")
+            if not isinstance(tier.get("url"), str) or not tier.get("url"):
+                probs.append("meta.tier.url: missing or empty")
+            worker = tier.get("worker")
+            workers = tier.get("workers")
+            for key, v in (("worker", worker), ("workers", workers),
+                           ("wal_depth", tier.get("wal_depth"))):
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.tier.{key}: missing or not a "
+                                 "non-negative int")
+            if isinstance(worker, int) and isinstance(workers, int) \
+                    and not isinstance(worker, bool) \
+                    and not isinstance(workers, bool) \
+                    and (workers < 1 or not 0 <= worker < workers):
+                probs.append(f"meta.tier: worker {worker} out of range "
+                             f"for {workers} worker(s)")
 
     # meta.frames (written by preprocess, sofa_tpu/frames.py +
     # preprocess.py): which interchange format the run's frames landed
