@@ -522,6 +522,28 @@ try:
                    "fleet_saturation_rps"):
             if _k in _fl.get("metrics", {{}}):
                 out[_k] = _fl["metrics"][_k]
+        _tm = _fl.get("tier_metrics") or {{}}
+        if _tm.get("scrape_wall_ms") is not None:
+            out["tier_scrape_wall_time_s"] = round(
+                _tm["scrape_wall_ms"] / 1000.0, 4)
+        # metrics-overhead evidence (sofa_tpu/metrics.py): the SAME
+        # smoke workload with the observability plane OFF
+        # (SOFA_TIER_METRICS=0) — the saturation delta is what the
+        # per-request counters/spans cost the push path (the ISSUE's
+        # < 5% bar rides tier_metrics_overhead_pct)
+        _r2 = _sp.run(
+            [sys.executable,
+             os.path.join({root!r}, "tools", "fleet_load.py"),
+             "--smoke", "--workers", "2", "--no_metrics"],
+            capture_output=True, text=True, timeout=240,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if _r2.returncode == 0:
+            _fl2 = json.loads(_r2.stdout.strip().splitlines()[-1])
+            _on = _fl.get("metrics", {{}}).get("fleet_saturation_rps")
+            _off = _fl2.get("metrics", {{}}).get("fleet_saturation_rps")
+            if _on and _off:
+                out["tier_metrics_overhead_pct"] = round(
+                    (_off - _on) / _off * 100.0, 2)
 except Exception as e:
     out["fleet_load_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # catalog-index evidence (sofa_tpu/archive/index.py): the fleet query
@@ -622,7 +644,9 @@ print(json.dumps(out))
                     "fleet_evidence_error", "fleet_push_p50_ms",
                     "fleet_push_p99_ms", "fleet_query_p50_ms",
                     "fleet_query_p99_ms", "fleet_saturation_rps",
-                    "fleet_load_evidence_error", "live_epoch_wall_time_s",
+                    "fleet_load_evidence_error",
+                    "tier_metrics_overhead_pct", "tier_scrape_wall_time_s",
+                    "live_epoch_wall_time_s",
                     "live_lag_events", "live_evidence_error",
                     "catalog_index_refresh_wall_time_s",
                     "fleet_query_wall_time_s", "catalog_evidence_error"):
@@ -652,6 +676,12 @@ print(json.dumps(out))
                  f"{out.get('fleet_push_p99_ms')} ms, query p99 "
                  f"{out.get('fleet_query_p99_ms')} ms (2-worker pool, "
                  "tools/fleet_load.py --smoke)")
+        if "tier_metrics_overhead_pct" in out:
+            _log(f"bench: tier metrics overhead "
+                 f"{out['tier_metrics_overhead_pct']}% of push "
+                 f"saturation, scrape wall "
+                 f"{out.get('tier_scrape_wall_time_s')}s (metrics on "
+                 "vs SOFA_TIER_METRICS=0)")
         if "live_epoch_wall_time_s" in out:
             _log(f"bench: live incremental epoch "
                  f"{out['live_epoch_wall_time_s']}s, drained "
@@ -787,7 +817,8 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "catalog_index_refresh_wall_time_s",
                      "fleet_query_wall_time_s", "fleet_push_p50_ms",
                      "fleet_push_p99_ms", "fleet_query_p50_ms",
-                     "fleet_query_p99_ms", "fleet_saturation_rps")
+                     "fleet_query_p99_ms", "fleet_saturation_rps",
+                     "tier_metrics_overhead_pct", "tier_scrape_wall_time_s")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

@@ -102,10 +102,16 @@ class _AgentPass:
 def _push_meta(spool, client, logdir: str, run_id: str) -> dict:
     """Deliver one spooled run; returns the meta.agent ``push`` section
     (status pushed|spooled|rejected) and patches meta.serve on success."""
+    from sofa_tpu import metrics as fleet_metrics
     from sofa_tpu.archive.client import ServiceRejected, ServiceUnavailable
 
     t0 = time.perf_counter()
     base_attempts = client.attempts
+    # one trace id per push ATTEMPT: every request of this delivery
+    # (have/put/commit) carries it as X-Sofa-Trace, and the service's
+    # spans — handler, WAL append, drain, index commit — join under it
+    # in the exported fleet trace (docs/FLEET.md "Observing the tier")
+    client.trace_id = fleet_metrics.new_trace_id()
     try:
         result = spool.push(run_id, client)
     except ServiceRejected as e:
@@ -115,18 +121,21 @@ def _push_meta(spool, client, logdir: str, run_id: str) -> dict:
                          "gc the tenant)" if e.quota else ""))
         return {"status": "rejected", "error": str(e)[:300],
                 "quota": bool(e.quota),
+                "trace": client.trace_id,
                 "attempts": client.attempts - base_attempts,
                 "wall_s": round(time.perf_counter() - t0, 3)}
     except ServiceUnavailable as e:
         print_warning(f"agent: service unreachable for {run_id[:12]}: "
                       f"{e} — spooled, will retry")
         return {"status": "spooled", "error": str(e)[:300],
+                "trace": client.trace_id,
                 "attempts": client.attempts - base_attempts,
                 "wall_s": round(time.perf_counter() - t0, 3)}
     spool.mark_pushed(logdir, run_id, result.get("server") or {})
     return {"status": "pushed",
             "objects_sent": result.get("objects_sent", 0),
             "bytes_sent": result.get("bytes_sent", 0),
+            "trace": client.trace_id,
             "attempts": client.attempts - base_attempts,
             "wall_s": round(time.perf_counter() - t0, 3),
             "server": result.get("server") or {}}
@@ -185,6 +194,23 @@ def _process_logdir(cfg, spool, client, logdir: str,
                     # (validated by tools/manifest_check.py)
                     tel.set_meta(tier={**ack["tier"],
                                        "url": client.base})
+                if isinstance(ack.get("metrics"), dict):
+                    # the tier's observability fold rides the ack home:
+                    # the manifest records the push's trace id, wall,
+                    # and the worker's scrape/SLO state at commit time
+                    # (validated by tools/manifest_check.py)
+                    tel.set_meta(metrics={
+                        **ack["metrics"],
+                        "trace": push.get("trace") or "",
+                        "push_wall_s": push.get("wall_s"),
+                    })
+                    if ack["metrics"].get("slo_ok") is not None:
+                        tel.set_meta(slo={
+                            "ok": bool(ack["metrics"].get("slo_ok")),
+                            "breaching": list(
+                                ack["metrics"].get("slo_breaching")
+                                or []),
+                        })
             else:
                 tick.failed += 1
         tel.set_meta(agent=meta_agent)

@@ -62,10 +62,15 @@ def percentile(xs: List[float], pct: float) -> float:
 # peak memory (the out-of-core frame store's analyze_peak_rss_mb),
 # speed-of-light distance (sol_roofline: how far measured kernels sit
 # from the hardware's attainable peak — the fleet board's ranking key),
-# and millisecond latencies (the fleet tier's push/query p50/p99).
+# millisecond latencies (the fleet tier's push/query p50/p99), and the
+# observability plane's own cost pair (tier_metrics_overhead_pct /
+# tier_scrape_wall_time_s — `_overhead_pct$`/`_wall_time_s$` are pinned
+# explicitly; a blanket `_pct$` would flip the higher-is-better payoff
+# percentages like whatif_overlap_payoff_pct).
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
-    r"|_idle|_error_pct$|_rss_mb$|_sol_distance$|_ms$)")
+    r"|_idle|_error_pct$|_rss_mb$|_sol_distance$|_ms$|_overhead_pct$"
+    r"|_wall_time_s$)")
 # Lower is worse: rates and utilization (including the fleet tier's
 # saturation throughput, fleet_saturation_rps).
 _WORSE_LOW = re.compile(

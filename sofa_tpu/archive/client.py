@@ -93,6 +93,10 @@ class ServiceClient:
         # transparency counters the agent folds into meta.agent
         self.attempts = 0
         self.retried = 0
+        # cross-process push trace id (docs/FLEET.md "Observing the
+        # tier"): when set, every request carries it as X-Sofa-Trace so
+        # the service's spans join the agent's under ONE id
+        self.trace_id = ""
 
     # -- single attempt ----------------------------------------------------
     def _attempt(self, method: str, path: str, body: "bytes | None",
@@ -120,6 +124,8 @@ class ServiceClient:
                     body = body[:max(int(len(body) * spec.fraction), 1)]
             req = urllib.request.Request(url, data=body, method=method)
             req.add_header("Authorization", f"Bearer {self.token}")
+            if self.trace_id:
+                req.add_header("X-Sofa-Trace", self.trace_id)
             if body is not None:
                 req.add_header("Content-Type", "application/octet-stream")
             with urllib.request.urlopen(req,

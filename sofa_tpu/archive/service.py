@@ -107,7 +107,7 @@ class _FleetServer(http.server.ThreadingHTTPServer):
                  quota_mb: float = 0.0, max_inflight: int = 8,
                  worker: int = 0, workers: int = 1,
                  reuse_port: bool = False, role: str = "primary",
-                 generation: int = 0):
+                 generation: int = 0, slo: str = ""):
         # consumed by server_bind(), which super().__init__ invokes —
         # set BEFORE the bind happens
         self.reuse_port = bool(reuse_port)
@@ -143,6 +143,19 @@ class _FleetServer(http.server.ThreadingHTTPServer):
             self.drainer = tier.Drainer(self.root, worker=self.worker,
                                         workers=self.workers)
             self.drainer.start()
+        # The observability plane (sofa_tpu/metrics.py): per-root
+        # registry + this worker's scrape loop.  A bad --slo spec is a
+        # usage error at sofa_serve(); by here the string parses.
+        from sofa_tpu import metrics
+
+        self.metrics = metrics.for_root(self.root, worker=self.worker)
+        self.slo_spec = slo or ""
+        self.scraper = None
+        if metrics.metrics_enabled():
+            self.scraper = metrics.Scraper(
+                self.metrics, slo_targets=metrics.parse_slo(self.slo_spec),
+                role=role)
+            self.scraper.start()
 
     def server_bind(self):
         """SO_REUSEPORT before bind: every pool worker listens on the
@@ -165,6 +178,9 @@ class _FleetServer(http.server.ThreadingHTTPServer):
             drainer.stop()
         if replica is not None:
             replica.stop()
+        scraper, self.scraper = self.scraper, None
+        if scraper is not None:
+            scraper.close()
         super().server_close()
 
     # -- the write-ahead ingest queue --------------------------------------
@@ -375,7 +391,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 return None
         tenant = parts[1]
         if not _TENANT_RE.match(tenant) or tenant in (
-                TENANTS_DIR_NAME, "tier", "..", "."):
+                TENANTS_DIR_NAME, "tier", "metrics", "..", "."):
             self._json(400, {"error": "bad_tenant"})
             return None
         return tenant, parts[2:]
@@ -403,6 +419,18 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return True
         return False
 
+    def _trace_id(self) -> str:
+        """The push's cross-process trace id (X-Sofa-Trace, docs/FLEET.md
+        "Observing the tier") — empty for untraced clients."""
+        return self.headers.get("X-Sofa-Trace") or ""
+
+    def _span(self, name: str, tenant: str, t0: float, **args) -> None:
+        """One service-lane span on this worker's registry, joined to the
+        agent's trace id when the request carried one."""
+        self.server.metrics.span(
+            name, "service", t0, time.time() - t0,
+            trace=self._trace_id(), tenant=tenant, **args)
+
     # -- GET ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server handler contract
         clean = self.path.split("?", 1)[0]
@@ -418,6 +446,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 self._json(401, {"error": "unauthorized"})
                 return
             self._tier()
+            return
+        if clean == "/v1/metrics":
+            if not self.server.auth_ok(
+                    self.headers.get("Authorization")):
+                self._count("401_unauthorized")
+                self._json(401, {"error": "unauthorized"})
+                return
+            self._metrics_route()
             return
         routed = self._route(allow_token_param=clean.endswith("/query"))
         if routed is None:
@@ -518,6 +554,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
 
         if self._backpressure(tenant):
             return
+        t0 = time.time()
         qs = urllib.parse.parse_qs(self.path.partition("?")[2])
 
         def one(key, default=None):
@@ -574,6 +611,10 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             return
         self._count(f"query_{doc.get('source', '?')}")
+        reg = self.server.metrics
+        reg.inc("queries")
+        reg.inc(f"tenant_requests.{tenant}")
+        reg.observe("query", (time.time() - t0) * 1e3)
         self._json(200, {"schema": SERVICE_SCHEMA,
                          "version": SERVICE_VERSION,
                          "tenant": tenant, **doc},
@@ -594,7 +635,50 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         # only — sample repeatedly to see the whole pool)
         doc["inflight"] = self.server.inflight
         doc["max_inflight"] = self.server.max_inflight
+        from sofa_tpu import metrics as fleet_metrics
+
+        doc["metrics"] = fleet_metrics.metrics_summary(self.server.metrics)
         self._json(200, doc)
+
+    def _metrics_route(self) -> None:
+        """``GET /v1/metrics`` — this worker's observability document
+        (docs/FLEET.md "Observing the tier"): live snapshot, bounded
+        windowed history (``?offset/?limit/?window``), and the latest
+        SLO verdict.  ETag'd on the STABLE content — an idle poll (the
+        tier board's steady state) costs a 304, not a body."""
+        import urllib.parse
+
+        from sofa_tpu import metrics as fleet_metrics
+
+        qs = urllib.parse.parse_qs(self.path.partition("?")[2])
+
+        def _one(key: str) -> "str | None":
+            return (qs.get(key) or [None])[0]
+
+        try:
+            offset = int(_one("offset") or 0)
+            limit = int(_one("limit") or fleet_metrics.HISTORY_ROWS)
+            window = float(_one("window")) if _one("window") else None
+        except ValueError:
+            self._json(400, {"error": "bad_params"})
+            return
+        if offset < 0 or limit < 0 or (window is not None and window <= 0):
+            self._json(400, {"error": "bad_params"})
+            return
+        doc, etag = fleet_metrics.metrics_doc(
+            self.server.metrics, offset=offset, limit=limit,
+            window_s=window, role=self.server.role)
+        if self.headers.get("If-None-Match") == etag:
+            self._count("304_metrics")
+            self.send_response(304)
+            self.send_header("ETag", etag)
+            for key, value in _CORS_HEADERS:
+                self.send_header(key, value)
+            self.end_headers()
+            return
+        self._count("metrics_read")
+        self._json(200, doc,
+                   extra_headers=[("ETag", etag)] + list(_CORS_HEADERS))
 
     _INDEX_FILE_RE = re.compile(r"^(\d{6}\.arrow|frame_index\.json)$")
 
@@ -700,6 +784,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         """The resume point: which of the run's objects the store already
         holds, and whether the run itself is already committed — the
         client uploads exactly the rest, nothing twice."""
+        t0 = time.time()
         store = self.server.tenant_store(tenant)
         run_id = run_content_id(files)
         shas = {e["sha256"] for e in files.values()}
@@ -710,6 +795,8 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             if e.get("ev") == "ingest") or \
             run_id in tier.wal_pending_runs(store.root)
         self._count("have")
+        self.server.metrics.inc(f"tenant_requests.{tenant}")
+        self._span("have", tenant, t0, run=run_id)
         self._json(200, {"run": run_id, "have": len(shas) - len(missing),
                          "missing": missing, "committed": committed})
 
@@ -723,6 +810,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         asynchronously behind the drainer: the ack's latency is
         independent of index size.  Replaying a committed run is a pure
         no-op."""
+        t0 = time.time()
         if self.server.io_ms:
             time.sleep(self.server.io_ms / 1000.0)  # emulated storage
         store = self.server.tenant_store(tenant)
@@ -749,6 +837,12 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 "files": files,
                 "features": doc.get("features") or {},
             }
+            if self._trace_id():
+                # the trace id rides the WAL record across the process
+                # boundary: the owning worker's drainer re-emits it on
+                # its apply/refresh spans, joining agent and drain lanes
+                # under ONE id in the exported fleet trace
+                rec["trace"] = self._trace_id()
             name, end = self.server.tier_append(tenant, rec)
             self._drop_slot()  # WAL record durable; the wait is in-memory
             if not self.server.tier_wait_applied(tenant, name, end):
@@ -761,6 +855,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                            retry_after=_RETRY_AFTER_S)
                 return
         self._count("commit" if not already else "commit_replayed")
+        from sofa_tpu import metrics as fleet_metrics
+
+        push_ms = (time.time() - t0) * 1e3
+        reg = self.server.metrics
+        reg.inc("pushes")
+        reg.inc(f"tenant_requests.{tenant}")
+        reg.observe("push", push_ms)
+        self._span("commit", tenant, t0, run=run_id, new=not already)
         self._json(200, {
             "run": run_id, "committed": True, "new": not already,
             "tenant": tenant,
@@ -771,6 +873,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                      "worker": self.server.worker,
                      "workers": self.server.workers,
                      "wal_depth": tier.wal_depth(store.root)},
+            "metrics": fleet_metrics.metrics_summary(reg),
         })
 
     # -- PUT (one content-addressed object == one upload chunk) ------------
@@ -784,6 +887,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(404, {"error": "no_such_route"})
             return
         sha = rest[1]
+        t0 = time.time()
         if self._read_only():
             return
         if not self.server.write_slot():
@@ -832,6 +936,10 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             if added:
                 self.server.charge_tenant(tenant, added)
             self._count("object_stored" if added else "object_dedup")
+            self.server.metrics.inc("objects_put")
+            self.server.metrics.inc(f"tenant_requests.{tenant}")
+            self._span("put_object", tenant, t0, sha=sha[:12],
+                       bytes=len(data))
             self._json(200, {"sha256": sha, "new": bool(added)})
         finally:
             self.server.release_slot()
@@ -899,6 +1007,15 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
     base_port = int(getattr(cfg, "serve_port", 8044) or 0)
     replica_of = (getattr(cfg, "serve_replica_of", "") or "").rstrip("/")
     workers = max(int(getattr(cfg, "serve_workers", 1) or 1), 1)
+    slo = (getattr(cfg, "serve_slo", "") or "").strip()
+    if slo:
+        from sofa_tpu import metrics as fleet_metrics
+
+        try:
+            fleet_metrics.parse_slo(slo)
+        except ValueError as e:
+            print_error(f"serve: bad --slo spec: {e}")
+            return 2 if serve_forever else None
     if replica_of and workers > 1:
         print_error("serve: --workers scales the PRIMARY; a replica is "
                     "one read-only process (run several replicas "
@@ -906,10 +1023,10 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
         return 2 if serve_forever else None
     if replica_of:
         return _serve_replica(root, token, replica_of, bind, base_port,
-                              max_inflight, serve_forever)
+                              max_inflight, serve_forever, slo=slo)
     if workers > 1:
         return _serve_pool(root, token, bind, base_port, quota_mb,
-                           max_inflight, workers, serve_forever)
+                           max_inflight, workers, serve_forever, slo=slo)
     httpd = None
     last_err = None
     ports = [0] if base_port == 0 else range(base_port, base_port + 20)
@@ -917,7 +1034,7 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
         try:
             httpd = _FleetServer((bind, port_try), _FleetHandler,
                                  root=root, token=token, quota_mb=quota_mb,
-                                 max_inflight=max_inflight)
+                                 max_inflight=max_inflight, slo=slo)
             break
         except OSError as e:
             last_err = e
@@ -955,11 +1072,11 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
 
 def _serve_pool(root: str, token: str, bind: str, base_port: int,
                 quota_mb: float, max_inflight: int, workers: int,
-                serve_forever: bool):
+                serve_forever: bool, slo: str = ""):
     """``sofa serve --workers N`` — the sharded worker pool.  Returns a
     running :class:`tier.TierHandle` when ``serve_forever=False``."""
     handle = tier.start_pool(root, token, bind, base_port, quota_mb,
-                             max_inflight, workers)
+                             max_inflight, workers, slo=slo)
     if handle is None:
         return 2 if serve_forever else None
     from sofa_tpu.viz import _display_host
@@ -989,7 +1106,7 @@ def _serve_pool(root: str, token: str, bind: str, base_port: int,
 
 def _serve_replica(root: str, token: str, upstream: str, bind: str,
                    base_port: int, max_inflight: int,
-                   serve_forever: bool):
+                   serve_forever: bool, slo: str = ""):
     """``sofa serve --replica-of <url>`` — a read-only query replica
     pulling immutable index commits from its upstream primary."""
     from sofa_tpu.archive import index as aindex
@@ -1002,7 +1119,7 @@ def _serve_replica(root: str, token: str, upstream: str, bind: str,
             httpd = _FleetServer((bind, port_try), _FleetHandler,
                                  root=root, token=token, quota_mb=0.0,
                                  max_inflight=max_inflight,
-                                 role="replica")
+                                 role="replica", slo=slo)
             break
         except OSError as e:
             last_err = e

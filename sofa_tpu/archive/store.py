@@ -366,9 +366,12 @@ def ingest_run(cfg, root: str, label: str = "",
 # Verbs whose manifest sections describe ARCHIVING/SHIPPING the run
 # rather than the run itself: stripped by normalization so that
 # archiving, re-archiving, or the agent stamping meta.agent/meta.serve
-# can never change the next ingest's content address ("serve" appears
-# only as a meta key, but the strip loops cover both namespaces).
-_SELF_VERBS = ("archive", "regress", "agent", "serve", "tier")
+# can never change the next ingest's content address ("serve",
+# "metrics", and "slo" appear only as meta keys — the ack's
+# observability fold carries a per-push trace id and wall time — but
+# the strip loops cover both namespaces).
+_SELF_VERBS = ("archive", "regress", "agent", "serve", "tier",
+               "metrics", "slo")
 
 
 def _normalized_manifest(logdir: str) -> Optional[bytes]:

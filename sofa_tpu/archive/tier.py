@@ -292,12 +292,17 @@ class WalAppender:
     def append(self, record: dict) -> Tuple[str, int]:
         """Durably append one record; returns (file name, end offset) —
         the coordinates a commit ack waits on.  Stamps the record's
-        timestamp HERE so replay reproduces identical bytes."""
+        timestamp HERE so replay reproduces identical bytes.  The
+        record's ``trace`` key (the push's X-Sofa-Trace id) rides the
+        WAL line across the process boundary — that is how one trace id
+        spans the handler's process and the drainer's."""
+        from sofa_tpu import metrics
         from sofa_tpu.durability import fsync_append
 
         record = dict(record)
         record.setdefault("t", round(time.time(), 3))
         line = json.dumps(record, sort_keys=True) + "\n"
+        t0 = time.time()
         with self._guard:
             name = self._name(self._epoch)
             path = os.path.join(wal_dir(self.tenant_root), name)  # sofa-lint: disable=SL020 — os.path.join is pure string math, not IO; the .join blocking-method heuristic misfires
@@ -312,7 +317,13 @@ class WalAppender:
                 path = os.path.join(wal_dir(self.tenant_root), name)  # sofa-lint: disable=SL020 — os.path.join is pure string math, not IO
                 size = 0
             fsync_append(path, line)
-            return name, size + len(line)
+        reg = metrics.for_tenant_root(self.tenant_root)
+        reg.inc("wal_appends")
+        reg.span("wal_append", "wal", t0, time.time() - t0,
+                 trace=str(record.get("trace") or ""),
+                 tenant=os.path.basename(self.tenant_root),
+                 run=str(record.get("run") or ""))
+        return name, size + len(line)
 
     def _gc_applied_epochs(self) -> None:
         """Unlink MY old epochs the owner has fully applied+refreshed.
@@ -350,6 +361,7 @@ def drain_tenant(tenant_root: str, refresh: bool = True,
     window).  Journaled as stage ``wal_drain`` in the tenant root.
     Returns ``{"applied", "replayed", "refreshed"}``."""
     global _WAL_APPLIED_TICKS
+    from sofa_tpu import metrics
     from sofa_tpu.archive.store import RUN_SCHEMA, RUN_VERSION, ArchiveStore
     from sofa_tpu.durability import Journal, atomic_write
 
@@ -363,6 +375,7 @@ def drain_tenant(tenant_root: str, refresh: bool = True,
     store = ArchiveStore(tenant_root, create=True)
     journal = Journal(tenant_root)
     tenant = os.path.basename(tenant_root)
+    reg = metrics.for_tenant_root(tenant_root)
     applied = replayed = 0
     if pend:
         journal.begin("wal_drain", key=tenant, records=len(pend))
@@ -372,6 +385,7 @@ def drain_tenant(tenant_root: str, refresh: bool = True,
         chaos_n = _chaos_wal_exit_after()
         for name, end, rec in pend:
             run_id = rec["run"]
+            rec_t0 = time.time()
             if run_id in cataloged:
                 replayed += 1
             else:
@@ -407,11 +421,21 @@ def drain_tenant(tenant_root: str, refresh: bool = True,
             # record leaves as soon as it lands, not after the whole
             # batch (the closed-loop latency = batch length otherwise)
             _save_wal_state(tenant_root, state, fsync=False)
+            reg.span("wal_apply", "drain", rec_t0, time.time() - rec_t0,
+                     trace=str(rec.get("trace") or ""), tenant=tenant,
+                     run=run_id)
             if on_applied is not None:
                 on_applied(name, end)
         _save_wal_state(tenant_root, state)
         journal.commit("wal_drain", key=tenant,
                        applied=applied, replayed=replayed)
+        reg.inc("wal_drained", applied)
+        reg.set_gauge("last_drain_unix", round(time.time(), 3))
+        # the ids drained here surface again under the NEXT coalesced
+        # index refresh's commit span — the drain→index-commit leg of
+        # the push trace
+        reg.mark_pending_refresh(
+            tenant, [str(rec.get("trace") or "") for _n, _e, rec in pend])
     did_refresh = refresh_tenant(tenant_root) if refresh else False
     return {"applied": applied, "replayed": replayed,
             "refreshed": did_refresh}
@@ -427,9 +451,21 @@ def refresh_tenant(tenant_root: str) -> bool:
     if not any(int(state["refreshed"].get(n, 0)) < int(off)
                for n, off in covered.items()):
         return False
+    from sofa_tpu import metrics
     from sofa_tpu.archive import index as aindex
 
+    t0 = time.time()
     aindex.refresh_after_ingest(tenant_root)
+    wall_s = time.time() - t0
+    tenant = os.path.basename(tenant_root)
+    reg = metrics.for_tenant_root(tenant_root)
+    reg.observe("index_refresh", wall_s * 1e3)
+    traces = reg.take_pending_refresh(tenant) or [""]
+    for tid in traces:
+        # one commit span per drained trace id: the refresh is coalesced,
+        # but each push's timeline still shows ITS index commit
+        reg.span("index_commit", "refresh", t0, wall_s, trace=tid,
+                 tenant=tenant)
     # re-load before saving: the drainer thread may have advanced the
     # applied ledger during the refresh — never clobber it backwards.
     # (Both races left are benign: a lost `refreshed` update re-runs a
@@ -685,14 +721,18 @@ def render_tier_status(doc: dict, url: str) -> List[str]:
 
 
 def sofa_fleet_status(cfg) -> int:
-    """``sofa status --fleet <url>`` — render the live tier topology."""
+    """``sofa status --fleet <url>`` — render the live tier topology,
+    replica staleness (the X-Sofa-Replica-Stale/-Behind headers read
+    explicitly, not only when a query happens to surface them), and the
+    metrics plane's SLO state.  Exit 0 healthy, 1 on unreachable tier OR
+    an ACTIVE SLO breach — scriptable the way `sofa regress` is."""
+    from sofa_tpu import metrics as fleet_metrics
     from sofa_tpu.archive.service import resolve_token
 
     url = (getattr(cfg, "status_fleet", "") or "").rstrip("/")
     token = resolve_token(cfg)
-    req = urllib.request.Request(
-        f"{url}/v1/tier",
-        headers={"Authorization": f"Bearer {token}"} if token else {})
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(f"{url}/v1/tier", headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=10.0) as r:
             doc = json.loads(r.read())
@@ -704,7 +744,104 @@ def sofa_fleet_status(cfg) -> int:
                     f"{TIER_SCHEMA} document")
         return 1
     print("\n".join(render_tier_status(doc, url)))
-    return 0
+    if doc.get("role") == "replica":
+        for line in _replica_staleness_lines(url, headers, doc):
+            print(line)
+    rc = 0
+    mdoc = _fetch_metrics_doc(url, headers)
+    if mdoc is not None:
+        lines, breach = render_fleet_metrics(mdoc)
+        for line in lines:
+            print(line)
+        if breach:
+            print_error("status --fleet: SLO breach ACTIVE — "
+                        + ", ".join((mdoc.get("slo") or {})
+                                    .get("breaching") or []))
+            rc = 1
+        last = mdoc.get("last_scrape_unix") or 0.0
+        age_s = time.time() - last if last else 0.0  # sofa-lint: disable=SL003 — last_scrape_unix is another process's wall-clock stamp; monotonic has no common epoch with it
+        if age_s > fleet_metrics.STALE_SCRAPE_S:
+            print_warning(
+                f"status --fleet: last metrics scrape is "
+                f"{age_s:.0f}s old (> "
+                f"{fleet_metrics.STALE_SCRAPE_S:.0f}s) — the scrape "
+                "loop may be stalled")
+    return rc
+
+
+def _fetch_metrics_doc(url: str, headers: dict) -> "dict | None":
+    """GET /v1/metrics, best-effort: a tier predating the metrics plane
+    (404) — or one with metrics disabled — just renders nothing."""
+    from sofa_tpu.metrics import METRICS_SCHEMA
+
+    req = urllib.request.Request(f"{url}/v1/metrics", headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            doc = json.loads(r.read())
+    except (OSError, ValueError, urllib.error.URLError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != METRICS_SCHEMA:
+        return None
+    return doc
+
+
+def _replica_staleness_lines(url: str, headers: dict,
+                             doc: dict) -> List[str]:
+    """One explicit staleness line per tenant, read from the query
+    endpoint's X-Sofa-Replica-Stale/-Behind headers (the honest-
+    staleness contract) instead of relying on whatever headers the last
+    incidental query happened to carry."""
+    lines: List[str] = []
+    for t in doc.get("tenants") or []:
+        tenant = t.get("tenant")
+        if not tenant:
+            continue
+        req = urllib.request.Request(
+            f"{url}/v1/{tenant}/query?limit=1", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                hdr = r.headers
+        except urllib.error.HTTPError as e:
+            hdr = e.headers
+        except (OSError, urllib.error.URLError):
+            continue
+        if hdr.get("X-Sofa-Replica-Stale"):
+            behind = hdr.get("X-Sofa-Replica-Behind") or ""
+            lines.append(f"  replica: tenant {tenant} STALE — upstream "
+                         f"moved to {behind[:12]} "
+                         "(X-Sofa-Replica-Stale/-Behind)")
+        elif hdr.get("X-Sofa-Replica"):
+            lines.append(f"  replica: tenant {tenant} current "
+                         f"(commit {(hdr.get('X-Sofa-Replica-Commit') or '-')[:12]})")
+    return lines
+
+
+def render_fleet_metrics(mdoc: dict) -> "Tuple[List[str], bool]":
+    """(lines, breach_active) from a /v1/metrics document — the
+    `sofa status --fleet` metrics block."""
+    lines: List[str] = []
+    snap = mdoc.get("snapshot") or {}
+    last = mdoc.get("last_scrape_unix") or 0.0
+    age = f"{max(time.time() - last, 0.0):.1f}s ago" if last \
+        else "never (no scrape yet)"
+    lines.append(
+        f"  metrics: worker {mdoc.get('worker', '?')}, last scrape "
+        f"{age}, push p99 {snap.get('push_p99_ms', '-')} ms, "
+        f"wal depth {snap.get('wal_depth', '-')}, replica behind "
+        f"{snap.get('replica_behind', '-')}")
+    slo = mdoc.get("slo")
+    breach = False
+    if isinstance(slo, dict):
+        for t in slo.get("targets") or []:
+            mark = {"ok": "ok", "breach": "BREACH",
+                    "no_data": "no data"}.get(t.get("status"), "?")
+            obs = t.get("observed")
+            lines.append(
+                f"  slo: {t.get('name')}{t.get('op')}{t.get('value'):g} "
+                f"-> {mark}"
+                + (f" (observed {obs:g})" if obs is not None else ""))
+        breach = not slo.get("ok", True)
+    return lines, breach
 
 
 # ---------------------------------------------------------------------------
@@ -879,7 +1016,13 @@ class ReplicaPuller:
 
     def pull_once(self) -> dict:
         """One pull across every upstream tenant; returns the summed
-        stats plus per-tenant results."""
+        stats plus per-tenant results.  Emits a ``replica_pull`` span
+        and the ``replica_behind`` gauge (tenants whose served commit
+        trails the upstream sha) into the root's metrics registry —
+        the staleness history /v1/metrics serves."""
+        from sofa_tpu import metrics
+
+        t0 = time.time()
         totals = {"fetched_chunks": 0, "reused_chunks": 0, "unchanged": 0,
                   "stale": 0, "errors": []}
         results: Dict[str, dict] = {}
@@ -893,6 +1036,16 @@ class ReplicaPuller:
             if res.get("error"):
                 totals["errors"].append(f"{tenant}: {res['error']}")
         totals["tenants"] = results
+        behind = sum(1 for s in self.state().values()
+                     if s.get("upstream") and s.get("upstream")
+                     != s.get("sha"))
+        reg = metrics.for_root(self.root)
+        reg.inc("replica_pulls")
+        reg.set_gauge("replica_behind", behind)
+        reg.observe("replica_pull", (time.time() - t0) * 1e3)
+        reg.span("replica_pull", "replica", t0, time.time() - t0,
+                 fetched=totals["fetched_chunks"],
+                 stale=totals["stale"], behind=behind)
         return totals
 
     # -- lifecycle ---------------------------------------------------------
@@ -977,7 +1130,8 @@ def _worker_main(spec: dict, worker: int, generation: int, ready) -> None:
             addr, _FleetHandler, root=spec["root"], token=spec["token"],
             quota_mb=spec["quota_mb"], max_inflight=spec["max_inflight"],
             worker=worker, workers=spec["workers"],
-            reuse_port=spec["reuse"], generation=generation)
+            reuse_port=spec["reuse"], generation=generation,
+            slo=spec.get("slo", ""))
     except OSError as e:
         ready.put({"worker": worker, "error": str(e)})
         return
@@ -1026,7 +1180,7 @@ class _DispatchHandler(__import__("http.server", fromlist=["x"])
         body = self.rfile.read(n) if n > 0 else b""
         fwd = {k: v for k, v in self.headers.items()
                if k.lower() in ("authorization", "content-type",
-                                "if-none-match")}
+                                "if-none-match", "x-sofa-trace")}
         for port in self._targets():
             conn = http.client.HTTPConnection("127.0.0.1", port,
                                               timeout=60.0)
@@ -1240,7 +1394,7 @@ class TierHandle:
 
 def start_pool(root: str, token: str, bind: str, base_port: int,
                quota_mb: float, max_inflight: int,
-               workers: int) -> "TierHandle | None":
+               workers: int, slo: str = "") -> "TierHandle | None":
     """Spawn the N-worker pool; returns the running handle or None."""
     import multiprocessing
 
@@ -1249,7 +1403,8 @@ def start_pool(root: str, token: str, bind: str, base_port: int,
     reuse = reuseport_available()
     spec = {"root": os.path.abspath(root), "token": token,
             "quota_mb": quota_mb, "max_inflight": max_inflight,
-            "bind": bind, "port": 0, "reuse": reuse, "workers": workers}
+            "bind": bind, "port": 0, "reuse": reuse, "workers": workers,
+            "slo": slo}
     reserve_sock = None
     dispatcher = None
     try:

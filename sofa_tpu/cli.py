@@ -315,6 +315,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve: run as a read-only query replica of this "
                         "primary — pulls immutable index commits, serves "
                         "/v1/query with honest staleness headers")
+    g.add_argument("--slo", dest="serve_slo", metavar="SPEC",
+                   help="serve: declared service-level objectives, e.g. "
+                        "'push_p99_ms<50,wal_depth<1000,replica_behind<3' "
+                        "— evaluated per scrape window into a typed "
+                        "slo_verdict; breaches hit the catalog and "
+                        "`sofa status --fleet` exits nonzero "
+                        "(docs/FLEET.md)")
     g.add_argument("--fleet", dest="status_fleet", metavar="URL",
                    help="status: render the live tier topology from this "
                         "service's /v1/tier endpoint instead of a logdir")
@@ -416,6 +423,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "live_interval_s", "live_epochs", "live_stall_s",
         "serve_bind", "serve_port", "serve_token", "serve_quota_mb",
         "serve_max_inflight", "serve_workers", "serve_replica_of",
+        "serve_slo",
         "status_fleet", "fleet_tenant", "agent_service",
         "agent_spool", "agent_poll_s", "agent_settle_s", "agent_timeout_s",
         "agent_retries", "agent_backoff_s", "agent_backoff_cap_s",

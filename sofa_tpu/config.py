@@ -210,6 +210,10 @@ class SofaConfig:
                                      # fallback), tenants hash-sharded
     serve_replica_of: str = ""       # --replica-of: run as a read-only
                                      # query replica of this primary URL
+    serve_slo: str = ""              # --slo: declared SLO targets, e.g.
+                                     # 'push_p99_ms<50,wal_depth<1000' —
+                                     # evaluated per scrape window
+                                     # (metrics.parse_slo grammar)
     status_fleet: str = ""           # status --fleet: render /v1/tier
                                      # topology from this service URL
     fleet_tenant: str = "default"    # tenant namespace for agent pushes

@@ -52,6 +52,17 @@ scaled tier — archive/tier.py/service.py — never by the client)::
                                upstream sha — /v1/query keeps answering,
                                with the honest X-Sofa-Replica-Stale /
                                X-Sofa-Replica-Behind headers
+    service:slo_breach@<n>     scrape window <n> (1-based ordinal) of the
+                               metrics plane reports a synthetic breach —
+                               the typed slo_verdict, the catalog breach
+                               event and the ``sofa status --fleet``
+                               nonzero exit are exercisable without
+                               hand-building real load (fires once)
+    service:scrape_stall       the metrics scrape loop freezes: ticks
+                               return without scraping, so last-scrape
+                               age grows and the stale-scrape warning
+                               path through manifest_warnings is
+                               reachable (holds until the plan clears)
 
 Stream-source fault kinds (target = a tailable ingest source, consumed by
 the `sofa live` tailer in sofa_tpu/live.py — docs/LIVE.md failure matrix)::
@@ -98,17 +109,18 @@ from typing import Dict, List, Optional
 
 KINDS = ("die", "wedge", "fail", "truncate", "corrupt",
          "conn_refused", "stall", "http_500", "partial",
-         "worker_die", "replica_stale",
+         "worker_die", "replica_stale", "slo_breach", "scrape_stall",
          "tail_truncate", "tail_torn", "rotate")
 #: Kinds injected into the fleet transport client (archive/client.py)
 #: rather than a collector lifecycle hook.
 NET_KINDS = ("conn_refused", "stall", "http_500", "partial",
-             "worker_die", "replica_stale")
+             "worker_die", "replica_stale", "slo_breach", "scrape_stall")
 #: The NET_KINDS subset consumed by the scaled tier's SERVER side
-#: (archive/tier.py, archive/service.py) — the transport client skips
-#: these entirely: a worker dying or a replica lagging is the tier's
-#: failure to absorb, not the client's to simulate.
-TIER_KINDS = ("worker_die", "replica_stale")
+#: (archive/tier.py, archive/service.py, sofa_tpu/metrics.py) — the
+#: transport client skips these entirely: a worker dying, a replica
+#: lagging or the metrics plane misbehaving is the tier's failure to
+#: absorb, not the client's to simulate.
+TIER_KINDS = ("worker_die", "replica_stale", "slo_breach", "scrape_stall")
 #: Kinds injected into the `sofa live` tailer (sofa_tpu/live.py) against a
 #: streaming ingest source.  ``stall`` is shared vocabulary with NET_KINDS:
 #: against the ``service`` target it is a transport stall, against a source
@@ -242,6 +254,29 @@ class FaultPlan:
         return any(s.kind == "replica_stale"
                    for s in self._by_target.get("service", ()))
 
+    def tier_slo_breach(self, window: int) -> bool:
+        """Consult-and-consume for ``slo_breach@<n>``: True exactly once,
+        at scrape window ``window`` (1-based) — the metrics plane folds a
+        synthetic breached target into that window's verdict so the
+        breach plumbing (typed verdict, catalog event, fleet-status exit)
+        is exercised without real load."""
+        for s in self._by_target.get("service", ()):
+            if s.kind != "slo_breach" or (s.epoch or 1) != window:
+                continue
+            fkey = ("slo_breach", window)
+            with self._fired_guard:
+                if self._fired.get(fkey):
+                    continue
+                self._fired[fkey] = True
+            return True
+        return False
+
+    def tier_scrape_stall(self) -> bool:
+        """Whether a ``scrape_stall`` spec is active (never consumed —
+        scrape ticks keep skipping until the plan clears)."""
+        return any(s.kind == "scrape_stall"
+                   for s in self._by_target.get("service", ()))
+
 
 def parse(text: str) -> FaultPlan:
     """Parse a spec string; raises ValueError with the offending entry."""
@@ -332,12 +367,24 @@ def _parse_net(entry: str, target: str, kind: str,
                 f"fault entry {entry!r}: worker_die takes a 1-based "
                 "pool-worker ordinal (e.g. worker_die@2)")
         return FaultSpec(target=target, kind=kind, epoch=ordinal)
-    if kind == "replica_stale":
+    if kind in ("replica_stale", "scrape_stall"):
         if when and when != "always":
             raise ValueError(
-                f"fault entry {entry!r}: replica_stale takes no firing "
+                f"fault entry {entry!r}: {kind} takes no firing "
                 "policy (it holds until the plan clears)")
         return FaultSpec(target=target, kind=kind, when="always")
+    if kind == "slo_breach":
+        if not when:
+            return FaultSpec(target=target, kind=kind, epoch=1)
+        try:
+            window = int(when)
+        except ValueError:
+            window = 0
+        if window < 1:
+            raise ValueError(
+                f"fault entry {entry!r}: slo_breach takes a 1-based "
+                "scrape-window ordinal (e.g. slo_breach@2)")
+        return FaultSpec(target=target, kind=kind, epoch=window)
     if kind == "partial":
         try:
             fraction = float(when)
@@ -467,6 +514,26 @@ def maybe_replica_stale() -> bool:
     if plan is None:
         return False
     return plan.tier_replica_stale()
+
+
+def maybe_slo_breach(window: int) -> bool:
+    """Metrics-plane hook (sofa_tpu/metrics.py): True when scrape window
+    ``window`` (1-based) should fold a synthetic breached target into its
+    slo_verdict — the ``slo_breach@<n>`` cell.  Fires once."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.tier_slo_breach(window)
+
+
+def maybe_scrape_stall() -> bool:
+    """Metrics-plane hook (sofa_tpu/metrics.py): True while a
+    ``scrape_stall`` spec freezes the scrape loop — ticks return without
+    scraping, so last-scrape age grows honestly."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.tier_scrape_stall()
 
 
 def maybe_stream_fault(source: str, epoch: int) -> Optional[FaultSpec]:

@@ -539,6 +539,27 @@ def manifest_warnings(doc: "dict | None") -> List[str]:
                 f"({push.get('status')}) — it is durable in the spool "
                 f"({agent_meta.get('spool')}) and retries on the next "
                 "agent pass")
+    metrics_meta = (doc.get("meta") or {}).get("metrics")
+    if isinstance(metrics_meta, dict):
+        from sofa_tpu import metrics as fleet_metrics
+
+        age = metrics_meta.get("scrape_age_s")
+        if isinstance(age, (int, float)) and \
+                age > fleet_metrics.STALE_SCRAPE_S:
+            out.append(
+                f"the tier worker that committed this run had not "
+                f"scraped its metrics for {age:.0f}s at commit time — "
+                "its /v1/metrics view (and any SLO verdict) was stale; "
+                "check the worker's scrape loop (docs/FLEET.md "
+                "\"Observing the tier\")")
+    slo_meta = (doc.get("meta") or {}).get("slo")
+    if isinstance(slo_meta, dict) and slo_meta.get("ok") is False:
+        names = ", ".join(str(n) for n in
+                          (slo_meta.get("breaching") or [])) or "unknown"
+        out.append(
+            f"the tier was BREACHING its declared SLO ({names}) when "
+            "this run committed — `sofa status --fleet` shows the live "
+            "verdict")
     fsck = (doc.get("meta") or {}).get("fsck")
     if isinstance(fsck, dict) and fsck.get("ok") is False:
         problems = fsck.get("problems") or {}

@@ -688,7 +688,16 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # marked roots the sweep/digest walks skip wholesale —
                  # registered for inventory closure, like the fleet
                  # ledgers above.
-                 "index_commit.json", "catalog.gen"]
+                 "index_commit.json", "catalog.gen",
+                 # fleet tier SLO verdict (sofa_tpu/metrics.py): the
+                 # scrape loop's per-window judgement, rewritten every
+                 # evaluation under <root>/_metrics/ — registered for
+                 # inventory closure like the fleet ledgers
+                 "slo_verdict.json",
+                 # fleet trace export (sofa_tpu/metrics.py): the merged
+                 # Chrome-trace ring from every worker's flush —
+                 # regenerated at will by export_fleet_trace
+                 "fleet_trace.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles",
                 # chunked columnar frame store (sofa_tpu/frames.py): the
@@ -699,7 +708,12 @@ DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 # derived state under an archive root — `sofa archive
                 # fsck --repair` drops + rebuilds it; registered for the
                 # same closure reason as the fleet ledgers
-                "_index"]
+                "_index",
+                # fleet tier observability plane (sofa_tpu/metrics.py):
+                # scraped metrics history chunks, trace rings, and the
+                # SLO verdict under a served root — pure derived state
+                # the running tier regenerates continuously
+                "_metrics"]
 
 # Never digested (the fsck ledger's skip-list): the ledgers themselves —
 # they change on every write, including fsck's own — live sentinels, and
@@ -718,6 +732,9 @@ DIGEST_SKIP_FILES = frozenset({
     # rewritten every `sofa live` epoch (it IS the epoch's commit
     # point); digesting it would turn each tick into fsck damage
     "_live_offsets.json",
+    # rewritten by every fleet tier scrape window / trace export
+    # (sofa_tpu/metrics.py) with no digest refresh in sight
+    "slo_verdict.json", "fleet_trace.json",
 })
 DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
@@ -728,6 +745,11 @@ DIGEST_SKIP_DIRS = frozenset({
     # index's sha-per-chunk job instead, enforced by fsck re-hashing
     # every committed chunk through frames.verify_frame_store
     "_frames",
+    # the fleet tier's observability plane (sofa_tpu/metrics.py): the
+    # scrape loop rewrites history chunks, trace rings, and the SLO
+    # verdict continuously while the tier serves — digesting them would
+    # turn every scrape window into fsck damage
+    "_metrics",
 })
 
 

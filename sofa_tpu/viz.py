@@ -297,6 +297,12 @@ def sofa_viz(cfg, serve_forever: bool = True):
                 "columnar catalog index (archive ls / regress --rolling "
                 "read the same index; docs/ARCHIVE.md). Point it at a "
                 "`sofa serve` /v1/query endpoint for the live fleet view")
+        print_progress(
+            "tier board: /tier.html watches a `sofa serve` worker's "
+            "observability plane — push-latency sparklines, WAL depth, "
+            "replica lag, and the declared-SLO verdict, polled from the "
+            "authenticated /v1/metrics endpoint with ETag-aware refresh "
+            "(docs/FLEET.md \"Observing the tier\")")
     from sofa_tpu.live import OFFSETS_NAME
 
     if os.path.isfile(os.path.join(cfg.logdir, OFFSETS_NAME)):

@@ -953,6 +953,103 @@ def _run_worker_kill_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_metrics_survival_cell(workdir: str, synth: str, mc) -> List[str]:
+    """kill-worker-metrics-survive: a pool worker that has already
+    persisted scrape windows to the ``_metrics/worker*`` chunk store is
+    SIGKILLed mid-drain (SOFA_WAL_EXIT_AFTER).  After the supervisor
+    respawn the history store must still open — no torn chunk, index
+    consistent (the scrape's atomic-publish discipline is the claim
+    under test) — a live /v1/metrics doc must validate against the
+    sofa_tpu/fleet_metrics schema, and the tenant store stays
+    fsck-clean."""
+    import json as _json
+    import signal
+    import time
+    import urllib.request
+
+    from sofa_tpu import frames
+    from sofa_tpu.agent import sofa_agent
+
+    logdir = os.path.join(workdir, "metrics-survive") + "/"
+    store = os.path.join(workdir, "metrics-survive-store")
+    spool = os.path.join(workdir, "metrics-survive-spool")
+    for path in (logdir, store, spool):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    # fast scrape so history chunks exist before AND after the kill
+    proc, url = _start_service(workdir, store,
+                               {"SOFA_CHAOS_SERVE_WORKERS": "2",
+                                "SOFA_WAL_EXIT_AFTER": "1",
+                                "SOFA_METRICS_SCRAPE_S": "0.2"})
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+        if rc != 0:
+            # commit connection died with the worker — one drain pass
+            # after the respawn must deliver
+            time.sleep(1.0)
+            rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                            watch=logdir, once=True)
+            if rc != 0:
+                problems.append(f"agent drain rc={rc} after the worker "
+                                "respawn (expected 0)")
+        # let the respawned workers run a few scrape windows
+        time.sleep(1.0)
+        # live metrics doc from whichever worker answers
+        req = urllib.request.Request(
+            f"{url}/v1/metrics",
+            headers={"Authorization": "Bearer chaos"})
+        deadline = time.monotonic() + 30.0
+        mdoc = None
+        while time.monotonic() < deadline and mdoc is None:
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    doc = _json.loads(resp.read())
+                if doc.get("scrape_seq"):
+                    mdoc = doc
+            except OSError:
+                pass
+            if mdoc is None:
+                time.sleep(0.2)
+        if mdoc is None:
+            problems.append("no scraped /v1/metrics doc within 30s of "
+                            "the worker respawn")
+        else:
+            problems += [f"/v1/metrics: {p}"
+                         for p in mc.validate_fleet_metrics(mdoc)]
+        problems += _fleet_store_problems(store)
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate(timeout=10)
+    if "exited 88" not in (out or ""):
+        problems.append("no worker death observed: the pool never logged "
+                        "the chaos exit-88 respawn")
+    # the persisted history survived the kill: every worker store opens
+    # with a consistent index and no torn chunk
+    mdir = os.path.join(store, "_metrics")
+    stores = sorted(n for n in (os.listdir(mdir)
+                                if os.path.isdir(mdir) else [])
+                    if n.startswith("worker"))
+    if frames.columnar_available():
+        if not stores:
+            problems.append("no _metrics/worker* history store persisted "
+                            "before the kill")
+        for name in stores:
+            sdir = os.path.join(mdir, name)
+            problems += [f"{name}: {p}" for p in
+                         frames.verify_chunk_store(sdir, f"_metrics/{name}")]
+            if frames.open_chunk_store(sdir) is None:
+                problems.append(f"{name}: history chunk store does not "
+                                "open after the worker kill")
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -960,13 +1057,14 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 9
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 10
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
                    ("kill-service-mid-upload", None),
                    ("agent-offline-spool-then-drain", None),
                    ("kill-worker-mid-wal-drain", None),
+                   ("kill-worker-metrics-survive", None),
                    ("kill-mid-live-epoch", None),
                    ("source-rotate-mid-tail", None),
                    ("kill-mid-index-refresh", None)])
@@ -1036,7 +1134,9 @@ def main(argv=None) -> int:
                        ("agent-offline-spool-then-drain",
                         _run_agent_spool_cell),
                        ("kill-worker-mid-wal-drain",
-                        _run_worker_kill_cell)):
+                        _run_worker_kill_cell),
+                       ("kill-worker-metrics-survive",
+                        _run_metrics_survival_cell)):
         try:
             problems = cell(workdir, synth, mc)
         except Exception:  # noqa: BLE001 — a crashed cell is a failed cell

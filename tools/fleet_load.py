@@ -69,8 +69,11 @@ class _Conn:
         self._conn: "http.client.HTTPConnection | None" = None
 
     def request(self, method: str, path: str,
-                body: "bytes | None" = None) -> Tuple[int, dict]:
+                body: "bytes | None" = None,
+                extra: "Dict[str, str] | None" = None) -> Tuple[int, dict]:
         headers = {"Authorization": f"Bearer {self.token}"}
+        if extra:
+            headers.update(extra)
         if body is not None:
             headers["Content-Type"] = "application/json" \
                 if method == "POST" else "application/octet-stream"
@@ -141,29 +144,33 @@ def _synthetic_run(tenant: str, agent: int, i: int,
             "payload.bin": blob}
 
 
-def _push_run(conn: _Conn, tenant: str, files_bytes: Dict[str, bytes]
-              ) -> Tuple[bool, float]:
+def _push_run(conn: _Conn, tenant: str, files_bytes: Dict[str, bytes],
+              trace: str = "") -> Tuple[bool, float]:
     """One full agent push (have -> missing puts -> commit); returns
-    (committed, wall ms)."""
+    (committed, wall ms).  ``trace`` rides every request as X-Sofa-Trace
+    — the cross-process push-tracing protocol (docs/FLEET.md) — so the
+    tier's spans join the harness's push under one id."""
     files = {rel: {"sha256": hashlib.sha256(data).hexdigest(),
                    "bytes": len(data)}
              for rel, data in files_bytes.items()}
     by_sha = {files[rel]["sha256"]: data
               for rel, data in files_bytes.items()}
+    extra = {"X-Sofa-Trace": trace} if trace else None
     t0 = time.perf_counter()
     status, doc = conn.request("POST", f"/v1/{tenant}/have",
-                               json.dumps({"files": files}).encode())
+                               json.dumps({"files": files}).encode(),
+                               extra=extra)
     if status != 200:
         return False, (time.perf_counter() - t0) * 1000.0
     for sha in doc.get("missing") or []:
         status, _ = conn.request("PUT", f"/v1/{tenant}/object/{sha}",
-                                 by_sha[sha])
+                                 by_sha[sha], extra=extra)
         if status != 200:
             return False, (time.perf_counter() - t0) * 1000.0
     status, ack = conn.request(
         "POST", f"/v1/{tenant}/commit",
         json.dumps({"files": files, "logdir": f"synthetic/{tenant}",
-                    "hostname": "fleet-load"}).encode())
+                    "hostname": "fleet-load"}).encode(), extra=extra)
     ms = (time.perf_counter() - t0) * 1000.0
     return status == 200 and bool(ack.get("committed")), ms
 
@@ -177,6 +184,7 @@ def run_fleet_load(url: str, token: str, *, agents: int = 8,
     push_ms: List[float] = []
     query_ms: List[float] = []
     errors: List[str] = []
+    traces: List[dict] = []
     lock = threading.Lock()
     done = threading.Event()
 
@@ -188,15 +196,22 @@ def run_fleet_load(url: str, token: str, *, agents: int = 8,
             # SO_REUSEPORT hash, so demand rebalances across workers
             # between pushes instead of pinning to one for the run
             conn = _Conn(url, token)
+            # deterministic per-push trace id (the workload is keyed
+            # the same way): the fleet-trace test greps for exactly it
+            trace = hashlib.sha256(
+                f"trace:{tenant}/{a}/{i}".encode()).hexdigest()[:16]
             try:
                 ok, ms = _push_run(
                     conn, tenant, _synthetic_run(tenant, a, i,
-                                                 payload_bytes))
+                                                 payload_bytes),
+                    trace=trace)
             finally:
                 conn.close()
             with lock:
                 if ok:
                     push_ms.append(ms)
+                    traces.append({"trace": trace, "tenant": tenant,
+                                   "agent": a, "i": i})
                 else:
                     errors.append(f"agent {a} push {i} failed")
 
@@ -244,6 +259,7 @@ def run_fleet_load(url: str, token: str, *, agents: int = 8,
     return {"metrics": metrics, "pushes": len(push_ms),
             "queries": len(query_ms), "wall_s": round(wall_s, 3),
             "errors": errors[:20], "error_count": len(errors),
+            "traces": traces,
             "tenants": [f"lt{i}" for i in range(tenants)]}
 
 
@@ -262,6 +278,17 @@ def wait_drained(url: str, token: str, timeout_s: float = 60.0) -> bool:
         return False
     finally:
         conn.close()
+
+
+def fetch_metrics(url: str, token: str) -> Optional[dict]:
+    """One authenticated ``GET /v1/metrics`` — the worker's observability
+    document (None on any failure: a metrics-off tier still loads)."""
+    conn = _Conn(url, token)
+    try:
+        status, doc = conn.request("GET", "/v1/metrics")
+    finally:
+        conn.close()
+    return doc if status == 200 and isinstance(doc, dict) else None
 
 
 def committed_runs(url: str, token: str,
@@ -331,6 +358,15 @@ def _one_tier(workers: int, token: str, load_kw: dict,
             res["drained"] = wait_drained(url, token)
             res["runs"] = committed_runs(url, token, res["tenants"])
             res["workers"] = workers
+            mdoc = fetch_metrics(url, token)
+            if mdoc is not None:
+                snap = mdoc.get("snapshot") or {}
+                res["tier_metrics"] = {
+                    "scrape_seq": mdoc.get("scrape_seq"),
+                    "scrape_wall_ms": snap.get("scrape_wall_ms"),
+                    "push_p99_ms": snap.get("push_p99_ms"),
+                    "wal_depth": snap.get("wal_depth"),
+                }
         finally:
             stop()
     return res
@@ -361,7 +397,13 @@ def main(argv: "List[str] | None" = None) -> int:
     ap.add_argument("--inflight", type=int, default=None,
                     help="per-worker write-slot budget; default 4 "
                          "under --compare, else 64")
+    ap.add_argument("--no_metrics", action="store_true",
+                    help="self-hosted tiers run with the observability "
+                         "plane off (SOFA_TIER_METRICS=0) — the bench's "
+                         "metrics-overhead baseline")
     args = ap.parse_args(argv)
+    if args.no_metrics:
+        os.environ["SOFA_TIER_METRICS"] = "0"
     # --compare measures admission capacity (slots / storage latency),
     # which is what the worker pool multiplies.  With io_ms=0 on a
     # page-cached dev box the bottleneck is one core of Python HTTP

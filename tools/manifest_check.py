@@ -78,6 +78,22 @@ _ARCHIVE_INDEX_FAMILIES = ("catalog", "runs", "features")
 _TIER_SCHEMA = "sofa_tpu/fleet_tier"
 _TIER_VERSION = 1
 
+# The tier observability plane (sofa_tpu/metrics.py): the /v1/metrics
+# document and the per-window SLO verdict at _metrics/slo_verdict.json.
+# meta.metrics / meta.slo are the agent-side folds of the commit ack.
+_METRICS_SCHEMA = "sofa_tpu/fleet_metrics"
+_METRICS_VERSION = 1
+_SLO_SCHEMA = "sofa_tpu/slo_verdict"
+_SLO_VERSION = 1
+_SLO_OPS = ("<", "<=", ">", ">=")
+_SLO_STATUSES = ("ok", "breach", "no_data")
+
+# The merged cross-process push trace (sofa_tpu/metrics.py
+# export_fleet_trace) — Chrome-trace JSON that Perfetto must accept.
+_FLEET_TRACE_NAME = "fleet_trace.json"
+_FLEET_TRACE_DIR = "fleet_trace"
+_METRICS_DIR = "_metrics"
+
 
 def _is_num(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -445,6 +461,47 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
                 probs.append(f"meta.tier: worker {worker} out of range "
                              f"for {workers} worker(s)")
 
+    # meta.metrics / meta.slo (stamped by `sofa agent` from the tier's
+    # commit ack, sofa_tpu/metrics.py): the push's trace id and the
+    # committing worker's scrape/SLO state at commit time.
+    mmet = (doc.get("meta") or {}).get("metrics")
+    if mmet is not None:
+        if not isinstance(mmet, dict):
+            probs.append("meta.metrics: not an object")
+        else:
+            if not isinstance(mmet.get("trace"), str):
+                probs.append("meta.metrics.trace: missing or not a string")
+            for key in ("last_scrape_unix", "scrape_age_s",
+                        "push_wall_s", "push_p99_ms", "wal_depth",
+                        "replica_behind"):
+                v = mmet.get(key)
+                if v is not None and key in mmet and not _is_num(v):
+                    probs.append(f"meta.metrics.{key}: not a number "
+                                 "or null")
+            if "slo_ok" in mmet and mmet["slo_ok"] is not None \
+                    and not isinstance(mmet["slo_ok"], bool):
+                probs.append("meta.metrics.slo_ok: not a bool or null")
+            br = mmet.get("slo_breaching")
+            if br is not None and (
+                    not isinstance(br, list)
+                    or any(not isinstance(n, str) for n in br)):
+                probs.append("meta.metrics.slo_breaching: not a list of "
+                             "metric names")
+    mslo = (doc.get("meta") or {}).get("slo")
+    if mslo is not None:
+        if not isinstance(mslo, dict) or \
+                not isinstance(mslo.get("ok"), bool):
+            probs.append("meta.slo: not an object with a bool ok")
+        else:
+            br = mslo.get("breaching")
+            if not isinstance(br, list) or \
+                    any(not isinstance(n, str) for n in br):
+                probs.append("meta.slo.breaching: not a list of metric "
+                             "names")
+            elif mslo["ok"] is False and not br:
+                probs.append("meta.slo: ok is false but breaching names "
+                             "no metric")
+
     # meta.frames (written by preprocess, sofa_tpu/frames.py +
     # preprocess.py): which interchange format the run's frames landed
     # in, and — for the chunked columnar store — the chunk/reuse/byte
@@ -574,6 +631,12 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             probs.append("unhealthy: the agent could not deliver this "
                          f"run ({agent['push'].get('status')}) — it is "
                          "spooled locally, not in the fleet archive")
+        if isinstance(mslo, dict) and mslo.get("ok") is False:
+            probs.append("unhealthy: the tier was breaching its declared "
+                         "SLO ("
+                         + ", ".join(str(n) for n in
+                                     (mslo.get("breaching") or []))
+                         + ") when this run committed")
         if isinstance(whatif, dict) and \
                 whatif.get("verdict") == "uncalibrated":
             probs.append("unhealthy: the what-if identity gate is "
@@ -789,6 +852,169 @@ def validate_inventory(doc, require_healthy: bool = False) -> List[str]:
             probs.append("gate: on-disk files no registry accounts for: "
                          + ", ".join(audit["unaccounted"][:8]))
     return probs
+
+
+def validate_slo_verdict(doc, require_passing: bool = False) -> List[str]:
+    """Schema problems in a ``_metrics/slo_verdict.json``
+    (sofa_tpu/metrics.py evaluate_slo) — the typed per-window judgement
+    of the tier's declared objectives.  ``require_passing`` additionally
+    fails on an actively breaching verdict — the CI-gate mode."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["slo verdict is not a JSON object"]
+    if doc.get("schema") != _SLO_SCHEMA:
+        probs.append(f"schema: expected {_SLO_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _SLO_VERSION:
+        probs.append(f"version: expected {_SLO_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    w = doc.get("window")
+    if not isinstance(w, int) or isinstance(w, bool) or w < 0:
+        probs.append("window: missing or not a non-negative int")
+    if not isinstance(doc.get("ok"), bool):
+        probs.append("ok: missing or not a bool")
+    breaching = doc.get("breaching")
+    if not isinstance(breaching, list) or \
+            any(not isinstance(n, str) for n in breaching):
+        probs.append("breaching: not a list of metric names")
+        breaching = []
+    targets = doc.get("targets")
+    if not isinstance(targets, list):
+        probs.append("targets: not a list")
+        targets = []
+    breached_names = []
+    for i, t in enumerate(targets):
+        if not isinstance(t, dict) \
+                or not isinstance(t.get("name"), str) \
+                or t.get("op") not in _SLO_OPS \
+                or not _is_num(t.get("value")) \
+                or t.get("status") not in _SLO_STATUSES:
+            probs.append(f"targets[{i}]: needs name, an op in {_SLO_OPS}, "
+                         f"a numeric value, and a status in "
+                         f"{_SLO_STATUSES}")
+            continue
+        obs = t.get("observed")
+        if t.get("status") != "no_data" and not _is_num(obs):
+            probs.append(f"targets[{i}].observed: a judged target must "
+                         "carry its observed number")
+        if t.get("status") == "breach":
+            breached_names.append(t["name"])
+    if isinstance(doc.get("ok"), bool) and targets and \
+            not probs and doc["ok"] == bool(breached_names):
+        probs.append(f"ok: {doc['ok']} disagrees with the target "
+                     f"statuses ({len(breached_names)} breach(es))")
+    if sorted(breaching) != sorted(breached_names) and not probs:
+        probs.append("breaching: disagrees with the per-target statuses")
+    if require_passing and doc.get("ok") is False:
+        probs.append("gate: the tier is actively breaching its SLO ("
+                     + ", ".join(breaching) + ")")
+    return probs
+
+
+def validate_fleet_metrics(doc) -> List[str]:
+    """Schema problems in a ``GET /v1/metrics`` document
+    (sofa_tpu/metrics.py metrics_doc) — the board/test contract."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["metrics document is not a JSON object"]
+    if doc.get("schema") != _METRICS_SCHEMA:
+        probs.append(f"schema: expected {_METRICS_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _METRICS_VERSION:
+        probs.append(f"version: expected {_METRICS_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    if doc.get("role") not in ("primary", "replica"):
+        probs.append(f"role: {doc.get('role')!r} not primary/replica")
+    worker = doc.get("worker")
+    if not isinstance(worker, int) or isinstance(worker, bool) \
+            or worker < 0:
+        probs.append("worker: missing or not a non-negative int")
+    seq = doc.get("scrape_seq")
+    if not isinstance(seq, int) or isinstance(seq, bool) or seq < 0:
+        probs.append("scrape_seq: missing or not a non-negative int")
+    snap = doc.get("snapshot")
+    if not isinstance(snap, dict) or any(
+            not isinstance(k, str) or (v is not None and not _is_num(v))
+            for k, v in snap.items()):
+        probs.append("snapshot: not a flat name -> number map")
+    hist = doc.get("history")
+    if not isinstance(hist, dict) \
+            or not isinstance(hist.get("rows"), list) \
+            or not isinstance(hist.get("total"), int) \
+            or isinstance(hist.get("total"), bool):
+        probs.append("history: needs a rows list and an int total")
+    else:
+        for i, r in enumerate(hist["rows"]):
+            if not isinstance(r, dict) or not _is_num(r.get("t")) \
+                    or not isinstance(r.get("name"), str) \
+                    or not _is_num(r.get("value")):
+                probs.append(f"history.rows[{i}]: needs numeric t, a "
+                             "name, and a numeric value")
+                break  # one line for a malformed table, not thousands
+    slo = doc.get("slo")
+    if slo is not None:
+        probs.extend(f"slo: {p}" for p in validate_slo_verdict(slo))
+    return probs
+
+
+def validate_fleet_trace(doc) -> List[str]:
+    """Schema problems in a merged ``fleet_trace.json``
+    (sofa_tpu/metrics.py export_fleet_trace) — the Chrome-trace shape
+    Perfetto accepts: a ``traceEvents`` list of M metadata events and
+    complete (``ph == "X"``) spans with numeric ts/dur and a pid, so
+    the cross-process join stays loadable."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["fleet trace is not a JSON object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents: missing or not a list"]
+    saw_span = False
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or not isinstance(e.get("name"), str):
+            probs.append(f"traceEvents[{i}]: not a named event object")
+            break
+        ph = e.get("ph")
+        if ph == "M":
+            continue
+        if ph != "X":
+            probs.append(f"traceEvents[{i}]: ph {ph!r} is neither "
+                         "metadata (M) nor a complete span (X)")
+            break
+        saw_span = True
+        if not _is_num(e.get("ts")) or e["ts"] < 0 \
+                or not _is_num(e.get("dur")) or e["dur"] < 0:
+            probs.append(f"traceEvents[{i}]: span needs non-negative "
+                         "numeric ts and dur")
+            break
+        if not isinstance(e.get("pid"), int):
+            probs.append(f"traceEvents[{i}]: span has no integer pid — "
+                         "the cross-process join is lost")
+            break
+    if not probs and not saw_span:
+        probs.append("traceEvents: no complete (X) span in the merge")
+    return probs
+
+
+def _check_fleet_trace(root: str) -> List[str]:
+    """Validate ``_metrics/fleet_trace/fleet_trace.json`` under an
+    archive root when an export has been written (absent = no export
+    yet, healthy)."""
+    path = os.path.join(root, _METRICS_DIR, _FLEET_TRACE_DIR,
+                        _FLEET_TRACE_NAME)
+    if not os.path.isfile(path):
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{_FLEET_TRACE_NAME}: unreadable ({e})"]
+    return [f"{_FLEET_TRACE_NAME}: {p}"
+            for p in validate_fleet_trace(doc)]
 
 
 def validate_live_offsets(doc) -> List[str]:
@@ -1026,8 +1252,9 @@ def check_path(path: str, require_healthy: bool = False) -> int:
     if os.path.isdir(path) and os.path.isfile(
             os.path.join(path, _ARCHIVE_MARKER_NAME)):
         # an archive root: the document to validate is its columnar
-        # catalog index (absent index = healthy, queries scan)
-        probs = _check_archive_index(path)
+        # catalog index (absent index = healthy, queries scan), plus
+        # the merged fleet trace when the tier has exported one
+        probs = _check_archive_index(path) + _check_fleet_trace(path)
         for p in probs:
             print(f"manifest_check: archive index: {p}", file=sys.stderr)
         if not probs:
@@ -1062,6 +1289,25 @@ def check_path(path: str, require_healthy: bool = False) -> int:
             print(f"manifest_check: OK ({path}; "
                   f"{(doc.get('counts') or {}).get('artifacts')} "
                   f"artifact(s), ok={doc.get('ok')})")
+        return 1 if probs else 0
+    if isinstance(doc, dict) and doc.get("schema") == _SLO_SCHEMA:
+        probs = validate_slo_verdict(doc, require_passing=require_healthy)
+        for p in probs:
+            print(f"manifest_check: slo: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; slo: "
+                  f"{'ok' if doc.get('ok') else 'BREACHING '}"
+                  + ("" if doc.get("ok")
+                     else ",".join(doc.get("breaching") or [])) + ")")
+        return 1 if probs else 0
+    if isinstance(doc, dict) and doc.get("schema") == _METRICS_SCHEMA:
+        probs = validate_fleet_metrics(doc)
+        for p in probs:
+            print(f"manifest_check: metrics: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; metrics: worker "
+                  f"{doc.get('worker')}, scrape_seq "
+                  f"{doc.get('scrape_seq')})")
         return 1 if probs else 0
     if isinstance(doc, dict) and doc.get("schema") == _WHATIF_SCHEMA:
         probs = validate_whatif(doc, require_healthy=require_healthy)
