@@ -546,6 +546,30 @@ try:
                     (_off - _on) / _off * 100.0, 2)
 except Exception as e:
     out["fleet_load_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# self-healing tier evidence (tools/chaos_tier.py): the chaos-under-load
+# smoke — a SIGKILLed worker, a full rolling restart, and a fires-once
+# disk_full ENOSPC under sustained fleet_load traffic — lands the tier's
+# recovery wall time (last push acked -> drained + healthy) and its
+# typed refusal rate.  Needs no hardware, so both ride dead-tunnel
+# rounds too.
+try:
+    import subprocess as _sp
+    _r = _sp.run(
+        [sys.executable, os.path.join({root!r}, "tools", "chaos_tier.py"),
+         "--smoke", "--no_replica"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if _r.returncode != 0:
+        _tail = (_r.stderr.strip().splitlines() or ["?"])[-1]
+        out["chaos_tier_evidence_error"] = f"rc={{_r.returncode}}: " \
+            f"{{_tail}}"[:160]
+    else:
+        _ct = json.loads(_r.stdout.strip().splitlines()[-1])
+        for _k in ("tier_recovery_wall_time_s", "tier_refusal_rate_pct"):
+            if _k in _ct.get("metrics", {{}}):
+                out[_k] = _ct["metrics"][_k]
+except Exception as e:
+    out["chaos_tier_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
 # catalog-index evidence (sofa_tpu/archive/index.py): the fleet query
 # path's steady-state numbers on a synthetic fleet archive —
 # catalog_index_refresh_wall_time_s is the SUFFIX-ONLY refresh after one
@@ -646,6 +670,8 @@ print(json.dumps(out))
                     "fleet_query_p99_ms", "fleet_saturation_rps",
                     "fleet_load_evidence_error",
                     "tier_metrics_overhead_pct", "tier_scrape_wall_time_s",
+                    "tier_recovery_wall_time_s", "tier_refusal_rate_pct",
+                    "chaos_tier_evidence_error",
                     "live_epoch_wall_time_s",
                     "live_lag_events", "live_evidence_error",
                     "catalog_index_refresh_wall_time_s",
@@ -682,6 +708,12 @@ print(json.dumps(out))
                  f"saturation, scrape wall "
                  f"{out.get('tier_scrape_wall_time_s')}s (metrics on "
                  "vs SOFA_TIER_METRICS=0)")
+        if "tier_recovery_wall_time_s" in out:
+            _log(f"bench: chaos tier recovery "
+                 f"{out['tier_recovery_wall_time_s']}s, refusal rate "
+                 f"{out.get('tier_refusal_rate_pct')}% (worker kill + "
+                 "rolling restart + disk_full under load, "
+                 "tools/chaos_tier.py --smoke)")
         if "live_epoch_wall_time_s" in out:
             _log(f"bench: live incremental epoch "
                  f"{out['live_epoch_wall_time_s']}s, drained "
@@ -818,7 +850,8 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "fleet_query_wall_time_s", "fleet_push_p50_ms",
                      "fleet_push_p99_ms", "fleet_query_p50_ms",
                      "fleet_query_p99_ms", "fleet_saturation_rps",
-                     "tier_metrics_overhead_pct", "tier_scrape_wall_time_s")
+                     "tier_metrics_overhead_pct", "tier_scrape_wall_time_s",
+                     "tier_recovery_wall_time_s", "tier_refusal_rate_pct")
 
 
 def _archive_evidence(value, extra: dict) -> dict:

@@ -213,6 +213,23 @@ def _process_logdir(cfg, spool, client, logdir: str,
                         })
             else:
                 tick.failed += 1
+        if client is not None:
+            # the endpoint-health picture AFTER the push (meta.health,
+            # docs/OBSERVABILITY.md): which endpoint served, how many
+            # failovers this client has taken, which breakers stand
+            # open — failover leaves a durable record in the manifest,
+            # never just a log line
+            from sofa_tpu.archive.client import HEALTH_SCHEMA, HEALTH_VERSION
+
+            meta_agent["service"] = client.base  # post-failover truth
+            tel.set_meta(health={
+                "schema": HEALTH_SCHEMA, "version": HEALTH_VERSION,
+                "endpoints": list(client.endpoints),
+                "active": client.base,
+                "failovers": int(client.failovers),
+                "breakers_open": [u for u in client.endpoints
+                                  if client.breaker_open(u)],
+            })
         tel.set_meta(agent=meta_agent)
         tel.write(logdir, rc=0 if (push is None
                                    or push["status"] == "pushed") else 1,

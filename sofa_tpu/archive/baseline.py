@@ -66,11 +66,15 @@ def percentile(xs: List[float], pct: float) -> float:
 # observability plane's own cost pair (tier_metrics_overhead_pct /
 # tier_scrape_wall_time_s — `_overhead_pct$`/`_wall_time_s$` are pinned
 # explicitly; a blanket `_pct$` would flip the higher-is-better payoff
-# percentages like whatif_overlap_payoff_pct).
+# percentages like whatif_overlap_payoff_pct).  The self-healing tier's
+# admission-control pair follows the same rule: tier_recovery_wall_time_s
+# rides `_wall_time_s$`, and tier_refusal_rate_pct gets its own
+# `_refusal_rate_pct$` pin — more typed refusals under the same load is
+# a regression, even though refusing *correctly* is the feature.
 _WORSE_HIGH = re.compile(
     r"(^elapsed_time$|_time$|_time_|_wall|latency|overhead|_skew_|ttft"
     r"|_idle|_error_pct$|_rss_mb$|_sol_distance$|_ms$|_overhead_pct$"
-    r"|_wall_time_s$)")
+    r"|_wall_time_s$|_refusal_rate_pct$)")
 # Lower is worse: rates and utilization (including the fleet tier's
 # saturation throughput, fleet_saturation_rps).
 _WORSE_LOW = re.compile(

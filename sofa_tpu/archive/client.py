@@ -42,6 +42,12 @@ from sofa_tpu import faults
 from sofa_tpu.concurrency import jittered_backoff
 from sofa_tpu.printing import print_warning
 
+#: The ``meta.health`` manifest section (docs/OBSERVABILITY.md): the
+#: agent's view of its endpoint set at push time — active endpoint,
+#: failover count, open breakers.  Bumps on BREAKING shape changes.
+HEALTH_SCHEMA = "sofa_tpu/fleet_health"
+HEALTH_VERSION = 1
+
 
 class ServiceUnavailable(Exception):
     """A transient transport failure — retry with backoff."""
@@ -74,13 +80,28 @@ class ServiceIncomplete(Exception):
 
 
 class ServiceClient:
-    """One service endpoint + tenant + token, with the retry policy."""
+    """One service endpoint SET + tenant + token, with the retry policy.
+
+    ``url`` may be a comma-separated failover list (``--service
+    url1,url2,...``): requests prefer the first endpoint whose circuit
+    breaker is closed.  A connection-level failure (refused, reset,
+    timeout — the endpoint itself is suspect) opens that endpoint's
+    breaker for a jittered-backoff window and the next attempt moves to
+    a sibling, health-probed first (``GET /v1/health``) so a dead
+    sibling costs one cheap GET, not a full request cycle.  An HTTP
+    error (the endpoint answered — it is alive, just loaded or
+    refusing) never trips the breaker.  Failing over is never silent:
+    it is printed, counted (``failovers``), and stamped into
+    ``meta.health``."""
 
     def __init__(self, url: str, token: str, tenant: str = "default",
                  timeout_s: float = 10.0, retries: int = 4,
                  backoff_s: float = 0.5, backoff_cap_s: float = 30.0,
                  rng=None):
-        self.base = url.rstrip("/")
+        self.endpoints = [u.strip().rstrip("/") for u in url.split(",")
+                          if u.strip()]
+        self.base = self.endpoints[0] if self.endpoints \
+            else url.rstrip("/")
         self.token = token
         self.tenant = tenant
         self.timeout_s = max(float(timeout_s), 0.1)
@@ -93,10 +114,63 @@ class ServiceClient:
         # transparency counters the agent folds into meta.agent
         self.attempts = 0
         self.retried = 0
+        self.failovers = 0
+        #: url -> (consecutive fails, monotonic open-until) — the
+        #: per-endpoint circuit breaker ledger
+        self._breaker: Dict[str, tuple] = {}
         # cross-process push trace id (docs/FLEET.md "Observing the
         # tier"): when set, every request carries it as X-Sofa-Trace so
         # the service's spans join the agent's under ONE id
         self.trace_id = ""
+
+    # -- circuit breaker ---------------------------------------------------
+    def _note_endpoint_down(self, url: str) -> None:
+        fails, _until = self._breaker.get(url, (0, 0.0))
+        fails += 1
+        hold = jittered_backoff(fails - 1, self.backoff_s,
+                                self.backoff_cap_s, self.rng)
+        self._breaker[url] = (fails, time.monotonic() + hold)
+
+    def _note_endpoint_up(self, url: str) -> None:
+        self._breaker.pop(url, None)
+
+    def breaker_open(self, url: str) -> bool:
+        _fails, until = self._breaker.get(url, (0, 0.0))
+        return until > time.monotonic()
+
+    def check_health(self, url: str) -> bool:
+        """``GET /v1/health`` (unauthenticated, like the server's ping):
+        True only for an endpoint that is up AND accepting — a draining
+        worker answers 503 here, so the breaker routes around a rolling
+        restart without burning a real push on it."""
+        req = urllib.request.Request(f"{url}/v1/health")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=min(self.timeout_s, 3.0)) as resp:
+                doc = json.loads(resp.read() or b"{}")
+        except (OSError, ValueError, urllib.error.URLError):
+            return False
+        return bool(isinstance(doc, dict) and doc.get("ok", True))
+
+    def _select_endpoint(self) -> str:
+        """The endpoint this attempt should use: first closed-breaker
+        endpoint in preference order (a previously-failed one must pass
+        a health probe before being trusted again).  With EVERY breaker
+        open, the one that re-closes soonest — the client never refuses
+        to try at all; the service may be back."""
+        now = time.monotonic()
+        best, best_until = None, None
+        for url in self.endpoints:
+            fails, until = self._breaker.get(url, (0, 0.0))
+            if until <= now:
+                if fails == 0 or self.check_health(url):
+                    return url
+                # the probe said no: re-open and keep looking
+                self._note_endpoint_down(url)
+                _f, until = self._breaker.get(url, (0, 0.0))
+            if best_until is None or until < best_until:
+                best, best_until = url, until
+        return best or self.base
 
     # -- single attempt ----------------------------------------------------
     def _attempt(self, method: str, path: str, body: "bytes | None",
@@ -109,6 +183,12 @@ class ServiceClient:
                 if spec.kind == "conn_refused":
                     raise urllib.error.URLError(
                         ConnectionRefusedError("injected conn_refused"))
+                if spec.kind == "conn_reset":
+                    # the connection died mid-request: the ack (if any)
+                    # is lost in flight and the request may or may not
+                    # have landed server-side — exactly why every verb
+                    # is idempotent (the retry is a committed no-op)
+                    raise ConnectionResetError("injected conn_reset")
                 if spec.kind == "stall":
                     # models the read deadline having expired — the
                     # exception the bounded timeout would raise, without
@@ -124,6 +204,13 @@ class ServiceClient:
                     body = body[:max(int(len(body) * spec.fraction), 1)]
             req = urllib.request.Request(url, data=body, method=method)
             req.add_header("Authorization", f"Bearer {self.token}")
+            # the push deadline (absolute unix seconds): when THIS
+            # request's read timeout expires the client is gone — a
+            # worker that sees the deadline already passed abandons the
+            # work instead of answering nobody (docs/FLEET.md)
+            req.add_header(
+                "X-Sofa-Deadline",
+                f"{time.time() + self.timeout_s:.3f}")  # sofa-lint: disable=SL003 — the deadline crosses process+machine boundaries; monotonic has no common epoch, wall clock is the only shared one (skew is capped server-side)
             if self.trace_id:
                 req.add_header("X-Sofa-Trace", self.trace_id)
             if body is not None:
@@ -168,9 +255,25 @@ class ServiceClient:
               op: str, key: str = "") -> dict:
         attempt = 0
         while True:
+            if len(self.endpoints) > 1:
+                url = self._select_endpoint()
+                if url != self.base:
+                    self.failovers += 1
+                    print_warning(
+                        f"service: failing over {self.base} -> {url} "
+                        "(circuit breaker)")
+                    self.base = url
             try:
-                return self._attempt(method, path, body, op, key)
+                result = self._attempt(method, path, body, op, key)
+                self._note_endpoint_up(self.base)
+                return result
             except ServiceUnavailable as e:
+                if e.status is None:
+                    # connection-level (refused/reset/timeout): the
+                    # ENDPOINT is suspect — open its breaker so the
+                    # retry prefers a sibling.  An HTTP status means
+                    # the endpoint answered; it stays trusted.
+                    self._note_endpoint_down(self.base)
                 if attempt >= self.retries:
                     raise
                 delay = jittered_backoff(attempt, self.backoff_s,

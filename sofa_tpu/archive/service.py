@@ -77,6 +77,10 @@ _MAX_BODY = 1 << 30
 
 _RETRY_AFTER_S = "1"
 
+#: An ``X-Sofa-Deadline`` further out than this is a skewed client
+#: clock, not intent — treated as absent rather than obeyed.
+_DEADLINE_SKEW_CAP_S = 24 * 3600.0
+
 #: CORS grant on the read-only query route (the fleet board is served by
 #: `sofa viz` on another origin).  Writes carry no CORS headers at all —
 #: browsers cannot be made into upload agents.
@@ -132,11 +136,17 @@ class _FleetServer(http.server.ThreadingHTTPServer):
             self.io_ms = 0.0
         self._state_guard = Guard("serve.state", protects=(
             "stats", "inflight", "tenant_bytes", "writes_handled",
-            "drainer", "replica"))
+            "drainer", "replica", "draining", "_wal_depth"))
         self.stats: Dict[str, int] = {}
         self.inflight = 0
         self.tenant_bytes: Dict[str, int] = {}
         self.writes_handled = 0
+        #: SIGTERM flips this: new writes answer a typed 503
+        #: ``draining`` while the WAL empties (graceful lifecycle).
+        self.draining = False
+        #: tenant -> (sampled_monotonic, depth) — the admission check's
+        #: once-a-second WAL-depth cache (wal_pressure()).
+        self._wal_depth: Dict[str, Tuple[float, int]] = {}
         self._appenders: Dict[str, "tier.WalAppender"] = {}
         self.drainer = None
         if role == "primary":
@@ -199,7 +209,8 @@ class _FleetServer(http.server.ThreadingHTTPServer):
             self.drainer.kick()
         return name, end
 
-    def tier_wait_applied(self, tenant: str, name: str, end: int) -> bool:
+    def tier_wait_applied(self, tenant: str, name: str, end: int,
+                          timeout_s: "float | None" = None) -> bool:
         """The commit-ack wait.  On the tenant's OWNER the ack keeps
         read-your-writes: block (condvar + in-memory offsets, no file
         I/O) until the drainer applied the record — single-worker
@@ -212,13 +223,26 @@ class _FleetServer(http.server.ThreadingHTTPServer):
         re-parsing the shared state file per poll melts the tier)."""
         if self.drainer is not None and \
                 tier.ring_owner(tenant, self.workers) == self.worker:
-            return self.drainer.wait_local(tenant, name, end)
+            wait = tier.COMMIT_APPLY_TIMEOUT_S if timeout_s is None \
+                else max(min(timeout_s, tier.COMMIT_APPLY_TIMEOUT_S), 0.0)
+            return self.drainer.wait_local(tenant, name, end,
+                                           timeout_s=wait)
         return True
 
     # -- counters ----------------------------------------------------------
     def count_response(self, key: str) -> None:
         with self._state_guard:
             self.stats[key] = self.stats.get(key, 0) + 1
+        # fleet-wide denominator for the refusal-rate benchmark
+        # (tier_refusal_rate_pct = refusals / responses)
+        self.metrics.inc("responses")
+
+    def count_refusal(self, key: str) -> None:
+        """A typed refusal (admission control, brownout, draining,
+        deadline, disk_full): the stats key plus the fleet-wide
+        ``refusals`` counter the refusal-rate benchmark reads."""
+        self.count_response(key)
+        self.metrics.inc("refusals")
 
     def stats_line(self) -> "str | None":
         with self._state_guard:
@@ -249,6 +273,32 @@ class _FleetServer(http.server.ThreadingHTTPServer):
     def release_slot(self) -> None:
         with self._state_guard:
             self.inflight = max(self.inflight - 1, 0)
+
+    def is_draining(self) -> bool:
+        with self._state_guard:
+            return bool(self.draining)
+
+    def wal_pressure(self, tenant: str) -> int:
+        """The tenant's unapplied WAL depth for the admission check,
+        sampled at most once a second — the watermark consult runs per
+        request, and a per-request file-parsing depth scan would make
+        the overload check itself the overload."""
+        now = time.monotonic()
+        with self._state_guard:
+            ts, depth = self._wal_depth.get(tenant, (0.0, -1))
+            if depth >= 0 and now - ts < 1.0:
+                return depth
+        depth = tier.wal_depth(self.tenant_root(tenant))
+        with self._state_guard:
+            self._wal_depth[tenant] = (time.monotonic(), depth)
+        return depth
+
+    def max_cached_wal_depth(self) -> int:
+        """Worst sampled WAL depth across tenants — the /v1/health
+        brownout signal (0 until some admission check sampled)."""
+        with self._state_guard:
+            return max((d for _ts, d in self._wal_depth.values()),
+                       default=0)
 
     def chaos_tick(self) -> None:
         """Count a write request; hard-exit at the chaos threshold — the
@@ -431,6 +481,45 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             name, "service", t0, time.time() - t0,
             trace=self._trace_id(), tenant=tenant, **args)
 
+    def _refuse(self, key: str, code: int, doc: dict,
+                retry_after: "str | None" = _RETRY_AFTER_S) -> None:
+        """One typed refusal: machine-readable error + Retry-After, on
+        the refusal counters (admission control is observable or it is
+        just packet loss with extra steps)."""
+        self.server.count_refusal(key)
+        self._json(code, doc, retry_after=retry_after)
+
+    def _deadline_left_s(self) -> "float | None":
+        """Seconds remaining on the request's ``X-Sofa-Deadline``
+        (absolute unix seconds, stamped by the agent) — None when the
+        header is absent, unparsable, or further out than the skew cap
+        (a clock-skewed agent must not buy itself an infinite deadline;
+        an absurd value is treated as absent, never obeyed)."""
+        raw = self.headers.get("X-Sofa-Deadline")
+        if not raw:
+            return None
+        try:
+            deadline = float(raw)
+        except ValueError:
+            return None
+        left = deadline - time.time()  # sofa-lint: disable=SL003 — the deadline is the AGENT's wall-clock stamp; monotonic has no common epoch across processes
+        if left > _DEADLINE_SKEW_CAP_S:
+            return None
+        return left
+
+    def _deadline_expired(self) -> bool:
+        """True when the request was refused as expired-on-arrival: the
+        client already gave up on this work — doing it anyway would burn
+        a write slot producing an answer nobody is waiting for.  (The
+        commit itself stays idempotent: the retry with a fresh deadline
+        lands as a no-op if a racing attempt got through.)"""
+        left = self._deadline_left_s()
+        if left is None or left > 0:
+            return False
+        self._refuse("504_deadline_expired", 504,
+                     {"error": "deadline_expired"}, retry_after=None)
+        return True
+
     # -- GET ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802 — http.server handler contract
         clean = self.path.split("?", 1)[0]
@@ -438,6 +527,9 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._count("ping")
             self._json(200, {"ok": True, "schema": SERVICE_SCHEMA,
                              "version": SERVICE_VERSION})
+            return
+        if clean == "/v1/health":
+            self._health()
             return
         if clean == "/v1/tier":
             if not self.server.auth_ok(
@@ -493,6 +585,27 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self.send_header(key, value)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+    def _health(self) -> None:
+        """``GET /v1/health`` — the failover probe (unauthenticated like
+        /v1/ping: it leaks liveness and load posture only).  200 =
+        accepting; 503 = draining (SIGTERM'd, the WAL is emptying) — a
+        client circuit breaker opens on it without burning a real
+        request.  ``brownout`` says reads are being shed (soft
+        watermark) BEFORE the client wastes a query on a 503."""
+        soft, hard = tier.wal_watermarks()
+        depth = self.server.max_cached_wal_depth()
+        draining = self.server.is_draining()
+        doc = {"ok": not draining, "schema": SERVICE_SCHEMA,
+               "version": SERVICE_VERSION, "role": self.server.role,
+               "worker": self.server.worker, "draining": draining,
+               "brownout": depth >= soft, "wal_depth": depth,
+               "wal_soft": soft, "wal_hard": hard}
+        if draining:
+            self._refuse("503_draining", 503, doc)
+            return
+        self._count("health")
+        self._json(200, doc)
 
     def _catalog_etag(self, store: ArchiveStore) -> "Tuple[str, int]":
         """(ETag, byte size) keyed on the catalog's size+mtime — the
@@ -553,6 +666,16 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         from sofa_tpu.archive import index as aindex
 
         if self._backpressure(tenant):
+            return
+        soft, _hard = tier.wal_watermarks()
+        if self.server.role != "replica" and \
+                self.server.wal_pressure(tenant) >= soft:
+            # brownout: reads are the degradable load — shed THEM first
+            # (a refused query re-asks a replica or retries; a refused
+            # push costs the agent a spool round-trip), keeping the
+            # ingest path fed until the hard watermark
+            self._refuse("503_brownout", 503,
+                         {"error": "brownout", "tenant": tenant})
             return
         t0 = time.time()
         qs = urllib.parse.parse_qs(self.path.partition("?")[2])
@@ -739,6 +862,11 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return
         if self._read_only():
             return
+        if self.server.is_draining():
+            self._refuse("503_draining", 503, {"error": "draining"})
+            return
+        if self._deadline_expired():
+            return
         if not self.server.write_slot():
             self._count("503_loaded")
             self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
@@ -811,6 +939,18 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         independent of index size.  Replaying a committed run is a pure
         no-op."""
         t0 = time.time()
+        _soft, hard = tier.wal_watermarks()
+        depth = self.server.wal_pressure(tenant)
+        if depth >= hard:
+            # the hard watermark: bounded queueing.  A WAL this deep
+            # means the drainer is behind by more than the ack timeout
+            # can hide — accepting more only converts future acks into
+            # timeouts.  (A replayed commit is refused too: harmless,
+            # the retry lands once the backlog drains.)
+            self._refuse("503_wal_depth", 503,
+                         {"error": "wal_backlog", "tenant": tenant,
+                          "wal_depth": depth, "wal_hard": hard})
+            return
         if self.server.io_ms:
             time.sleep(self.server.io_ms / 1000.0)  # emulated storage
         store = self.server.tenant_store(tenant)
@@ -843,9 +983,20 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 # its apply/refresh spans, joining agent and drain lanes
                 # under ONE id in the exported fleet trace
                 rec["trace"] = self._trace_id()
-            name, end = self.server.tier_append(tenant, rec)
+            try:
+                name, end = self.server.tier_append(tenant, rec)
+            except OSError as e:
+                if getattr(e, "errno", None) != errno.ENOSPC:
+                    raise
+                # out of space (the disk_full fault's landing site):
+                # NOTHING was made durable, so nothing may be acked —
+                # a typed 507 the client's backoff path retries
+                self._refuse("507_disk_full", 507,
+                             {"error": "no_space", "run": run_id})
+                return
             self._drop_slot()  # WAL record durable; the wait is in-memory
-            if not self.server.tier_wait_applied(tenant, name, end):
+            if not self.server.tier_wait_applied(
+                    tenant, name, end, timeout_s=self._deadline_left_s()):
                 # durably queued but the owner's drainer is backlogged
                 # (or mid-respawn): the record CANNOT be lost, but the
                 # read-your-writes promise can't be kept yet — tell the
@@ -890,6 +1041,11 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         t0 = time.time()
         if self._read_only():
             return
+        if self.server.is_draining():
+            self._refuse("503_draining", 503, {"error": "draining"})
+            return
+        if self._deadline_expired():
+            return
         if not self.server.write_slot():
             self._count("503_loaded")
             self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
@@ -932,6 +1088,15 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 self._json(422, {"error": "hash_mismatch",
                                  "expected": sha, "got": got})
                 return
+            from sofa_tpu import faults
+
+            if faults.maybe_disk_full():
+                # disk_full on the object store: refuse before the
+                # write — the bytes were never durable, so the 507 is
+                # honest and the client's retry (fault consumed) lands
+                self._refuse("507_disk_full", 507,
+                             {"error": "no_space", "sha256": sha})
+                return
             _, added = store.put_bytes(data)
             if added:
                 self.server.charge_tenant(tenant, added)
@@ -943,6 +1108,67 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             self._json(200, {"sha256": sha, "new": bool(added)})
         finally:
             self.server.release_slot()
+
+
+def graceful_drain(httpd) -> int:
+    """The SIGTERM drain discipline (docs/FLEET.md "Graceful
+    lifecycle"): with the accept loop stopped and new writes already
+    refused (``draining``), apply every owned tenant's pending WAL
+    records to EMPTY and flush one final metrics scrape.  Returns the
+    records applied.  After this the worker may exit 0: every ack it
+    ever sent is applied state on disk — nothing rides out with the
+    process."""
+    with httpd._state_guard:
+        drainer = httpd.drainer
+    applied = 0
+    if drainer is not None:
+        drainer.stop()
+        for tenant in drainer.owned_tenants():
+            troot = httpd.tenant_root(tenant)
+            if not os.path.isdir(tier.wal_dir(troot)):
+                continue
+            try:
+                stats = tier.drain_tenant(troot)
+            except OSError as e:
+                # routed, not swallowed (SL002): an undrainable tenant
+                # is why the exit code below would NOT be 0
+                print_warning(f"serve: drain-on-term for tenant "
+                              f"{tenant} failed: {e}")
+                continue
+            applied += stats["applied"] + stats["replayed"]
+    if httpd.scraper is not None:
+        try:
+            httpd.scraper.tick()  # the final metrics flush
+        except OSError as e:
+            print_warning(f"serve: final metrics flush failed: {e}")
+    print_progress(f"serve: worker {httpd.worker} drained "
+                   f"{applied} WAL record(s) on SIGTERM — exiting 0")
+    return applied
+
+
+def _install_sigterm_drain(httpd) -> "threading.Event":
+    """Install the graceful-lifecycle SIGTERM handler on the CURRENT
+    (main) thread's process: flip ``draining`` and stop the accept loop
+    from a helper thread (``shutdown()`` blocks until ``serve_forever``
+    returns, and the handler runs ON that thread — a direct call
+    deadlocks).  Returns the event that says a SIGTERM arrived."""
+    import signal
+    import threading
+
+    got_term = threading.Event()
+
+    def _on_term(signum, frame):  # noqa: ARG001 — signal handler contract
+        got_term.set()
+        with httpd._state_guard:
+            httpd.draining = True
+        threading.Thread(target=httpd.shutdown, daemon=True,  # sofa-lint: disable=SL023 — this thread IS the stop path: shutdown() unblocks serve_forever below, the drain runs, and the process exits
+                         name="sofa-serve-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # non-main thread (tests): Ctrl-C/stop() remain the paths
+    return got_term
 
 
 def _write_fleet_marker(root: str) -> None:
@@ -989,6 +1215,10 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
     from sofa_tpu.archive import resolve_root
 
     root = root or resolve_root(cfg)
+    if getattr(cfg, "serve_rolling_restart", False):
+        # not a server at all: signal the running supervisor and leave
+        rc = tier.signal_rolling_restart(root)
+        return rc if serve_forever else None
     token = resolve_token(cfg)
     if not token:
         print_error(
@@ -1058,11 +1288,14 @@ def sofa_serve(cfg, root: "str | None" = None, serve_forever: bool = True):
         f"http://{host}:{port} --token <secret> (docs/FLEET.md)")
     if not serve_forever:
         return httpd
+    got_term = _install_sigterm_drain(httpd)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if got_term.is_set():
+            graceful_drain(httpd)
         httpd.server_close()
         served = httpd.stats_line()
         if served:
@@ -1094,12 +1327,30 @@ def _serve_pool(root: str, token: str, bind: str, base_port: int,
         f"http://{host}:{handle.port} --token <secret> (docs/FLEET.md)")
     if not serve_forever:
         return handle
+    # the long-running supervisor: record the pid so `sofa serve
+    # --rolling-restart <root>` can find us, and hand SIGHUP to the
+    # one-worker-at-a-time restart (off the signal thread — the restart
+    # waits on respawns, and a blocked main thread cannot supervise)
+    import signal
+    import threading
+
+    tier.write_supervisor_pidfile(root)
+
+    def _on_hup(signum, frame):  # noqa: ARG001 — signal handler contract
+        threading.Thread(target=handle.rolling_restart, daemon=True,  # sofa-lint: disable=SL023 — bounded by rolling_restart's own per-worker timeout; joining in a signal handler would block the supervisor loop it restarts under
+                         name="sofa-rolling-restart").start()
+
+    try:
+        signal.signal(signal.SIGHUP, _on_hup)
+    except (ValueError, AttributeError):
+        pass  # non-main thread / platform without SIGHUP
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
     finally:
+        tier.remove_supervisor_pidfile(root)
         handle.stop()
     return 0
 

@@ -367,11 +367,11 @@ def ingest_run(cfg, root: str, label: str = "",
 # rather than the run itself: stripped by normalization so that
 # archiving, re-archiving, or the agent stamping meta.agent/meta.serve
 # can never change the next ingest's content address ("serve",
-# "metrics", and "slo" appear only as meta keys — the ack's
-# observability fold carries a per-push trace id and wall time — but
-# the strip loops cover both namespaces).
+# "metrics", "slo", "health", and "backup" appear only as meta keys —
+# the ack's observability fold, the client's failover picture, and the
+# backup receipt — but the strip loops cover both namespaces).
 _SELF_VERBS = ("archive", "regress", "agent", "serve", "tier",
-               "metrics", "slo")
+               "metrics", "slo", "health", "backup")
 
 
 def _normalized_manifest(logdir: str) -> Optional[bytes]:
@@ -663,6 +663,192 @@ def _archive_repair(store: ArchiveStore, report: Dict[str, list]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Disaster recovery: incremental content-addressed backup / restore.
+# ---------------------------------------------------------------------------
+
+#: Marker at a backup destination root.  Schema registry:
+#: docs/OBSERVABILITY.md; bumps on BREAKING layout changes only.
+BACKUP_MARKER_NAME = "sofa_backup.json"
+BACKUP_SCHEMA = "sofa_tpu/archive_backup"
+BACKUP_VERSION = 1
+BACKUP_SNAPSHOTS_DIR = "snapshots"
+
+_SNAPSHOT_RE_LEN = 6  # snapshots/000001.json
+
+
+def _backup_snapshot_ids(dest: str) -> List[int]:
+    try:
+        names = os.listdir(os.path.join(dest, BACKUP_SNAPSHOTS_DIR))
+    except OSError:
+        return []
+    return sorted(int(n[:-5]) for n in names
+                  if n.endswith(".json")
+                  and n[:-5].isdigit() and len(n[:-5]) == _SNAPSHOT_RE_LEN)
+
+
+def _load_snapshot(dest: str, snap_id: int) -> Optional[dict]:
+    path = os.path.join(dest, BACKUP_SNAPSHOTS_DIR,
+                        f"{snap_id:06d}.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != BACKUP_SCHEMA:
+        return None
+    return doc
+
+
+def _backup_walk(root: str) -> List[Tuple[str, str]]:
+    """(relpath, abspath) of every file a snapshot must carry: the whole
+    root except staging leftovers (``*.tmp`` is by definition not yet
+    data) and the quarantine (fsck already evicted those bytes).  The
+    WAL, catalog, run docs, and index all ride along — restore is
+    byte-identical, not a re-derivation."""
+    out: List[Tuple[str, str]] = []
+    for dirpath, dirs, names in os.walk(root):
+        dirs[:] = [d for d in sorted(dirs) if d != QUARANTINE_DIR_NAME]
+        for name in sorted(names):
+            if name.endswith(".tmp"):
+                continue
+            path = os.path.join(dirpath, name)
+            out.append((os.path.relpath(path, root), path))
+    return out
+
+
+def backup_archive(root: str, dest: str) -> dict:
+    """``sofa archive backup <root> <dest>`` — one incremental snapshot.
+
+    The destination is itself content-addressed: every source file's
+    bytes land once under ``objects/<aa>/<sha256>`` (an object already
+    present from an earlier snapshot costs a stat — the store's sha-keyed
+    layout makes increments trivial), and the snapshot manifest
+    ``snapshots/<n>.json`` maps relpath -> sha for the WHOLE root at
+    this instant.  Every snapshot is a full restore point; only new
+    bytes travel.  Returns the snapshot stats."""
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.durability import atomic_write
+
+    if os.path.abspath(dest).startswith(os.path.abspath(root) + os.sep):
+        raise OSError(f"backup destination {dest} is inside the source "
+                      "root — a snapshot must survive the root dying")
+    marker = os.path.join(dest, BACKUP_MARKER_NAME)
+    if os.path.isfile(marker):
+        try:
+            with open(marker) as f:
+                mdoc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise OSError(f"unreadable {BACKUP_MARKER_NAME}: {e}") \
+                from None
+        if not isinstance(mdoc, dict) \
+                or mdoc.get("schema") != BACKUP_SCHEMA:
+            raise OSError(f"{dest} is not a sofa backup destination")
+        if mdoc.get("version") != BACKUP_VERSION:
+            raise OSError(
+                f"{dest} holds backup layout v{mdoc.get('version')}; "
+                f"this build writes v{BACKUP_VERSION} — refusing to mix")
+    else:
+        os.makedirs(os.path.join(dest, BACKUP_SNAPSHOTS_DIR),
+                    exist_ok=True)
+        os.makedirs(os.path.join(dest, OBJECTS_DIR_NAME), exist_ok=True)
+        with atomic_write(marker, fsync=True) as f:
+            json.dump({"schema": BACKUP_SCHEMA,
+                       "version": BACKUP_VERSION,
+                       "created_unix": round(time.time(), 3)}, f)
+    cas = ArchiveStore(dest)  # reuse the CAS path/put machinery only
+    files: Dict[str, dict] = {}
+    new_objects = reused = 0
+    bytes_added = 0
+    for rel, path in _backup_walk(root):
+        sha = _sha256_file(path)
+        if sha is None:
+            print_warning(f"backup: {rel} vanished mid-walk — skipped "
+                          "(take another snapshot once the root is "
+                          "quiet)")
+            continue
+        if cas.has_object(sha):
+            reused += 1
+        else:
+            _sha, added = cas.put_file(path, expected_sha=sha)
+            new_objects += 1
+            bytes_added += added
+        files[rel] = {"sha256": sha}
+    snaps = _backup_snapshot_ids(dest)
+    snap_id = (snaps[-1] + 1) if snaps else 1
+    commit = aindex.load_commit(root) or {}
+    doc = {"schema": BACKUP_SCHEMA, "version": BACKUP_VERSION,
+           "snapshot": snap_id,
+           "created_unix": round(time.time(), 3),
+           "source_root": os.path.abspath(root),
+           "commit_sha": commit.get("commit_sha") or "",
+           "files": files}
+    with atomic_write(os.path.join(dest, BACKUP_SNAPSHOTS_DIR,
+                                   f"{snap_id:06d}.json"),
+                      fsync=True) as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    return {"snapshot": snap_id, "files": len(files),
+            "new_objects": new_objects, "reused_objects": reused,
+            "bytes_added": bytes_added,
+            "commit_sha": doc["commit_sha"]}
+
+
+def restore_archive(dest: str, target: str,
+                    snapshot: int = 0) -> dict:
+    """``sofa archive restore <backup> <target>`` — materialize a
+    snapshot (latest by default) into ``target`` and VERIFY it: restore
+    without proof is hope.  Verification is (1) ``archive_fsck`` over
+    the restored root — every object re-hashes to its name — and (2)
+    the restored index commit sha equals the sha recorded at backup
+    time.  Returns the stats; ``ok`` is the verdict."""
+    marker = os.path.join(dest, BACKUP_MARKER_NAME)
+    if not os.path.isfile(marker):
+        raise OSError(f"{dest} is not a sofa backup destination "
+                      f"(no {BACKUP_MARKER_NAME})")
+    snaps = _backup_snapshot_ids(dest)
+    if not snaps:
+        raise OSError(f"{dest} holds no snapshots")
+    snap_id = snapshot or snaps[-1]
+    doc = _load_snapshot(dest, snap_id)
+    if doc is None:
+        raise OSError(f"snapshot {snap_id} in {dest} is unreadable")
+    if os.path.isdir(target) and os.listdir(target):
+        raise OSError(f"restore target {target} is not empty — a "
+                      "restored root must be byte-identical to the "
+                      "snapshot, not merged into leftovers")
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.durability import atomic_write
+
+    cas = ArchiveStore(dest)
+    restored = 0
+    missing: List[str] = []
+    for rel, ent in sorted((doc.get("files") or {}).items()):
+        blob = cas.read_object(str(ent.get("sha256") or ""))
+        if blob is None:
+            missing.append(rel)
+            continue
+        path = os.path.join(target, rel)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with atomic_write(path, "wb") as f:
+            f.write(blob)
+        restored += 1
+    problems = 0
+    report = archive_fsck(target, repair=False)
+    if report is None:
+        problems = -1  # not even a store — the verdict is NO
+    else:
+        problems = sum(len(report.get(k) or [])
+                       for k in ARCHIVE_FSCK_VERDICTS)
+    commit = aindex.load_commit(target) or {}
+    want_sha = str(doc.get("commit_sha") or "")
+    got_sha = commit.get("commit_sha") or ""
+    ok = (not missing and problems == 0 and got_sha == want_sha)
+    return {"snapshot": snap_id, "files": restored,
+            "missing": missing, "fsck_problems": problems,
+            "commit_sha": got_sha, "commit_sha_expected": want_sha,
+            "ok": ok}
+
+
+# ---------------------------------------------------------------------------
 # Tile diff — the multi-run board view's fast path.
 # ---------------------------------------------------------------------------
 
@@ -836,14 +1022,102 @@ def render_show(store: ArchiveStore, doc: dict) -> List[str]:
     return lines
 
 
-def sofa_archive(cfg, action: str, arg: str = "",
+def _archive_backup_verb(cfg, src: str, dest: str) -> int:
+    """``sofa archive backup <root> <dest>``: one incremental snapshot,
+    stamped as ``meta.backup`` into the configured logdir's manifest
+    when one exists — an operator can later prove WHEN the last restore
+    point was taken (tools/manifest_check.py validates the section)."""
+    from sofa_tpu import telemetry
+    from sofa_tpu.telemetry import MANIFEST_NAME
+
+    if not dest:
+        print_error("archive backup needs a destination: "
+                    "`sofa archive backup <root> <dest>`")
+        return 2
+    if not ArchiveStore(src).exists:
+        print_error(f"archive backup: no archive at {src}")
+        return 2
+    try:
+        stats = backup_archive(src, dest)
+    except OSError as e:
+        print_error(f"archive backup: {e}")
+        return 2
+    print_progress(
+        f"archive backup: snapshot {stats['snapshot']:06d} of {src} -> "
+        f"{dest}: {stats['files']} file(s), {stats['new_objects']} new "
+        f"object(s) ({stats['bytes_added']} B), "
+        f"{stats['reused_objects']} reused"
+        + (f"; index commit {stats['commit_sha'][:12]}"
+           if stats.get("commit_sha") else ""))
+    logdir = getattr(cfg, "logdir", "") or ""
+    if logdir and os.path.isfile(os.path.join(logdir, MANIFEST_NAME)):
+        tel = telemetry.begin("backup")
+        try:
+            tel.set_meta(backup={
+                "schema": BACKUP_SCHEMA, "version": BACKUP_VERSION,
+                "snapshot": stats["snapshot"],
+                "dest": os.path.abspath(dest),
+                "source_root": os.path.abspath(src),
+                "files": stats["files"],
+                "new_objects": stats["new_objects"],
+                "bytes_added": stats["bytes_added"],
+                "commit_sha": stats.get("commit_sha") or "",
+                "taken_unix": round(time.time(), 3),
+            })
+            tel.write(logdir, rc=0, cfg=cfg)
+        finally:
+            telemetry.end(tel)
+    return 0
+
+
+def _archive_restore_verb(dest: str, target: str) -> int:
+    """``sofa archive restore <backup> <target>``: materialize + verify
+    (fsck clean AND the restored index commit sha equals the one the
+    snapshot recorded).  Exit 0 verified, 1 restored-but-unproven, 2
+    usage."""
+    if not dest or not target:
+        print_error("archive restore needs both ends: "
+                    "`sofa archive restore <backup> <target>`")
+        return 2
+    try:
+        stats = restore_archive(dest, target)
+    except OSError as e:
+        print_error(f"archive restore: {e}")
+        return 2
+    sha = stats.get("commit_sha") or ""
+    print_progress(
+        f"archive restore: snapshot {stats['snapshot']:06d} -> {target}: "
+        f"{stats['files']} file(s), fsck problems "
+        f"{stats['fsck_problems']}, index commit "
+        f"{(sha or '-')[:12]}"
+        + ("" if stats["ok"] else " — VERIFICATION FAILED"))
+    if not stats["ok"]:
+        if stats.get("missing"):
+            print_error(f"archive restore: {len(stats['missing'])} "
+                        "object(s) missing from the backup store — "
+                        "the snapshot is damaged, try an earlier one")
+        if stats.get("commit_sha") != stats.get("commit_sha_expected"):
+            print_error(
+                "archive restore: restored index commit "
+                f"{(sha or '-')[:12]} != recorded "
+                f"{(stats.get('commit_sha_expected') or '-')[:12]}")
+        return 1
+    return 0
+
+
+def sofa_archive(cfg, action: str, arg: str = "", arg2: str = "",
                  repair: bool = False) -> int:
     """``sofa archive <logdir> | ls | show <run> | gc [--keep N]
-    [--keep_days D] | fsck [--repair]`` — the trace-database verb."""
+    [--keep_days D] | fsck [--repair] | backup <root> <dest> |
+    restore <backup> <target>`` — the trace-database verb."""
     from sofa_tpu import telemetry
     from sofa_tpu.archive import resolve_root
 
     root = resolve_root(cfg)
+    if action == "backup":
+        return _archive_backup_verb(cfg, arg or root, arg2)
+    if action == "restore":
+        return _archive_restore_verb(arg, arg2)
     if action in ("", None):
         print_error("archive needs an action: `sofa archive <logdir>` "
                     "to ingest, or ls / show <run> / gc")

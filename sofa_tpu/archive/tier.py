@@ -77,6 +77,21 @@ WAL_VERSION = 1
 #: old epochs are unlinked by their OWN appender (single-writer rule).
 WAL_ROTATE_BYTES = 1 << 20
 
+#: Admission-control watermarks on a tenant's unapplied WAL depth
+#: (records), env-tunable (SOFA_WAL_SOFT_DEPTH / SOFA_WAL_HARD_DEPTH).
+#: Crossing SOFT sheds /v1/query first — brownout: reads are degradable
+#: (a stale or refused query re-asks later), ingest is not (a refused
+#: push costs the agent a spool round-trip).  Crossing HARD refuses new
+#: pushes with a typed Retry-After'd 503 — bounded queueing instead of
+#: a WAL that grows until the disk does the refusing (docs/FLEET.md
+#: "Failure matrix").
+WAL_SOFT_DEPTH = 64
+WAL_HARD_DEPTH = 256
+
+#: Written at the served root by the pool supervisor while it runs —
+#: `sofa serve --rolling-restart` finds the supervisor to SIGHUP here.
+SUPERVISOR_PIDFILE_NAME = "sofa_serve.pid"
+
 _WAL_FILE_RE = re.compile(r"^wal\.(\d{3})\.(\d{6})\.jsonl$")
 
 #: Virtual nodes per worker on the consistent-hash ring — enough that
@@ -113,6 +128,25 @@ def _chaos_wal_exit_after() -> int:
 
 
 _WAL_APPLIED_TICKS = 0
+
+
+def wal_watermarks() -> Tuple[int, int]:
+    """(soft, hard) WAL-depth watermarks, read per call so a running
+    tier can be re-tuned by env without a restart and tests can pin
+    them per server.  hard >= soft >= 1 always — a zero/negative or
+    inverted pair is operator error, clamped rather than obeyed."""
+    try:
+        soft = int(os.environ.get("SOFA_WAL_SOFT_DEPTH", "")
+                   or WAL_SOFT_DEPTH)
+    except ValueError:
+        soft = WAL_SOFT_DEPTH
+    try:
+        hard = int(os.environ.get("SOFA_WAL_HARD_DEPTH", "")
+                   or WAL_HARD_DEPTH)
+    except ValueError:
+        hard = WAL_HARD_DEPTH
+    soft = max(soft, 1)
+    return soft, max(hard, soft)
 
 
 # ---------------------------------------------------------------------------
@@ -296,7 +330,7 @@ class WalAppender:
         record's ``trace`` key (the push's X-Sofa-Trace id) rides the
         WAL line across the process boundary — that is how one trace id
         spans the handler's process and the drainer's."""
-        from sofa_tpu import metrics
+        from sofa_tpu import faults, metrics
         from sofa_tpu.durability import fsync_append
 
         record = dict(record)
@@ -316,6 +350,14 @@ class WalAppender:
                 name = self._name(self._epoch)
                 path = os.path.join(wal_dir(self.tenant_root), name)  # sofa-lint: disable=SL020 — os.path.join is pure string math, not IO
                 size = 0
+            if faults.maybe_disk_full():
+                # the disk_full@<n> cell: refuse BEFORE the append — an
+                # ack must never stand on bytes that were not made
+                # durable.  The caller answers a typed 507; the consumed
+                # fault lets the client's backed-off retry land.
+                raise OSError(errno.ENOSPC,
+                              f"disk_full fault: WAL append refused "
+                              f"({name})")
             fsync_append(path, line)
         reg = metrics.for_tenant_root(self.tenant_root)
         reg.inc("wal_appends")
@@ -729,20 +771,37 @@ def sofa_fleet_status(cfg) -> int:
     from sofa_tpu import metrics as fleet_metrics
     from sofa_tpu.archive.service import resolve_token
 
-    url = (getattr(cfg, "status_fleet", "") or "").rstrip("/")
+    urls = [u.strip().rstrip("/")
+            for u in (getattr(cfg, "status_fleet", "") or "").split(",")
+            if u.strip()]
     token = resolve_token(cfg)
     headers = {"Authorization": f"Bearer {token}"} if token else {}
-    req = urllib.request.Request(f"{url}/v1/tier", headers=headers)
-    try:
-        with urllib.request.urlopen(req, timeout=10.0) as r:
-            doc = json.loads(r.read())
-    except (OSError, ValueError, urllib.error.URLError) as e:
-        print_error(f"status --fleet: cannot read {url}/v1/tier: {e}")
+    doc = None
+    url = urls[0] if urls else ""
+    errors: List[str] = []
+    for candidate in urls:
+        req = urllib.request.Request(f"{candidate}/v1/tier",
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                got = json.loads(r.read())
+        except (OSError, ValueError, urllib.error.URLError) as e:
+            errors.append(f"{candidate}: {e}")
+            continue
+        if not isinstance(got, dict) or got.get("schema") != TIER_SCHEMA:
+            errors.append(f"{candidate}: not a {TIER_SCHEMA} document")
+            continue
+        doc, url = got, candidate
+        break
+    if doc is None:
+        print_error("status --fleet: no endpoint answered — "
+                    + "; ".join(errors or ["no urls given"]))
         return 1
-    if not isinstance(doc, dict) or doc.get("schema") != TIER_SCHEMA:
-        print_error(f"status --fleet: {url}/v1/tier is not a "
-                    f"{TIER_SCHEMA} document")
-        return 1
+    if url != urls[0]:
+        # failover is never silent: say WHICH endpoint answered and why
+        # the preferred one did not (the client-failover contract)
+        print_warning(f"status --fleet: failed over to {url} ("
+                      + "; ".join(errors) + ")")
     print("\n".join(render_tier_status(doc, url)))
     if doc.get("role") == "replica":
         for line in _replica_staleness_lines(url, headers, doc):
@@ -1113,9 +1172,15 @@ def _reserve_port(bind: str, base_port: int):
 def _worker_main(spec: dict, worker: int, generation: int, ready) -> None:
     """One pool worker: bind (shared port with SO_REUSEPORT, else a
     loopback ephemeral the dispatcher proxies to), drain owned tenants,
-    serve forever.  Runs in a forked child; exits with the process."""
+    serve forever.  Runs in a forked child; exits with the process.
+
+    SIGTERM is the graceful-lifecycle contract (docs/FLEET.md): stop
+    accepting (new writes answer a typed 503 ``draining``), drain every
+    owned tenant's WAL to empty, flush metrics, exit 0 — an acked push
+    can never ride out the door with a dying worker."""
     from sofa_tpu import faults
-    from sofa_tpu.archive.service import _FleetHandler, _FleetServer
+    from sofa_tpu.archive.service import (_FleetHandler, _FleetServer,
+                                          graceful_drain)
 
     if faults.active() is None:
         try:
@@ -1135,6 +1200,20 @@ def _worker_main(spec: dict, worker: int, generation: int, ready) -> None:
     except OSError as e:
         ready.put({"worker": worker, "error": str(e)})
         return
+    got_term = threading.Event()
+
+    def _on_term(signum, frame):  # noqa: ARG001 — signal handler contract
+        got_term.set()
+        with httpd._state_guard:
+            httpd.draining = True
+        # shutdown() blocks until serve_forever returns; the handler
+        # runs ON the serve_forever thread — a direct call deadlocks
+        threading.Thread(target=httpd.shutdown, daemon=True,  # sofa-lint: disable=SL023 — this thread IS the stop path: shutdown() unblocks serve_forever below, the drain runs, and the process exits
+                         name="sofa-tier-drain").start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _on_term)
     ready.put({"worker": worker, "port": httpd.server_address[1],
                "pid": os.getpid()})
     try:
@@ -1142,6 +1221,8 @@ def _worker_main(spec: dict, worker: int, generation: int, ready) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if got_term.is_set():
+            graceful_drain(httpd)
         httpd.server_close()
 
 
@@ -1180,7 +1261,8 @@ class _DispatchHandler(__import__("http.server", fromlist=["x"])
         body = self.rfile.read(n) if n > 0 else b""
         fwd = {k: v for k, v in self.headers.items()
                if k.lower() in ("authorization", "content-type",
-                                "if-none-match", "x-sofa-trace")}
+                                "if-none-match", "x-sofa-trace",
+                                "x-sofa-deadline")}
         for port in self._targets():
             conn = http.client.HTTPConnection("127.0.0.1", port,
                                               timeout=60.0)
@@ -1372,6 +1454,44 @@ class TierHandle:
             name="sofa-tier-dispatch")
         self._dispatch_thread.start()
 
+    def rolling_restart(self, timeout_s: float = 60.0) -> bool:
+        """Restart the pool ONE worker at a time with zero acked-push
+        loss: SIGTERM worker w (graceful drain — it refuses new writes,
+        empties its WAL, exits 0), wait for the supervisor's respawn to
+        report ready, then move on.  The ring makes the handoff safe:
+        the dying worker's tenants are fully applied before it exits,
+        siblings keep accepting all along (their WAL appends are
+        fsync'd — the new life of the owner drains them), and at every
+        instant N-1 workers serve."""
+        import signal
+
+        for w in range(self.workers):
+            with self._guard:
+                p = self._procs[w]
+                old_pid = self.worker_pids.get(w, 0)
+            if p is None or old_pid == 0:
+                continue
+            try:
+                os.kill(old_pid, signal.SIGTERM)  # sofa-lint: disable=SL008 — graceful drain of our own child: TERM->KILL escalation would defeat the WAL drain; the supervisor respawn IS the fallback
+            except OSError:
+                continue  # already gone; the supervisor is on it
+            deadline = time.monotonic() + timeout_s
+            ok = False
+            while time.monotonic() < deadline:
+                with self._guard:
+                    new_pid = self.worker_pids.get(w, 0)
+                if new_pid and new_pid != old_pid:
+                    ok = True
+                    break
+                time.sleep(0.05)
+            if not ok:
+                print_error(f"serve: rolling restart stalled waiting "
+                            f"for worker {w} to respawn")
+                return False
+            print_warning(f"serve: rolling restart — worker {w} "
+                          f"handed off (pid {old_pid} -> {new_pid})")
+        return True
+
     def stop(self) -> None:
         self._stopping.set()
         for p in self._procs:
@@ -1390,6 +1510,55 @@ class TierHandle:
             self._reserve_sock = None
         if self._supervisor is not None:
             self._supervisor.join(timeout=5.0)
+
+
+def supervisor_pidfile(root: str) -> str:
+    """Where a long-running pool supervisor records its pid — the
+    rendezvous `sofa serve --rolling-restart` signals through."""
+    return os.path.join(os.path.abspath(root), SUPERVISOR_PIDFILE_NAME)
+
+
+def write_supervisor_pidfile(root: str) -> str:
+    from sofa_tpu.durability import atomic_write
+
+    path = supervisor_pidfile(root)
+    with atomic_write(path) as f:
+        f.write(f"{os.getpid()}\n")
+    return path
+
+
+def remove_supervisor_pidfile(root: str) -> None:
+    try:
+        os.unlink(supervisor_pidfile(root))
+    except OSError:
+        pass
+
+
+def signal_rolling_restart(root: str) -> int:
+    """``sofa serve --rolling-restart <root>``: SIGHUP the supervisor
+    recorded in the root's pidfile.  Exit 0 signal delivered, 2 when no
+    live supervisor is found (a stale pidfile is reported, not obeyed)."""
+    import signal
+
+    path = supervisor_pidfile(root)
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip() or "0")
+    except (OSError, ValueError):
+        print_error(f"serve --rolling-restart: no supervisor pidfile at "
+                    f"{path} — is `sofa serve --workers N` running on "
+                    "this root?")
+        return 2
+    try:
+        os.kill(pid, signal.SIGHUP)  # sofa-lint: disable=SL008 — SIGHUP is a control message to the supervisor (restart request), not a kill; nothing to escalate
+    except OSError as e:
+        print_error(f"serve --rolling-restart: supervisor pid {pid} from "
+                    f"{path} is not signalable ({e}) — stale pidfile?")
+        return 2
+    print_warning(f"serve: rolling restart requested (SIGHUP -> "
+                  f"supervisor pid {pid}); workers hand off one at a "
+                  "time — watch the serving terminal")
+    return 0
 
 
 def start_pool(root: str, token: str, bind: str, base_port: int,

@@ -111,7 +111,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "watch directory (agent)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
-                        "the baseline run for `regress`")
+                        "the baseline run for `regress`, the archive root "
+                        "for `archive backup`/`archive restore`")
+    p.add_argument("extra2", nargs="?", default="",
+                   help="third positional: the destination for `archive "
+                        "backup`, the restore target for `archive restore`")
 
     g = p.add_argument_group("pipeline")
     g.add_argument("--logdir")
@@ -324,14 +328,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/FLEET.md)")
     g.add_argument("--fleet", dest="status_fleet", metavar="URL",
                    help="status: render the live tier topology from this "
-                        "service's /v1/tier endpoint instead of a logdir")
+                        "service's /v1/tier endpoint instead of a logdir "
+                        "(comma-join URLs for failover)")
+    g.add_argument("--rolling-restart", "--rolling_restart",
+                   dest="serve_rolling_restart", action="store_true",
+                   default=False,
+                   help="serve: signal the running supervisor for this root "
+                        "to restart its workers one at a time (ring handoff, "
+                        "zero acked-push loss) and exit")
     g.add_argument("--tenant", dest="fleet_tenant",
                    help="agent: tenant namespace to push into "
                         "(default 'default')")
     g.add_argument("--service", dest="agent_service",
                    help="agent: fleet service URL, e.g. "
                         "http://collector:8044 (SOFA_AGENT_SERVICE env; "
-                        "empty = spool-only mode)")
+                        "empty = spool-only mode; comma-join URLs for "
+                        "client-side failover with /v1/health probes)")
     g.add_argument("--spool", dest="agent_spool",
                    help="agent: durable spool root (SOFA_AGENT_SPOOL env; "
                         "default ./sofa_spool)")
@@ -423,7 +435,7 @@ def config_from_args(args: argparse.Namespace) -> SofaConfig:
         "live_interval_s", "live_epochs", "live_stall_s",
         "serve_bind", "serve_port", "serve_token", "serve_quota_mb",
         "serve_max_inflight", "serve_workers", "serve_replica_of",
-        "serve_slo",
+        "serve_slo", "serve_rolling_restart",
         "status_fleet", "fleet_tenant", "agent_service",
         "agent_spool", "agent_poll_s", "agent_settle_s", "agent_timeout_s",
         "agent_retries", "agent_backoff_s", "agent_backoff_cap_s",
@@ -633,7 +645,7 @@ def _run(argv=None) -> int:
             from sofa_tpu.archive.store import sofa_archive
             print_main_progress("SOFA archive")
             return sofa_archive(cfg, args.usr_command, args.extra,
-                                repair=args.repair)
+                                args.extra2, repair=args.repair)
         if cmd == "serve":
             from sofa_tpu.archive.service import sofa_serve
             print_main_progress("SOFA serve")

@@ -214,6 +214,10 @@ class SofaConfig:
                                      # 'push_p99_ms<50,wal_depth<1000' —
                                      # evaluated per scrape window
                                      # (metrics.parse_slo grammar)
+    serve_rolling_restart: bool = False  # --rolling-restart: signal the
+                                     # running supervisor (SIGHUP via its
+                                     # pidfile) to restart workers one at
+                                     # a time, then exit
     status_fleet: str = ""           # status --fleet: render /v1/tier
                                      # topology from this service URL
     fleet_tenant: str = "default"    # tenant namespace for agent pushes

@@ -32,6 +32,10 @@ client in sofa_tpu/archive/client.py — the server is never faulted, so
 what these prove is the CLIENT's retry/resume/backoff contract)::
 
     service:conn_refused[@start|@always]   connection refused
+    service:conn_reset[@start|@always]     connection reset mid-request —
+                                           the ack (if any) is lost in
+                                           flight; retry must be a
+                                           committed no-op
     service:stall[@start|@always]          request exceeds its deadline
     service:http_500[@start|@always]       server-side 5xx
     service:partial@<fraction>             upload body truncated at the
@@ -63,6 +67,11 @@ scaled tier — archive/tier.py/service.py — never by the client)::
                                age grows and the stale-scrape warning
                                path through manifest_warnings is
                                reachable (holds until the plan clears)
+    service:disk_full@<n>      the tier's <n>-th WAL/store write (1-based,
+                               counted across the process) raises ENOSPC —
+                               the worker answers a typed 507/503 refusal
+                               instead of acking a write it cannot make
+                               durable (fires once; the retry lands)
 
 Stream-source fault kinds (target = a tailable ingest source, consumed by
 the `sofa live` tailer in sofa_tpu/live.py — docs/LIVE.md failure matrix)::
@@ -108,19 +117,21 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 KINDS = ("die", "wedge", "fail", "truncate", "corrupt",
-         "conn_refused", "stall", "http_500", "partial",
+         "conn_refused", "conn_reset", "stall", "http_500", "partial",
          "worker_die", "replica_stale", "slo_breach", "scrape_stall",
-         "tail_truncate", "tail_torn", "rotate")
+         "disk_full", "tail_truncate", "tail_torn", "rotate")
 #: Kinds injected into the fleet transport client (archive/client.py)
 #: rather than a collector lifecycle hook.
-NET_KINDS = ("conn_refused", "stall", "http_500", "partial",
-             "worker_die", "replica_stale", "slo_breach", "scrape_stall")
+NET_KINDS = ("conn_refused", "conn_reset", "stall", "http_500", "partial",
+             "worker_die", "replica_stale", "slo_breach", "scrape_stall",
+             "disk_full")
 #: The NET_KINDS subset consumed by the scaled tier's SERVER side
 #: (archive/tier.py, archive/service.py, sofa_tpu/metrics.py) — the
 #: transport client skips these entirely: a worker dying, a replica
-#: lagging or the metrics plane misbehaving is the tier's failure to
-#: absorb, not the client's to simulate.
-TIER_KINDS = ("worker_die", "replica_stale", "slo_breach", "scrape_stall")
+#: lagging, the metrics plane misbehaving or the store's disk filling is
+#: the tier's failure to absorb, not the client's to simulate.
+TIER_KINDS = ("worker_die", "replica_stale", "slo_breach", "scrape_stall",
+              "disk_full")
 #: Kinds injected into the `sofa live` tailer (sofa_tpu/live.py) against a
 #: streaming ingest source.  ``stall`` is shared vocabulary with NET_KINDS:
 #: against the ``service`` target it is a transport stall, against a source
@@ -277,6 +288,29 @@ class FaultPlan:
         return any(s.kind == "scrape_stall"
                    for s in self._by_target.get("service", ()))
 
+    def tier_disk_full(self) -> bool:
+        """Consult-and-consume for ``disk_full@<n>``: True exactly once,
+        at the plan's <n>-th consulted WAL/store write (1-based, counted
+        process-wide across tenants).  The write site answers with a
+        typed out-of-space refusal instead of acking bytes it never made
+        durable; the consumed spec lets the client's retry land."""
+        spec = None
+        for s in self._by_target.get("service", ()):
+            if s.kind == "disk_full":
+                spec = s
+                break
+        if spec is None:
+            return False
+        with self._fired_guard:
+            if self._fired.get(("disk_full",)):
+                return False
+            count = int(self._fired.get(("disk_full_writes",), 0)) + 1
+            self._fired[("disk_full_writes",)] = count
+            if count != (spec.epoch or 1):
+                return False
+            self._fired[("disk_full",)] = True
+        return True
+
 
 def parse(text: str) -> FaultPlan:
     """Parse a spec string; raises ValueError with the offending entry."""
@@ -385,6 +419,18 @@ def _parse_net(entry: str, target: str, kind: str,
                 f"fault entry {entry!r}: slo_breach takes a 1-based "
                 "scrape-window ordinal (e.g. slo_breach@2)")
         return FaultSpec(target=target, kind=kind, epoch=window)
+    if kind == "disk_full":
+        if not when:
+            return FaultSpec(target=target, kind=kind, epoch=1)
+        try:
+            nth = int(when)
+        except ValueError:
+            nth = 0
+        if nth < 1:
+            raise ValueError(
+                f"fault entry {entry!r}: disk_full takes a 1-based "
+                "write ordinal (e.g. disk_full@3)")
+        return FaultSpec(target=target, kind=kind, epoch=nth)
     if kind == "partial":
         try:
             fraction = float(when)
@@ -524,6 +570,18 @@ def maybe_slo_breach(window: int) -> bool:
     if plan is None:
         return False
     return plan.tier_slo_breach(window)
+
+
+def maybe_disk_full() -> bool:
+    """Scaled-tier hook (archive/tier.py WAL appends, archive/service.py
+    object uploads): True when THIS durable write should see ENOSPC — the
+    ``disk_full@<n>`` cell.  The caller refuses the request with a typed
+    out-of-space error instead of acking; fires once, so the client's
+    backed-off retry proves recovery."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.tier_disk_full()
 
 
 def maybe_scrape_stall() -> bool:

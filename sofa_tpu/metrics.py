@@ -807,7 +807,11 @@ def _doc_etag(doc: dict) -> str:
                           # rates divide by wall time since the last
                           # scrape, so they drift between identical
                           # polls — content, not the clock, moves the tag
-                          and not k.endswith("_rps")}
+                          and not k.endswith("_rps")
+                          # the metrics GET is itself a response, so this
+                          # counter bumps on every poll — keeping it in the
+                          # tag would make an idle tier never answer 304
+                          and k != "responses_total"}
     slo = doc.get("slo")
     if isinstance(slo, dict):
         stable["slo"] = {k: v for k, v in slo.items()
@@ -832,6 +836,10 @@ def metrics_summary(reg: MetricsRegistry) -> dict:
         "push_p99_ms": flat.get("push_p99_ms"),
         "wal_depth": flat.get("wal_depth"),
         "replica_behind": flat.get("replica_behind"),
+        # admission-control observability: the refusal-rate pair rides
+        # /v1/tier so chaos_tier.py can aggregate it per worker ordinal
+        "refusals_total": flat.get("refusals_total"),
+        "responses_total": flat.get("responses_total"),
         "slo_ok": None if verdict is None else bool(verdict.get("ok")),
         "slo_breaching": list((verdict or {}).get("breaching") or []),
     }

@@ -674,6 +674,12 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # digest walk already skip wholesale — registering them
                  # keeps the artifact inventory's closure honest.
                  "agent_state.json", "sofa_fleet.json",
+                 # archive backup marker (sofa_tpu/archive/store.py
+                 # backup_archive): the destination's layout stamp.  A
+                 # backup destination is never a logdir, so the sweep
+                 # cannot reach it — registered for inventory closure
+                 # like the fleet ledgers above.
+                 "sofa_backup.json",
                  # chunk-store commit manifest (sofa_tpu/frames.py
                  # write_chunk_store): lives under _frames/<name>/ and
                  # _index/<family>/ — both swept wholesale via

@@ -285,6 +285,11 @@ def test_polarity_classes():
     assert bl.polarity("resnet50_profiling_overhead") == 1
     assert bl.polarity("comm_ici_bandwidth") == -1
     assert bl.polarity("tpu_ops") == 0
+    # the self-healing tier's benchmark pair: slower recovery and a
+    # higher refusal rate under the same load are both regressions
+    assert bl.polarity("tier_recovery_wall_time_s") == 1
+    assert bl.polarity("tier_refusal_rate_pct") == 1
+    assert bl.polarity("fleet_saturation_rps") == -1
 
 
 def test_rolling_verdict_discipline():
@@ -544,3 +549,89 @@ def test_resolve_root_precedence(monkeypatch):
     assert resolve_root(SofaConfig()) == "/env/root"
     monkeypatch.delenv("SOFA_ARCHIVE_ROOT")
     assert resolve_root(None) == "sofa_archive"
+
+
+# --- backup / restore (disaster recovery) -----------------------------------
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, _dirs, names in os.walk(root):
+        for n in sorted(names):
+            if n.endswith(".tmp"):
+                continue
+            p = os.path.join(dirpath, n)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+def test_backup_restore_is_byte_identical(tmp_path):
+    """`sofa archive backup` + `restore`: the restored root is
+    byte-identical to the source at snapshot time, fsck answers 0
+    problems, and the restored index commit sha equals the one recorded
+    in the snapshot — restore without proof is hope."""
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.archive.store import backup_archive, restore_archive
+
+    root = str(tmp_path / "arch")
+    ingest_run(_mini_logdir(tmp_path, "a", elapsed=1.5), root)
+    ingest_run(_mini_logdir(tmp_path, "b", elapsed=2.5), root)
+    if aindex.available():
+        aindex.refresh(root, jobs=0)  # the sha the restore must match
+    dest = str(tmp_path / "backup")
+    stats = backup_archive(root, dest)
+    assert stats["snapshot"] == 1 and stats["files"] > 0
+    assert stats["new_objects"] > 0
+
+    target = str(tmp_path / "restored")
+    verdict = restore_archive(dest, target)
+    assert verdict["ok"], verdict
+    assert verdict["missing"] == [] and verdict["fsck_problems"] == 0
+    assert verdict["commit_sha"] == verdict["commit_sha_expected"]
+    assert _tree_bytes(target) == _tree_bytes(root)
+    # the restored root serves reads: every run doc loads
+    restored = ArchiveStore(target)
+    for ent in catalog.ingest_entries(catalog.read_catalog(target)):
+        assert restored.load_run(ent["run"]) is not None
+
+
+def test_backup_is_incremental(tmp_path):
+    """A second snapshot after one new run re-uses every unchanged
+    object (content-addressed increments) and restores independently."""
+    from sofa_tpu.archive.store import backup_archive, restore_archive
+
+    root = str(tmp_path / "arch")
+    ingest_run(_mini_logdir(tmp_path, "a", elapsed=1.5), root)
+    dest = str(tmp_path / "backup")
+    s1 = backup_archive(root, dest)
+    ingest_run(_mini_logdir(tmp_path, "b", elapsed=2.5), root)
+    s2 = backup_archive(root, dest)
+    assert s2["snapshot"] == 2
+    assert s2["reused_objects"] > 0          # only new bytes traveled
+    # each snapshot is a FULL restore point: the older one still lands
+    old = restore_archive(dest, str(tmp_path / "r1"), snapshot=1)
+    assert old["missing"] == [] and old["fsck_problems"] == 0
+    new = restore_archive(dest, str(tmp_path / "r2"))
+    assert new["missing"] == [] and new["fsck_problems"] == 0
+    assert len(_tree_bytes(str(tmp_path / "r2"))) > \
+        len(_tree_bytes(str(tmp_path / "r1")))
+
+
+def test_backup_restore_guardrails(tmp_path):
+    """The refusals that keep DR honest: no backup into the source
+    root, no restore onto leftovers, no restore from a non-backup."""
+    from sofa_tpu.archive.store import backup_archive, restore_archive
+
+    root = str(tmp_path / "arch")
+    ingest_run(_mini_logdir(tmp_path), root)
+    with pytest.raises(OSError):
+        backup_archive(root, os.path.join(root, "nested"))
+    dest = str(tmp_path / "backup")
+    backup_archive(root, dest)
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "leftover.txt").write_text("x")
+    with pytest.raises(OSError):
+        restore_archive(dest, str(dirty))
+    with pytest.raises(OSError):
+        restore_archive(str(tmp_path / "not_a_backup"), str(tmp_path / "t"))

@@ -167,6 +167,7 @@ def test_triple_push_is_idempotent(service, tmp_path):
 @pytest.mark.parametrize("spec", [
     "service:conn_refused@start",
     "service:conn_refused",
+    "service:conn_reset",
     "service:stall",
     "service:http_500",
     "service:partial@0.5",
@@ -227,6 +228,68 @@ def test_acceptance_faulted_push_is_byte_identical(service, tmp_path):
         assert sofa_agent(faulted, watch=str(watch), once=True) == 0
     assert service.stats.get("object_stored", 0) == before
     assert len(_server_runs(service, "faulted")) == 1
+
+
+def _dead_url():
+    """A loopback URL nothing listens on (bind + close to claim it)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def test_client_fails_over_and_opens_breaker(service, tmp_path):
+    """Multi-endpoint failover (docs/FLEET.md "Client failover"): a
+    dead first endpoint opens its circuit breaker on the connection
+    error, the next attempt moves to the live sibling, and the
+    failover is counted — never silent."""
+    dead = _dead_url()
+    client = ServiceClient(f"{dead},{service_url(service)}", TOKEN,
+                           timeout_s=5, retries=3,
+                           backoff_s=0.01, backoff_cap_s=0.05)
+    assert client.ping()["ok"] is True
+    assert client.failovers >= 1
+    assert client.base == service_url(service)
+    assert client.breaker_open(dead)
+    # HTTP-status refusals never trip a breaker: the live endpoint
+    # answered, so it stays trusted even across a 503
+    assert not client.breaker_open(service_url(service))
+
+
+def test_failover_push_lands_and_stamps_meta_health(service, tmp_path):
+    """An agent configured with `--service dead,live` still lands the
+    run, and the manifest carries the durable meta.health record: the
+    post-failover endpoint, the failover count, the open breaker."""
+    dead = _dead_url()
+    watch = tmp_path / "watch"
+    logdir = _mklog(watch)
+    cfg = _agent_cfg(tmp_path, f"{dead},{service_url(service)}")
+    assert sofa_agent(cfg, watch=str(watch), once=True) == 0
+    assert len(_server_runs(service)) == 1
+    _fsck_clean(_tenant_root(service))
+    with open(os.path.join(logdir, telemetry.MANIFEST_NAME)) as f:
+        meta = json.load(f)["meta"]
+    mh = meta["health"]
+    assert mh["schema"] == "sofa_tpu/fleet_health"
+    assert mh["active"] == service_url(service)
+    assert mh["endpoints"] == [dead, service_url(service)]
+    assert mh["failovers"] >= 1
+    assert meta["agent"]["service"] == service_url(service)
+
+
+def test_all_endpoints_dead_is_routed_not_hung(tmp_path):
+    """Every endpoint down: the client raises the retryable typed error
+    after its bounded retries — no infinite loop, no bare socket
+    traceback."""
+    client = ServiceClient(f"{_dead_url()},{_dead_url()}", TOKEN,
+                           timeout_s=1, retries=1,
+                           backoff_s=0.01, backoff_cap_s=0.02)
+    with pytest.raises(ServiceUnavailable) as exc:
+        client.ping()
+    assert exc.value.status is None  # connection-level, not HTTP
 
 
 def test_offline_spools_then_drains(tmp_path):

@@ -16,11 +16,13 @@ fallback, the `worker_die`/`replica_stale` fault grammar, and the
 import json
 import os
 import shutil
+import signal
 import subprocess
 import sys
 import threading
 import time
 import types
+import urllib.error
 import urllib.request
 
 import pytest
@@ -457,6 +459,134 @@ def test_tier_fault_kinds_parse_and_consume():
         faults.parse("service:worker_die@zero")
     with pytest.raises(ValueError):
         faults.parse("service:replica_stale@start")
+
+
+def test_tier_disk_full_and_conn_reset_grammar():
+    """Satellite: the two new fault kinds parse, consume, and stay on
+    their own side of the client/server split (docs/ROBUSTNESS.md)."""
+    plan = faults.parse("service:disk_full@2")
+    # disk_full is the SERVER side's (TIER_KINDS) — the transport
+    # client must skip it entirely
+    assert plan.service_fault("service", "put", "k") is None
+    assert plan.tier_disk_full() is False      # 1st consulted write
+    assert plan.tier_disk_full() is True       # 2nd: ENOSPC fires once
+    assert plan.tier_disk_full() is False      # consumed — retry lands
+    # the ordinal defaults to the first write
+    plan = faults.parse("service:disk_full")
+    assert plan.tier_disk_full() is True
+    with pytest.raises(ValueError):
+        faults.parse("service:disk_full@zero")
+    with pytest.raises(ValueError):
+        faults.parse("service:disk_full@0.5")
+    # conn_reset is a client-side NET kind: once per request key
+    plan = faults.parse("service:conn_reset")
+    assert plan.tier_disk_full() is False
+    spec = plan.service_fault("service", "put", "a")
+    assert spec is not None and spec.kind == "conn_reset"
+    assert plan.service_fault("service", "put", "a") is None
+    assert plan.service_fault("service", "put", "b") is not None
+
+
+# ---------------------------------------------------------------------------
+# Admission control: the X-Sofa-Deadline contract.
+# ---------------------------------------------------------------------------
+
+def _post(url, doc, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Authorization": f"Bearer {TOKEN}", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+_HAVE_DOC = {"files": {"a.txt": {"sha256": "ab" * 32}}}
+
+
+def test_deadline_expired_on_arrival_is_504(primary):
+    """A request whose X-Sofa-Deadline already passed is refused with a
+    typed 504 and NO Retry-After — the client gave up; doing the work
+    would answer nobody (docs/FLEET.md)."""
+    url = service_url(primary) + "/v1/default/have"
+    code, headers, doc = _post(url, _HAVE_DOC, headers={
+        "X-Sofa-Deadline": f"{time.time() - 5.0:.3f}"})
+    assert code == 504
+    assert doc["error"] == "deadline_expired"
+    assert "Retry-After" not in headers
+    assert primary.stats.get("504_deadline_expired", 0) >= 1
+
+
+def test_deadline_missing_header_serves_normally(primary):
+    code, _headers, doc = _post(service_url(primary) +
+                                "/v1/default/have", _HAVE_DOC)
+    assert code == 200 and doc["missing"] == ["ab" * 32]
+
+
+@pytest.mark.parametrize("raw", [
+    # a clock-skewed agent 30 days in the future must not buy itself an
+    # infinite deadline: beyond the skew cap the header is IGNORED (the
+    # request serves), never obeyed
+    lambda: f"{time.time() + 30 * 86400:.3f}",
+    lambda: "not-a-deadline",                  # unparsable: ignored
+])
+def test_deadline_skew_and_garbage_are_ignored(primary, raw):
+    code, _headers, doc = _post(
+        service_url(primary) + "/v1/default/have", _HAVE_DOC,
+        headers={"X-Sofa-Deadline": raw()})
+    assert code == 200 and doc["missing"] == ["ab" * 32]
+
+
+def test_deadline_within_cap_is_honored_not_refused(primary):
+    """A sane near-future deadline serves: only EXPIRED refuses."""
+    code, _headers, _doc = _post(
+        service_url(primary) + "/v1/default/have", _HAVE_DOC,
+        headers={"X-Sofa-Deadline": f"{time.time() + 30.0:.3f}"})
+    assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# Graceful lifecycle: SIGTERM drains the WAL and exits 0.
+# ---------------------------------------------------------------------------
+
+def test_sigterm_worker_drains_wal_and_exits_zero(tmp_path):
+    """The graceful-lifecycle contract (docs/FLEET.md): a SIGTERM'd
+    pool worker stops accepting, drains every owned tenant's WAL to
+    EMPTY, and exits 0 — the acked pushes seeded into the WAL are
+    committed state on disk after the exit, never lost."""
+    import multiprocessing
+
+    root = str(tmp_path / "store")
+    troot = os.path.join(root, TENANTS_DIR_NAME, "default")
+    app = tier.WalAppender(troot, worker=0)
+    recs = _wal_records(3)
+    for rec in recs:
+        app.append(rec)
+    assert tier.wal_depth(troot) == 3
+
+    ctx = multiprocessing.get_context("fork")
+    ready = ctx.Queue()
+    spec = {"root": root, "token": TOKEN, "bind": "127.0.0.1",
+            "port": 0, "reuse": False, "quota_mb": 0.0,
+            "max_inflight": 8, "workers": 1, "slo": ""}
+    proc = ctx.Process(target=tier._worker_main,
+                       args=(spec, 0, 0, ready), daemon=True)
+    proc.start()
+    msg = ready.get(timeout=30)
+    assert "error" not in msg, msg
+    # the worker is serving — health answers before the TERM
+    _wait_for(lambda: _get(
+        f"http://127.0.0.1:{msg['port']}/v1/health")[0] == 200,
+        what="worker health")
+    os.kill(proc.pid, signal.SIGTERM)
+    proc.join(timeout=30)
+    assert proc.exitcode == 0, f"worker exited {proc.exitcode}"
+    assert tier.wal_depth(troot) == 0
+    runs = acat.ingest_entries(acat.read_catalog(troot))
+    assert [e["run"] for e in runs] == [r["run"] for r in recs]
+    _fsck_clean(troot)
 
 
 def test_fleet_status_renders_tier(primary, tmp_path, capsys):

@@ -1050,6 +1050,135 @@ def _run_metrics_survival_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _load_chaos_tier():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_tier", os.path.join(_TOOLS, "chaos_tier.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_kill_under_load_cell(workdir: str, synth: str, mc) -> List[str]:
+    """kill-worker-under-load: chaos_tier.py's core pass at matrix
+    scale — a pool worker is SIGKILLed and the whole pool rolling-
+    restarted WHILE fleet_load traffic runs; zero acked pushes lost,
+    run sets equal to an uninterrupted twin, every tenant fsck-clean
+    with a commit sha byte-identical to an uninterrupted index build
+    over the same ledger."""
+    ct = _load_chaos_tier()
+    doc = ct.run_chaos(workers=2, agents=4, pushes=3, pollers=1,
+                       tenants=2, replica=False, disk_full_at=0)
+    return list(doc["problems"])
+
+
+def _run_disk_full_wal_cell(workdir: str, synth: str, mc) -> List[str]:
+    """disk-full-WAL: the service's 5th durable write (the WAL append
+    behind the commit, after the synth run's 4 object puts) sees a
+    fires-once ENOSPC and answers a typed 507 no_space instead of
+    acking bytes it never made durable; the agent's backed-off retry
+    lands the run, and the store converges fsck-clean."""
+    from sofa_tpu.agent import sofa_agent
+
+    logdir = os.path.join(workdir, "disk-full-wal") + "/"
+    store = os.path.join(workdir, "disk-full-wal-store")
+    spool = os.path.join(workdir, "disk-full-wal-spool")
+    for path in (logdir, store, spool):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    proc, url = _start_service(workdir, store,
+                               {"SOFA_FAULTS": "service:disk_full@5"})
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+        if rc != 0:
+            problems.append(f"agent rc={rc} across the disk_full "
+                            "refusal (expected 0: the retry lands)")
+        problems += _fleet_store_problems(store)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    doc = telemetry.load_manifest(logdir)
+    if doc is None:
+        problems.append("no run_manifest.json after the push")
+    else:
+        problems += [f"manifest: {p}" for p in mc.validate_manifest(doc)]
+    return problems
+
+
+def _run_restore_then_serve_cell(workdir: str, synth: str, mc) -> List[str]:
+    """restore-then-serve: push a run, back the tenant store up
+    (incremental content-addressed snapshot), restore it into a FRESH
+    root, and serve the restored root — /v1/query must answer the same
+    run and the restore's own verification (fsck 0 + commit sha
+    equality) must hold.  The disaster-recovery path proven end to end,
+    not just file-by-file."""
+    import json as _json
+    import urllib.request
+
+    from sofa_tpu.agent import sofa_agent
+    from sofa_tpu.archive.store import backup_archive, restore_archive
+
+    logdir = os.path.join(workdir, "restore-serve") + "/"
+    store = os.path.join(workdir, "restore-serve-store")
+    spool = os.path.join(workdir, "restore-serve-spool")
+    backup = os.path.join(workdir, "restore-serve-backup")
+    restored = os.path.join(workdir, "restore-serve-restored")
+    for path in (logdir, store, spool, backup, restored):
+        shutil.rmtree(path, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    problems: List[str] = []
+    sofa_preprocess(SofaConfig(logdir=logdir))
+    proc, url = _start_service(workdir, store)
+    try:
+        rc = sofa_agent(_fleet_agent_cfg(logdir, url, spool),
+                        watch=logdir, once=True)
+        if rc != 0:
+            problems.append(f"agent rc={rc} (expected 0)")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    troot = os.path.join(store, "tenants", "default")
+    summary = backup_archive(troot, backup)
+    if summary.get("files", 0) <= 0:
+        problems.append(f"backup copied {summary.get('files')} file(s)")
+    verdict = restore_archive(backup, os.path.join(restored, "tenants",
+                                                   "default"))
+    if not verdict.get("ok"):
+        problems.append(f"restore verification failed: {verdict}")
+    # serve the RESTORED root: the run answers from the new tier
+    proc, url = _start_service(workdir, restored)
+    try:
+        req = urllib.request.Request(
+            f"{url}/v1/default/query?kind=runs&limit=10",
+            headers={"Authorization": "Bearer chaos"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = _json.loads(resp.read())
+        rows = [r.get("run") for r in doc.get("rows") or []]
+        if len(rows) != 1:
+            problems.append(f"restored tier answers {len(rows)} run(s), "
+                            "expected the 1 pushed run")
+    except OSError as e:
+        problems.append(f"restored tier query failed: {e}")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    problems += _fleet_store_problems(restored)
+    return problems
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     workdir = os.path.abspath(args[0] if args else "/tmp/sofa_chaos")
@@ -1057,7 +1186,7 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 10
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 13
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
@@ -1065,6 +1194,9 @@ def main(argv=None) -> int:
                    ("agent-offline-spool-then-drain", None),
                    ("kill-worker-mid-wal-drain", None),
                    ("kill-worker-metrics-survive", None),
+                   ("kill-worker-under-load", None),
+                   ("disk-full-wal", None),
+                   ("restore-then-serve", None),
                    ("kill-mid-live-epoch", None),
                    ("source-rotate-mid-tail", None),
                    ("kill-mid-index-refresh", None)])
@@ -1136,7 +1268,12 @@ def main(argv=None) -> int:
                        ("kill-worker-mid-wal-drain",
                         _run_worker_kill_cell),
                        ("kill-worker-metrics-survive",
-                        _run_metrics_survival_cell)):
+                        _run_metrics_survival_cell),
+                       ("kill-worker-under-load",
+                        _run_kill_under_load_cell),
+                       ("disk-full-wal", _run_disk_full_wal_cell),
+                       ("restore-then-serve",
+                        _run_restore_then_serve_cell)):
         try:
             problems = cell(workdir, synth, mc)
         except Exception:  # noqa: BLE001 — a crashed cell is a failed cell

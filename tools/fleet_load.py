@@ -114,9 +114,11 @@ class _Conn:
                 self._conn = None
                 time.sleep(min(0.05 * (attempt + 1), 0.5))
                 continue
-            if resp.status in (503, 429):
+            if resp.status in (503, 429, 507):
                 # short fixed backoff: a long sleep leaves server write
-                # slots idle and measures the sleep, not the tier
+                # slots idle and measures the sleep, not the tier.  507
+                # is the fires-once disk_full refusal — the backed-off
+                # retry proving recovery is the chaos_tier.py contract.
                 time.sleep(0.05)
                 continue
             try:
@@ -177,20 +179,38 @@ def _push_run(conn: _Conn, tenant: str, files_bytes: Dict[str, bytes],
 
 def run_fleet_load(url: str, token: str, *, agents: int = 8,
                    pushes: int = 8, pollers: int = 2, tenants: int = 4,
-                   payload_bytes: int = 2048) -> dict:
-    """Drive the closed-loop workload; returns the metrics document.
-    Deterministic run set: ``agents * pushes`` runs spread over
-    ``tenants`` tenant namespaces."""
+                   payload_bytes: int = 2048,
+                   push_interval_s: float = 0.0) -> dict:
+    """Drive the workload; returns the metrics document.  Deterministic
+    run set: ``agents * pushes`` runs spread over ``tenants`` tenant
+    namespaces.
+
+    ``push_interval_s > 0`` switches the agents from closed-loop
+    (back-to-back) to OPEN-LOOP pacing: agent ``a``'s push ``i`` is due
+    at ``harness_start + i * push_interval_s`` on the shared absolute
+    clock, and a thread that falls behind fires immediately without
+    re-anchoring.  Per-iteration sleeps would let a slow tier quietly
+    lower the offered load (each stall pushes every later request back),
+    which inflates the saturation number exactly when the tier is
+    struggling — the regime chaos_tier.py exists to measure."""
     push_ms: List[float] = []
     query_ms: List[float] = []
     errors: List[str] = []
     traces: List[dict] = []
     lock = threading.Lock()
     done = threading.Event()
+    # The shared schedule origin: set ONCE just before the threads
+    # start, never re-read per iteration — the absolute harness start.
+    t_start = time.monotonic()
 
     def agent_main(a: int) -> None:
         tenant = f"lt{a % tenants}"
         for i in range(pushes):
+            if push_interval_s > 0.0:
+                due = t_start + i * push_interval_s
+                lag = due - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
             # fresh connection per push, like the real short-lived
             # `sofa agent` invocations — and it re-rolls the
             # SO_REUSEPORT hash, so demand rebalances across workers
@@ -218,8 +238,21 @@ def run_fleet_load(url: str, token: str, *, agents: int = 8,
     def poller_main(p: int) -> None:
         conn = _Conn(url, token)
         tenant = f"lt{p % tenants}"
+        # Open-loop pacing from the absolute harness start: query k is
+        # due at t_start + k * 0.05.  A sleep-after-each-query loop
+        # would add each slow query's latency to every later deadline,
+        # silently lowering the offered poll rate exactly when the tier
+        # is slow — the case the p99 exists to expose.
+        k = 0
         try:
             while not done.is_set():
+                due = t_start + k * 0.05
+                k += 1
+                lag = due - time.monotonic()
+                if lag > 0:
+                    time.sleep(lag)
+                if done.is_set():
+                    break
                 t0 = time.perf_counter()
                 status, _ = conn.request(
                     "GET", f"/v1/{tenant}/query?kind=runs&limit=50")
@@ -229,7 +262,6 @@ def run_fleet_load(url: str, token: str, *, agents: int = 8,
                         query_ms.append(ms)
                     else:
                         errors.append(f"poller {p} query -> {status}")
-                time.sleep(0.05)
         finally:
             conn.close()
 
@@ -383,6 +415,10 @@ def main(argv: "List[str] | None" = None) -> int:
     ap.add_argument("--pollers", type=int, default=4)
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--payload_bytes", type=int, default=2048)
+    ap.add_argument("--push_interval_s", type=float, default=0.0,
+                    help="open-loop pacing: agent push i is due at "
+                         "harness_start + i * interval on the shared "
+                         "absolute clock (0 = closed loop)")
     ap.add_argument("--workers", type=int, default=2,
                     help="self-hosted tier size (no --url)")
     ap.add_argument("--smoke", action="store_true",
@@ -417,7 +453,8 @@ def main(argv: "List[str] | None" = None) -> int:
         args.pollers, args.tenants = min(args.pollers, 2), 2
     load_kw = dict(agents=args.agents, pushes=args.pushes,
                    pollers=args.pollers, tenants=args.tenants,
-                   payload_bytes=args.payload_bytes)
+                   payload_bytes=args.payload_bytes,
+                   push_interval_s=args.push_interval_s)
 
     if args.compare:
         counts = sorted({max(int(c), 1)

@@ -88,6 +88,19 @@ _SLO_VERSION = 1
 _SLO_OPS = ("<", "<=", ">", ">=")
 _SLO_STATUSES = ("ok", "breach", "no_data")
 
+# The self-healing tier's client failover record (sofa_tpu/archive/
+# client.py HEALTH_SCHEMA): which endpoint served the push, how many
+# failovers the client took, which breakers stand open — written into
+# meta.health by `sofa agent` after the push.
+_HEALTH_SCHEMA = "sofa_tpu/fleet_health"
+_HEALTH_VERSION = 1
+
+# The incremental content-addressed archive backup (sofa_tpu/archive/
+# store.py BACKUP_SCHEMA): `sofa archive backup` stamps the snapshot it
+# took into meta.backup when a logdir is in scope.
+_BACKUP_SCHEMA = "sofa_tpu/archive_backup"
+_BACKUP_VERSION = 1
+
 # The merged cross-process push trace (sofa_tpu/metrics.py
 # export_fleet_trace) — Chrome-trace JSON that Perfetto must accept.
 _FLEET_TRACE_NAME = "fleet_trace.json"
@@ -501,6 +514,79 @@ def validate_manifest(doc, require_healthy: bool = False) -> List[str]:
             elif mslo["ok"] is False and not br:
                 probs.append("meta.slo: ok is false but breaching names "
                              "no metric")
+
+    # meta.health (stamped by `sofa agent` after the push,
+    # sofa_tpu/archive/client.py): the client-side failover record —
+    # which endpoint served, how many failovers, which breakers stand
+    # open.  Failover must leave a durable manifest record, never just
+    # a log line.
+    mh = (doc.get("meta") or {}).get("health")
+    if mh is not None:
+        if not isinstance(mh, dict):
+            probs.append("meta.health: not an object")
+        else:
+            if mh.get("schema") != _HEALTH_SCHEMA:
+                probs.append(f"meta.health.schema: expected "
+                             f"{_HEALTH_SCHEMA!r}, got {mh.get('schema')!r}")
+            if mh.get("version") != _HEALTH_VERSION:
+                probs.append(f"meta.health.version: expected "
+                             f"{_HEALTH_VERSION}, got {mh.get('version')!r}")
+            eps = mh.get("endpoints")
+            if not isinstance(eps, list) or not eps or any(
+                    not isinstance(u, str) or not u for u in eps):
+                probs.append("meta.health.endpoints: not a non-empty "
+                             "list of URLs")
+            active = mh.get("active")
+            if not isinstance(active, str) or not active:
+                probs.append("meta.health.active: missing or empty")
+            elif isinstance(eps, list) and eps and active not in eps:
+                probs.append(f"meta.health.active: {active!r} not in "
+                             "endpoints")
+            fo = mh.get("failovers")
+            if not isinstance(fo, int) or isinstance(fo, bool) or fo < 0:
+                probs.append("meta.health.failovers: missing or not a "
+                             "non-negative int")
+            bo = mh.get("breakers_open")
+            if not isinstance(bo, list) or any(
+                    not isinstance(u, str) for u in bo):
+                probs.append("meta.health.breakers_open: not a list of "
+                             "endpoint URLs")
+
+    # meta.backup (stamped by `sofa archive backup`,
+    # sofa_tpu/archive/store.py): the incremental content-addressed
+    # snapshot record — which snapshot, where it landed, and the index
+    # commit sha the restore must reproduce byte-identically.
+    mb = (doc.get("meta") or {}).get("backup")
+    if mb is not None:
+        if not isinstance(mb, dict):
+            probs.append("meta.backup: not an object")
+        else:
+            if mb.get("schema") != _BACKUP_SCHEMA:
+                probs.append(f"meta.backup.schema: expected "
+                             f"{_BACKUP_SCHEMA!r}, got {mb.get('schema')!r}")
+            if mb.get("version") != _BACKUP_VERSION:
+                probs.append(f"meta.backup.version: expected "
+                             f"{_BACKUP_VERSION}, got {mb.get('version')!r}")
+            snap = mb.get("snapshot")
+            if not isinstance(snap, int) or isinstance(snap, bool) \
+                    or snap < 1:
+                probs.append("meta.backup.snapshot: missing or not a "
+                             "positive int")
+            for key in ("dest", "source_root"):
+                if not isinstance(mb.get(key), str) or not mb[key]:
+                    probs.append(f"meta.backup.{key}: missing or empty")
+            for key in ("files", "new_objects", "bytes_added"):
+                v = mb.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    probs.append(f"meta.backup.{key}: missing or not a "
+                                 "non-negative int")
+            sha = mb.get("commit_sha")
+            if not isinstance(sha, str) or (sha and len(sha) != 40):
+                probs.append("meta.backup.commit_sha: not a 40-hex sha "
+                             "or empty string")
+            if not _is_num(mb.get("taken_unix")):
+                probs.append("meta.backup.taken_unix: missing or not a "
+                             "number")
 
     # meta.frames (written by preprocess, sofa_tpu/frames.py +
     # preprocess.py): which interchange format the run's frames landed
