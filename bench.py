@@ -836,6 +836,32 @@ def _artifact_evidence() -> dict:
         return {"artifact_error": f"{type(e).__name__}: {e}"[:160]}
 
 
+def _protocol_evidence() -> dict:
+    """Protocol-contract closure riding the evidence extras: build the
+    `sofa protocol` inventory (sofa_tpu/protocol.py) and report
+    ``protocol_inventory_ok`` + ``protocol_route_count``, so a bench
+    round whose code drifted the client<->server contract (an
+    undeclared status, a refusal without Retry-After, an undocumented
+    SOFA_* knob) is visibly unhealthy.  Needs no device; shares the
+    SOFA_BENCH_LINT=0 opt-out with the lint gate (same static-analysis
+    family)."""
+    if os.environ.get("SOFA_BENCH_LINT", "1") != "1":
+        return {}
+    _state["phase"] = "protocol-inventory evidence"
+    try:
+        from sofa_tpu.protocol import build_inventory
+
+        doc = build_inventory()
+        ok = bool(doc.get("ok"))
+        _log(f"bench: protocol inventory {'OK' if ok else 'FAILED'} "
+             f"({doc['counts']['routes']} routes, "
+             f"{doc['counts']['violations']} violations)")
+        return {"protocol_inventory_ok": ok,
+                "protocol_route_count": int(doc["counts"]["routes"])}
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        return {"protocol_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 # Metrics whose trajectory the archive catalog tracks round over round
 # (the headline plus the device-free report-path numbers, so dead-tunnel
 # rounds still extend the trajectory).
@@ -1146,6 +1172,7 @@ def main() -> int:
         extra.update(_preprocess_wall_evidence())
         extra.update(_lint_evidence())
         extra.update(_artifact_evidence())
+        extra.update(_protocol_evidence())
         # Dead-tunnel rounds still extend the archived trajectory: the
         # report-path metrics need no device, and the rolling verdict
         # proves the round against the catalog's history.
@@ -1238,6 +1265,7 @@ def main() -> int:
     pre = _preprocess_wall_evidence()
     pre.update(_lint_evidence())
     pre.update(_artifact_evidence())
+    pre.update(_protocol_evidence())
     pre.update(_archive_evidence(round(overhead, 3), {**extra, **pre}))
     if pre:
         _emit(round(overhead, 3), p_value=p_value, extra={**extra, **pre})

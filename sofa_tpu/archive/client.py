@@ -39,6 +39,9 @@ import urllib.request
 from typing import Dict, Optional
 
 from sofa_tpu import faults
+from sofa_tpu.archive.protocol import (
+    CLIENT_FATAL_STATUSES, CLIENT_RETRY_FLOOR, CLIENT_RETRY_STATUSES,
+    ERR_QUOTA)
 from sofa_tpu.concurrency import jittered_backoff
 from sofa_tpu.printing import print_warning
 
@@ -220,11 +223,11 @@ class ServiceClient:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             doc = _error_doc(e)
-            if e.code in (401, 403):
+            if e.code in CLIENT_FATAL_STATUSES:
                 raise ServiceRejected(
                     f"{op}: service rejected the token ({e.code})",
                     status=e.code) from None
-            if e.code == 429 and doc.get("error") == "quota":
+            if e.code == 429 and doc.get("error") == ERR_QUOTA:
                 raise ServiceRejected(
                     f"{op}: tenant {self.tenant!r} is over quota "
                     f"({doc.get('used_mb')}/{doc.get('quota_mb')} MB)",
@@ -234,7 +237,8 @@ class ServiceClient:
                     f"{op}: commit refused, "
                     f"{len(doc.get('missing') or [])} object(s) missing "
                     "server-side", doc.get("missing")) from None
-            if e.code in (408, 422, 425, 429) or e.code >= 500:
+            if e.code in CLIENT_RETRY_STATUSES or \
+                    e.code >= CLIENT_RETRY_FLOOR:
                 raise ServiceUnavailable(
                     f"{op}: HTTP {e.code} ({doc.get('error') or e.reason})",
                     status=e.code,

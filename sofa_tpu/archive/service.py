@@ -53,6 +53,14 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from sofa_tpu.archive import catalog, tier
+from sofa_tpu.archive.protocol import (
+    ERR_BAD_FILES_MAP, ERR_BAD_JSON, ERR_BAD_KIND, ERR_BAD_PARAMS,
+    ERR_BAD_TENANT, ERR_BROWNOUT, ERR_DEADLINE_EXPIRED, ERR_DRAINING,
+    ERR_HASH_MISMATCH, ERR_LENGTH_REQUIRED, ERR_LOADED, ERR_MID_GC,
+    ERR_MISSING_OBJECTS, ERR_NO_INDEX, ERR_NO_SPACE, ERR_NO_SUCH_CHUNK,
+    ERR_NO_SUCH_ROUTE, ERR_NO_SUCH_RUN, ERR_QUOTA,
+    ERR_READ_ONLY_REPLICA, ERR_REPLICA_WARMING, ERR_TOO_LARGE,
+    ERR_UNAUTHORIZED, ERR_WAL_BACKLOG)
 from sofa_tpu.archive.store import ArchiveStore, run_content_id
 from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_error, print_progress, print_warning
@@ -405,10 +413,10 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         try:
             n = int(self.headers.get("Content-Length") or "")
         except ValueError:
-            self._json(411, {"error": "length_required"})
+            self._json(411, {"error": ERR_LENGTH_REQUIRED})
             return None
         if n < 0 or n > _MAX_BODY:
-            self._json(413, {"error": "too_large", "max_bytes": _MAX_BODY})
+            self._json(413, {"error": ERR_TOO_LARGE, "max_bytes": _MAX_BODY})
             return None
         data = self.rfile.read(n)
         if len(data) != n:
@@ -426,7 +434,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         browser page cannot always attach an Authorization header)."""
         parts = [p for p in self.path.split("?", 1)[0].split("/") if p]
         if len(parts) < 2 or parts[0] != "v1":
-            self._json(404, {"error": "no_such_route"})
+            self._json(404, {"error": ERR_NO_SUCH_ROUTE})
             return None
         if not self.server.auth_ok(self.headers.get("Authorization")):
             tok = None
@@ -437,12 +445,12 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 tok = (qs.get("token") or [None])[0]
             if not (tok and hmac.compare_digest(tok, self.server.token)):
                 self._count("401_unauthorized")
-                self._json(401, {"error": "unauthorized"})
+                self._json(401, {"error": ERR_UNAUTHORIZED})
                 return None
         tenant = parts[1]
         if not _TENANT_RE.match(tenant) or tenant in (
                 TENANTS_DIR_NAME, "tier", "metrics", "..", "."):
-            self._json(400, {"error": "bad_tenant"})
+            self._json(400, {"error": ERR_BAD_TENANT})
             return None
         return tenant, parts[2:]
 
@@ -453,7 +461,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         if self.server.role != "replica":
             return False
         self._count("403_read_only")
-        self._json(403, {"error": "read_only_replica"})
+        self._json(403, {"error": ERR_READ_ONLY_REPLICA})
         return True
 
     def _backpressure(self, tenant: str) -> bool:
@@ -464,7 +472,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
 
         if derived_writing(self.server.tenant_root(tenant)):
             self._count("503_mid_gc")
-            self._json(503, {"error": "mid_gc"},
+            self._json(503, {"error": ERR_MID_GC},
                        retry_after=_RETRY_AFTER_S)
             return True
         return False
@@ -517,7 +525,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         if left is None or left > 0:
             return False
         self._refuse("504_deadline_expired", 504,
-                     {"error": "deadline_expired"}, retry_after=None)
+                     {"error": ERR_DEADLINE_EXPIRED}, retry_after=None)
         return True
 
     # -- GET ---------------------------------------------------------------
@@ -535,7 +543,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             if not self.server.auth_ok(
                     self.headers.get("Authorization")):
                 self._count("401_unauthorized")
-                self._json(401, {"error": "unauthorized"})
+                self._json(401, {"error": ERR_UNAUTHORIZED})
                 return
             self._tier()
             return
@@ -543,7 +551,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             if not self.server.auth_ok(
                     self.headers.get("Authorization")):
                 self._count("401_unauthorized")
-                self._json(401, {"error": "unauthorized"})
+                self._json(401, {"error": ERR_UNAUTHORIZED})
                 return
             self._metrics_route()
             return
@@ -564,12 +572,12 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         if len(rest) == 2 and rest[0] == "run" and store.exists:
             doc = store.load_run(rest[1]) if _SHA_RE.match(rest[1]) else None
             if doc is None:
-                self._json(404, {"error": "no_such_run"})
+                self._json(404, {"error": ERR_NO_SUCH_RUN})
                 return
             self._count("run_read")
             self._json(200, doc)
             return
-        self._json(404, {"error": "no_such_route"})
+        self._json(404, {"error": ERR_NO_SUCH_ROUTE})
 
     def do_OPTIONS(self):  # noqa: N802 — CORS preflight for the board
         # The fleet board (board/fleet.html, served by `sofa viz` on a
@@ -578,7 +586,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         # credentials by design, so this answers unauthenticated — it
         # grants nothing but the right to ASK.
         if not self.path.startswith("/v1/"):
-            self._json(404, {"error": "no_such_route"})
+            self._json(404, {"error": ERR_NO_SUCH_ROUTE})
             return
         self.send_response(204)
         for key, value in _CORS_HEADERS:
@@ -602,7 +610,8 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                "brownout": depth >= soft, "wal_depth": depth,
                "wal_soft": soft, "wal_hard": hard}
         if draining:
-            self._refuse("503_draining", 503, doc)
+            self._refuse("503_draining", 503,
+                         {"error": ERR_DRAINING, **doc})
             return
         self._count("health")
         self._json(200, doc)
@@ -675,7 +684,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             # push costs the agent a spool round-trip), keeping the
             # ingest path fed until the hard watermark
             self._refuse("503_brownout", 503,
-                         {"error": "brownout", "tenant": tenant})
+                         {"error": ERR_BROWNOUT, "tenant": tenant})
             return
         t0 = time.time()
         qs = urllib.parse.parse_qs(self.path.partition("?")[2])
@@ -685,7 +694,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
 
         kind = one("kind", "runs")
         if kind not in ("runs", "features"):
-            self._json(400, {"error": "bad_kind",
+            self._json(400, {"error": ERR_BAD_KIND,
                              "kinds": ["runs", "features"]})
             return
         try:
@@ -693,14 +702,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             limit = int(one("limit") or aindex.QUERY_DEFAULT_LIMIT)
             offset = int(one("offset") or 0)
         except ValueError:
-            self._json(400, {"error": "bad_params"})
+            self._json(400, {"error": ERR_BAD_PARAMS})
             return
         if self.server.role == "replica" and \
                 aindex.load_commit(store.root) is None:
             # nothing pulled yet — honesty over an empty 200: the
             # replica is warming, the client should come back
             self._count("503_replica_warming")
-            self._json(503, {"error": "replica_warming"},
+            self._json(503, {"error": ERR_REPLICA_WARMING},
                        retry_after=_RETRY_AFTER_S)
             return
         doc = aindex.query(store.root, kind=kind, host=one("host"),
@@ -783,10 +792,10 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             limit = int(_one("limit") or fleet_metrics.HISTORY_ROWS)
             window = float(_one("window")) if _one("window") else None
         except ValueError:
-            self._json(400, {"error": "bad_params"})
+            self._json(400, {"error": ERR_BAD_PARAMS})
             return
         if offset < 0 or limit < 0 or (window is not None and window <= 0):
-            self._json(400, {"error": "bad_params"})
+            self._json(400, {"error": ERR_BAD_PARAMS})
             return
         doc, etag = fleet_metrics.metrics_doc(
             self.server.metrics, offset=offset, limit=limit,
@@ -817,7 +826,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         if rest == ["commit"]:
             commit = aindex.load_commit(troot)
             if commit is None:
-                self._json(404, {"error": "no_index"})
+                self._json(404, {"error": ERR_NO_INDEX})
                 return
             etag = f'"idx-{commit.get("commit_sha") or ""}"'
             if self.headers.get("If-None-Match") == etag:
@@ -837,7 +846,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 with open(path, "rb") as f:
                     data = f.read()
             except OSError:
-                self._json(404, {"error": "no_such_chunk"})
+                self._json(404, {"error": ERR_NO_SUCH_CHUNK})
                 return
             self._count("index_chunk_read")
             self.send_response(200)
@@ -849,7 +858,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             except OSError:
                 self._count("client_disconnect")
             return
-        self._json(404, {"error": "no_such_route"})
+        self._json(404, {"error": ERR_NO_SUCH_ROUTE})
 
     # -- POST (have / commit) ----------------------------------------------
     def do_POST(self):  # noqa: N802 — http.server handler contract
@@ -858,18 +867,18 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return
         tenant, rest = routed
         if rest not in (["have"], ["commit"]):
-            self._json(404, {"error": "no_such_route"})
+            self._json(404, {"error": ERR_NO_SUCH_ROUTE})
             return
         if self._read_only():
             return
         if self.server.is_draining():
-            self._refuse("503_draining", 503, {"error": "draining"})
+            self._refuse("503_draining", 503, {"error": ERR_DRAINING})
             return
         if self._deadline_expired():
             return
         if not self.server.write_slot():
             self._count("503_loaded")
-            self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
+            self._json(503, {"error": ERR_LOADED}, retry_after=_RETRY_AFTER_S)
             return
         self._holds_slot = True
         try:
@@ -882,14 +891,14 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             try:
                 doc = json.loads(data)
             except ValueError:
-                self._json(400, {"error": "bad_json"})
+                self._json(400, {"error": ERR_BAD_JSON})
                 return
             files = doc.get("files")
             if not isinstance(files, dict) or not files or any(
                     not isinstance(e, dict)
                     or not _SHA_RE.match(str(e.get("sha256", "")))
                     for e in files.values()):
-                self._json(400, {"error": "bad_files_map"})
+                self._json(400, {"error": ERR_BAD_FILES_MAP})
                 return
             if rest == ["have"]:
                 self._have(tenant, files)
@@ -948,7 +957,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             # timeouts.  (A replayed commit is refused too: harmless,
             # the retry lands once the backlog drains.)
             self._refuse("503_wal_depth", 503,
-                         {"error": "wal_backlog", "tenant": tenant,
+                         {"error": ERR_WAL_BACKLOG, "tenant": tenant,
                           "wal_depth": depth, "wal_hard": hard})
             return
         if self.server.io_ms:
@@ -959,7 +968,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                          if not store.has_object(e["sha256"])})
         if missing:
             self._count("409_incomplete")
-            self._json(409, {"error": "missing_objects", "run": run_id,
+            self._json(409, {"error": ERR_MISSING_OBJECTS, "run": run_id,
                              "missing": missing})
             return
         already = any(
@@ -992,7 +1001,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 # NOTHING was made durable, so nothing may be acked —
                 # a typed 507 the client's backoff path retries
                 self._refuse("507_disk_full", 507,
-                             {"error": "no_space", "run": run_id})
+                             {"error": ERR_NO_SPACE, "run": run_id})
                 return
             self._drop_slot()  # WAL record durable; the wait is in-memory
             if not self.server.tier_wait_applied(
@@ -1002,7 +1011,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 # read-your-writes promise can't be kept yet — tell the
                 # client when to come back (a replayed commit no-ops)
                 self._count("503_wal_backlog")
-                self._json(503, {"error": "wal_backlog", "run": run_id},
+                self._json(503, {"error": ERR_WAL_BACKLOG, "run": run_id},
                            retry_after=_RETRY_AFTER_S)
                 return
         self._count("commit" if not already else "commit_replayed")
@@ -1035,20 +1044,20 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         tenant, rest = routed
         if len(rest) != 2 or rest[0] != "object" or \
                 not _SHA_RE.match(rest[1]):
-            self._json(404, {"error": "no_such_route"})
+            self._json(404, {"error": ERR_NO_SUCH_ROUTE})
             return
         sha = rest[1]
         t0 = time.time()
         if self._read_only():
             return
         if self.server.is_draining():
-            self._refuse("503_draining", 503, {"error": "draining"})
+            self._refuse("503_draining", 503, {"error": ERR_DRAINING})
             return
         if self._deadline_expired():
             return
         if not self.server.write_slot():
             self._count("503_loaded")
-            self._json(503, {"error": "loaded"}, retry_after=_RETRY_AFTER_S)
+            self._json(503, {"error": ERR_LOADED}, retry_after=_RETRY_AFTER_S)
             return
         try:
             if self._backpressure(tenant):
@@ -1071,11 +1080,11 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                     + len(data) > quota:
                 self._count("429_quota")
                 self._json(429, {
-                    "error": "quota", "tenant": tenant,
+                    "error": ERR_QUOTA, "tenant": tenant,
                     "quota_mb": round(quota / 2 ** 20, 3),
                     "used_mb": round(
                         self.server.tenant_used_bytes(tenant) / 2 ** 20,
-                        3)})
+                        3)}, retry_after=_RETRY_AFTER_S)
                 return
             if self.server.io_ms:
                 time.sleep(self.server.io_ms / 1000.0)  # emulated storage
@@ -1085,7 +1094,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 # landing site): reject, client re-sends — the store
                 # only ever holds bytes that hash to their name
                 self._count("422_hash_mismatch")
-                self._json(422, {"error": "hash_mismatch",
+                self._json(422, {"error": ERR_HASH_MISMATCH,
                                  "expected": sha, "got": got})
                 return
             from sofa_tpu import faults
@@ -1095,7 +1104,7 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 # write — the bytes were never durable, so the 507 is
                 # honest and the client's retry (fault consumed) lands
                 self._refuse("507_disk_full", 507,
-                             {"error": "no_space", "sha256": sha})
+                             {"error": ERR_NO_SPACE, "sha256": sha})
                 return
             _, added = store.put_bytes(data)
             if added:

@@ -59,6 +59,7 @@ import urllib.request
 from typing import Dict, List, Tuple
 
 from sofa_tpu.archive import catalog
+from sofa_tpu.archive.protocol import ERR_NO_WORKER
 from sofa_tpu.concurrency import Guard
 from sofa_tpu.printing import print_error, print_warning
 
@@ -1292,7 +1293,7 @@ class _DispatchHandler(__import__("http.server", fromlist=["x"])
                 pass
             conn.close()
             return
-        body = json.dumps({"error": "no_worker"}).encode()
+        body = json.dumps({"error": ERR_NO_WORKER}).encode()
         self.send_response(502)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

@@ -26,6 +26,14 @@ CLI (/root/reference/bin/sofa:328-376):
                     flow graph SL014-SL018 enforce; optional logdir audit;
                     --json emits schema sofa_tpu/artifact_inventory
                     (exit 2 on closure violations)
+  protocol          client<->server protocol inventory (sofa_tpu/
+                    protocol.py): every fleet-tier route -> statuses ->
+                    typed error bodies -> Retry-After discipline ->
+                    client dispatch, plus the fault-kind grammar and the
+                    SOFA_* env-knob registry, from the statically-
+                    extracted graph SL024-SL028 enforce; --json emits
+                    schema sofa_tpu/protocol_inventory (exit 2 on
+                    closure violations)
   passes            render the analysis-pass registry (sofa_tpu/analysis/
                     registry.py): the resolved dependency DAG, each pass's
                     declared contract, and — when logdir holds a manifest —
@@ -101,7 +109,7 @@ def build_parser() -> argparse.ArgumentParser:
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
         "resume", "fsck", "archive", "regress", "whatif", "artifacts",
-        "serve", "agent", "live",
+        "protocol", "serve", "agent", "live",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
@@ -396,9 +404,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p.add_argument("--json", action="store_true", dest="as_json",
                    default=False,
-                   help="artifacts: machine-readable inventory on stdout "
-                        "(schema sofa_tpu/artifact_inventory, validated "
-                        "by tools/manifest_check.py)")
+                   help="artifacts/protocol: machine-readable inventory "
+                        "on stdout (schema sofa_tpu/artifact_inventory "
+                        "or sofa_tpu/protocol_inventory, validated by "
+                        "tools/manifest_check.py)")
     p.add_argument("--plugin", action="append", dest="plugins",
                    help="module[:func] called with the config at startup")
     return p
@@ -677,6 +686,11 @@ def _run(argv=None) -> int:
             # to audit against the extracted graph.
             return sofa_artifacts(logdir=args.usr_command or None,
                                   as_json=args.as_json)
+        if cmd == "protocol":
+            from sofa_tpu.protocol import sofa_protocol
+            # config-free like artifacts: the inventory is a property of
+            # the shipped tree, not of any logdir.
+            return sofa_protocol(as_json=args.as_json)
         if cmd == "clean":
             from sofa_tpu.record import sofa_clean
             sofa_clean(cfg)

@@ -98,6 +98,10 @@ class ProjectContext:
     #: — None/inactive when the context is built by hand (fixture
     #: isolation), so SL019–SL023 only run under detect().
     concurrency: object = None
+    #: The client<->server protocol graph (lint/protocol_rules.py) —
+    #: None/inactive unless the linted set carries a STATUS_ERRORS
+    #: vocabulary module, so SL024–SL028 only fire on protocol trees.
+    protocol: object = None
 
     @classmethod
     def detect(cls, files: Sequence[str],
@@ -137,13 +141,15 @@ class ProjectContext:
                 break
         from sofa_tpu.lint.artifact_rules import build_artifact_graph
         from sofa_tpu.lint.concurrency_rules import build_concurrency_graph
+        from sofa_tpu.lint.protocol_rules import build_protocol_graph
 
         artifacts = build_artifact_graph(files, base=base,
                                          passes=tuple(passes))
         concurrency = build_concurrency_graph(files, base=base)
+        protocol = build_protocol_graph(files, base=base)
         return cls(columns=columns, passes=tuple(passes),
                    ambient_features=ambient, artifacts=artifacts,
-                   concurrency=concurrency)
+                   concurrency=concurrency, protocol=protocol)
 
 
 def _columns_from_trace(path: str) -> List[str]:

@@ -448,6 +448,9 @@ from sofa_tpu.lint.concurrency_rules import (  # noqa: E402 — SL019-SL023:
 from sofa_tpu.lint.pass_rules import (  # noqa: E402 — SL010-SL013 live in
     PASS_RULES,                         # their own module; one rule set
 )
+from sofa_tpu.lint.protocol_rules import (  # noqa: E402 — SL024-SL028:
+    PROTOCOL_RULES,                     # client<->server protocol closure
+)
 
 ALL_RULES = (
     BoundedSubprocess,
@@ -459,7 +462,7 @@ ALL_RULES = (
     RawArtifactBypass,
     DirectKill,
     NonAtomicDerivedWrite,
-) + PASS_RULES + ARTIFACT_RULES + CONCURRENCY_RULES
+) + PASS_RULES + ARTIFACT_RULES + CONCURRENCY_RULES + PROTOCOL_RULES
 
 
 def default_rules() -> List[Rule]:

@@ -230,14 +230,22 @@ def test_acceptance_faulted_push_is_byte_identical(service, tmp_path):
     assert len(_server_runs(service, "faulted")) == 1
 
 
+#: Sockets backing _dead_url ports, held for the session so the kernel
+#: keeps refusing connects AND no concurrent test server can claim the
+#: port (a bind+close port can be reused before the client connects).
+_DEAD_SOCKETS = []
+
+
 def _dead_url():
-    """A loopback URL nothing listens on (bind + close to claim it)."""
+    """A loopback URL whose connects are refused: the port stays bound
+    (never listen()ed) for the whole session, so it cannot be grabbed
+    by another ephemeral-port server mid-test."""
     import socket
 
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
-    s.close()
+    _DEAD_SOCKETS.append(s)
     return f"http://127.0.0.1:{port}"
 
 

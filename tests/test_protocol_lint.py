@@ -1,0 +1,742 @@
+"""Protocol-contract flow analysis: SL024–SL028 fixtures, seeded
+mutations over copies of the shipped service.py/client.py, the
+shipped-tree closure gate, and the `sofa protocol` inventory verb
+(schema, exit codes, determinism).
+
+Fixture trees opt into companions per rule, mirroring the artifact
+graph's discipline: a STATUS_ERRORS-bearing pkg/archive/protocol.py
+activates the graph; docs/OBSERVABILITY.md enables SL026; a
+KINDS+NET_KINDS module enables SL027; tools/*.py at the repo root
+enables the chaos-reference leg.  Absent companions keep those legs
+inert, matching how a single-file `sofa lint` run behaves.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+from sofa_tpu.lint.core import ProjectContext, lint_paths
+from sofa_tpu.lint.rules import default_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+PROTO_IDS = ("SL024", "SL025", "SL026", "SL027", "SL028")
+
+#: Minimal vocabulary: statuses without error strings so fixtures that
+#: never attach a body do not trip the dead-vocabulary leg.
+SLIM_VOCAB = """
+    STATUS_ERRORS = {200: (), 429: (), 503: (), 504: ()}
+    RETRY_AFTER_STATUSES = (429, 503)
+    NO_RETRY_AFTER_STATUSES = (504,)
+    CLIENT_RETRY_STATUSES = (429, 503)
+    CLIENT_FATAL_STATUSES = (401,)
+    CLIENT_RESUME_STATUSES = ()
+    CLIENT_RETRY_FLOOR = 500
+    ROUTES = ("GET /v1/ping",)
+"""
+
+#: Full vocabulary for the clean kitchen-sink tree: typed errors, a
+#: fatal override, a placeholder route.
+FULL_VOCAB = """
+    ERR_BUSY = "busy"
+    ERR_QUOTA = "quota"
+    STATUS_ERRORS = {
+        200: (),
+        429: (ERR_BUSY, ERR_QUOTA),
+        503: (ERR_BUSY,),
+        504: ("deadline",),
+    }
+    RETRY_AFTER_STATUSES = (429, 503)
+    NO_RETRY_AFTER_STATUSES = (504,)
+    CLIENT_RETRY_STATUSES = (429, 503)
+    CLIENT_FATAL_STATUSES = (401,)
+    CLIENT_RESUME_STATUSES = ()
+    CLIENT_RETRY_FLOOR = 500
+    FATAL_ERRORS = (ERR_QUOTA,)
+    ROUTES = (
+        "GET /v1/ping",
+        "POST /v1/<tenant>/commit",
+    )
+"""
+
+
+def run_protocol_rules(tmp_path, files):
+    """Write {relname: src} under tmp_path, lint the .py files, return
+    only the SL024–SL028 findings."""
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        if rel.endswith(".py"):
+            paths.append(str(p))
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    return [f for f in fs if f.rule_id in PROTO_IDS]
+
+
+# --- the clean kitchen sink -------------------------------------------------
+
+def test_protocol_clean_kitchen_sink(tmp_path):
+    """A tree exercising every leg — typed refusals, Retry-After on
+    both sides of the line, matching client dispatch, a placeholder
+    route — produces zero findings."""
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": FULL_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def _refuse(self, key, code, doc, retry_after="1"):
+                    self._json(code, doc, retry_after=retry_after)
+                def handle(self):
+                    seg = "ping"
+                    if seg == "commit":
+                        pass
+                    path = "/v1/ping"
+                    self._json(200, {"ok": True})
+                    self._refuse("429_busy", 429, {"error": "busy"})
+                    self._refuse("429_quota", 429, {"error": "quota"})
+                    self._refuse("503_busy", 503, {"error": "busy"})
+                    self._refuse("504_deadline", 504,
+                                 {"error": "deadline"}, retry_after=None)
+        """,
+        "pkg/archive/client.py": """
+            class ServiceUnavailable(Exception):
+                pass
+            class ServiceRejected(Exception):
+                pass
+            def dispatch(e, doc):
+                url = "/v1/<t>/commit"
+                if e.code == 429 and doc.get("error") == "quota":
+                    raise ServiceRejected(e)
+                if e.code in (401,):
+                    raise ServiceRejected(e)
+                if e.code in (429, 503) or e.code >= 500:
+                    raise ServiceUnavailable(e)
+        """,
+    })
+    assert fs == [], [f.render() for f in fs]
+
+
+# --- SL024 ------------------------------------------------------------------
+
+def test_sl024_flags_undeclared_status(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def handle(self):
+                    path = "/v1/ping"
+                    self._json(418, {})
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL024"]
+    assert "418" in fs[0].message and fs[0].file.endswith("service.py")
+
+
+def test_sl024_flags_unknown_client_route(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            def handle(self):
+                seg = "ping"
+                url = "/v1/ghost"
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL024"]
+    assert "/v1/ghost" in fs[0].message and "404" in fs[0].message
+
+
+def test_sl024_flags_dead_route_entry(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB.replace(
+            'ROUTES = ("GET /v1/ping",)',
+            'ROUTES = ("GET /v1/ping", "GET /v1/ghost")'),
+        "pkg/archive/service.py": """
+            def handle(self):
+                url = "/v1/ping"
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL024"]
+    assert "ghost" in fs[0].message and "dead route" in fs[0].message
+    assert fs[0].file.endswith("protocol.py")
+
+
+def test_sl024_flags_dead_status_and_dead_error(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": """
+            STATUS_ERRORS = {
+                200: (),
+                418: (),
+                429: ("busy", "dead_err"),
+            }
+            RETRY_AFTER_STATUSES = (429,)
+            NO_RETRY_AFTER_STATUSES = ()
+            CLIENT_RETRY_STATUSES = (429,)
+            CLIENT_FATAL_STATUSES = ()
+            CLIENT_RESUME_STATUSES = ()
+            ROUTES = ("GET /v1/ping",)
+        """,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def handle(self):
+                    path = "/v1/ping"
+                    self._json(200, {"ok": 1})
+                    self._json(429, {"error": "busy"}, retry_after="1")
+        """,
+        "pkg/archive/client.py": """
+            class ServiceUnavailable(Exception):
+                pass
+            def dispatch(e):
+                if e.code in (429,):
+                    raise ServiceUnavailable(e)
+        """,
+    })
+    msgs = sorted(f.message for f in fs)
+    assert [f.rule_id for f in fs] == ["SL024", "SL024"]
+    assert any("418" in m and "dead status" in m for m in msgs)
+    assert any("dead_err" in m and "dead vocabulary" in m for m in msgs)
+    assert all(f.file.endswith("protocol.py") for f in fs)
+
+
+# --- SL025 ------------------------------------------------------------------
+
+def test_sl025_flags_missing_retry_after(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def handle(self):
+                    path = "/v1/ping"
+                    self._json(429, {})
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL025"]
+    assert "attaches no Retry-After" in fs[0].message
+
+
+def test_sl025_flags_deadline_with_retry_after(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def handle(self):
+                    path = "/v1/ping"
+                    self._json(504, {}, retry_after="1")
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL025"]
+    assert "deadline refusal" in fs[0].message
+
+
+def test_sl025_flags_untyped_and_undeclared_bodies(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB.replace(
+            "429: ()", '429: ("busy",)'),
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def _refuse(self, key, code, doc, retry_after="1"):
+                    self._json(code, doc, retry_after=retry_after)
+                def handle(self):
+                    path = "/v1/ping"
+                    self._refuse("k", 429, {})
+                    self._refuse("k", 429, {"error": "mystery"})
+                    self._refuse("k", 429, {"error": "busy"})
+        """,
+    })
+    msgs = sorted(f.message for f in fs)
+    assert [f.rule_id for f in fs] == ["SL025", "SL025"]
+    assert any("no typed" in m for m in msgs)
+    assert any("'mystery'" in m and "STATUS_ERRORS[429]" in m
+               for m in msgs)
+
+
+def test_sl025_flags_raw_send_bypass(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def handle(self):
+                    path = "/v1/ping"
+                    self.send_response(503)
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL025"]
+    assert "bypasses the typed refusal helpers" in fs[0].message
+
+
+# --- SL026 ------------------------------------------------------------------
+
+def test_sl026_both_directions(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/svc.py": """
+            import os
+            ALIVE = os.environ.get("SOFA_ALIVE", "")
+            GHOST = os.environ.get("SOFA_GHOST", "")
+        """,
+        "docs/OBSERVABILITY.md": """
+            | knob | default |
+            |---|---|
+            | `SOFA_ALIVE` | - |
+            | `SOFA_DEAD` | - |
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL026", "SL026"]
+    ghost = next(f for f in fs if "SOFA_GHOST" in f.message)
+    assert ghost.file.endswith("svc.py")
+    assert "undocumented" in ghost.message
+    dead = next(f for f in fs if "SOFA_DEAD" in f.message)
+    assert dead.file.endswith("OBSERVABILITY.md")
+    assert "dead registry row" in dead.message
+
+
+def test_sl026_inert_without_docs_registry(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/svc.py": """
+            import os
+            GHOST = os.environ.get("SOFA_GHOST", "")
+        """,
+    })
+    assert [f.rule_id for f in fs] == []
+
+
+# --- SL027 ------------------------------------------------------------------
+
+def test_sl027_phantom_and_unconsumed_kinds(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/faults.py": """
+            KINDS = ("stall", "drop")
+            NET_KINDS = ("refuse",)
+            def consume(spec):
+                if spec.kind == "stall":
+                    return 1
+                if spec.kind == "ghost":
+                    return 2
+        """,
+    })
+    msgs = sorted(f.message for f in fs)
+    assert [f.rule_id for f in fs] == ["SL027"] * 3
+    assert any("'ghost'" in m and "phantom" in m for m in msgs)
+    assert any("'drop'" in m and "silent no-op" in m for m in msgs)
+    assert any("'refuse'" in m and "silent no-op" in m for m in msgs)
+
+
+def test_sl027_taint_scoping_in_importers(tmp_path):
+    """A `.kind` compare on a name NOT assigned from a faults.*() call
+    (an ingest task, say) is a different namespace and stays silent; a
+    fault-tainted name consuming an undeclared kind is a phantom."""
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/faults.py": """
+            KINDS = ("stall",)
+            NET_KINDS = ("refuse",)
+            def maybe_fault(op):
+                return None
+            def consume(spec):
+                if spec.kind == "stall":
+                    return 1
+                if spec.kind == "refuse":
+                    return 2
+        """,
+        "pkg/ingest.py": """
+            from pkg import faults
+            def go(pending):
+                tasks = [t for t in pending if t.kind == "proc"]
+                spec = faults.maybe_fault("op")
+                if spec and spec.kind == "ghost2":
+                    return spec
+                return tasks
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL027"]
+    assert "'ghost2'" in fs[0].message
+    assert fs[0].file.endswith("ingest.py")
+
+
+def test_sl027_chaos_reference_leg(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/faults.py": """
+            KINDS = ("stall",)
+            NET_KINDS = ("refuse",)
+            def consume(spec):
+                if spec.kind == "stall":
+                    return 1
+                if spec.kind == "refuse":
+                    return 2
+        """,
+        "tools/chaos.py": """
+            USED = ("stall",)
+        """,
+    })
+    assert [f.rule_id for f in fs] == ["SL027"]
+    assert "'refuse'" in fs[0].message
+    assert "no chaos/test reference" in fs[0].message
+
+
+# --- SL028 ------------------------------------------------------------------
+
+def test_sl028_divergent_retry_set_and_floor(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/client.py": """
+            class ServiceUnavailable(Exception):
+                pass
+            def dispatch(e):
+                if e.code in (408,) or \\
+                        e.code > 500:
+                    raise ServiceUnavailable(e)
+        """,
+    })
+    msgs = sorted(f.message for f in fs)
+    assert all(f.rule_id == "SL028" for f in fs)
+    assert any("[408]" in m and "CLIENT_RETRY_STATUSES" in m
+               for m in msgs)
+    assert any("retry floor 501" in m for m in msgs)
+    assert any("429" in m and "never retries" in m for m in msgs)
+
+
+def test_sl028_fatal_override_outside_vocabulary(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": """
+            STATUS_ERRORS = {200: (), 429: ("busy", "quota")}
+            RETRY_AFTER_STATUSES = ()
+            NO_RETRY_AFTER_STATUSES = ()
+            CLIENT_RETRY_STATUSES = ()
+            CLIENT_FATAL_STATUSES = ()
+            CLIENT_RESUME_STATUSES = ()
+            FATAL_ERRORS = ("quota",)
+            ROUTES = ("GET /v1/ping",)
+        """,
+        "pkg/archive/client.py": """
+            class ServiceRejected(Exception):
+                pass
+            def dispatch(e, doc):
+                if e.code == 429 and doc.get("error") == "busy":
+                    raise ServiceRejected(e)
+        """,
+    })
+    sl28 = [f for f in fs if f.rule_id == "SL028"]
+    msgs = sorted(f.message for f in sl28)
+    assert len(sl28) == 2
+    assert any("'busy'" in m and "FATAL_ERRORS does not declare" in m
+               for m in msgs)
+    assert any("'quota'" in m and "dead override" in m for m in msgs)
+
+
+def test_sl028_fatal_vs_retryable_contradiction(tmp_path):
+    fs = run_protocol_rules(tmp_path, {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/client.py": """
+            class ServiceUnavailable(Exception):
+                pass
+            class ServiceRejected(Exception):
+                pass
+            def dispatch(e):
+                if e.code in (429, 503) or e.code >= 500:
+                    raise ServiceUnavailable(e)
+                if e.code in (429,):
+                    raise ServiceRejected(e)
+        """,
+    })
+    assert any(f.rule_id == "SL028"
+               and "contradictory contract" in f.message for f in fs)
+
+
+# --- seeded mutations over copies of the shipped tree -----------------------
+
+SHIPPED = {
+    "pkg/archive/protocol.py": "sofa_tpu/archive/protocol.py",
+    "pkg/archive/service.py": "sofa_tpu/archive/service.py",
+    "pkg/archive/tier.py": "sofa_tpu/archive/tier.py",
+    "pkg/archive/client.py": "sofa_tpu/archive/client.py",
+}
+
+
+def lint_shipped_copy(tmp_path, mutations=None, extra_shipped=(),
+                      extra_files=None):
+    """Copy the shipped protocol core under tmp_path/pkg, apply
+    {destrel: fn(src)} mutations, lint, return (protocol findings,
+    {destrel: final source})."""
+    sources, paths = {}, []
+    items = dict(SHIPPED)
+    items.update(dict(extra_shipped))
+    for destrel, realrel in items.items():
+        with open(os.path.join(REPO, realrel), encoding="utf-8") as f:
+            src = f.read()
+        if mutations and destrel in mutations:
+            src = mutations[destrel](src)
+        p = tmp_path / destrel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        sources[destrel] = src
+        paths.append(str(p))
+    for rel, body in (extra_files or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body)
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    fs = lint_paths(paths, default_rules(), project=project,
+                    base=str(tmp_path))
+    return [f for f in fs if f.rule_id in PROTO_IDS], sources
+
+
+def _line_of(src: str, needle: str) -> int:
+    assert needle in src
+    return src[:src.index(needle)].count("\n") + 1
+
+
+def test_shipped_copy_is_protocol_clean(tmp_path):
+    """The protocol core (vocab + service + tier + client) is closed on
+    its own — the mutation tests below start from zero findings."""
+    fs, _src = lint_shipped_copy(tmp_path)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_mutated_refusal_without_retry_after_fires_sl025(tmp_path):
+    needle = 'self._refuse("503_draining", 503, {"error": ERR_DRAINING})'
+    swap = needle[:-1] + ", retry_after=None)"
+    fs, src = lint_shipped_copy(tmp_path, mutations={
+        "pkg/archive/service.py":
+            lambda s: s.replace(needle, swap, 1)})
+    line = _line_of(src["pkg/archive/service.py"], swap)
+    hits = [f for f in fs if f.rule_id == "SL025"]
+    assert [(f.file.endswith("service.py"), f.line) for f in hits] == \
+        [(True, line)], [f.render() for f in fs]
+    assert "attaches no Retry-After" in hits[0].message
+
+
+def test_mutated_route_typo_fires_sl024(tmp_path):
+    # a one-segment typo would still shape-match "OPTIONS /v1/<any>";
+    # typo a two-segment route so no declared shape fits
+    needle = 'f"/v1/{self.tenant}/have"'
+    swap = 'f"/v1/{self.tenant}/hav"'
+    fs, src = lint_shipped_copy(tmp_path, mutations={
+        "pkg/archive/client.py": lambda s: s.replace(needle, swap, 1)})
+    line = _line_of(src["pkg/archive/client.py"], swap)
+    hits = [f for f in fs if f.rule_id == "SL024"]
+    assert [(f.file.endswith("client.py"), f.line) for f in hits] == \
+        [(True, line)], [f.render() for f in fs]
+    assert "/v1/<>/hav" in hits[0].message
+
+
+def test_mutated_retry_tuple_fires_sl028(tmp_path):
+    needle = "if e.code in CLIENT_RETRY_STATUSES or \\"
+    swap = "if e.code in (408, 422, 425) or \\"
+    fs, src = lint_shipped_copy(tmp_path, mutations={
+        "pkg/archive/client.py": lambda s: s.replace(needle, swap, 1)})
+    line = _line_of(src["pkg/archive/client.py"], swap)
+    hits = [f for f in fs if f.rule_id == "SL028"
+            and "diverge" in f.message]
+    assert [(f.file.endswith("client.py"), f.line) for f in hits] == \
+        [(True, line)], [f.render() for f in fs]
+    assert "[408, 422, 425]" in hits[0].message
+
+
+def test_mutated_ghost_knob_fires_sl026(tmp_path):
+    from sofa_tpu.lint.protocol_rules import _KNOB_RE
+
+    tokens = set()
+    for realrel in SHIPPED.values():
+        with open(os.path.join(REPO, realrel), encoding="utf-8") as f:
+            tokens |= set(_KNOB_RE.findall(f.read()))
+    docs = "| knob | default |\n|---|---|\n" + "\n".join(
+        f"| `{t}` | - |" for t in sorted(tokens)) + "\n"
+    probe = '\n_GHOST_PROBE = os.environ.get("SOFA_GHOST_KNOB", "")\n'
+    fs, src = lint_shipped_copy(
+        tmp_path,
+        mutations={"pkg/archive/service.py": lambda s: s + probe},
+        extra_files={"docs/OBSERVABILITY.md": docs})
+    line = _line_of(src["pkg/archive/service.py"], "SOFA_GHOST_KNOB")
+    hits = [f for f in fs if f.rule_id == "SL026"]
+    assert [(f.file.endswith("service.py"), f.line) for f in hits] == \
+        [(True, line)], [f.render() for f in fs]
+    assert "SOFA_GHOST_KNOB" in hits[0].message
+
+
+def test_mutated_phantom_kind_fires_sl027(tmp_path):
+    probe = ('\ndef _phantom_probe(spec):\n'
+             '    if spec.kind == "sl027_phantom":\n'
+             '        return spec\n')
+    fs, src = lint_shipped_copy(
+        tmp_path,
+        mutations={"pkg/faults.py": lambda s: s + probe},
+        extra_shipped={"pkg/faults.py": "sofa_tpu/faults.py"}.items())
+    line = _line_of(src["pkg/faults.py"], 'spec.kind == "sl027_phantom"')
+    hits = [f for f in fs if f.rule_id == "SL027"
+            and "phantom" in f.message]
+    assert [(f.file.endswith("faults.py"), f.line) for f in hits] == \
+        [(True, line)], [f.render() for f in hits]
+    assert "'sl027_phantom'" in hits[0].message
+
+
+# --- the shipped-tree closure gate -----------------------------------------
+
+def test_shipped_tree_has_zero_protocol_findings():
+    """Stronger than the baseline gate: SL024–SL028 must be fully
+    burned down on the shipped tree — no grandfathering."""
+    pkg = os.path.join(REPO, "sofa_tpu")
+    fs = lint_paths([pkg], default_rules(), base=REPO)
+    proto = [f for f in fs if f.rule_id in PROTO_IDS]
+    assert proto == [], [f.render() for f in proto]
+
+
+# --- the inventory verb -----------------------------------------------------
+
+def test_build_inventory_full_closure():
+    from sofa_tpu.protocol import build_inventory
+
+    doc = build_inventory()
+    assert doc["ok"] is True
+    assert doc["counts"]["violations"] == 0
+    paths = {r["path"] for r in doc["routes"]}
+    assert "/v1/ping" in paths and len(doc["routes"]) >= 10
+    statuses = {s["status"]: s for s in doc["statuses"]}
+    assert statuses[429]["retry_after"] is True
+    assert statuses[504]["no_retry_after"] is True
+    assert statuses[401]["client"] == "fatal"
+    assert statuses[409]["client"] == "resume"
+    assert statuses[503]["client"] == "retry"
+    knobs = {k["knob"] for k in doc["knobs"]}
+    assert "SOFA_SERVE_TOKEN" in knobs
+    undocumented = [k["knob"] for k in doc["knobs"]
+                    if k["read_by"] and not k["documented"]]
+    assert undocumented == []
+    kinds = {r["kind"]: r for r in doc["fault_kinds"]}
+    assert "http_500" in kinds
+    for row in kinds.values():
+        assert row["consumed_by"] and row["referenced"], row
+
+
+def test_protocol_inventory_schema_validates():
+    from sofa_tpu.protocol import build_inventory
+    import manifest_check
+
+    doc = build_inventory()
+    assert manifest_check.validate_protocol_inventory(doc) == []
+    assert manifest_check.validate_protocol_inventory(
+        doc, require_healthy=True) == []
+    broken = dict(doc, version=99)
+    assert manifest_check.validate_protocol_inventory(broken)
+
+
+def test_manifest_check_dispatches_protocol_doc(tmp_path):
+    from sofa_tpu.protocol import build_inventory
+    import manifest_check
+
+    path = tmp_path / "proto.json"
+    path.write_text(json.dumps(build_inventory()))
+    assert manifest_check.check_path(str(path)) == 0
+
+
+def test_cli_protocol_verb_json(capsys):
+    from sofa_tpu.cli import main
+
+    assert main(["protocol", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "sofa_tpu/protocol_inventory"
+    assert doc["version"] == 1
+    assert doc["ok"] is True
+
+
+def test_cli_protocol_verb_human(capsys):
+    from sofa_tpu.cli import main
+
+    assert main(["protocol"]) == 0
+    out = capsys.readouterr().out
+    assert "GET /v1/ping" in out
+    assert "full closure" in out
+
+
+# --- lint CLI: --rule filter, exit codes, determinism -----------------------
+
+def test_lint_cli_rule_filter_exit_codes(tmp_path, capsys):
+    from sofa_tpu.lint.cli import run_lint
+
+    rc = run_lint([os.path.join(REPO, "sofa_tpu"), "--base", REPO,
+                   "--rule", ",".join(PROTO_IDS)])
+    capsys.readouterr()
+    assert rc == 0
+    pkg = tmp_path / "pkg" / "archive"
+    pkg.mkdir(parents=True)
+    (pkg / "protocol.py").write_text(textwrap.dedent(SLIM_VOCAB))
+    (pkg / "service.py").write_text(textwrap.dedent("""
+        class H:
+            def _json(self, code, doc, retry_after=None):
+                pass
+            def handle(self):
+                path = "/v1/ping"
+                self._json(418, {})
+    """))
+    rc = run_lint([str(tmp_path / "pkg"), "--no-baseline",
+                   "--base", str(tmp_path), "--rule", "SL024"])
+    capsys.readouterr()
+    assert rc == 1
+    rc = run_lint([str(tmp_path / "pkg"), "--no-baseline",
+                   "--base", str(tmp_path), "--rule", "BOGUS"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_explain_covers_protocol_rules(capsys):
+    from sofa_tpu.lint.cli import run_lint
+
+    for rid in PROTO_IDS:
+        assert run_lint(["--explain", rid]) == 0
+        out = capsys.readouterr().out
+        assert rid in out
+
+
+def test_protocol_findings_deterministic_across_jobs(tmp_path):
+    files = {
+        "pkg/archive/protocol.py": SLIM_VOCAB,
+        "pkg/archive/service.py": """
+            class H:
+                def _json(self, code, doc, retry_after=None):
+                    pass
+                def handle(self):
+                    path = "/v1/ping"
+                    self._json(418, {})
+                    self._json(429, {})
+                    self._json(504, {}, retry_after="1")
+        """,
+        "pkg/archive/client.py": """
+            class ServiceUnavailable(Exception):
+                pass
+            def dispatch(e):
+                if e.code in (408,):
+                    raise ServiceUnavailable(e)
+        """,
+    }
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        paths.append(str(p))
+    project = ProjectContext.detect(paths, base=str(tmp_path))
+    runs = []
+    for jobs in (1, 4):
+        fs = lint_paths(paths, default_rules(), project=project,
+                        base=str(tmp_path), jobs=jobs)
+        runs.append([(f.file, f.line, f.rule_id, f.message)
+                     for f in fs if f.rule_id in PROTO_IDS])
+    assert runs[0] == runs[1]
+    assert {r[2] for r in runs[0]} >= {"SL024", "SL025", "SL028"}

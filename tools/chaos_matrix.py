@@ -1073,6 +1073,33 @@ def _run_kill_under_load_cell(workdir: str, synth: str, mc) -> List[str]:
     return list(doc["problems"])
 
 
+def _run_lint_under_chaos_cell(workdir: str, synth: str, mc) -> List[str]:
+    """lint-under-chaos: the protocol contract holds AFTER the tier has
+    been through the kill-worker-under-load wringer — the static
+    closure (`sofa protocol` + the SL024–SL028 lint slice) still exits
+    0 against the tree.  Guards the class of regression where a chaos
+    fix patches a handler into emitting a status/body the vocabulary
+    never declared: the runtime cells above would pass while the
+    contract silently forked."""
+    problems: List[str] = []
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for label, cmd in (
+            ("sofa protocol --json",
+             [sys.executable, "-m", "sofa_tpu", "protocol", "--json"]),
+            ("sofa lint --rule SL024..SL028",
+             [sys.executable, "-m", "sofa_tpu", "lint",
+              os.path.join(root, "sofa_tpu"),
+              "--rule", "SL024,SL025,SL026,SL027,SL028"])):
+        r = subprocess.run(cmd, cwd=root, capture_output=True, text=True,
+                           timeout=300)
+        if r.returncode != 0:
+            tail = (r.stderr.strip() or r.stdout.strip()).splitlines()
+            problems.append(
+                f"{label} rc={r.returncode} after chaos: "
+                + "; ".join(tail[-3:]))
+    return problems
+
+
 def _run_disk_full_wal_cell(workdir: str, synth: str, mc) -> List[str]:
     """disk-full-WAL: the service's 5th durable write (the WAL append
     behind the commit, after the synth run's 4 object puts) sees a
@@ -1186,7 +1213,7 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 13
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 14
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
@@ -1195,6 +1222,7 @@ def main(argv=None) -> int:
                    ("kill-worker-mid-wal-drain", None),
                    ("kill-worker-metrics-survive", None),
                    ("kill-worker-under-load", None),
+                   ("lint-under-chaos", None),
                    ("disk-full-wal", None),
                    ("restore-then-serve", None),
                    ("kill-mid-live-epoch", None),
@@ -1271,6 +1299,7 @@ def main(argv=None) -> int:
                         _run_metrics_survival_cell),
                        ("kill-worker-under-load",
                         _run_kill_under_load_cell),
+                       ("lint-under-chaos", _run_lint_under_chaos_cell),
                        ("disk-full-wal", _run_disk_full_wal_cell),
                        ("restore-then-serve",
                         _run_restore_then_serve_cell)):
@@ -1280,8 +1309,10 @@ def main(argv=None) -> int:
             problems = ["crashed:\n" + traceback.format_exc()]
         status = "PASS" if not problems else "FAIL"
         failures += bool(problems)
-        print(f"{name.ljust(width)}  {status}  (sofa serve + sofa agent, "
-              "sofa_tpu/archive/service.py)")
+        detail = ("sofa protocol + sofa lint SL024..SL028, post-chaos"
+                  if name == "lint-under-chaos" else
+                  "sofa serve + sofa agent, sofa_tpu/archive/service.py")
+        print(f"{name.ljust(width)}  {status}  ({detail})")
         for p in problems:
             print(f"{' ' * width}    - {p}")
     for name, cell in (("kill-mid-live-epoch", _run_live_kill_cell),

@@ -42,6 +42,8 @@ _WHATIF_SCHEMA = "sofa_tpu/whatif_report"
 _WHATIF_VERSION = 1
 _INVENTORY_SCHEMA = "sofa_tpu/artifact_inventory"
 _INVENTORY_VERSION = 1
+_PROTOCOL_SCHEMA = "sofa_tpu/protocol_inventory"
+_PROTOCOL_VERSION = 1
 _WHATIF_CALIBRATION = ("calibrated", "uncalibrated")
 _WHATIF_SCENARIO_STATUSES = ("parsed", "unknown")
 _WHATIF_ATTRIBUTION_STATUSES = ("applied", "no_match", "unknown")
@@ -940,6 +942,76 @@ def validate_inventory(doc, require_healthy: bool = False) -> List[str]:
     return probs
 
 
+def validate_protocol_inventory(doc,
+                                require_healthy: bool = False) -> List[str]:
+    """Schema problems in a ``sofa protocol --json`` document
+    (sofa_tpu/protocol.py).  ``require_healthy`` additionally fails on
+    closure violations — the CI-gate mode bench.py rides."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["protocol inventory is not a JSON object"]
+    if doc.get("schema") != _PROTOCOL_SCHEMA:
+        probs.append(f"schema: expected {_PROTOCOL_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _PROTOCOL_VERSION:
+        probs.append(f"version: expected {_PROTOCOL_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not _is_num(doc.get("generated_unix")):
+        probs.append("generated_unix: missing or not a number")
+    if not isinstance(doc.get("ok"), bool):
+        probs.append("ok: missing or not a bool")
+    routes = doc.get("routes")
+    if not isinstance(routes, list) or not routes:
+        probs.append("routes: missing or empty")
+        routes = []
+    for i, r in enumerate(routes):
+        if not isinstance(r, dict) or not isinstance(r.get("method"), str) \
+                or not isinstance(r.get("path"), str) \
+                or not r.get("path", "").startswith("/v1/") \
+                or not isinstance(r.get("clients"), list):
+            probs.append(f"routes[{i}]: needs method, a /v1/ path, and a "
+                         "clients list")
+            break
+    statuses = doc.get("statuses")
+    if not isinstance(statuses, list) or not statuses:
+        probs.append("statuses: missing or empty")
+        statuses = []
+    for i, s in enumerate(statuses):
+        if not isinstance(s, dict) \
+                or not isinstance(s.get("status"), int) \
+                or not isinstance(s.get("errors"), list) \
+                or not isinstance(s.get("retry_after"), bool) \
+                or s.get("client") not in ("fatal", "resume", "retry", "-"):
+            probs.append(f"statuses[{i}]: needs an int status, an errors "
+                         "list, a retry_after bool, and a client "
+                         "handling class")
+            break
+        if s.get("retry_after") and s.get("client") == "fatal":
+            probs.append(f"statuses[{i}]: HTTP {s.get('status')} carries "
+                         "Retry-After but the client treats it as fatal")
+    for section in ("errors", "knobs", "fault_kinds", "violations"):
+        if not isinstance(doc.get(section), list):
+            probs.append(f"{section}: not a list")
+    counts = doc.get("counts")
+    if not isinstance(counts, dict) or not all(
+            isinstance(counts.get(k), int)
+            for k in ("routes", "statuses", "errors", "knobs",
+                      "fault_kinds", "violations")):
+        probs.append("counts: missing route/status/error/knob/fault/"
+                     "violation counters")
+    if require_healthy:
+        viol = doc.get("violations")
+        if isinstance(viol, list) and viol:
+            probs.append(f"gate: {len(viol)} closure violation(s)")
+        undocumented = [k.get("knob") for k in doc.get("knobs") or []
+                        if isinstance(k, dict) and not k.get("documented")
+                        and k.get("read_by")]
+        if undocumented:
+            probs.append("gate: undocumented knobs: "
+                         + ", ".join(undocumented[:8]))
+    return probs
+
+
 def validate_slo_verdict(doc, require_passing: bool = False) -> List[str]:
     """Schema problems in a ``_metrics/slo_verdict.json``
     (sofa_tpu/metrics.py evaluate_slo) — the typed per-window judgement
@@ -1375,6 +1447,16 @@ def check_path(path: str, require_healthy: bool = False) -> int:
             print(f"manifest_check: OK ({path}; "
                   f"{(doc.get('counts') or {}).get('artifacts')} "
                   f"artifact(s), ok={doc.get('ok')})")
+        return 1 if probs else 0
+    if isinstance(doc, dict) and doc.get("schema") == _PROTOCOL_SCHEMA:
+        probs = validate_protocol_inventory(doc,
+                                            require_healthy=require_healthy)
+        for p in probs:
+            print(f"manifest_check: protocol: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; "
+                  f"{(doc.get('counts') or {}).get('routes')} "
+                  f"route(s), ok={doc.get('ok')})")
         return 1 if probs else 0
     if isinstance(doc, dict) and doc.get("schema") == _SLO_SCHEMA:
         probs = validate_slo_verdict(doc, require_passing=require_healthy)
