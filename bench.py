@@ -612,6 +612,45 @@ try:
     _sh.rmtree(_cw, ignore_errors=True)
 except Exception as e:
     out["catalog_evidence_error"] = f"{{type(e).__name__}}: {{e}}"[:160]
+# fleet-pass evidence (sofa_tpu/analysis/fleet.py): the incremental
+# cross-run engine's cold-vs-warm wall on a synthetic archive —
+# fleet_analyze_wall_time_s is the full cold fan-out over the index and
+# fleet_analyze_warm_wall_time_s the delta refresh after ONE appended
+# ingest (the drainer's post-commit steady-state cost).  The warm
+# report is asserted byte-identical to a drop-and-recompute before
+# either number is emitted — a fast stale answer is not evidence.
+# tools/fleet_analyze_bench.py prints the 50k-run cold/warm/per-pass
+# table; needs no hardware, so both ride dead-tunnel rounds.
+try:
+    from catalog_bench import synthesize as _fcat_synth
+    from sofa_tpu.analysis import fleet as _afleet
+    from sofa_tpu.archive import catalog as _facat
+    _fw = _tf.mkdtemp(prefix="sofa_fleet_pass_")
+    _froot = os.path.join(_fw, "archive")
+    _fcat_synth(_froot, 400)
+    t0 = time.perf_counter()
+    _afleet.analyze(_froot)
+    out["fleet_analyze_wall_time_s"] = round(time.perf_counter() - t0, 4)
+    _run = "f" * 64
+    with open(os.path.join(_froot, "runs", _run + ".json"), "w") as f:
+        json.dump({{"run": _run, "hostname": "hostX", "t": 1.8e9,
+                   "features": {{"elapsed_time": 1.0,
+                                "tpu0_sol_distance": 3.3}}}}, f)
+    _facat.append_event(_froot, "ingest", run=_run, logdir="/x",
+                        files=1, new_objects=1, bytes_added=10)
+    t0 = time.perf_counter()
+    _afleet.analyze(_froot)
+    out["fleet_analyze_warm_wall_time_s"] = round(
+        time.perf_counter() - t0, 4)
+    _fwarm = open(_afleet.report_path(_froot), "rb").read()
+    _afleet.drop(_froot)
+    _afleet.analyze(_froot)
+    if _fwarm != open(_afleet.report_path(_froot), "rb").read():
+        out["fleet_analyze_evidence_error"] = "warm != cold recompute"
+    _sh.rmtree(_fw, ignore_errors=True)
+except Exception as e:
+    out["fleet_analyze_evidence_error"] = \
+        f"{{type(e).__name__}}: {{e}}"[:160]
 # durability evidence (sofa_tpu/durability.py): fsck over the healthy
 # logdir, then drop the preprocess commit marker (a crash one instruction
 # before the commit) and time `sofa resume` — the number proves committed
@@ -675,7 +714,10 @@ print(json.dumps(out))
                     "live_epoch_wall_time_s",
                     "live_lag_events", "live_evidence_error",
                     "catalog_index_refresh_wall_time_s",
-                    "fleet_query_wall_time_s", "catalog_evidence_error"):
+                    "fleet_query_wall_time_s", "catalog_evidence_error",
+                    "fleet_analyze_wall_time_s",
+                    "fleet_analyze_warm_wall_time_s",
+                    "fleet_analyze_evidence_error"):
             if key in doc:
                 out[key] = doc[key]
         if "report_js_bytes" in out:
@@ -726,6 +768,12 @@ print(json.dumps(out))
                  f"{out['fleet_query_wall_time_s']}s "
                  "(scan-identical, tools/catalog_bench.py has the "
                  "50k table)")
+        if "fleet_analyze_wall_time_s" in out:
+            _log(f"bench: fleet analyze cold "
+                 f"{out['fleet_analyze_wall_time_s']}s, warm delta "
+                 f"{out.get('fleet_analyze_warm_wall_time_s')}s "
+                 "(byte-identical to recompute, "
+                 "tools/fleet_analyze_bench.py has the 50k table)")
         # Every bench run also asserts the self-telemetry ledger the
         # preprocess above must have written (tools/manifest_check.py):
         # a healthy number from an unhealthy pipeline is not evidence.
@@ -873,7 +921,10 @@ _ARCHIVED_METRICS = ("resnet50_profiling_overhead", "preprocess_wall_time_s",
                      "live_lag_events", "frame_load_wall_time_s",
                      "analyze_peak_rss_mb",
                      "catalog_index_refresh_wall_time_s",
-                     "fleet_query_wall_time_s", "fleet_push_p50_ms",
+                     "fleet_query_wall_time_s",
+                     "fleet_analyze_wall_time_s",
+                     "fleet_analyze_warm_wall_time_s",
+                     "fleet_push_p50_ms",
                      "fleet_push_p99_ms", "fleet_query_p50_ms",
                      "fleet_query_p99_ms", "fleet_saturation_rps",
                      "tier_metrics_overhead_pct", "tier_scrape_wall_time_s",

@@ -260,11 +260,15 @@ def covered(pattern: str, declared) -> bool:
 
 # --- scheduling -------------------------------------------------------------
 
-def pass_dependencies(specs: List[PassSpec]) -> Dict[str, List[str]]:
+def pass_dependencies(specs: List[PassSpec],
+                      ambient=AMBIENT_FEATURES) -> Dict[str, List[str]]:
     """name -> sorted producer/after dependency names, from declarations
     alone.  A pass reading a feature pattern depends on every OTHER pass
     providing an overlapping pattern; ``after`` edges add non-feature
-    ordering (ROI mutation)."""
+    ordering (ROI mutation).  ``ambient`` is the driver-provided feature
+    list whose reads need no producer — the analysis domain's
+    AMBIENT_FEATURES by default; the fleet domain passes ``()`` (no
+    ambient fleet features exist)."""
     by_name = {s.name: s for s in specs}
     deps: Dict[str, set] = {s.name: set() for s in specs}
     for s in specs:
@@ -272,7 +276,7 @@ def pass_dependencies(specs: List[PassSpec]) -> Dict[str, List[str]]:
             if dep in by_name and dep != s.name:
                 deps[s.name].add(dep)
         for pat in s.reads_features:
-            if covered(pat, AMBIENT_FEATURES):
+            if covered(pat, ambient):
                 continue
             for other in specs:
                 if other.name != s.name and covered(pat,
@@ -281,15 +285,17 @@ def pass_dependencies(specs: List[PassSpec]) -> Dict[str, List[str]]:
     return {k: sorted(v) for k, v in deps.items()}
 
 
-def resolve_schedule(specs: List[PassSpec],
-                     strict: bool = False) -> List[List[PassSpec]]:
+def resolve_schedule(specs: List[PassSpec], strict: bool = False,
+                     ambient=AMBIENT_FEATURES) -> List[List[PassSpec]]:
     """Kahn-level waves over the declared dependency graph, canonical
     order within each wave.  A cycle raises in ``strict`` mode (``sofa
     passes`` reports it); at runtime it degrades to canonical-order
     execution of the cyclic remainder with a warning — analysis must not
-    be un-runnable because a plugin mis-declared."""
+    be un-runnable because a plugin mis-declared.  ``ambient`` forwards
+    to :func:`pass_dependencies` (the fleet domain schedules with the
+    same machinery but an empty ambient list)."""
     specs = sorted(specs, key=lambda s: (s.order, s.seq))
-    deps = pass_dependencies(specs)
+    deps = pass_dependencies(specs, ambient=ambient)
     done: set = set()
     waves: List[List[PassSpec]] = []
     pending = list(specs)

@@ -138,8 +138,14 @@ class ServiceClient:
         self._breaker.pop(url, None)
 
     def breaker_open(self, url: str) -> bool:
-        _fails, until = self._breaker.get(url, (0, 0.0))
-        return until > time.monotonic()
+        """True while the endpoint is untrusted — its ledger entry
+        stands until a request on it succeeds (``_note_endpoint_up``).
+        The jittered hold only delays when ``_select_endpoint`` starts
+        health-probing it again; a probe pass still routes ONE request
+        there before the ledger clears, so expiry alone never re-opens
+        this answer."""
+        fails, _until = self._breaker.get(url, (0, 0.0))
+        return fails > 0
 
     def check_health(self, url: str) -> bool:
         """``GET /v1/health`` (unauthenticated, like the server's ping):

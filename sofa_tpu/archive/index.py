@@ -329,29 +329,45 @@ def _feature_rows(events: List[dict],
 
 def _runs_rows(ev_all, ft_all) -> "object":
     """The deduped run family from the full event family: newest ingest
-    event per run id, ``catalog.ingest_entries`` order EXACTLY (dict
-    first-insertion position breaks timestamp ties), plus each run's
-    feature count so the rolling-baseline window selection never touches
-    the features family."""
+    event per run id, ``catalog.ingest_entries`` order EXACTLY (each
+    run's first-appearance position breaks timestamp ties — the dict-
+    insertion rule of the old per-row loop), plus each run's feature
+    count so the rolling-baseline window selection never touches the
+    features family.
+
+    Whole-column pandas/NumPy ops throughout: the per-row
+    ``to_dict("records")`` round trip this replaces dominated full
+    index rebuilds at catalog scale.  An absent timestamp sorts as 0
+    (the loop's ``or 0`` rule, now total: NaN keys previously fell
+    through ``or`` into undefined float comparisons)."""
+    import numpy as np
     import pandas as pd
 
     ing = ev_all[(ev_all["verb"] == "ingest") & (ev_all["run"] != "")]
-    latest: Dict[str, dict] = {}
-    for rec in ing.to_dict("records"):
-        latest[rec["run"]] = rec
-    ordered = sorted(latest.values(),
-                     key=lambda r: (r.get("timestamp") or 0))
-    counts: Dict[str, int] = {}
+    ing = ing.reset_index(drop=True)
+    if len(ing):
+        # keep-last dedup carries the newest event's values; the sort
+        # key pairs (timestamp NaN->0, per-run first-appearance
+        # position) — first-position is unique, so the order is total
+        first_pos = pd.Series(ing.index, index=ing["run"]) \
+            .groupby(level=0, sort=False).first()
+        dedup = ing[~ing.duplicated("run", keep="last")]
+        order = np.lexsort((
+            dedup["run"].map(first_pos).to_numpy(dtype=np.int64),
+            np.nan_to_num(dedup["timestamp"].to_numpy(dtype=float),
+                          nan=0.0)))
+        dedup = dedup.iloc[order]
+    else:
+        dedup = ing
+    out = dedup[["run", "label", "host", "logdir",
+                 "timestamp", "bytes", "files"]].copy()
     if len(ft_all):
         dd = ft_all[~ft_all.duplicated(["run", "name"], keep="last")]
-        counts = dd["run"].value_counts().to_dict()
-    rows = [{"run": r["run"], "label": r["label"], "host": r["host"],
-             "logdir": r["logdir"], "timestamp": r["timestamp"],
-             "bytes": r["bytes"], "files": r["files"],
-             "n_features": float(counts.get(r["run"], 0))}
-            for r in ordered]
-    return _conform_family(pd.DataFrame(rows, columns=RUNS_COLUMNS),
-                           RUNS_COLUMNS)
+        out["n_features"] = out["run"].map(
+            dd["run"].value_counts()).fillna(0.0).astype(float)
+    else:
+        out["n_features"] = 0.0
+    return _conform_family(out.reset_index(drop=True), RUNS_COLUMNS)
 
 
 def _family_frame(root: str, family: str, columns: List[str]):
@@ -737,16 +753,17 @@ def rolling_samples(root: str, rolling: int,
         # a re-ingested run's newest rows are nearest the tail: within
         # the buffer keep-last is exactly the newest-event-wins rule
         buf = buf[~buf.duplicated(["run", "name"], keep="last")]
-    by_run: Dict[str, List[tuple]] = {}
-    for rec in buf.to_dict("records"):
-        by_run.setdefault(rec["run"], []).append(
-            (rec["name"], float(rec["value"])))
+    # whole-column regroup (the per-row records loop this replaces was
+    # the O(window * features) hot spot): a stable sort by each row's
+    # window rank orders the buffer newest run first while keeping the
+    # family's row order within a run, so per-name value lists reversed
+    # read oldest first — exactly the nested selected/by_run loops
+    rank = {run_id: i for i, run_id in enumerate(selected)}
     out: Dict[str, List[float]] = {}
-    for run_id in selected:                  # newest first
-        for name, value in by_run.get(run_id, ()):
-            out.setdefault(name, []).append(value)
-    for name in out:
-        out[name].reverse()                  # oldest first, for readers
+    if len(buf):
+        buf = buf.iloc[buf["run"].map(rank).argsort(kind="stable")]
+        for name, grp in buf.groupby("name", sort=False)["value"]:
+            out[name] = grp.tolist()[::-1]   # oldest first, for readers
     return out
 
 
@@ -759,15 +776,25 @@ def _runs_meta(root: str, commit: dict,
     if handle is None or not run_ids:
         return {}
     import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
 
-    runs_col = handle.read_table(columns=["run"])["run"].to_numpy(
-        zero_copy_only=False)
+    # hash-join membership (pc.is_in), NOT np.isin: the run column is
+    # strings, and numpy's object-dtype isin degrades to an O(rows*ids)
+    # scan that dominated every fleet-pass fold at catalog scale
+    vset = pa.array(sorted(run_ids))
+    mask = pc.is_in(handle.read_table(columns=["run"])["run"],
+                    value_set=vset)
     step = int(handle.index.get("chunk_rows") or INDEX_CHUNK_ROWS)
-    hits = np.nonzero(np.isin(runs_col, list(run_ids)))[0]
+    hits = np.nonzero(mask.to_numpy(zero_copy_only=False))[0]
     meta: Dict[str, dict] = {}
     for ci in sorted({int(p) // step for p in hits}):
-        df = handle.read_chunk(ci)
-        for rec in df[df["run"].isin(run_ids)].to_dict("records"):
+        # filter in Arrow, THEN materialize — to_pandas on the matched
+        # rows only, not the whole chunk (to_pandas keeps the family's
+        # null->NaN convention, so the row dicts are unchanged)
+        tbl = handle.read_chunk_table(ci)
+        sub = tbl.filter(pc.is_in(tbl["run"], value_set=vset))
+        for rec in sub.to_pandas().to_dict("records"):
             meta[rec["run"]] = rec
     return meta
 

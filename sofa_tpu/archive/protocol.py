@@ -28,6 +28,7 @@ ERR_BAD_KIND = "bad_kind"
 ERR_BAD_PARAMS = "bad_params"
 ERR_REPLICA_WARMING = "replica_warming"
 ERR_NO_INDEX = "no_index"
+ERR_NO_FLEET_REPORT = "no_fleet_report"
 ERR_NO_SUCH_CHUNK = "no_such_chunk"
 ERR_NO_SUCH_RUN = "no_such_run"
 ERR_LENGTH_REQUIRED = "length_required"
@@ -56,7 +57,7 @@ STATUS_ERRORS = {
     401: (ERR_UNAUTHORIZED,),
     403: (ERR_READ_ONLY_REPLICA,),
     404: (ERR_NO_SUCH_ROUTE, ERR_NO_SUCH_RUN, ERR_NO_INDEX,
-          ERR_NO_SUCH_CHUNK),
+          ERR_NO_SUCH_CHUNK, ERR_NO_FLEET_REPORT),
     408: (),
     409: (ERR_MISSING_OBJECTS,),
     411: (ERR_LENGTH_REQUIRED,),
@@ -107,6 +108,7 @@ ROUTES = (
     "GET /v1/metrics",
     "GET /v1/<tenant>/catalog",
     "GET /v1/<tenant>/query",
+    "GET /v1/<tenant>/fleet",
     "GET /v1/<tenant>/index/commit",
     "GET /v1/<tenant>/index/<family>/<chunk>",
     "GET /v1/<tenant>/run/<run_id>",

@@ -57,8 +57,8 @@ from sofa_tpu.archive.protocol import (
     ERR_BAD_FILES_MAP, ERR_BAD_JSON, ERR_BAD_KIND, ERR_BAD_PARAMS,
     ERR_BAD_TENANT, ERR_BROWNOUT, ERR_DEADLINE_EXPIRED, ERR_DRAINING,
     ERR_HASH_MISMATCH, ERR_LENGTH_REQUIRED, ERR_LOADED, ERR_MID_GC,
-    ERR_MISSING_OBJECTS, ERR_NO_INDEX, ERR_NO_SPACE, ERR_NO_SUCH_CHUNK,
-    ERR_NO_SUCH_ROUTE, ERR_NO_SUCH_RUN, ERR_QUOTA,
+    ERR_MISSING_OBJECTS, ERR_NO_FLEET_REPORT, ERR_NO_INDEX, ERR_NO_SPACE,
+    ERR_NO_SUCH_CHUNK, ERR_NO_SUCH_ROUTE, ERR_NO_SUCH_RUN, ERR_QUOTA,
     ERR_READ_ONLY_REPLICA, ERR_REPLICA_WARMING, ERR_TOO_LARGE,
     ERR_UNAUTHORIZED, ERR_WAL_BACKLOG)
 from sofa_tpu.archive.store import ArchiveStore, run_content_id
@@ -555,7 +555,8 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
                 return
             self._metrics_route()
             return
-        routed = self._route(allow_token_param=clean.endswith("/query"))
+        routed = self._route(allow_token_param=clean.endswith("/query")
+                             or clean.endswith("/fleet"))
         if routed is None:
             return
         tenant, rest = routed
@@ -565,6 +566,9 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
             return
         if rest == ["query"]:
             self._query(tenant, store)
+            return
+        if rest == ["fleet"]:
+            self._fleet_report(tenant, store)
             return
         if rest and rest[0] == "index":
             self._index_file(tenant, rest[1:])
@@ -747,6 +751,56 @@ class _FleetHandler(http.server.BaseHTTPRequestHandler):
         reg.inc("queries")
         reg.inc(f"tenant_requests.{tenant}")
         reg.observe("query", (time.time() - t0) * 1e3)
+        self._json(200, {"schema": SERVICE_SCHEMA,
+                         "version": SERVICE_VERSION,
+                         "tenant": tenant, **doc},
+                   extra_headers=headers)
+
+    def _fleet_report(self, tenant: str, store: ArchiveStore) -> None:
+        """``GET /v1/<tenant>/fleet`` — the committed fleet-pass report
+        (schema ``sofa_tpu/fleet_report`` v1, docs/FLEET.md): the board
+        reads cross-run analysis as ONE artifact instead of re-ranking
+        on every poll.  ETag is the index commit sha the report covers —
+        the drainer's post-commit refresh (tier.refresh_tenant) keeps it
+        warm, so an idle poll is a 304.  Read-only and brownout-shedding
+        exactly like /v1/query."""
+        from sofa_tpu.analysis import fleet as fleet_mod
+
+        if self._backpressure(tenant):
+            return
+        soft, _hard = tier.wal_watermarks()
+        if self.server.role != "replica" and \
+                self.server.wal_pressure(tenant) >= soft:
+            self._refuse("503_brownout", 503,
+                         {"error": ERR_BROWNOUT, "tenant": tenant})
+            return
+        t0 = time.time()
+        doc = fleet_mod.load_report(store.root)
+        if doc is None:
+            # no committed report yet: the artifact is derived state —
+            # `sofa fleet analyze` (or the next drain's refresh) builds
+            # it; answering an empty 200 would read as "fleet is clean"
+            self._count("404_no_fleet_report")
+            self._json(404, {"error": ERR_NO_FLEET_REPORT,
+                             "tenant": tenant},
+                       extra_headers=list(_CORS_HEADERS))
+            return
+        etag = f'"idx-{doc.get("commit_sha")}"'
+        headers = [("ETag", etag)] + list(_CORS_HEADERS)
+        if self.server.role == "replica":
+            headers.append(("X-Sofa-Replica", "1"))
+        if self.headers.get("If-None-Match") == etag:
+            self._count("304_fleet")
+            self.send_response(304)
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            return
+        self._count("fleet_read")
+        reg = self.server.metrics
+        reg.inc("fleet_reads")
+        reg.inc(f"tenant_requests.{tenant}")
+        reg.observe("fleet", (time.time() - t0) * 1e3)
         self._json(200, {"schema": SERVICE_SCHEMA,
                          "version": SERVICE_VERSION,
                          "tenant": tenant, **doc},

@@ -58,8 +58,10 @@ _HASH_CHUNK = 1 << 20
 # index-signed sha — pure derived state: --repair drops + rebuilds it).
 # ``unreferenced`` objects (no surviving run points at them) are reported
 # but are NOT damage: they are what `sofa archive gc` exists to sweep.
+# ``fleet`` (a present-but-unreadable _fleet/ report or memo — derived
+# like the index: --repair drops it; the next analyze rebuilds).
 ARCHIVE_FSCK_VERDICTS = ("corrupt", "missing", "orphaned", "uncataloged",
-                         "index")
+                         "index", "fleet")
 
 
 class ArchiveStore:
@@ -560,6 +562,12 @@ def archive_fsck(root: str, repair: bool = False) -> Optional[dict]:
     from sofa_tpu.archive import index as aindex
 
     report["index"] = aindex.verify(root)
+    # The fleet-pass tier (_fleet/, analysis/fleet.py) is one more layer
+    # of pure derived state: schema-validate what is present; a torn
+    # report-ahead-of-memo window is healthy pending, not damage.
+    from sofa_tpu.analysis import fleet as afleet
+
+    report["fleet"] = afleet.verify(root)
     report["checked"] = checked
     if repair:
         _archive_repair(store, report)
@@ -660,6 +668,16 @@ def _archive_repair(store: ArchiveStore, report: Dict[str, list]) -> None:
                            "index and rebuilt it from the catalog")
         else:
             report["index"] = still or report["index"]
+    if report.get("fleet"):
+        # same rule one layer up: the fleet report/memo are pure
+        # functions of the index commit — drop and let the next analyze
+        # (or post-drain refresh) rebuild rather than trusting rot
+        from sofa_tpu.analysis import fleet as afleet
+
+        afleet.drop(store.root)
+        report["fleet"] = []
+        print_progress("archive fsck: dropped the damaged fleet report "
+                       "— `sofa fleet analyze` rebuilds it")
 
 
 # ---------------------------------------------------------------------------

@@ -503,6 +503,14 @@ def refresh_tenant(tenant_root: str) -> bool:
     tenant = os.path.basename(tenant_root)
     reg = metrics.for_tenant_root(tenant_root)
     reg.observe("index_refresh", wall_s * 1e3)
+    # piggyback the incremental fleet-pass refresh on the freshly
+    # committed index — O(delta chunks), degrading (a stale fleet
+    # report is only a staler /v1/<tenant>/fleet answer)
+    from sofa_tpu.analysis import fleet
+
+    tf = time.time()
+    if fleet.refresh_after_ingest(tenant_root):
+        reg.observe("fleet_refresh", (time.time() - tf) * 1e3)
     traces = reg.take_pending_refresh(tenant) or [""]
     for tid in traces:
         # one commit span per drained trace id: the refresh is coalesced,

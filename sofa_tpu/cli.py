@@ -109,18 +109,19 @@ def build_parser() -> argparse.ArgumentParser:
         "record", "preprocess", "analyze", "report", "stat", "diff", "viz",
         "export", "top", "status", "lint", "passes", "clean", "setup",
         "resume", "fsck", "archive", "regress", "whatif", "artifacts",
-        "protocol", "serve", "agent", "live",
+        "protocol", "serve", "agent", "live", "fleet",
     ])
     p.add_argument("usr_command", nargs="?", default="",
                    help="command to profile (record/stat); logdir "
                         "(status/resume/fsck/passes/whatif/artifacts/live); "
                         "path to lint (lint); logdir or ls/show/gc/fsck "
                         "(archive); run (regress); archive root (serve); "
-                        "watch directory (agent)")
+                        "watch directory (agent); analyze (fleet)")
     p.add_argument("extra", nargs="?", default="",
                    help="second positional: the run id for `archive show`, "
                         "the baseline run for `regress`, the archive root "
-                        "for `archive backup`/`archive restore`")
+                        "for `archive backup`/`archive restore` and "
+                        "`fleet analyze`")
     p.add_argument("extra2", nargs="?", default="",
                    help="third positional: the destination for `archive "
                         "backup`, the restore target for `archive restore`")
@@ -659,6 +660,10 @@ def _run(argv=None) -> int:
             from sofa_tpu.archive.service import sofa_serve
             print_main_progress("SOFA serve")
             return sofa_serve(cfg, root=args.usr_command or None)
+        if cmd == "fleet":
+            from sofa_tpu.analysis.fleet import sofa_fleet
+            print_main_progress("SOFA fleet")
+            return sofa_fleet(cfg, args.usr_command, args.extra)
         if cmd == "agent":
             from sofa_tpu.agent import sofa_agent
             print_main_progress("SOFA agent")

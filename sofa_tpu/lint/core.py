@@ -60,14 +60,18 @@ class Finding:
 
 @dataclass(frozen=True)
 class PassDecl:
-    """One ``@analysis_pass(...)`` declaration, read straight from the AST
-    (never by importing): the cross-file facts SL010–SL013 verify pass
-    bodies and the dependency graph against."""
+    """One ``@analysis_pass(...)`` or ``@fleet_pass(...)`` declaration,
+    read straight from the AST (never by importing): the cross-file facts
+    SL010–SL013 verify pass bodies and the dependency graph against.
+    ``domain`` separates the two registries — per-run analysis passes
+    read trace frames, fleet passes read archive-index column families —
+    and the graph rules refuse edges that cross it."""
 
     name: str
     func: str
     relpath: str
     line: int
+    domain: str = "analysis"
     reads_frames: tuple = ()
     reads_columns: tuple = ()
     reads_features: tuple = ()
@@ -85,8 +89,13 @@ class ProjectContext:
     #: The unified trace schema (trace.COLUMNS), extracted from the AST of
     #: trace.py — empty set disables the schema-drift rule.
     columns: frozenset = frozenset()
-    #: Every @analysis_pass declaration in the linted tree (pass_rules.py).
+    #: Every @analysis_pass / @fleet_pass declaration in the linted tree
+    #: (pass_rules.py) — PassDecl.domain tells them apart.
     passes: tuple = ()
+    #: Pinned archive-index family schemas as "family.column" strings,
+    #: extracted from the AST of archive/index.py — empty set disables
+    #: the fleet-domain column checks.
+    index_columns: frozenset = frozenset()
     #: AMBIENT_FEATURES from analysis/registry.py — features the analyze
     #: driver provides without a producing pass.
     ambient_features: tuple = ()
@@ -110,9 +119,11 @@ class ProjectContext:
         declaring BASE_COLUMNS/EXTRA_COLUMNS and read the literals out of
         its AST (falling back to this package's own trace.py so linting a
         single file still knows the schema), collect every
-        ``@analysis_pass`` declaration, and read AMBIENT_FEATURES from
-        the registry module.  ``base`` must match the relpath anchor the
-        engine uses so declarations join up with FileContext.relpath."""
+        ``@analysis_pass`` / ``@fleet_pass`` declaration, read
+        AMBIENT_FEATURES from the registry module, and the index family
+        schemas from archive/index.py.  ``base`` must match the relpath
+        anchor the engine uses so declarations join up with
+        FileContext.relpath."""
         candidates = [f for f in files if os.path.basename(f) == "trace.py"]
         pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         here = os.path.join(pkg, "trace.py")
@@ -139,6 +150,15 @@ class ProjectContext:
             ambient = _ambient_from_registry(cand)
             if ambient:
                 break
+        index_columns: frozenset = frozenset()
+        idx_candidates = [f for f in files
+                          if os.path.basename(f) == "index.py"]
+        idx_candidates.append(os.path.join(pkg, "archive", "index.py"))
+        for cand in idx_candidates:
+            cols = _index_columns_from_archive(cand)
+            if cols:
+                index_columns = frozenset(cols)
+                break
         from sofa_tpu.lint.artifact_rules import build_artifact_graph
         from sofa_tpu.lint.concurrency_rules import build_concurrency_graph
         from sofa_tpu.lint.protocol_rules import build_protocol_graph
@@ -148,8 +168,9 @@ class ProjectContext:
         concurrency = build_concurrency_graph(files, base=base)
         protocol = build_protocol_graph(files, base=base)
         return cls(columns=columns, passes=tuple(passes),
-                   ambient_features=ambient, artifacts=artifacts,
-                   concurrency=concurrency, protocol=protocol)
+                   ambient_features=ambient, index_columns=index_columns,
+                   artifacts=artifacts, concurrency=concurrency,
+                   protocol=protocol)
 
 
 def _columns_from_trace(path: str) -> List[str]:
@@ -191,6 +212,34 @@ def _ambient_from_registry(path: str) -> tuple:
     return ()
 
 
+#: Index schema constant -> family name (mirrors index.FAMILIES; kept as
+#: a literal map so the extractor stays import-free like the rest).
+_INDEX_FAMILY_CONSTS = {"CATALOG_COLUMNS": "catalog",
+                        "RUNS_COLUMNS": "runs",
+                        "FEATURE_COLUMNS": "features"}
+
+
+def _index_columns_from_archive(path: str) -> List[str]:
+    """Pinned "family.column" strings out of archive/index.py's AST."""
+    try:
+        with open(path, "rb") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return []
+    out: List[str] = []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and tgt.id in _INDEX_FAMILY_CONSTS \
+                and isinstance(node.value, ast.List):
+            family = _INDEX_FAMILY_CONSTS[tgt.id]
+            out.extend(f"{family}.{e.value}" for e in node.value.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str))
+    return out
+
+
 def _str_tuple(node) -> tuple:
     """String literals out of a tuple/list AST literal (non-literals and
     non-strings are dropped — the runtime registry rejects those loudly)."""
@@ -201,10 +250,15 @@ def _str_tuple(node) -> tuple:
     return ()
 
 
+#: Decorator name -> registry domain a declaration belongs to.
+_PASS_DECORATORS = {"analysis_pass": "analysis", "fleet_pass": "fleet"}
+
+
 def _pass_decls_from_file(path: str, relpath: str) -> List[PassDecl]:
-    """Every ``@analysis_pass(...)`` (bare or attribute-qualified) in one
-    file, contracts read as literals.  Purely syntactic — a decorator of
-    that name is treated as a pass declaration wherever it appears."""
+    """Every ``@analysis_pass(...)`` / ``@fleet_pass(...)`` (bare or
+    attribute-qualified) in one file, contracts read as literals.  Purely
+    syntactic — a decorator of either name is treated as a pass
+    declaration wherever it appears."""
     try:
         with open(path, "rb") as f:
             tree = ast.parse(f.read(), filename=path)
@@ -220,7 +274,7 @@ def _pass_decls_from_file(path: str, relpath: str) -> List[PassDecl]:
             fn = deco.func
             deco_name = fn.id if isinstance(fn, ast.Name) else (
                 fn.attr if isinstance(fn, ast.Attribute) else "")
-            if deco_name != "analysis_pass":
+            if deco_name not in _PASS_DECORATORS:
                 continue
             kw = {k.arg: k.value for k in deco.keywords if k.arg}
             name_node = kw.get("name")
@@ -230,7 +284,7 @@ def _pass_decls_from_file(path: str, relpath: str) -> List[PassDecl]:
             series_node = kw.get("provides_series")
             out.append(PassDecl(
                 name=name, func=node.name, relpath=relpath,
-                line=deco.lineno,
+                line=deco.lineno, domain=_PASS_DECORATORS[deco_name],
                 reads_frames=_str_tuple(kw.get("reads_frames")),
                 reads_columns=_str_tuple(kw.get("reads_columns")),
                 reads_features=_str_tuple(kw.get("reads_features")),

@@ -1,19 +1,28 @@
-"""SL010–SL013 — the analysis-pass contract rules.
+"""SL010–SL013 — the pass contract rules (analysis + fleet domains).
 
 ``sofa_tpu/analysis/registry.py`` made every analysis pass declare its
 contract (frames/columns/features read, features/artifacts produced,
-ordering edges) as plain literals on the ``@analysis_pass`` decorator.
-These rules are what make those declarations *verified* rather than
-documentation: each decorated pass body is checked against its own
-declaration, and the cross-pass dependency graph is validated from the
-declarations alone — statically, before any trace is ever analyzed.
+ordering edges) as plain literals on the ``@analysis_pass`` decorator,
+and ``sofa_tpu/analysis/fleet.py`` reuses the same machinery for
+``@fleet_pass`` cross-run passes over the archive index.  These rules
+are what make those declarations *verified* rather than documentation:
+each decorated pass body is checked against its own declaration, and the
+cross-pass dependency graph is validated from the declarations alone —
+statically, before any trace is ever analyzed.
 
-SL010  a pass body may only touch frames, trace columns, and feature
-       keys it declared (undeclared read/write = finding)
+SL010  a pass body may only touch frames, columns, and feature keys it
+       declared (undeclared read/write = finding).  Analysis passes are
+       checked against the trace schema; fleet passes against the
+       pinned index family schemas (their ``reads_columns`` entries are
+       ``family.column`` qualified and must exist in archive/index.py's
+       column constants)
 SL011  a declaration may not claim outputs the body never produces
 SL012  the declared graph must schedule: no dependency cycles, no read
-       of a feature no registered pass (or the driver's ambient set)
-       provides, no ``after`` edge to an unknown pass
+       of a feature no registered pass (or the driver's ambient set —
+       analysis domain only; the fleet driver injects nothing)
+       provides, no ``after`` edge to an unknown pass, and no ``after``
+       edge crossing the analysis/fleet domain boundary — the two
+       registries never co-schedule
 SL013  pass bodies must not call another pass directly — composition
        happens in the scheduler, where fault isolation and the
        meta.passes ledger live
@@ -120,23 +129,33 @@ def _enclosing_pass(ctx: FileContext,
     return None
 
 
-def _param_names(funcdef) -> Tuple[str, str]:
-    """(frames, features) parameter names of a pass fn(frames, cfg,
-    features)."""
+def _param_names(funcdef, decl: PassDecl) -> Tuple[str, str]:
+    """(frames, features) parameter names of a pass body.  Analysis
+    passes are ``fn(frames, cfg, features)``; fleet passes are
+    ``fn(state, tables, ctx, features)`` — their "frames" mapping is the
+    declared-slice table dict in slot 1."""
     args = [a.arg for a in funcdef.args.args]
-    frames = args[0] if args else "frames"
-    features = args[2] if len(args) > 2 else "features"
+    if decl.domain == "fleet":
+        frames = args[1] if len(args) > 1 else "tables"
+        features = args[3] if len(args) > 3 else "features"
+    else:
+        frames = args[0] if args else "frames"
+        features = args[2] if len(args) > 2 else "features"
     return frames, features
 
 
 class UndeclaredPassAccess(Rule):
     """SL010 — a registered pass touches only what it declared.  Frame
     lookups (``frames.get("x")`` / ``frames["x"]``) must name declared
-    ``reads_frames``; any string literal naming a trace column must be in
-    ``reads_columns``; ``features.add/add_info`` names must match
-    ``provides_features``; ``features.get/by_regex`` must match
-    ``reads_features`` (or the pass's own provides — reading back your
-    own output is composition-free)."""
+    ``reads_frames``; any string literal naming a trace column (analysis)
+    or a declared index family's column (fleet, ``family.column``
+    qualified) must be in ``reads_columns``; ``features.add/add_info``
+    names must match ``provides_features``; ``features.get/by_regex``
+    must match ``reads_features`` (or the pass's own provides — reading
+    back your own output is composition-free).  :meth:`finish` also
+    checks fleet declarations themselves against the pinned family
+    schemas: a ``reads_columns`` entry naming an unknown family or a
+    column outside its schema is a phantom read."""
 
     rule_id = "SL010"
     severity = SEV_ERROR
@@ -147,10 +166,23 @@ class UndeclaredPassAccess(Rule):
         if hit is None:
             return
         decl, funcdef = hit
-        frames_p, features_p = _param_names(funcdef)
+        frames_p, features_p = _param_names(funcdef, decl)
         if isinstance(node, ast.Constant):
-            if isinstance(node.value, str) and node.value in \
-                    ctx.project.columns and \
+            if not isinstance(node.value, str):
+                return
+            if decl.domain == "fleet":
+                qualified = [f"{fam}.{node.value}"
+                             for fam in decl.reads_frames
+                             if f"{fam}.{node.value}"
+                             in ctx.project.index_columns]
+                if qualified and not any(q in decl.reads_columns
+                                         for q in qualified):
+                    yield self.finding(
+                        ctx, node,
+                        f"fleet pass {decl.name!r} touches index column "
+                        f"{node.value!r} of a declared family without a "
+                        f"matching reads_columns entry ({qualified[0]!r})")
+            elif node.value in ctx.project.columns and \
                     node.value not in decl.reads_columns:
                 yield self.finding(
                     ctx, node,
@@ -209,6 +241,38 @@ class UndeclaredPassAccess(Rule):
                     "not declare in reads_features — undeclared reads "
                     "hide scheduling dependencies")
 
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        index_cols = ctx.project.index_columns
+        if not index_cols:
+            return
+        families = {q.split(".", 1)[0] for q in index_cols}
+        idx = _index(ctx)
+        for _func, decl in sorted(idx.decls.items()):
+            if decl.domain != "fleet":
+                continue
+            for fam in decl.reads_frames:
+                if fam not in families:
+                    yield Finding(
+                        ctx.relpath, decl.line, self.rule_id,
+                        f"fleet pass {decl.name!r} declares reads_frames "
+                        f"{fam!r} which is not an archive index family "
+                        f"({sorted(families)})", self.severity)
+            for col in decl.reads_columns:
+                fam, _, bare = col.partition(".")
+                if not bare or fam not in decl.reads_frames:
+                    yield Finding(
+                        ctx.relpath, decl.line, self.rule_id,
+                        f"fleet pass {decl.name!r} declares reads_columns "
+                        f"{col!r} — entries must be 'family.column' with "
+                        "the family in reads_frames", self.severity)
+                elif col not in index_cols:
+                    yield Finding(
+                        ctx.relpath, decl.line, self.rule_id,
+                        f"fleet pass {decl.name!r} declares reads_columns "
+                        f"{col!r} outside the pinned {fam!r} family "
+                        "schema — a phantom read the index can never "
+                        "serve", self.severity)
+
 
 class PhantomPassOutput(Rule):
     """SL011 — a declaration may not claim outputs the body never writes:
@@ -228,7 +292,7 @@ class PhantomPassOutput(Rule):
             funcdef = idx.funcdefs.get(func)
             if funcdef is None:
                 continue
-            frames_p, features_p = _param_names(funcdef)
+            frames_p, features_p = _param_names(funcdef, decl)
             writes: List[str] = []
             strings: List[str] = []
             forwarded = False
@@ -273,9 +337,12 @@ class PhantomPassOutput(Rule):
 class UnschedulablePassGraph(Rule):
     """SL012 — the declared dependency graph must schedule, verified from
     the declarations alone: every ``reads_features`` pattern needs a
-    provider (some registered pass, or the driver's AMBIENT_FEATURES),
-    every ``after`` edge a registered target, and the combined graph must
-    be acyclic.  Findings anchor at the declaring decorator."""
+    provider (some registered pass of the same domain, or — analysis
+    domain only — the driver's AMBIENT_FEATURES), every ``after`` edge a
+    registered target *in the same domain* (the analysis and fleet
+    registries never co-schedule, so a cross-domain edge is a contract
+    error, not an ordering hint), and each domain's graph must be
+    acyclic.  Findings anchor at the declaring decorator."""
 
     rule_id = "SL012"
     severity = SEV_ERROR
@@ -286,11 +353,13 @@ class UnschedulablePassGraph(Rule):
         deps: Dict[str, set] = {d.name: set() for d in decls}
         for d in decls:
             for dep in d.after:
-                if dep in by_name and dep != d.name:
+                if dep in by_name and dep != d.name \
+                        and by_name[dep].domain == d.domain:
                     deps[d.name].add(dep)
             for pat in d.reads_features:
                 for other in decls:
-                    if other.name != d.name and \
+                    if other.name != d.name \
+                            and other.domain == d.domain and \
                             _covered(pat, other.provides_features):
                         deps[d.name].add(other.name)
         return deps
@@ -314,22 +383,31 @@ class UnschedulablePassGraph(Rule):
         if not mine:
             return
         all_decls = tuple(ctx.project.passes)
-        names = {d.name for d in all_decls}
+        domain_of = {d.name: d.domain for d in all_decls}
         deps = self._graph(all_decls)
         cyclic = self._cyclic_names(deps)
         for d in mine:
             for dep in d.after:
-                if dep not in names:
+                if dep not in domain_of:
                     yield Finding(
                         ctx.relpath, d.line, self.rule_id,
                         f"pass {d.name!r} declares after={dep!r} but no "
                         "registered pass has that name",
                         self.severity)
+                elif domain_of[dep] != d.domain:
+                    yield Finding(
+                        ctx.relpath, d.line, self.rule_id,
+                        f"{d.domain} pass {d.name!r} declares "
+                        f"after={dep!r}, a {domain_of[dep]} pass — the "
+                        "two registries never co-schedule, so a "
+                        "cross-domain edge can never order anything",
+                        self.severity)
             for pat in d.reads_features:
-                if _covered(pat, ctx.project.ambient_features):
+                if d.domain == "analysis" \
+                        and _covered(pat, ctx.project.ambient_features):
                     continue
                 if not any(_covered(pat, o.provides_features)
-                           for o in all_decls):
+                           for o in all_decls if o.domain == d.domain):
                     yield Finding(
                         ctx.relpath, d.line, self.rule_id,
                         f"pass {d.name!r} reads feature {pat!r} that no "

@@ -703,7 +703,14 @@ DERIVED_FILES = ["report.js", "features.csv", "swarms_report.txt",
                  # fleet trace export (sofa_tpu/metrics.py): the merged
                  # Chrome-trace ring from every worker's flush —
                  # regenerated at will by export_fleet_trace
-                 "fleet_trace.json"]
+                 "fleet_trace.json",
+                 # incremental fleet-pass engine (sofa_tpu/analysis/
+                 # fleet.py): the served cross-run report artifact and
+                 # the fold-state memo behind it — pure functions of the
+                 # index commit, rebuilt by `sofa fleet analyze`; both
+                 # live under _fleet/ in archive-marked roots, registered
+                 # for inventory closure like the index manifests above
+                 "fleet_report.json", "fleet_state.json"]
 DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 "_tiles",
                 # chunked columnar frame store (sofa_tpu/frames.py): the
@@ -719,7 +726,12 @@ DERIVED_DIRS = ["board", "sofa_hints", "_ingest_cache", "_quarantine",
                 # scraped metrics history chunks, trace rings, and the
                 # SLO verdict under a served root — pure derived state
                 # the running tier regenerates continuously
-                "_metrics"]
+                "_metrics",
+                # incremental fleet-pass engine (sofa_tpu/analysis/
+                # fleet.py): report + fold memo derived from the _index
+                # commit — dropped and rebuilt at will by `sofa fleet
+                # analyze`
+                "_fleet"]
 
 # Never digested (the fsck ledger's skip-list): the ledgers themselves —
 # they change on every write, including fsck's own — live sentinels, and
@@ -741,6 +753,10 @@ DIGEST_SKIP_FILES = frozenset({
     # rewritten by every fleet tier scrape window / trace export
     # (sofa_tpu/metrics.py) with no digest refresh in sight
     "slo_verdict.json", "fleet_trace.json",
+    # rewritten by every post-drain fleet-pass refresh
+    # (sofa_tpu/analysis/fleet.py) with no digest refresh in sight;
+    # integrity is fleet.verify's schema-validated-load job instead
+    "fleet_report.json", "fleet_state.json",
 })
 DIGEST_SKIP_DIRS = frozenset({
     "_ingest_cache", "_quarantine", "_inject", "board", "__pycache__",
@@ -756,6 +772,10 @@ DIGEST_SKIP_DIRS = frozenset({
     # verdict continuously while the tier serves — digesting them would
     # turn every scrape window into fsck damage
     "_metrics",
+    # the fleet-pass engine's home (sofa_tpu/analysis/fleet.py): report
+    # and memo are rewritten by every post-drain refresh without a
+    # digest refresh; fsck validates them via fleet.verify instead
+    "_fleet",
 })
 
 

@@ -430,6 +430,147 @@ def _run_index_kill_cell(workdir: str, synth: str, mc) -> List[str]:
     return problems
 
 
+def _run_fleet_kill_cell(workdir: str, synth: str, mc) -> List[str]:
+    """SIGKILL `sofa fleet analyze` inside the commit window — report
+    written, fold memo NOT (SOFA_FLEET_EXIT_AFTER, sofa_tpu/analysis/
+    fleet.py) — then prove the torn ``_fleet/`` reads as healthy-pending
+    (fleet verify/fsck 0) and a plain re-run converges BYTE-IDENTICALLY
+    to a drop-and-full-recompute twin: the artifact carries no wall
+    clock, so crash, resume, warm, and cold all hash the same."""
+    import shutil as sh
+
+    from sofa_tpu.analysis import fleet as afleet
+    from sofa_tpu.archive.store import archive_fsck, ingest_run
+
+    logdir = os.path.join(workdir, "kill-mid-fleet") + "/"
+    root = os.path.join(workdir, "kill-mid-fleet-store")
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    sofa_preprocess(cfg)
+    ingest_run(cfg, root)
+
+    repo = os.path.dirname(_TOOLS)
+    env = dict(os.environ, SOFA_FLEET_EXIT_AFTER="1",
+               SOFA_FLEET_REFRESH="0")
+    env.pop("_SOFA_FLEET_TICKS", None)
+    snippet = (
+        "import sys\n"
+        "sys.path.insert(0, sys.argv[2])\n"
+        "from sofa_tpu.analysis import fleet\n"
+        "fleet.analyze(sys.argv[1])\n")
+    r = subprocess.run([sys.executable, "-c", snippet, root, repo],
+                       capture_output=True, text=True, timeout=600,
+                       env=env)
+    if r.returncode != 86:
+        return problems + [f"crash child exited rc={r.returncode} "
+                           "(expected the fleet chaos knob's hard-exit "
+                           "86 between the report and memo writes); "
+                           f"stderr tail: {r.stderr.strip()[-200:]}"]
+    if not os.path.isfile(afleet.report_path(root)):
+        problems.append("crash window left no fleet_report.json — the "
+                        "report write must precede the chaos tick")
+    if os.path.isfile(afleet.state_path(root)):
+        problems.append("crash window left a fleet_state.json — the "
+                        "memo commit must FOLLOW the chaos tick")
+    if afleet.verify(root):
+        problems.append("torn _fleet/ (report ahead of memo) read as "
+                        f"damage, not healthy-pending: {afleet.verify(root)}")
+    # a plain re-run converges the torn state...
+    afleet.analyze(root)
+    recovered = open(afleet.report_path(root), "rb").read()
+    if not os.path.isfile(afleet.state_path(root)):
+        problems.append("re-run after crash did not commit the memo")
+    # ...to the byte-identical artifact a never-interrupted cold
+    # recompute writes
+    twin = root + "-twin"
+    shutil.rmtree(twin, ignore_errors=True)
+    sh.copytree(root, twin)
+    afleet.drop(twin)
+    afleet.analyze(twin)
+    if recovered != open(afleet.report_path(twin), "rb").read():
+        problems.append("recovered fleet_report.json differs from a "
+                        "never-interrupted cold recompute")
+    report = archive_fsck(root)
+    for verdict in ("corrupt", "missing", "orphaned", "uncataloged",
+                    "index", "fleet"):
+        if (report or {}).get(verdict):
+            problems.append(f"archive fsck: {len(report[verdict])} "
+                            f"{verdict} after fleet crash+re-run")
+    doc = afleet.load_report(root)
+    if doc is None:
+        problems.append("recovered fleet report unreadable")
+    else:
+        problems += [f"fleet report: {p}"
+                     for p in mc.validate_fleet_report(doc)]
+    return problems
+
+
+def _run_fleet_verb_cell(workdir: str, synth: str, mc) -> List[str]:
+    """The `sofa fleet analyze` verb's exit-code ladder under fault
+    injection (sofa_tpu/analysis/fleet.py sofa_fleet): 2 on usage and on
+    a missing archive, 0 on a clean run, 1 when a registered pass
+    crashes (fault isolation: the report still commits with the sticky
+    ``failed`` entry and every healthy pass's artifact intact)."""
+    from sofa_tpu.analysis import fleet as afleet
+    from sofa_tpu.archive.store import ingest_run
+
+    logdir = os.path.join(workdir, "fleet-verb") + "/"
+    root = os.path.join(workdir, "fleet-verb-store")
+    shutil.rmtree(logdir, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    shutil.copytree(synth, logdir)
+    cfg = SofaConfig(logdir=logdir)
+    problems: List[str] = []
+    sofa_preprocess(cfg)
+    ingest_run(cfg, root)
+
+    rc = afleet.sofa_fleet(cfg, "analyze", "")
+    if rc != 2:
+        problems.append(f"usage (no root) exited {rc}, expected 2")
+    rc = afleet.sofa_fleet(cfg, "analyze",
+                           os.path.join(workdir, "no-such-store"))
+    if rc != 2:
+        problems.append(f"missing archive exited {rc}, expected 2")
+    rc = afleet.sofa_fleet(cfg, "analyze", root)
+    if rc != 0:
+        problems.append(f"clean analyze exited {rc}, expected 0")
+    with afleet.scoped():
+        afleet.load_builtin_passes()
+
+        def chaos_fleet_crash(state, tables, ctx, features):
+            raise RuntimeError("chaos: deliberate fleet pass crash")
+
+        afleet.register_fleet_pass(chaos_fleet_crash,
+                                   name="chaos_fleet_crash",
+                                   reads_frames=("runs",))
+        afleet.drop(root)
+        rc = afleet.sofa_fleet(cfg, "analyze", root)
+    if rc != 1:
+        problems.append(f"crashing fleet pass exited {rc}, expected 1 "
+                        "(report commits, pass entry sticky-failed)")
+    doc = afleet.load_report(root)
+    if doc is None:
+        problems.append("no committed report after the crashing pass")
+    else:
+        entry = (doc.get("passes") or {}).get("chaos_fleet_crash") or {}
+        if entry.get("status") != "failed":
+            problems.append("crashing pass entry not sticky-failed: "
+                            f"{entry.get('status')!r}")
+        ok = [n for n, e in (doc.get("passes") or {}).items()
+              if (e or {}).get("status") == "ok"]
+        if not ok:
+            problems.append("crashing pass took every other fleet "
+                            "pass down with it")
+    # converge back to the healthy artifact for any later consumer
+    afleet.drop(root)
+    if afleet.sofa_fleet(cfg, "analyze", root) != 0:
+        problems.append("post-chaos reconverge analyze failed")
+    return problems
+
+
 def _run_crash_pass_cell(workdir: str, synth: str, mc) -> List[str]:
     """Register a deliberately crashing analysis pass, then run the full
     analyze: the registry executor must degrade it to a sticky ``failed``
@@ -1213,7 +1354,7 @@ def main(argv=None) -> int:
     mc = _load_manifest_check()
     synth = _synth(workdir)
     failures = 0
-    n_cells = len(MATRIX) + len(KILL_CELLS) + 14
+    n_cells = len(MATRIX) + len(KILL_CELLS) + 16
     width = max(len(n) for n, _s in
                 [(n, None) for n, _s, _o in MATRIX] + KILL_CELLS
                 + [("kill-mid-archive", None), ("whatif-degraded", None),
@@ -1227,7 +1368,9 @@ def main(argv=None) -> int:
                    ("restore-then-serve", None),
                    ("kill-mid-live-epoch", None),
                    ("source-rotate-mid-tail", None),
-                   ("kill-mid-index-refresh", None)])
+                   ("kill-mid-index-refresh", None),
+                   ("kill-mid-fleet-analyze", None),
+                   ("fleet-verb-exit-codes", None)])
     for name, spec, overrides in MATRIX:
         try:
             problems = _run_cell(name, spec, overrides, workdir, synth, mc)
@@ -1268,6 +1411,26 @@ def main(argv=None) -> int:
     failures += bool(problems)
     print(f"{'kill-mid-index-refresh'.ljust(width)}  {status}  (SIGKILL "
           "between index chunk-store writes, then sofa resume)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_fleet_kill_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'kill-mid-fleet-analyze'.ljust(width)}  {status}  (SIGKILL "
+          "between fleet report and memo writes, then re-analyze)")
+    for p in problems:
+        print(f"{' ' * width}    - {p}")
+    try:
+        problems = _run_fleet_verb_cell(workdir, synth, mc)
+    except Exception:  # noqa: BLE001 — a crashed cell is a failed cell
+        problems = ["crashed:\n" + traceback.format_exc()]
+    status = "PASS" if not problems else "FAIL"
+    failures += bool(problems)
+    print(f"{'fleet-verb-exit-codes'.ljust(width)}  {status}  (sofa fleet "
+          "analyze exit ladder, crashing registered fleet pass)")
     for p in problems:
         print(f"{' ' * width}    - {p}")
     try:

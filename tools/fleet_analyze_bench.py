@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Cold-vs-warm wall-time table for the incremental fleet-pass engine.
+
+Synthesizes an N-run archive (tools/catalog_bench.py's corpus, default
+50 000 runs), builds the columnar catalog index, and times
+``sofa fleet analyze`` (sofa_tpu/analysis/fleet.py) three ways:
+
+  cold     full fan-out: every pass folds every committed chunk
+  warm     delta refresh after ONE appended ingest — each pass folds
+           only the tail chunks the append touched.  Timed the way the
+           drainer runs it (archive/tier.py refresh_tenant): AFTER the
+           index commit, whose suffix-refresh cost is the ingest
+           path's own number (tools/catalog_bench.py) and prints here
+           as a separate line
+  noop     unchanged index: the memoized report replays, zero folds
+
+Before a single number prints, the warm report is asserted
+BYTE-IDENTICAL to a drop-and-full-recompute and ``--jobs 1`` is
+asserted byte-identical to ``--jobs 4`` — a fast divergent answer is
+not a result.  Exits 1 when warm speedup falls under the 20x floor.
+
+bench.py carries the cold/warm pair every round as
+``fleet_analyze_wall_time_s`` / ``fleet_analyze_warm_wall_time_s`` on
+success AND dead-tunnel paths (archived, ``_wall`` polarity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, REPO)
+sys.path.insert(0, _TOOLS)
+
+#: The acceptance floor: a warm delta refresh over a 50k-run index must
+#: beat the cold full fan-out by at least this factor.
+SPEEDUP_FLOOR = 20.0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--runs", type=int, default=50_000,
+                   help="synthetic catalog size (default 50000)")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the synthetic archive root")
+    args = p.parse_args(argv)
+
+    from catalog_bench import synthesize
+
+    from sofa_tpu.analysis import fleet
+    from sofa_tpu.archive import catalog
+    from sofa_tpu.archive import index as aindex
+    from sofa_tpu.telemetry import _table
+
+    workdir = tempfile.mkdtemp(prefix="sofa_fleetbench_")
+    root = os.path.join(workdir, "archive")
+    print(f"synthesizing {args.runs} runs under {root} ...")
+    t0 = time.perf_counter()
+    synthesize(root, args.runs)
+    print(f"  synthesized in {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    commit = aindex.refresh(root)
+    assert commit is not None, "pyarrow missing — nothing to benchmark"
+    print(f"  index build (full): {time.perf_counter() - t0:.2f}s "
+          f"({commit['events']} events, {commit['features_rows']} "
+          "feature rows)")
+
+    # --- cold: full fan-out over the committed index ----------------------
+    t0 = time.perf_counter()
+    cold = fleet.analyze(root)
+    t_cold = time.perf_counter() - t0
+    cold_stats = cold["_stats"]
+    assert all(ps["mode"] == "full"
+               for ps in cold_stats["passes"].values()), \
+        "cold run did not take the full-recompute path"
+
+    # --- warm: delta refresh after one appended ingest --------------------
+    run = "f" * 64
+    with open(os.path.join(root, "runs", run + ".json"), "w") as f:
+        json.dump({"run": run, "hostname": "hostX", "t": 1.8e9,
+                   "features": {"elapsed_time": 1.0,
+                                "swarm_count": 12.0,
+                                "tpu0_sol_distance": 9.9}}, f)
+    catalog.append_event(root, "ingest", run=run, logdir="/fleet/x",
+                         files=1, new_objects=1, bytes_added=10)
+    # the index suffix refresh is the INGEST commit point's cost — in
+    # the drained tier it has already happened when the fleet hook
+    # fires, so it prints separately and the warm number starts after
+    t0 = time.perf_counter()
+    inc = aindex.refresh(root)
+    t_idx = time.perf_counter() - t0
+    assert not inc["_stats"]["full"], "append triggered a full rebuild"
+    t0 = time.perf_counter()
+    warm = fleet.analyze(root)
+    t_warm = time.perf_counter() - t0
+    warm_stats = warm["_stats"]
+    assert all(ps["mode"] == "delta"
+               for ps in warm_stats["passes"].values()), \
+        "append did not take the delta path: " + \
+        str({n: ps["mode"] for n, ps in warm_stats["passes"].items()})
+    warm_bytes = open(fleet.report_path(root), "rb").read()
+
+    # --- noop: unchanged index replays the memo ---------------------------
+    t0 = time.perf_counter()
+    noop = fleet.analyze(root)
+    t_noop = time.perf_counter() - t0
+    assert noop["_stats"].get("noop"), "idle re-run was not a memo no-op"
+
+    # --- identity gates before any verdict --------------------------------
+    fleet.drop(root)
+    fleet.analyze(root, jobs=1)
+    jobs1 = open(fleet.report_path(root), "rb").read()
+    assert jobs1 == warm_bytes, \
+        "drop-and-recompute report differs from the warm delta report"
+    fleet.drop(root)
+    fleet.analyze(root, jobs=4)
+    jobs4 = open(fleet.report_path(root), "rb").read()
+    assert jobs1 == jobs4, "--jobs 1 and --jobs 4 reports differ"
+
+    rows = [["pass", "cold", "warm (1 append)", "speedup"]]
+    for name in cold["order"]:
+        cw = cold_stats["passes"][name]["wall_s"]
+        ww = warm_stats["passes"][name]["wall_s"]
+        rows.append([name, f"{cw:.3f}s", f"{ww * 1000:.1f}ms",
+                     f"{cw / ww:.0f}x" if ww else "inf"])
+    rows.append(["TOTAL (engine + index check)", f"{t_cold:.3f}s",
+                 f"{t_warm * 1000:.1f}ms", f"{t_cold / t_warm:.0f}x"])
+    print()
+    print("\n".join(_table(rows)))
+    print()
+    print(f"cold full fan-out ({args.runs} runs):  {t_cold:.3f}s")
+    print(f"index suffix refresh (ingest's cost): {t_idx * 1000:.1f}ms")
+    print(f"warm delta (1 appended ingest):       {t_warm * 1000:.1f}ms")
+    print(f"noop (unchanged index, memo replay):  {t_noop * 1000:.2f}ms")
+    print("byte-identity: warm == drop-recompute == jobs1 == jobs4  OK")
+    speedup = t_cold / t_warm
+    verdict = "OK" if speedup >= SPEEDUP_FLOOR else "FAIL"
+    print(f"warm speedup {speedup:.0f}x "
+          f"(floor {SPEEDUP_FLOOR:.0f}x)  {verdict}")
+    if args.keep:
+        print(f"kept: {root}")
+    else:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if speedup >= SPEEDUP_FLOOR else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,20 @@ _ARCHIVE_INDEX_SCHEMA = "sofa_tpu/archive_index"
 _ARCHIVE_INDEX_VERSION = 1
 _ARCHIVE_INDEX_FAMILIES = ("catalog", "runs", "features")
 
+# The incremental fleet-pass engine (sofa_tpu/analysis/fleet.py):
+# checking an archive root validates the served cross-run report and the
+# fold-state memo behind it under _fleet/.  Neither carries a wall-clock
+# stamp by design — both are pure functions of the index commit, so a
+# killed-and-resumed analyze converges byte-identical.
+_FLEET_DIR = "_fleet"
+_FLEET_REPORT_NAME = "fleet_report.json"
+_FLEET_REPORT_SCHEMA = "sofa_tpu/fleet_report"
+_FLEET_REPORT_VERSION = 1
+_FLEET_STATE_NAME = "fleet_state.json"
+_FLEET_STATE_SCHEMA = "sofa_tpu/fleet_state"
+_FLEET_STATE_VERSION = 1
+_FLEET_PASS_STATUSES = ("ok", "failed")
+
 # The scaled-tier commit stamp (sofa_tpu/archive/tier.py TIER_SCHEMA):
 # which pool worker committed the run, out of how many, at what queue
 # depth — written into meta.tier by `sofa agent` from the commit ack.
@@ -1387,6 +1401,171 @@ def _check_archive_index(root: str) -> List[str]:
     return probs
 
 
+def validate_fleet_report(doc, require_healthy: bool = False) -> List[str]:
+    """Schema problems in a ``_fleet/fleet_report.json``
+    (sofa_tpu/analysis/fleet.py analyze) — the served cross-run pass
+    artifact behind ``GET /v1/<tenant>/fleet``.  ``require_healthy``
+    additionally fails on any failed pass — the CI-gate mode."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["fleet report is not a JSON object"]
+    if doc.get("schema") != _FLEET_REPORT_SCHEMA:
+        probs.append(f"schema: expected {_FLEET_REPORT_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _FLEET_REPORT_VERSION:
+        probs.append(f"version: expected {_FLEET_REPORT_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    sha = doc.get("commit_sha")
+    if not isinstance(sha, str) or not sha:
+        probs.append("commit_sha: missing (the /v1/fleet ETag key)")
+    for key in ("catalog_gen", "runs", "ingest_events", "features_rows"):
+        v = doc.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key}: missing or not a non-negative int")
+    sched = doc.get("schedule")
+    if not isinstance(sched, list) or any(
+            not isinstance(w, list)
+            or any(not isinstance(n, str) for n in w) for w in sched):
+        probs.append("schedule: not a list of name-list waves")
+        sched = []
+    order = doc.get("order")
+    if not isinstance(order, list) or \
+            any(not isinstance(n, str) for n in order):
+        probs.append("order: not a list of pass names")
+        order = []
+    passes = doc.get("passes")
+    if not isinstance(passes, dict):
+        probs.append("passes: missing per-pass ledger")
+        passes = {}
+    if sorted(passes) != sorted(order):
+        probs.append("passes: ledger disagrees with order "
+                     f"({sorted(passes)} vs {sorted(order)})")
+    scheduled = {n for w in sched for n in w}
+    for name, ent in sorted(passes.items()):
+        where = f"passes.{name}"
+        if not isinstance(ent, dict):
+            probs.append(f"{where}: not an object")
+            continue
+        if ent.get("status") not in _FLEET_PASS_STATUSES:
+            probs.append(f"{where}.status: {ent.get('status')!r} not in "
+                         f"{_FLEET_PASS_STATUSES}")
+        if not isinstance(ent.get("fingerprint"), str) \
+                or not ent.get("fingerprint"):
+            probs.append(f"{where}.fingerprint: missing contract "
+                         "fingerprint")
+        wave = ent.get("wave")
+        if not isinstance(wave, int) or isinstance(wave, bool) or wave < 0:
+            probs.append(f"{where}.wave: missing or not a non-negative "
+                         "int")
+        if ent.get("status") == "ok" and \
+                not isinstance(ent.get("report"), (dict, type(None))):
+            probs.append(f"{where}.report: not an object or null")
+        if ent.get("status") == "failed" and \
+                not isinstance(ent.get("error"), str):
+            probs.append(f"{where}.error: a failed pass must carry its "
+                         "error")
+        if name not in scheduled:
+            probs.append(f"{where}: absent from the resolved schedule")
+    feats = doc.get("features")
+    if not isinstance(feats, dict) or any(
+            not isinstance(k, str) or not _is_num(v)
+            for k, v in feats.items()):
+        probs.append("features: not a flat name -> number map")
+    if require_healthy:
+        for name, ent in sorted(passes.items()):
+            if isinstance(ent, dict) and ent.get("status") == "failed":
+                probs.append(f"gate: fleet pass {name} failed"
+                             + (f" ({ent['error']})"
+                                if ent.get("error") else ""))
+    return probs
+
+
+def validate_fleet_state(doc) -> List[str]:
+    """Schema problems in a ``_fleet/fleet_state.json``
+    (sofa_tpu/analysis/fleet.py) — the fold-state memo written LAST as
+    the incremental engine's commit point."""
+    probs: List[str] = []
+    if not isinstance(doc, dict):
+        return ["fleet state is not a JSON object"]
+    if doc.get("schema") != _FLEET_STATE_SCHEMA:
+        probs.append(f"schema: expected {_FLEET_STATE_SCHEMA!r}, "
+                     f"got {doc.get('schema')!r}")
+    if doc.get("version") != _FLEET_STATE_VERSION:
+        probs.append(f"version: expected {_FLEET_STATE_VERSION}, "
+                     f"got {doc.get('version')!r}")
+    if not isinstance(doc.get("commit_sha"), str) \
+            or not doc.get("commit_sha"):
+        probs.append("commit_sha: missing memoization key")
+    for key in ("catalog_gen", "chunk_rows"):
+        v = doc.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            probs.append(f"{key}: missing or not a non-negative int")
+    fams = doc.get("families")
+    if not isinstance(fams, dict):
+        probs.append("families: missing append-only family signatures")
+        fams = {}
+    for name, ent in sorted(fams.items()):
+        if not isinstance(ent, dict) \
+                or not isinstance(ent.get("rows"), int) \
+                or isinstance(ent.get("rows"), bool) \
+                or not isinstance(ent.get("chunks"), list) \
+                or any(not isinstance(s, str) for s in ent["chunks"]):
+            probs.append(f"families.{name}: needs int rows + a chunk-sha "
+                         "list")
+    passes = doc.get("passes")
+    if not isinstance(passes, dict):
+        probs.append("passes: missing per-pass memo")
+        passes = {}
+    for name, ent in sorted(passes.items()):
+        if not isinstance(ent, dict) \
+                or not isinstance(ent.get("fingerprint"), str):
+            probs.append(f"passes.{name}: needs a contract fingerprint")
+            continue
+        feats = ent.get("features")
+        if not isinstance(feats, list) or any(
+                not (isinstance(p, list) and len(p) == 2
+                     and isinstance(p[0], str) and _is_num(p[1]))
+                for p in feats):
+            probs.append(f"passes.{name}.features: not a list of "
+                         "[name, value] pairs")
+    return probs
+
+
+def _check_fleet_dir(root: str) -> List[str]:
+    """Validate an archive root's ``_fleet/`` tier: report + memo when
+    present.  An absent dir (or a report ahead of its memo — the crash
+    window the next analyze converges) is healthy; unreadable or
+    schema-invalid documents are not."""
+    fdir = os.path.join(root, _FLEET_DIR)
+    if not os.path.isdir(fdir):
+        return []
+    probs: List[str] = []
+    docs = {}
+    for name, validate in ((_FLEET_REPORT_NAME, validate_fleet_report),
+                           (_FLEET_STATE_NAME, validate_fleet_state)):
+        path = os.path.join(fdir, name)
+        if not os.path.isfile(path):
+            continue
+        where = f"{_FLEET_DIR}/{name}"
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            probs.append(f"{where}: unreadable ({e})")
+            continue
+        docs[name] = doc
+        probs.extend(f"{where}: {p}" for p in validate(doc))
+    report = docs.get(_FLEET_REPORT_NAME)
+    state = docs.get(_FLEET_STATE_NAME)
+    if isinstance(state, dict) and not isinstance(report, dict):
+        # the inverse tear (memo ahead of report) cannot come from the
+        # report-first write order — a memo with no report is damage
+        probs.append(f"{_FLEET_DIR}/{_FLEET_STATE_NAME}: memo present "
+                     "but the report is missing — the write order is "
+                     "report first, memo last")
+    return probs
+
+
 def _check_live_offsets(logdir: str) -> List[str]:
     path = os.path.join(logdir, _LIVE_OFFSETS_NAME)
     if not os.path.isfile(path):
@@ -1411,8 +1590,10 @@ def check_path(path: str, require_healthy: bool = False) -> int:
             os.path.join(path, _ARCHIVE_MARKER_NAME)):
         # an archive root: the document to validate is its columnar
         # catalog index (absent index = healthy, queries scan), plus
-        # the merged fleet trace when the tier has exported one
-        probs = _check_archive_index(path) + _check_fleet_trace(path)
+        # the merged fleet trace when the tier has exported one and the
+        # fleet-pass report/memo when an analyze has committed one
+        probs = _check_archive_index(path) + _check_fleet_trace(path) \
+            + _check_fleet_dir(path)
         for p in probs:
             print(f"manifest_check: archive index: {p}", file=sys.stderr)
         if not probs:
@@ -1492,6 +1673,23 @@ def check_path(path: str, require_healthy: bool = False) -> int:
         if not probs:
             print(f"manifest_check: OK ({path}; verdict: "
                   f"{doc.get('verdict')})")
+        return 1 if probs else 0
+    if isinstance(doc, dict) and doc.get("schema") == _FLEET_REPORT_SCHEMA:
+        probs = validate_fleet_report(doc, require_healthy=require_healthy)
+        for p in probs:
+            print(f"manifest_check: fleet report: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; fleet report: "
+                  f"{len(doc.get('order') or [])} pass(es) at commit "
+                  f"{str(doc.get('commit_sha'))[:12]})")
+        return 1 if probs else 0
+    if isinstance(doc, dict) and doc.get("schema") == _FLEET_STATE_SCHEMA:
+        probs = validate_fleet_state(doc)
+        for p in probs:
+            print(f"manifest_check: fleet state: {p}", file=sys.stderr)
+        if not probs:
+            print(f"manifest_check: OK ({path}; fleet memo at commit "
+                  f"{str(doc.get('commit_sha'))[:12]})")
         return 1 if probs else 0
     probs = validate_manifest(doc, require_healthy=require_healthy) \
         + live_probs
